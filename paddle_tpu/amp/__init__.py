@@ -1,0 +1,52 @@
+"""paddle_tpu.amp (reference: /root/reference/python/paddle/amp/)."""
+from . import debugging  # noqa: F401
+from .auto_cast import auto_cast, amp_guard, WHITE_LIST, BLACK_LIST  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate (reference amp/auto_cast.py:789): O2 casts the
+    model to the low-precision dtype; optimizers keep fp32 master weights."""
+    from ..core import dtypes as _dt
+    from ..nn import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        d = _dt.convert_dtype(dtype)
+        for m in model_list:
+            excluded = set()
+            if excluded_layers:
+                for el in (excluded_layers if isinstance(excluded_layers, (list, tuple))
+                           else [excluded_layers]):
+                    if isinstance(el, type):
+                        excluded |= {id(l) for l in m.sublayers(include_self=True)
+                                     if isinstance(l, el)}
+                    else:
+                        excluded.add(id(el))
+            for l in m.sublayers(include_self=True):
+                from ..nn.layer.norm import _BatchNormBase, LayerNorm
+                if isinstance(l, (_BatchNormBase, LayerNorm)) or id(l) in excluded:
+                    continue
+                for p in l._parameters.values():
+                    if p is not None and _dt.is_floating_point(p.dtype):
+                        p._value = p._value.astype(d)
+    if optimizers is not None:
+        opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) else list(optimizers)
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+        if single_model and len(opt_list) == 1:
+            return models, opt_list[0]
+        return model_list, opt_list
+    return models if single_model else model_list
+
+
+def is_bfloat16_supported():
+    return True
+
+
+def is_float16_supported():
+    return True

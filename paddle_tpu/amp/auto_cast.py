@@ -1,0 +1,98 @@
+"""AMP auto-cast.
+
+Reference: `/root/reference/python/paddle/amp/auto_cast.py:1029` (`auto_cast`,
+`amp_guard` at :462) + the eager AMP hooks
+(`fluid/eager/amp_auto_cast.h`). TPU-native design: O1 list-based casting is
+applied at op-dispatch time (core/engine.py calls `maybe_cast_inputs`), O2
+casts parameters/layers to the low dtype up front (`amp.decorate`). bfloat16
+is the TPU-native low-precision dtype (MXU-native) and the default.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..core import dtypes as _dt
+
+# O1 lists (subset of reference python/paddle/static/amp/fp16_lists.py):
+# ops that are numerically safe and MXU-bound run in low precision;
+# reductions/softmax/norm stay in fp32.
+WHITE_LIST = {
+    "matmul", "bmm", "mv", "einsum", "conv2d", "conv1d", "conv3d",
+    "conv2d_transpose", "linear", "mm", "addmm", "flash_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "mean", "sum", "cos_sim", "layer_norm", "rms_norm", "norm",
+    "reduce_sum", "pow", "erf", "erfinv", "cumsum", "prod",
+}
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = _dt.bfloat16
+        _state.level = "O1"
+    return _state
+
+
+def amp_state():
+    return _tls()
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast equivalent."""
+    tls = _tls()
+    prev = (tls.enabled, tls.dtype, tls.level,
+            getattr(tls, "white", None), getattr(tls, "black", None))
+    tls.enabled = enable
+    tls.dtype = _dt.convert_dtype(dtype)
+    tls.level = level
+    tls.white = WHITE_LIST | set(custom_white_list or ())
+    tls.black = (BLACK_LIST - set(custom_white_list or ())) | set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        tls.enabled, tls.dtype, tls.level, tls.white, tls.black = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name: str, args):
+    """Called from core.engine.apply on every differentiable dispatch."""
+    tls = _tls()
+    if not tls.enabled or not op_name:
+        return args
+    white = getattr(tls, "white", WHITE_LIST)
+    black = getattr(tls, "black", BLACK_LIST)
+
+    from ..core.tensor import Tensor
+
+    if op_name in white:
+        target = tls.dtype
+    elif tls.level == "O2" and op_name not in black:
+        target = tls.dtype
+    elif op_name in black:
+        target = _dt.float32
+    else:
+        return args
+
+    def cast(a):
+        if isinstance(a, Tensor) and _dt.is_floating_point(a.dtype) and a.dtype != target:
+            return _casted(a, target)
+        return a
+
+    return tuple(cast(a) for a in args)
+
+
+def _casted(a, target):
+    """Cast THROUGH the autograd tape so grads flow back in the original dtype
+    (empty op name avoids re-entering AMP)."""
+    from ..core import engine
+    return engine.apply(lambda x: x.astype(target), a, name="")

@@ -1,0 +1,101 @@
+"""Numerical debugging (reference: /root/reference/python/paddle/amp/debugging.py:
+TensorCheckerConfig :173, check_numerics :361, op stats :481; plus the
+FLAGS_check_nan_inf watchdog in fluid/eager/nan_inf_utils.cc)."""
+from __future__ import annotations
+
+import contextlib
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..utils.flags import set_flags, flag_value
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+    def update_and_check_step_id(self):
+        return self.enable
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    if config.enable:
+        set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Returns (num_nan, num_inf, num_zero) and aborts per debug_mode."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.sum(jnp.isnan(v)))
+    num_inf = int(jnp.sum(jnp.isinf(v)))
+    num_zero = int(jnp.sum(v == 0))
+    if num_nan or num_inf:
+        msg = f"[check_numerics] op={op_type} var={var_name}: {num_nan} nan, {num_inf} inf"
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(msg)
+    return (Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf)),
+            Tensor(jnp.asarray(num_zero)))
+
+
+def check_layer_numerics(func):
+    """Decorator for Layer.forward that checks inputs/outputs."""
+
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, op_type=type(self).__name__, var_name=f"input{i}")
+        out = func(self, *args, **kwargs)
+        if isinstance(out, Tensor):
+            check_numerics(out, op_type=type(self).__name__, var_name="output")
+        return out
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """op-dtype stats (reference debugging.py:481). Counts ops dispatched
+    through the engine, grouped by dtype."""
+    from ..core import engine
+    stats: dict = {}
+    orig = engine.apply
+
+    def counting_apply(fn, *args, **kw):
+        name = kw.get("name", "") or getattr(fn, "__name__", "op")
+        out = orig(fn, *args, **kw)
+        first = next((a for a in args if isinstance(a, Tensor)), None)
+        dt = str(np.dtype(first.dtype)) if first is not None else "none"
+        stats.setdefault(name, {}).setdefault(dt, 0)
+        stats[name][dt] += 1
+        return out
+
+    engine.apply = counting_apply
+    try:
+        yield
+    finally:
+        engine.apply = orig
+        print("<------------------------------ op list ------------------------------->")
+        for op, by_dt in sorted(stats.items()):
+            print(f"  {op:30s} " + "  ".join(f"{d}: {c}" for d, c in by_dt.items()))

@@ -1,0 +1,74 @@
+"""paddle_tpu.autograd — user-facing autograd API.
+
+Reference: /root/reference/python/paddle/autograd/ (backward.py, py_layer.py:36).
+Engine internals live in core/engine.py; this module adds `backward`, `grad`
+(the paddle.grad partial-graph API) and `PyLayer` custom-vjp support.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ..core import engine
+from ..core.engine import no_grad, enable_grad  # noqa: F401
+from ..core.tensor import Tensor
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    engine.backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad — gradients of `outputs` w.r.t. `inputs` without touching
+    `.grad` of other leaves (reference: python/paddle/autograd/backward.py,
+    C++ GeneralGrad fluid/eager/general_grad.h)."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    single = isinstance(inputs, Tensor)
+    inputs = [inputs] if single else list(inputs)
+
+    # stash current .grad of inputs, run backward, read the fresh grads
+    saved = [t._grad_value for t in inputs]
+    for t in inputs:
+        t._grad_value = None
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    engine.backward(outputs, grad_outputs if grad_outputs is None else list(grad_outputs),
+                    retain_graph=retain)
+    grads = []
+    for t, s in zip(inputs, saved):
+        g = t._grad_value
+        if g is None and not allow_unused:
+            g_t = Tensor(jax.numpy.zeros(t.shape, t.dtype))
+        elif g is None:
+            g_t = None
+        else:
+            g_t = Tensor(g)
+        grads.append(g_t)
+        t._grad_value = s
+    return grads[0] if single else grads
+
+
+def is_grad_enabled():
+    return engine.grad_enabled()
+
+
+def set_grad_enabled(mode: bool):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        tls = engine._tls()
+        prev, tls.grad_enabled = tls.grad_enabled, mode
+        try:
+            yield
+        finally:
+            tls.grad_enabled = prev
+
+    return _ctx()

@@ -1,0 +1,84 @@
+"""PyLayer — user-defined forward/backward
+(reference: /root/reference/python/paddle/autograd/py_layer.py:36 and C++
+support fluid/eager/pylayer/). TPU-native: the user backward is wired into the
+eager tape as a GradNode; under jit, use `paddle_tpu.jit.custom_vjp` (a thin
+jax.custom_vjp wrapper) instead.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import engine
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with engine.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        is_tuple = isinstance(out, (tuple, list))
+        outs = list(out) if is_tuple else [out]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires = any(not t.stop_gradient for t in tensor_inputs) and engine.grad_enabled()
+        if not requires:
+            return out
+
+        tensor_outs = [o for o in outs if isinstance(o, Tensor)]
+
+        def vjp_fn(cots):
+            gs = cls.backward(ctx, *[Tensor(c) for c in cots])
+            if not isinstance(gs, (tuple, list)):
+                gs = (gs,)
+            vals = []
+            for g in gs:
+                vals.append(None if g is None else (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return vals
+
+        node = engine.GradNode(
+            vjp_fn,
+            tensor_inputs,
+            [(tuple(t.shape), t._value.dtype) for t in tensor_outs],
+            name=cls.__name__,
+        )
+        wrapped = []
+        idx = 0
+        for o in outs:
+            if isinstance(o, Tensor):
+                t = Tensor(o._value, stop_gradient=False, _node=(node, idx))
+                wrapped.append(t)
+                idx += 1
+            else:
+                wrapped.append(o)
+        return tuple(wrapped) if is_tuple else wrapped[0]

@@ -1,0 +1,3 @@
+from . import dtypes, engine, random, tensor  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor, wrap_output  # noqa: F401
+from .engine import no_grad, enable_grad, grad_enabled, apply, apply_nondiff  # noqa: F401

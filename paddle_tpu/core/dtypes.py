@@ -1,0 +1,100 @@
+"""Dtype system for paddle_tpu.
+
+TPU-first equivalent of the reference's phi dtype enum
+(`/root/reference/paddle/phi/common/data_type.h`): instead of an enum +
+per-kernel dtype dispatch, dtypes are jnp dtypes directly; this module adds
+the paddle-style names (`paddle.float32`, `'float32'` strings) and promotion
+helpers used by the AMP machinery.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtypes under the hood).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+FLOATING = {float16, bfloat16, float32, float64}
+INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (str / np.dtype / jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype name: {dtype!r}") from None
+    if hasattr(dtype, "dtype"):  # e.g. jnp.float32 is a scalar type; np.dtype ok
+        return np.dtype(dtype).type
+    return np.dtype(dtype).type
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating)
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)
+
+
+# Default dtype management (paddle.get_default_dtype / set_default_dtype,
+# reference: python/paddle/base/framework.py).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {dtype_name(d)}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

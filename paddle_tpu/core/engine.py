@@ -1,0 +1,239 @@
+"""Eager autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(`/root/reference/paddle/fluid/eager/grad_node_info.h:197` GradNodeBase,
+`backward.cc:439` Backward): instead of per-op generated C++ GradNode classes,
+every differentiable op is dispatched through `jax.vjp`, whose returned vjp
+closure *is* the grad node — residuals live in device buffers held by the
+closure, and XLA provides the kernel for both directions. The engine below is
+only the graph walk (Kahn/heap traversal, grad accumulation, hooks), which in
+the reference is `eager/backward.cc:23-120`.
+
+Inside a `jax.jit`/`grad` trace the tape is bypassed entirely (tracers flow
+through the raw jax functions), so the same user code serves both eager and
+compiled modes — the analog of the reference's dygraph/static dual-mode ops
+(`python/paddle/tensor/*.py`).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import heapq
+import itertools
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+    return _state
+
+
+def grad_enabled() -> bool:
+    return _tls().grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad equivalent (reference: python/paddle/base/dygraph/base.py)."""
+    tls = _tls()
+    prev, tls.grad_enabled = tls.grad_enabled, False
+    try:
+        yield
+    finally:
+        tls.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    tls = _tls()
+    prev, tls.grad_enabled = tls.grad_enabled, True
+    try:
+        yield
+    finally:
+        tls.grad_enabled = prev
+
+
+_node_counter = itertools.count()
+
+
+class GradNode:
+    """One recorded differentiable op.
+
+    `vjp_fn` is the closure returned by jax.vjp (holds residual device
+    buffers). `inputs` are the input Tensors (or None for non-tensor args);
+    `out_meta` is (shape, dtype) per output for zero-cotangent synthesis.
+    """
+
+    __slots__ = ("id", "vjp_fn", "inputs", "out_meta", "cotangents", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_meta, name=""):
+        self.id = next(_node_counter)
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_meta = out_meta  # list of (shape, dtype)
+        self.cotangents: list = [None] * len(out_meta)
+        self.name = name
+
+    def ready_cotangents(self):
+        cots = []
+        for slot, (shape, dtype) in zip(self.cotangents, self.out_meta):
+            if slot is None:
+                cots.append(jnp.zeros(shape, dtype))
+            else:
+                cots.append(slot)
+        return cots
+
+
+def _accum(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def backward(tensors: Sequence, grad_tensors: Sequence | None = None, retain_graph: bool = False):
+    """Run reverse accumulation from `tensors`.
+
+    Mirrors `egr::Backward` (reference fluid/eager/backward.cc:439): seed
+    cotangents, walk producing nodes in reverse creation order (creation order
+    is a valid topological order for a tape), accumulate into leaf `.grad`.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    tensors = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    heap: list[tuple[int, GradNode]] = []
+    in_heap: dict[int, GradNode] = {}
+
+    def seed(t: Tensor, g):
+        node_ref = t._node
+        if node_ref is None:
+            if not t.stop_gradient:
+                t._grad_value = _accum(t._grad_value, g)
+            return
+        node, idx = node_ref
+        node.cotangents[idx] = _accum(node.cotangents[idx], g)
+        if node.id not in in_heap:
+            in_heap[node.id] = node
+            heapq.heappush(heap, (-node.id, node))
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            g = jnp.ones(t.shape, t.dtype)
+        else:
+            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        seed(t, g)
+
+    while heap:
+        _, node = heapq.heappop(heap)
+        del in_heap[node.id]
+        cots = node.ready_cotangents()
+        in_grads = node.vjp_fn(cots)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp is None or g is None:
+                continue
+            # jax uses float0 for non-differentiable (integer) inputs
+            if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                continue
+            if inp.stop_gradient:
+                continue
+            seed(inp, g)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.cotangents = [None] * len(node.out_meta)
+        else:
+            node.cotangents = [None] * len(node.out_meta)
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **static_kwargs):
+    """Dispatch a differentiable op.
+
+    `fn(*arrays, **static_kwargs)` must be a pure jax function. Tensor args
+    are unwrapped; under an active tape (eager, grad enabled, some input
+    requires grad) the op is executed through jax.vjp and recorded.
+
+    This is the analog of the generated `<op>_ad_func` entry points
+    (reference fluid/eager/auto_code_generator/generator/eager_gen.py): AMP
+    cast hooks run first, then the kernel, then grad-node wiring.
+    """
+    from .tensor import Tensor, wrap_output
+    from ..amp.auto_cast import maybe_cast_inputs
+
+    args = maybe_cast_inputs(name, args)
+
+    arrs = []
+    tensor_inputs = []  # parallel list: Tensor or None
+    any_requires = False
+    any_tracer = False
+    for a in args:
+        if isinstance(a, Tensor):
+            arrs.append(a._value)
+            tensor_inputs.append(a)
+            if not a.stop_gradient:
+                any_requires = True
+            if _is_tracer(a._value):
+                any_tracer = True
+        else:
+            arrs.append(a)
+            tensor_inputs.append(None)
+            if _is_tracer(a):
+                any_tracer = True
+
+    f = functools.partial(fn, **static_kwargs) if static_kwargs else fn
+
+    track = grad_enabled() and any_requires and not any_tracer
+    if not track:
+        out = f(*arrs)
+        return wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
+
+    out, vjp_fn = jax.vjp(f, *arrs)
+    leaves, treedef = jax.tree.flatten(out)
+    node = GradNode(
+        _TreeVjp(vjp_fn, treedef),
+        tensor_inputs,
+        [(l.shape, l.dtype) for l in leaves],
+        name=name,
+    )
+    out_tensors = [Tensor(l, stop_gradient=False, _node=(node, i)) for i, l in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, out_tensors)
+
+
+class _TreeVjp:
+    """Adapts a pytree-output vjp_fn to flat-list cotangents."""
+
+    __slots__ = ("vjp_fn", "treedef")
+
+    def __init__(self, vjp_fn, treedef):
+        self.vjp_fn = vjp_fn
+        self.treedef = treedef
+
+    def __call__(self, flat_cots):
+        return self.vjp_fn(jax.tree.unflatten(self.treedef, list(flat_cots)))
+
+
+def apply_nondiff(fn: Callable, *args, name: str = "", **static_kwargs):
+    """Dispatch an op that is never differentiated (argmax, comparisons, ...)."""
+    from .tensor import Tensor, wrap_output
+
+    arrs = [a._value if isinstance(a, Tensor) else a for a in args]
+    f = functools.partial(fn, **static_kwargs) if static_kwargs else fn
+    return wrap_output(f(*arrs), stop_gradient=True)

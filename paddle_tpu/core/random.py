@@ -1,0 +1,56 @@
+"""Global RNG state.
+
+Reference: phi Generator (`/root/reference/paddle/phi/core/generator.h`) +
+`paddle.seed`. TPU-native design: a splittable JAX PRNG key held in a stack;
+eager calls split the concrete key, while traced code (inside jit) pushes a
+traced key via `rng_guard`, so the SAME dropout/random-op code works in both
+modes and stays reproducible under compilation.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "stack"):
+        _state.stack = [jax.random.PRNGKey(0)]
+    return _state
+
+
+def seed(n: int):
+    """paddle.seed equivalent — reset the global generator."""
+    _tls().stack[:] = [jax.random.PRNGKey(int(n))]
+    return n
+
+
+def split_key():
+    """Draw a fresh subkey from the top-of-stack generator (stateful split)."""
+    tls = _tls()
+    key = tls.stack[-1]
+    key, sub = jax.random.split(key)
+    tls.stack[-1] = key
+    return sub
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Run a region with an explicit key (used to thread keys through jit)."""
+    tls = _tls()
+    tls.stack.append(key)
+    try:
+        yield
+    finally:
+        tls.stack.pop()
+
+
+def get_rng_state():
+    return _tls().stack[-1]
+
+
+def set_rng_state(key):
+    _tls().stack[-1] = key

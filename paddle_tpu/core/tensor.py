@@ -1,0 +1,400 @@
+"""The paddle_tpu Tensor.
+
+TPU-native re-design of the reference's `paddle::Tensor` / eager Tensor
+(`/root/reference/paddle/phi/api/include/tensor.h:82`,
+`fluid/pybind/eager.cc`): a thin pytree-registered wrapper over a
+`jax.Array` (PJRT buffer). Device memory, layout, streams, and allocation —
+which the reference implements in phi's allocator/DeviceContext stack
+(`phi/core/memory/`, ~12k LoC) — are delegated to PJRT/XLA.
+
+Being a pytree node means the SAME Tensor flows through `jax.jit` /
+`jax.grad` / `pjit` traces (the leaf is the underlying array), so eager code
+and compiled code share one op surface, replacing the reference's dual
+dygraph/static codegen (`paddle/fluid/eager/auto_code_generator`,
+`fluid/pir/dialect/op_generator`).
+
+Autograd state (`stop_gradient`, `.grad`, the producing GradNode) lives only
+on eager tensors; see core/engine.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as _dt
+from . import engine
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "wrap_output"]
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad_value", "_node", "name", "persistable", "__weakref__")
+
+    # make numpy defer to our __r*__ operators
+    __array_priority__ = 100
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = "", _node=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad_value = None
+        self._node = _node  # (GradNode, out_index) or None
+        self.name = name
+        self.persistable = False
+
+    # ---------------- basic metadata ----------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return self._value.dtype.type if hasattr(self._value.dtype, "type") else self._value.dtype
+
+    @property
+    def place(self):
+        try:
+            devs = self._value.devices()
+            return next(iter(devs)) if devs else None
+        except Exception:
+            return None
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self.dtype).itemsize
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}{grad_txt},\n       {self._value})"
+
+    # ---------------- conversion ----------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *idx):
+        if idx:
+            return self.numpy().item(*idx)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of a multi-element Tensor is ambiguous")
+        return bool(self._value)
+
+    def __index__(self):
+        return int(self.item())
+
+    # ---------------- autograd ----------------
+    @property
+    def grad(self):
+        if self._grad_value is None:
+            return None
+        return Tensor(self._grad_value, stop_gradient=True, name=self.name + "@GRAD" if self.name else "")
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad_value = None
+        else:
+            self._grad_value = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        engine.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad_value = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return engine.apply(lambda x: x + 0, self, name="clone")
+
+    def requires_grad_(self, requires_grad: bool = True):
+        self.stop_gradient = not requires_grad
+        return self
+
+    def register_hook(self, hook):
+        # grad hook: applied when backward seeds this tensor's grad
+        raise NotImplementedError("tensor-level grad hooks land with the hook milestone")
+
+    # ---------------- mutation (leaf/in-place semantics) ----------------
+    def set_value(self, value):
+        """Replace the underlying buffer (used by optimizers / load)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        else:
+            value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    # ---------------- dtype/device ----------------
+    def astype(self, dtype):
+        dtype = _dt.convert_dtype(dtype)
+        return engine.apply(lambda x: x.astype(dtype), self, name="cast")
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        # supports dtype only (single-process device movement is XLA-managed)
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "gpu", "tpu"):
+                continue
+            dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def cpu(self):
+        return Tensor(np.asarray(self._value), stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    # ---------------- indexing ----------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return engine.apply(lambda x: x[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = self._value.at[idx].set(value)
+
+    # ---------------- operators (implementations attached by paddle_tpu.tensor) ----
+    def __add__(self, o):
+        return _ops()["add"](self, o)
+
+    def __radd__(self, o):
+        return _ops()["add"](self, o)
+
+    def __sub__(self, o):
+        return _ops()["subtract"](self, o)
+
+    def __rsub__(self, o):
+        return _ops()["subtract"](_const_like(o, self), self)
+
+    def __mul__(self, o):
+        return _ops()["multiply"](self, o)
+
+    def __rmul__(self, o):
+        return _ops()["multiply"](self, o)
+
+    def __truediv__(self, o):
+        return _ops()["divide"](self, o)
+
+    def __rtruediv__(self, o):
+        return _ops()["divide"](_const_like(o, self), self)
+
+    def __floordiv__(self, o):
+        return _ops()["floor_divide"](self, o)
+
+    def __mod__(self, o):
+        return _ops()["mod"](self, o)
+
+    def __pow__(self, o):
+        return _ops()["pow"](self, o)
+
+    def __rpow__(self, o):
+        return _ops()["pow"](_const_like(o, self), self)
+
+    def __matmul__(self, o):
+        return _ops()["matmul"](self, o)
+
+    def __rmatmul__(self, o):
+        return _ops()["matmul"](_const_like(o, self), self)
+
+    def __neg__(self):
+        return _ops()["neg"](self)
+
+    def __abs__(self):
+        return _ops()["abs"](self)
+
+    def __eq__(self, o):
+        return _ops()["equal"](self, o)
+
+    def __ne__(self, o):
+        return _ops()["not_equal"](self, o)
+
+    def __lt__(self, o):
+        return _ops()["less_than"](self, o)
+
+    def __le__(self, o):
+        return _ops()["less_equal"](self, o)
+
+    def __gt__(self, o):
+        return _ops()["greater_than"](self, o)
+
+    def __ge__(self, o):
+        return _ops()["greater_equal"](self, o)
+
+    def __invert__(self):
+        return _ops()["logical_not"](self)
+
+    def __and__(self, o):
+        return _ops()["logical_and"](self, o) if self.dtype == _dt.bool_ else _ops()["bitwise_and"](self, o)
+
+    def __or__(self, o):
+        return _ops()["logical_or"](self, o) if self.dtype == _dt.bool_ else _ops()["bitwise_or"](self, o)
+
+    def __xor__(self, o):
+        return _ops()["logical_xor"](self, o) if self.dtype == _dt.bool_ else _ops()["bitwise_xor"](self, o)
+
+    def __hash__(self):
+        return id(self)
+
+    @property
+    def T(self):
+        return _ops()["t_"](self)
+
+    @property
+    def mT(self):
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return _ops()["transpose"](self, perm)
+
+
+def _const_like(o, ref: Tensor):
+    return Tensor(jnp.asarray(o, dtype=ref.dtype))
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+_OPS_CACHE: dict = {}
+
+
+def _ops():
+    """Late-bound tensor op table (filled by paddle_tpu.tensor at import)."""
+    if not _OPS_CACHE:
+        import paddle_tpu.tensor  # noqa: F401  (registers ops)
+    return _OPS_CACHE
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, name: str = "", trainable: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+
+    def __repr__(self):
+        return f"Parameter(name={self.name}, shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, trainable={self.trainable})\n  {self._value}"
+
+
+def wrap_output(out, stop_gradient: bool = True):
+    """Wrap a jax pytree output into Tensors (single leaf → single Tensor)."""
+    if isinstance(out, (jax.Array, jax.core.Tracer)) or np.isscalar(out):
+        return Tensor(out, stop_gradient=stop_gradient)
+    return jax.tree.map(lambda l: Tensor(l, stop_gradient=stop_gradient), out)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
+    """paddle.to_tensor (reference python/paddle/tensor/creation.py:to_tensor)."""
+    if isinstance(data, Tensor):
+        val = data._value
+    else:
+        val = data
+    dtype = _dt.convert_dtype(dtype)
+    if dtype is None and not isinstance(val, (jax.Array, jax.core.Tracer)):
+        a = np.asarray(val)
+        if a.dtype == np.float64:
+            dtype = _dt.get_default_dtype()
+        elif a.dtype == np.int64 and not isinstance(data, np.ndarray):
+            dtype = _dt.int64
+    arr = jnp.asarray(val, dtype=dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+# ---------------- pytree registration ----------------
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    sg, name = aux
+    return Tensor(children[0], stop_gradient=sg, name=name)
+
+
+def _param_flatten(p: Parameter):
+    return (p._value,), (p.name, p.trainable)
+
+
+def _param_unflatten(aux, children):
+    name, trainable = aux
+    val = children[0]
+    if isinstance(val, (jax.Array, jax.core.Tracer, np.ndarray)) or val is None:
+        return Parameter(val, name=name, trainable=trainable) if val is not None else None
+    return Parameter(val, name=name, trainable=trainable)
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)

@@ -1,0 +1,5 @@
+"""paddle_tpu.distributed — SPMD auto-parallel over jax.sharding
+(reference: /root/reference/python/paddle/distributed/, 148k LoC; see
+SURVEY.md §2.2). Populated incrementally; env first."""
+from . import env  # noqa: F401
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

@@ -1,0 +1,70 @@
+"""Distributed environment (reference: the PADDLE_TRAINER_* env contract,
+/root/reference/python/paddle/distributed/parallel.py:1069-1078 and
+launch/controllers/collective.py:127).
+
+TPU-native: rank/world come from jax.distributed (coordination service) when
+initialized, else from the launcher env vars, else single-process defaults.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank() -> int:
+    if "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ["PADDLE_TRAINER_ID"])
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+
+def is_initialized() -> bool:
+    return get_world_size() > 1 or os.environ.get("PADDLE_DIST_INITIALIZED") == "1"
+
+
+class ParallelEnv:
+    """Reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_local_rank()
+
+    @property
+    def dev_id(self):
+        return get_local_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")

@@ -1,0 +1,142 @@
+"""Framework-level utilities: device management, save/load, flags.
+
+Reference: /root/reference/python/paddle/framework/ + `python/paddle/device/`
+(device mgmt) + `paddle/common/flags.cc` (flag registry). On TPU the device
+zoo collapses to PJRT platforms ('tpu'/'cpu'); streams/places are XLA-managed.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from ..utils.flags import get_flags, set_flags  # noqa: F401
+
+
+# ---------------- places ----------------
+class Place:
+    def __init__(self, kind: str, device_id: int = 0):
+        self.kind = kind
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.device_id) == (other.kind, other.device_id)
+
+
+def CPUPlace():
+    return Place("cpu")
+
+
+def CUDAPlace(did=0):
+    # GPU-free build: maps to the accelerator place for API compatibility
+    return Place("tpu", did)
+
+
+def TPUPlace(did=0):
+    return Place("tpu", did)
+
+
+def CUDAPinnedPlace():
+    return Place("cpu")
+
+
+_device = None
+
+
+def set_device(device: str):
+    global _device
+    _device = device
+    return get_device()
+
+
+def get_device() -> str:
+    plat = jax.default_backend()
+    return f"{plat}:0"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def in_pir_mode() -> bool:
+    return False
+
+
+# ---------------- save / load ----------------
+def _to_saveable(obj):
+    """Tensors → numpy for pickling (reference python/paddle/framework/io.py:773)."""
+    if isinstance(obj, (Tensor, Parameter)):
+        return {"__paddle_tpu_tensor__": True, "data": np.asarray(obj._value),
+                "name": obj.name, "trainable": isinstance(obj, Parameter) and obj.trainable}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            return Tensor(obj["data"], name=obj.get("name", ""))
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_saveable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save — pickle-based, Tensors stored as numpy."""
+    if hasattr(path, "write"):
+        pickle.dump(_to_saveable(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(str(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **kwargs):
+    """paddle.load."""
+    if hasattr(path, "read"):
+        obj = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=return_numpy)

@@ -1,0 +1,129 @@
+"""paddle_tpu.jit — trace-and-compile
+(reference: /root/reference/python/paddle/jit/ — to_static api.py:195, SOT
+bytecode frontend, PartialProgramLayer).
+
+TPU-native design: "static mode" IS `jax.jit` tracing of the same eager ops
+(Tensor is a pytree, so tracers flow through every op). `to_static` wraps a
+function or Layer into a StaticFunction that:
+  * functionalizes Layer parameters/buffers (value-swap bridge),
+  * threads the global RNG key in (dropout reproducible under jit),
+  * caches one executable per input signature (shape/dtype/tree),
+  * donates no user buffers (training-step donation is handled by
+    paddle_tpu.jit.TrainStep).
+The reference's guard/cache system (executor_cache.py, guards) maps to jax's
+trace cache keyed on abstract signatures.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+
+from ..core import engine
+from ..core import random as _rng
+from ..core.tensor import Tensor
+from .train_step import TrainStep  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "StaticFunction",
+           "TrainStep", "save", "load", "enable_to_static"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
+                 full_graph=True, donate_args=()):
+        from ..nn import Layer
+
+        self._layer = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        else:
+            self._fn = function
+            self._layer = getattr(function, "__self__", None) \
+                if isinstance(getattr(function, "__self__", None), Layer) else None
+        self._input_spec = input_spec
+        functools.update_wrapper(self, self._fn)
+
+        layer = self._layer
+
+        if layer is not None:
+            def traced(values, key, args, kwargs):
+                with _rng.rng_guard(key):
+                    with layer._swapped_state(values):
+                        return self._fn(*args, **kwargs)
+        else:
+            def traced(values, key, args, kwargs):
+                with _rng.rng_guard(key):
+                    return self._fn(*args, **kwargs)
+
+        self._jitted = jax.jit(traced)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)
+        if self._layer is not None:
+            entries = self._layer.state_dict()
+            values = {k: v._value for k, v in entries.items()}
+        else:
+            values = {}
+        key = _rng.split_key()
+        return self._jitted(values, key, args, kwargs)
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        raise NotImplementedError("program introspection lands with jit.save")
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True):
+    """paddle.jit.to_static — decorator or call."""
+
+    def decorate(fn):
+        return StaticFunction(fn, input_spec, build_strategy, backend, full_graph)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — serializes params + config; the compiled artifact is
+    rebuilt at load time (XLA executables are not portable across versions)."""
+    from ..framework import save as fsave
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    fsave({"state_dict": state, "class": type(layer).__name__}, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework import load as fload
+    return fload(path + ".pdparams")
+
+
+def custom_vjp(fn, fwd=None, bwd=None):
+    """Thin jax.custom_vjp wrapper for advanced users (PyLayer covers eager)."""
+    cv = jax.custom_vjp(fn)
+    if fwd is not None and bwd is not None:
+        cv.defvjp(fwd, bwd)
+    return cv

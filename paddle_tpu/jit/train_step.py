@@ -1,0 +1,83 @@
+"""TrainStep — the compiled training loop core.
+
+This is the TPU-native replacement for the reference's static-graph executor
+path (`Engine._parallel_pir` + `StandaloneExecutor`, see SURVEY.md §3.3/§3.5):
+one jitted function per (model, optimizer) holding the whole
+forward+backward+update, with parameter/optimizer-state buffer DONATION (XLA
+updates in place — the analog of the reference's inplace optimizer ops), AMP
+via bf16 compute, and GSPMD sharding when params/batch are sharded arrays.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _rng
+from ..core.tensor import Parameter, Tensor
+
+
+class TrainStep:
+    """train_step = TrainStep(model, loss_fn, opt); loss = train_step(batch)
+
+    loss_fn: callable(model, *batch) -> scalar Tensor (runs under trace).
+    The optimizer must be a paddle_tpu Optimizer (pure update rule).
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer, donate: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        entries = model.state_dict()
+        self._param_keys = [k for k, v in entries.items()
+                            if isinstance(v, Parameter) and v.trainable]
+        self._buffer_keys = [k for k in entries if k not in set(self._param_keys)]
+        self._params = {k: entries[k]._value for k in self._param_keys}
+        self._buffers = {k: entries[k]._value for k in self._buffer_keys}
+        self._opt_state = optimizer.init_state(self._params)
+        self._step = 0
+
+        def step_fn(params, opt_state, buffers, key, lr, step, batch):
+            def inner(p):
+                values = dict(p)
+                values.update(buffers)
+                with _rng.rng_guard(key):
+                    with model._swapped_state({k: jnp.asarray(v) for k, v in values.items()}):
+                        loss = loss_fn(model, *batch)
+                return loss._value if isinstance(loss, Tensor) else loss
+
+            loss, grads = jax.value_and_grad(inner)(params)
+            new_params, new_opt = optimizer.apply_gradients(grads, params, opt_state,
+                                                            lr=lr, step=step)
+            return loss, new_params, new_opt
+
+        donate_argnums = (0, 1) if donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+
+    def __call__(self, *batch):
+        batch_vals = tuple(b._value if isinstance(b, Tensor) else b for b in batch)
+        key = _rng.split_key()
+        self._step += 1
+        loss, self._params, self._opt_state = self._jitted(
+            self._params, self._opt_state, self._buffers, key,
+            jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step), batch_vals)
+        from ..optimizer.lr import LRScheduler
+        if isinstance(self.optimizer._learning_rate, LRScheduler):
+            pass  # user drives scheduler.step() per their schedule
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write the compiled-loop parameter values back into the Layer."""
+        entries = self.model.state_dict()
+        for k, v in self._params.items():
+            entries[k]._value = v
+        return self.model
+
+    @property
+    def parameters(self):
+        return self._params
+
+    @property
+    def opt_state(self):
+        return self._opt_state

@@ -1,0 +1,165 @@
+"""paddle_tpu.metric (reference: /root/reference/python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
+        label = np.asarray(label._value if isinstance(label, Tensor) else label)
+        idx = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            label = np.argmax(label, axis=-1)
+        correct = idx == label[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        correct = np.asarray(correct._value if isinstance(correct, Tensor) else correct)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = correct[..., :k].sum()
+            self.total[i] += num
+            self.count[i] += correct.shape[0] if correct.ndim > 1 else len(correct)
+            accs.append(float(num) / max(correct.shape[0], 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        pred_cls = (preds > 0.5).astype(np.int64).reshape(-1)
+        labels = labels.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (labels == 1)).sum())
+        self.fp += int(((pred_cls == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        pred_cls = (preds > 0.5).astype(np.int64).reshape(-1)
+        labels = labels.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (labels == 1)).sum())
+        self.fn += int(((pred_cls == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name or "auc"
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels._value if isinstance(labels, Tensor) else labels)
+        if preds.ndim == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = labels.reshape(-1)
+        bins = np.minimum((preds * self.num_thresholds).astype(np.int64), self.num_thresholds)
+        for b, l in zip(bins, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            pos, neg = self._stat_pos[i], self._stat_neg[i]
+            auc += neg * tot_pos + pos * neg / 2.0
+            tot_pos += pos
+            tot_neg += neg
+        return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    pred = np.asarray(input._value if isinstance(input, Tensor) else input)
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label).reshape(-1)
+    idx = np.argsort(-pred, axis=-1)[:, :k]
+    c = (idx == lab[:, None]).any(axis=1).sum()
+    return Tensor(np.asarray(c / len(lab), np.float32))

@@ -1,0 +1,114 @@
+"""Gradient clipping (reference: /root/reference/python/paddle/nn/clip.py —
+ClipGradByGlobalNorm et al., applied inside Optimizer._create_optimization_pass).
+
+Each clipper exposes BOTH the eager interface (list of (param, grad) Tensors)
+and a functional one (`clip_tree`) used by the jitted train step — the global
+norm is one fused XLA reduction across the whole grad pytree.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def clip_tree(self, grads):
+        """Functional: pytree of jnp arrays in → clipped pytree out."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+    def clip_tree(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(self._clip_one(g._value))))
+        return out
+
+    def clip_tree(self, grads):
+        return jax.tree.map(self._clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        vals = [g._value for p, g in params_grads
+                if g is not None and getattr(p, "need_clip", True)]
+        if not vals:
+            return params_grads
+        gn = global_norm(vals)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-6), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value.astype(jnp.float32) * scale).astype(g._value.dtype))))
+        return out
+
+    def clip_tree(self, grads):
+        leaves = [l for l in jax.tree.leaves(grads) if l is not None]
+        gn = global_norm(leaves)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-6), 1.0)
+        return jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def global_norm(leaves):
+    return jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves))
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """paddle.nn.utils.clip_grad_norm_ — in-place on .grad."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    params = [p for p in parameters if p._grad_value is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad_value)) for p in params]))
+    else:
+        total = sum(jnp.sum(jnp.abs(p._grad_value.astype(jnp.float32)) ** norm_type)
+                    for p in params) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p._grad_value = (p._grad_value.astype(jnp.float32) * scale).astype(p._grad_value.dtype)
+    return Tensor(total)

@@ -1,0 +1,386 @@
+"""Layer — the module base class.
+
+Reference: /root/reference/python/paddle/nn/layer/layers.py:354 (`class Layer`:
+parameter registry, sublayers, buffers, hooks, state_dict, to/cast, train/eval).
+
+TPU-native addition: `functional_state` / `functional_call` — a zero-copy
+bridge that swaps parameter/buffer values (possibly jax tracers) into the
+layer, so the SAME stateful Layer runs under `jax.jit`/`jax.grad`/`pjit`
+functionally. This replaces the reference's dual dygraph/static codegen and
+dy2static program translator for the common training path.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+import jax
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core.tensor import Parameter, Tensor
+from ..initializer import Constant, XavierUniform, Normal, calculate_gain  # noqa: F401
+
+__all__ = ["Layer", "ParamAttr"]
+
+
+class ParamAttr:
+    """Reference: python/paddle/base/param_attr.py."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        if callable(attr):  # bare initializer
+            return ParamAttr(initializer=attr)
+        return ParamAttr()
+
+
+_layer_counter: dict[str, int] = {}
+
+
+def _unique_name(prefix: str) -> str:
+    n = _layer_counter.get(prefix, 0)
+    _layer_counter[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: OrderedDict, hid: int):
+        self._hooks = hooks
+        self._id = hid
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        self.training = True
+        self._dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+        self._full_name = _unique_name(name_scope or self.__class__.__name__.lower())
+        self._parameters: OrderedDict[str, Parameter] = OrderedDict()
+        self._sub_layers: OrderedDict[str, Layer] = OrderedDict()
+        self._buffers: OrderedDict[str, Tensor] = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._hook_id = 0
+
+    # ---------------- registration ----------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params.pop(name)
+                    object.__setattr__(self, name, None)
+                    return
+                raise TypeError(f"cannot assign non-Parameter to parameter {name!r}")
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+                object.__setattr__(self, name, None)
+                return
+            if buffers is not None and name in buffers:
+                if value is None:
+                    buffers.pop(name)
+                    object.__setattr__(self, name, None)
+                else:
+                    buffers[name] = value if isinstance(value, Tensor) else Tensor(value)
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name: str, parameter: Parameter | None):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        if parameter is None:
+            self._parameters.pop(name, None)
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Reference: layers.py `Layer.create_parameter`."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = _dt.convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        value = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(value, name=attr.name or "", trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return Tensor(np.zeros((), dtype=np.dtype(_dt.convert_dtype(dtype) or self._dtype)))
+
+    # ---------------- traversal ----------------
+    def parameters(self, include_sublayers: bool = True) -> list:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, sub, pfx in self._walk(prefix, include_sublayers):
+            for pname, p in sub._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{pfx}{pname}", p)
+
+    def buffers(self, include_sublayers: bool = True) -> list:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, sub, pfx in self._walk(prefix, include_sublayers):
+            for bname, b in sub._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{pfx}{bname}", b)
+
+    def _walk(self, prefix="", include_sublayers=True):
+        """Yields (name, layer, param_prefix) depth-first, self first."""
+        yield ("", self, prefix)
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                for sname, slayer, spfx in sub._walk(f"{prefix}{name}.", True):
+                    yield (sname, slayer, spfx)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self: bool = False) -> list:
+        out = []
+        for _, l, _pfx in self._walk("", True):
+            out.append(l)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, l, pfx in self._walk(prefix, True):
+            if l is self and not include_self:
+                continue
+            yield pfx.rstrip("."), l
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ---------------- modes ----------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ---------------- state dict ----------------
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="",
+                   use_hook=True, keep_vars=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for _, sub, pfx in self._walk(structured_name_prefix, include_sublayers):
+            for bname, b in sub._buffers.items():
+                if b is not None and bname not in sub._non_persistable_buffer_names:
+                    dest[f"{pfx}{bname}"] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Reference: layers.py set_state_dict — matches by structured key."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                val = v._value if isinstance(v, Tensor) else v
+                own[k].set_value(val)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ---------------- dtype / device ----------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(_dt.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(_dt.convert_dtype(dtype))
+        return self
+
+    def _cast_all(self, dtype, floating_only: bool = True):
+        for _, p in self.named_parameters():
+            if not floating_only or _dt.is_floating_point(p.dtype):
+                p._value = p._value.astype(dtype)
+        for _, b in self.named_buffers():
+            if not floating_only or _dt.is_floating_point(b.dtype):
+                b._value = b._value.astype(dtype)
+        for l in self.sublayers(include_self=True):
+            l._dtype = dtype
+
+    def float(self):
+        return self.to(dtype=_dt.float32)
+
+    def bfloat16(self):
+        return self.to(dtype=_dt.bfloat16)
+
+    def half(self):
+        return self.to(dtype=_dt.float16)
+
+    # ---------------- hooks ----------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ---------------- call ----------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + ("\n  ".join(sub_repr)))
+        main = f"{self.__class__.__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    # ---------------- functional bridge (TPU-native) ----------------
+    def functional_state(self):
+        """Current (params, buffers) as plain value pytrees (dicts of arrays)."""
+        params = {k: v._value for k, v in self.state_dict().items()
+                  if isinstance(v, Parameter)}
+        buffers = {k: v._value for k, v in self.state_dict().items()
+                   if not isinstance(v, Parameter)}
+        return params, buffers
+
+    @contextlib.contextmanager
+    def _swapped_state(self, values: dict):
+        entries = self.state_dict()
+        saved = {}
+        try:
+            for k, v in values.items():
+                if k in entries and v is not None:
+                    saved[k] = entries[k]._value
+                    entries[k]._value = v
+            yield
+        finally:
+            for k, old in saved.items():
+                entries[k]._value = old
+
+    def functional_call(self, values: dict, *args, **kwargs):
+        """Run forward with parameter/buffer values substituted (jit-safe)."""
+        from ...core import engine
+        with self._swapped_state(values):
+            with engine.no_grad():
+                return self(*args, **kwargs)

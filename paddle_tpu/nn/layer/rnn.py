@@ -1,0 +1,259 @@
+"""RNN layers (reference: /root/reference/python/paddle/nn/layer/rnn.py).
+TPU-native: the whole sequence loop is a single `lax.scan` inside one
+dispatched op, so eager autograd sees one GradNode and XLA compiles one fused
+loop — no per-step python dispatch as in the reference's dygraph RNN."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.engine import apply
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([gates * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([gates * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([gates * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([gates * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as pt
+        if states is None:
+            states = pt.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as pt
+        if states is None:
+            z = pt.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+            states = (z, z.clone())
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(fgt) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply(f, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as pt
+        if states is None:
+            states = pt.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence runner (reference rnn.py:RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        steps = inputs.shape[0 if self.time_major else 1]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        for t in order:
+            x_t = inputs[:, t] if not self.time_major else inputs[t]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+        return stack(outs, axis=1 if not self.time_major else 0), states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent net over lax.scan."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        self.activation = activation
+        gates = {"LSTM": 4, "GRU": 3}.get(self.MODE, 1)
+        self._gates = gates
+
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"{layer}" + ("_reverse" if d == 1 else "")
+                self.add_parameter(f"weight_ih_l{sfx}", self.create_parameter(
+                    [gates * hidden_size, in_sz], default_initializer=init))
+                self.add_parameter(f"weight_hh_l{sfx}", self.create_parameter(
+                    [gates * hidden_size, hidden_size], default_initializer=init))
+                self.add_parameter(f"bias_ih_l{sfx}", self.create_parameter(
+                    [gates * hidden_size], is_bias=True, default_initializer=init))
+                self.add_parameter(f"bias_hh_l{sfx}", self.create_parameter(
+                    [gates * hidden_size], is_bias=True, default_initializer=init))
+
+    def _cell_fn(self):
+        mode = self.MODE
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        if mode == "LSTM":
+            def step(carry, x_t, wi, wh, bi, bh):
+                h, c = carry
+                gates = x_t @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+        elif mode == "GRU":
+            def step(carry, x_t, wi, wh, bi, bh):
+                h = carry
+                xg = x_t @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h_new = (1 - z) * n + z * h
+                return h_new, h_new
+        else:
+            def step(carry, x_t, wi, wh, bi, bh):
+                h = carry
+                h_new = act(x_t @ wi.T + bi + h @ wh.T + bh)
+                return h_new, h_new
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.MODE == "LSTM"
+        step = self._cell_fn()
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+
+        params = []
+        for layer in range(nl):
+            for d in range(nd):
+                sfx = f"{layer}" + ("_reverse" if d == 1 else "")
+                params += [getattr(self, f"weight_ih_l{sfx}"),
+                           getattr(self, f"weight_hh_l{sfx}"),
+                           getattr(self, f"bias_ih_l{sfx}"),
+                           getattr(self, f"bias_hh_l{sfx}")]
+
+        def f(x, *flat_params):
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, C]
+            b = xs.shape[1]
+            h_finals, c_finals = [], []
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    pi = (layer * nd + d) * 4
+                    wi, wh, bi, bh = flat_params[pi:pi + 4]
+                    h0 = jnp.zeros((b, hs), xs.dtype)
+                    carry = (h0, jnp.zeros((b, hs), xs.dtype)) if is_lstm else h0
+                    seq = xs[::-1] if d == 1 else xs
+
+                    def scan_step(c, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(c, x_t, wi, wh, bi, bh)
+
+                    carry, ys = jax.lax.scan(scan_step, carry, seq)
+                    if d == 1:
+                        ys = ys[::-1]
+                    outs_dir.append(ys)
+                    if is_lstm:
+                        h_finals.append(carry[0])
+                        c_finals.append(carry[1])
+                    else:
+                        h_finals.append(carry)
+                xs = jnp.concatenate(outs_dir, axis=-1) if nd == 2 else outs_dir[0]
+            out = xs if time_major else jnp.swapaxes(xs, 0, 1)
+            h_stack = jnp.stack(h_finals, axis=0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_finals, axis=0)
+            return out, h_stack
+
+        result = apply(f, inputs, *params, name="rnn")
+        if is_lstm:
+            out, h, c = result
+            return out, (h, c)
+        out, h = result
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

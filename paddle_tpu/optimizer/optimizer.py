@@ -1,0 +1,219 @@
+"""Optimizer base (reference: /root/reference/python/paddle/optimizer/optimizer.py:127).
+
+TPU-native design: every optimizer defines ONE pure update rule
+(`_init_one` / `_update_one`), shared by
+  * the eager path — `step()` runs a single jit-compiled fused update over the
+    whole parameter pytree with buffer donation (replacing the reference's
+    per-param optimizer CUDA kernels + multi_tensor paths), and
+  * the functional path — `init_state` / `apply_gradients` consumed by the
+    jitted/pjit train step (states shard with the params under GSPMD).
+
+Master weights (multi_precision) live in the state as fp32 copies, as the
+reference's master-weight accumulators do.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes as _dt
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators: dict[int, dict[str, Any]] = {}
+        self._step_count = 0
+        self._eager_step_fn = None
+
+    # ---------------- lr ----------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---------------- pure update rule (override) ----------------
+    def _init_one(self, p_val) -> dict:
+        """Per-parameter accumulator init (pure; p_val is a jnp array)."""
+        return {}
+
+    def _update_one(self, p_val, g_val, state: dict, lr, step) -> tuple:
+        """Pure update: returns (new_p, new_state). Override in subclasses."""
+        raise NotImplementedError
+
+    def _decoupled_decay(self) -> bool:
+        """AdamW-style decay (True) vs L2-regularization folded into grads."""
+        return False
+
+    def _needs_master(self, p_val) -> bool:
+        return self._multi_precision and p_val.dtype in (jnp.bfloat16, jnp.float16)
+
+    # ---------------- functional API (for jitted train steps) ----------------
+    def init_state(self, params):
+        """params: pytree of jnp arrays (or Tensors) → state pytree."""
+        def one(p):
+            v = p._value if isinstance(p, Tensor) else p
+            st = self._init_one(v)
+            if self._needs_master(v):
+                st["master"] = v.astype(jnp.float32)
+            return st
+
+        return jax.tree.map(one, params, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def apply_gradients(self, grads, params, state, lr=None, step=None):
+        """Pure: (grads, params, state) pytrees → (new_params, new_state)."""
+        lr = self.get_lr() if lr is None else lr
+        step = self._step_count + 1 if step is None else step
+        if self._grad_clip is not None:
+            grads = self._grad_clip.clip_tree(grads)
+
+        is_state_leaf = lambda x: isinstance(x, dict) and not any(
+            isinstance(v, dict) for v in x.values())
+
+        def one(p, g, st):
+            if g is None:
+                return p, st
+            master = st.get("master")
+            work = master if master is not None else p
+            g32 = g.astype(work.dtype)
+            if self._weight_decay and not self._decoupled_decay():
+                g32 = g32 + self._weight_decay * work
+            new_work, new_st = self._update_one(work, g32, st, lr, step)
+            if self._weight_decay and self._decoupled_decay():
+                new_work = new_work - lr * self._weight_decay * work
+            if master is not None:
+                new_st = dict(new_st)
+                new_st["master"] = new_work
+                return new_work.astype(p.dtype), new_st
+            return new_work, new_st
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state)
+        out = [one(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        new_p = jax.tree.unflatten(treedef, [o[0] for o in out])
+        new_s = jax.tree.unflatten(treedef, [o[1] for o in out])
+        return new_p, new_s
+
+    # ---------------- eager API ----------------
+    def _ensure_params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without a parameters list")
+        return self._parameter_list
+
+    def step(self):
+        params = [p for p in self._ensure_params()
+                  if isinstance(p, Parameter) and p.trainable and p._grad_value is not None]
+        if not params:
+            self._step_count += 1
+            if isinstance(self._learning_rate, LRScheduler) and self._learning_rate._auto_step:
+                pass
+            return
+
+        grads = [Tensor(p._grad_value) for p in params]
+        if self._grad_clip is not None:
+            pg = self._grad_clip([(p, g) for p, g in zip(params, grads)])
+            grads = [g for _, g in pg]
+
+        # lazily init accumulators & compile the fused update
+        for p in params:
+            if id(p) not in self._accumulators:
+                st = self._init_one(p._value)
+                if self._needs_master(p._value):
+                    st["master"] = p._value.astype(jnp.float32)
+                self._accumulators[id(p)] = st
+
+        p_vals = [p._value for p in params]
+        g_vals = [g._value for g in grads]
+        states = [self._accumulators[id(p)] for p in params]
+
+        if self._eager_step_fn is None:
+            def fused(p_list, g_list, s_list, lr, step):
+                out_p, out_s = [], []
+                for p, g, st in zip(p_list, g_list, s_list):
+                    master = st.get("master")
+                    work = master if master is not None else p
+                    g2 = g.astype(work.dtype)
+                    if self._weight_decay and not self._decoupled_decay():
+                        g2 = g2 + self._weight_decay * work
+                    np_, ns = self._update_one(work, g2, st, lr, step)
+                    if self._weight_decay and self._decoupled_decay():
+                        np_ = np_ - lr * self._weight_decay * work
+                    if master is not None:
+                        ns = dict(ns)
+                        ns["master"] = np_
+                        np_ = np_.astype(p.dtype)
+                    out_p.append(np_)
+                    out_s.append(ns)
+                return out_p, out_s
+
+            self._eager_step_fn = jax.jit(fused, donate_argnums=(0, 2))
+
+        new_p, new_s = self._eager_step_fn(
+            p_vals, g_vals, states, jnp.float32(self.get_lr()), jnp.int32(self._step_count + 1))
+        for p, nv, ns in zip(params, new_p, new_s):
+            p._value = nv
+            self._accumulators[id(p)] = ns
+        self._step_count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._ensure_params():
+            if isinstance(p, Tensor):
+                p._grad_value = None
+
+    clear_gradients = clear_grad
+
+    # ---------------- state dict ----------------
+    def state_dict(self):
+        sd = {"step": self._step_count, "accumulators": {}}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                st = self._accumulators.get(id(p))
+                if st is not None:
+                    key = p.name or f"param_{i}"
+                    sd["accumulators"][key] = {k: Tensor(v) for k, v in st.items()}
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step", 0))
+        accs = state_dict.get("accumulators", {})
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                key = p.name or f"param_{i}"
+                if key in accs:
+                    self._accumulators[id(p)] = {
+                        k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                        for k, v in accs[key].items()}
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

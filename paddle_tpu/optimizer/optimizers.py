@@ -1,0 +1,249 @@
+"""Concrete optimizers (reference: /root/reference/python/paddle/optimizer/{sgd,momentum,adam,adamw,lamb,adagrad,rmsprop,adadelta,adamax}.py).
+Each is a pure per-parameter update rule; see optimizer.py for how both the
+eager fused step and the pjit train step consume it."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad", "RMSProp",
+           "Adadelta", "Adamax", "NAdam", "RAdam"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update_one(self, p, g, state, lr, step):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_one(self, p):
+        return {"velocity": jnp.zeros_like(p, dtype=jnp.float32 if self._multi_precision else p.dtype)}
+
+    def _update_one(self, p, g, state, lr, step):
+        v = self._momentum * state["velocity"].astype(p.dtype) + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _init_one(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        st = {"moment1": z, "moment2": z}
+        if self._amsgrad:
+            st["moment2_max"] = z
+        return st
+
+    def _update_one(self, p, g, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = g.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** step_f)
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], v)
+            vhat = vmax / (1 - b2 ** step_f)
+            new_st = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            vhat = v / (1 - b2 ** step_f)
+            new_st = {"moment1": m, "moment2": v}
+        new_p = p - (lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+        return new_p, new_st
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name, amsgrad=amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_decay(self):
+        return True
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_one(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment1": z, "moment2": z}
+
+    def _update_one(self, p, g, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** step_f)
+        vhat = v / (1 - b2 ** step_f)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._lamb_weight_decay * p32
+        p_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        new_p = (p32 - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _init_one(self, p):
+        return {"moment": jnp.full_like(p, self._init_value, dtype=jnp.float32)}
+
+    def _update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        acc = state["moment"] + g32 * g32
+        new_p = p - (lr * g32 / (jnp.sqrt(acc) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_one(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        st = {"mean_square": z, "momentum": z}
+        if self._centered:
+            st["mean_grad"] = z
+        return st
+
+    def _update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g32 * g32
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new_st = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+            new_st = {"mean_square": ms}
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new_st["momentum"] = mom
+        return p - mom.astype(p.dtype), new_st
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_one(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return {"avg_squared_grad": z, "avg_squared_update": z}
+
+    def _update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        update = g32 * jnp.sqrt(state["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * update * update
+        return p - (lr * update).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_one(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment": z, "inf_norm": z}
+
+    def _update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        step_f = jnp.asarray(step, jnp.float32)
+        new_p = p - (lr / (1 - self._beta1 ** step_f) * m / (u + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class NAdam(Adam):
+    def _update_one(self, p, g, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = g.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1 ** step_f)
+        vhat = v / (1 - b2 ** step_f)
+        nesterov_m = b1 * mhat + (1 - b1) * g32 / (1 - b1 ** step_f)
+        new_p = p - (lr * nesterov_m / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class RAdam(Adam):
+    def _update_one(self, p, g, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = g.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        step_f = jnp.asarray(step, jnp.float32)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * step_f * b2 ** step_f / (1 - b2 ** step_f)
+        mhat = m / (1 - b1 ** step_f)
+
+        def rect_update():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                         ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v / (1 - b2 ** step_f))
+            return r * mhat / (vhat + eps)
+
+        upd = jnp.where(rho_t > 5.0, rect_update(), mhat)
+        return p - (lr * upd).astype(p.dtype), {"moment1": m, "moment2": v}

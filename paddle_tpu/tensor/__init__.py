@@ -1,0 +1,89 @@
+"""paddle_tpu.tensor — the op surface.
+
+Mirrors /root/reference/python/paddle/tensor/__init__.py: ops live in
+submodules, are exported flat here, and are monkey-patched onto Tensor as
+methods (the reference does the same via `monkey_patch_math_tensor`)."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, Parameter, to_tensor
+from ..core.tensor import _OPS_CACHE
+
+from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+_MODULES = (creation, linalg, logic, manipulation, math, random, search, stat, _einsum_mod)
+
+
+def _collect_ops():
+    for mod in _MODULES:
+        for name, fn in vars(mod).items():
+            if callable(fn) and not name.startswith("_") and fn.__module__ == mod.__name__:
+                _OPS_CACHE.setdefault(name, fn)
+    # operator-table aliases used by Tensor dunders
+    _OPS_CACHE["neg"] = math.neg
+    _OPS_CACHE["t_"] = manipulation.t_
+
+
+_collect_ops()
+
+
+# ---- monkey-patch Tensor methods (reference: tensor/__init__.py tensor_method_func) ----
+_METHOD_NAMES = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "lerp", "hypot",
+    "logaddexp", "heaviside", "abs", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sqrt", "rsqrt", "square", "reciprocal", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "erf", "erfinv", "sigmoid", "floor", "ceil", "round", "trunc", "frac",
+    "sign", "digamma", "lgamma", "clip", "scale", "stanh", "sum", "mean",
+    "max", "min", "amax", "amin", "prod", "logsumexp", "cumsum", "cumprod",
+    "cummax", "cummin", "nansum", "nanmean", "count_nonzero", "inner", "outer",
+    "kron", "trace", "diagonal", "isnan", "isinf", "isfinite", "nan_to_num",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "mv", "addmm", "norm", "cross", "cholesky",
+    "cholesky_solve", "triangular_solve", "inv", "inverse", "pinv", "solve",
+    "matrix_power", "det", "slogdet",
+    # logic
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all",
+    "allclose", "isclose", "any", "all", "isin",
+    # manipulation
+    "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "flatten",
+    "squeeze", "unsqueeze", "split", "chunk", "unbind", "tile", "expand",
+    "expand_as", "broadcast_to", "flip", "rot90", "roll", "gather", "gather_nd",
+    "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "where", "nonzero", "pad", "repeat_interleave", "unique",
+    "unique_consecutive", "as_complex", "as_real", "real", "imag", "conj",
+    "strided_slice", "view",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "searchsorted", "bucketize",
+    # stat
+    "std", "var", "median", "nanmedian", "quantile", "nanquantile",
+    # random (in-place)
+    "uniform_", "normal_", "bernoulli_", "exponential_",
+    # creation-ish
+    "diag", "diagflat", "tril", "triu", "bincount", "histogram",
+]
+
+for _name in _METHOD_NAMES:
+    if _name in _OPS_CACHE and not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _OPS_CACHE[_name])
+
+# a couple of names where the Tensor method differs from the free function
+import jax.numpy as _jnp
+
+Tensor.fill_ = lambda self, v: self.set_value(_jnp.full_like(self._value, v))
+Tensor.zero_ = lambda self: self.set_value(_jnp.zeros_like(self._value))

@@ -1,0 +1,171 @@
+"""Creation ops (reference: /root/reference/python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dt
+from ..core.engine import apply
+from ..core.tensor import Tensor, to_tensor  # noqa: F401 (re-export)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dtype(dtype):
+    d = _dt.convert_dtype(dtype)
+    return d if d is not None else _dt.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = _dt.get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.zeros_like(x, dtype=_dt.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.ones_like(x, dtype=_dt.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.full_like(x, fill_value, dtype=_dt.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def v(a):
+        return a.item() if isinstance(a, Tensor) else a
+
+    start, end, step = v(start), v(end), v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = _dt.int64 if all(isinstance(a, (int, np.integer)) for a in (start, end, step)) \
+            else _dt.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def v(a):
+        return a.item() if isinstance(a, Tensor) else a
+
+    return Tensor(jnp.linspace(v(start), v(stop), int(v(num)), dtype=_dt.convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def v(a):
+        return a.item() if isinstance(a, Tensor) else a
+
+    return Tensor(jnp.logspace(v(start), v(stop), int(v(num)), base=v(base), dtype=_dt.convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return apply(f, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + (0 if offset >= 0 else -offset)
+        c = idx + (offset if offset >= 0 else 0)
+        out = out.at[..., r, c].set(a)
+        d1, d2 = dim1 % out.ndim, dim2 % out.ndim
+        return jnp.moveaxis(out, (out.ndim - 2, out.ndim - 1), (d1, d2))
+
+    return apply(f, x, name="diag_embed")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(g) for g in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(val)
+        return output
+    return Tensor(val)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply(jax.lax.complex, real, imag, name="complex")
+
+
+def polar(abs_t, angle, name=None):
+    return apply(lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                 abs_t, angle, name="polar")

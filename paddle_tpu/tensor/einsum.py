@@ -1,0 +1,14 @@
+"""einsum (reference: /root/reference/python/paddle/tensor/einsum.py, ~1k LoC
+of a hand-rolled planner — here XLA's native einsum/dot_general planner is
+used directly, which maps contractions straight onto the MXU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.engine import apply
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *operands, name="einsum")

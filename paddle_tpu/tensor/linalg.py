@@ -1,0 +1,238 @@
+"""Linear algebra ops (reference: /root/reference/python/paddle/tensor/linalg.py).
+All matmul-family ops run on the MXU; `preferred_element_type` keeps bf16
+inputs accumulating in fp32 as the MXU does natively."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, x, y, name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="matmul")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y, name="addmm")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def f(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            return jnp.linalg.norm(a, ord=np.inf, axis=ax, keepdims=keepdim) if ax is not None \
+                else jnp.max(jnp.abs(a))
+        if p == -np.inf or p == float("-inf"):
+            return jnp.linalg.norm(a, ord=-np.inf, axis=ax, keepdims=keepdim) if ax is not None \
+                else jnp.min(jnp.abs(a))
+        if ax is None:
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return apply(f, x, name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim),
+                 x, name="norm")
+
+
+def cond(x, p=None, name=None):
+    return apply_nondiff(lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply(f, x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    return apply(lambda a: jnp.linalg.cholesky(a) if not upper
+                 else jnp.swapaxes(jnp.linalg.cholesky(jnp.swapaxes(a, -1, -2).conj()), -1, -2).conj(),
+                 x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        if upper:
+            l = jnp.swapaxes(l, -1, -2).conj()
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(l, -1, -2).conj(), z, lower=False)
+
+    return apply(f, x, y, name="cholesky_solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular),
+        x, y, name="triangular_solve")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inverse")
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a = x._value if isinstance(x, Tensor) else x
+    b = y._value if isinstance(y, Tensor) else y
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x, name="svd")
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, name="svd")
+
+
+def eig(x, name=None):
+    a = x._value if isinstance(x, Tensor) else x
+    w, v = np.linalg.eig(np.asarray(a))  # CPU path (XLA lacks general eig on TPU)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x, name="eigh")
+
+
+def eigvals(x, name=None):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a), x, name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, int(n)), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_nondiff(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return apply(f, x, name="slogdet")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv.astype(jnp.int32) + 1  # paddle uses 1-based pivots
+
+    a = x._value if isinstance(x, Tensor) else x
+    lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+    outs = [Tensor(lu_mat), Tensor(piv.astype(jnp.int32) + 1)]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), jnp.int32)))
+    return tuple(outs)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = np.asarray(input._value if isinstance(input, Tensor) else input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    hist, _ = np.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist, dtype=jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(a, weights=w, minlength=minlength)))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x, name="cov")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x, name="matmul")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * v[..., :, None] * v[..., None, :]
+            return q @ h
+
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return apply(f, x, tau, name="householder_product")

@@ -1,0 +1,107 @@
+"""Comparison / logical / bitwise ops
+(reference: /root/reference/python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.engine import apply_nondiff
+from ..core.tensor import Tensor
+
+
+def equal(x, y, name=None):
+    return apply_nondiff(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return apply_nondiff(jnp.not_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return apply_nondiff(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return apply_nondiff(jnp.less_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return apply_nondiff(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return apply_nondiff(jnp.greater_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply_nondiff(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply_nondiff(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply_nondiff(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_nondiff(jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return apply_nondiff(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return apply_nondiff(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return apply_nondiff(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_nondiff(jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply_nondiff(jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply_nondiff(jnp.right_shift, x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_nondiff(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nondiff(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nondiff(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_nondiff(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_nondiff(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_nondiff(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x)

@@ -1,0 +1,448 @@
+"""Shape/layout manipulation ops
+(reference: /root/reference/python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dt
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+
+py_slice = slice  # saved before the paddle-style `slice` op shadows the builtin
+
+
+def _ilist(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in v.tolist())
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x._value) if isinstance(x, Tensor) else int(x) for x in v)
+
+
+def reshape(x, shape, name=None):
+    shape = _ilist(shape)
+    return apply(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._node, x.stop_gradient = out._value, out._node, out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def transpose(x, perm, name=None):
+    perm = _ilist(perm)
+    return apply(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+def t_(x, name=None):
+    """paddle.t — transpose a 0/1/2-D tensor."""
+    if x.ndim < 2:
+        return x
+    return apply(lambda a: a.T, x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x, name="transpose")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x, name="transpose")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return apply(f, x, name="reshape")
+
+
+def squeeze(x, axis=None, name=None):
+    ax = None if axis is None else tuple(a % max(x.ndim, 1) for a in _ilist(axis))
+
+    def f(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        keep = tuple(i for i in ax if a.shape[i] == 1)
+        return jnp.squeeze(a, axis=keep) if keep else a
+
+    return apply(f, x, name="reshape")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ilist(axis)
+    return apply(lambda a: jnp.expand_dims(a, ax), x, name="reshape")
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *tensors, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *tensors, name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sections if s < 0)
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections)[:-1]
+    outs = []
+    for off, sz in zip(offsets, sections):
+        outs.append(apply(
+            lambda a, off=int(off), sz=int(sz): jax.lax.slice_in_dim(a, off, off + sz, axis=axis),
+            x, name="slice"))
+    return outs
+
+
+def builtins_sum(it, start=0):
+    import builtins
+    return builtins.sum(it, start)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    outs = split(x, x.shape[axis], axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ilist(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _ilist(shape)
+
+    def f(a):
+        tgt = tuple(a.shape[i - (len(shape) - a.ndim)] if s == -1 else s
+                    for i, s in enumerate(shape))
+        return jnp.broadcast_to(a, tgt)
+
+    return apply(f, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(y.shape)
+    return apply(lambda a: jnp.broadcast_to(a, tgt), x, name="expand")
+
+
+broadcast_to = expand
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    tgt = np.broadcast_shapes(*shapes)
+    return [apply(lambda a: jnp.broadcast_to(a, tgt), t, name="expand") for t in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    ax = _ilist(axis)
+    return apply(lambda a: jnp.flip(a, ax), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k, axes), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ilist(shifts)
+    ax = None if axis is None else _ilist(axis)
+    sh = sh[0] if len(sh) == 1 and ax is None else sh
+    return apply(lambda a: jnp.roll(a, sh, ax if ax is None or len(ax) > 1 else ax[0]), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply(f, x, index, name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                 arr, indices, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis % a.ndim else jnp.broadcast_to(dims[d], i.shape)
+                         for d in range(a.ndim))
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("multiply", "mul"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(reduce)
+
+    return apply(f, arr, indices, values, name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return a.at[i].set(u)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply(f, x, index, updates, name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply(f, x, index, updates, name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shp = _ilist(shape)
+
+    def f(i, u):
+        out = jnp.zeros(shp, u.dtype)
+        return out.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return apply(f, index, updates, name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+                 x, index, name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(am.at[i].add(vm), 0, axis)
+
+    return apply(f, x, index, value, name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._value if isinstance(i, Tensor) else i for i in indices)
+
+    def f(a, v):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+
+    return apply(f, x, value, name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape — eager only (like reference dygraph)
+    a = x._value if isinstance(x, Tensor) else x
+    m = mask._value if isinstance(mask, Tensor) else mask
+    return Tensor(a[np.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask, name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y, name="where")
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    idx = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=jnp.int64)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=-1), dtype=jnp.int64))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    pad = _ilist(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+            if not pad_from_left_axis:
+                pairs = pairs[::-1]
+        else:
+            # paddle nn.functional style: pad applies to last len(pad)//2 dims
+            # in (last-dim-first) order, with NCHW/NHWC data_format handling
+            n_pairs = len(pad) // 2
+            pairs = [(0, 0)] * nd
+            if data_format.endswith("C") and nd >= 3:  # NHWC/NDHWC: spatial dims are 1..nd-2
+                spatial = list(range(1, nd - 1))
+            else:  # NCHW-style: spatial dims are 2..nd-1
+                spatial = list(range(2, nd))
+            for k in range(n_pairs):
+                d = spatial[-(k + 1)] if spatial else nd - 1 - k
+                pairs[d] = (pad[2 * k], pad[2 * k + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode=jmode, constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return apply(f, x, name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats._value
+
+        def f(a, r):
+            return jnp.repeat(a, r, axis=axis, total_repeat_length=int(np.sum(np.asarray(r))))
+
+        return apply(f, x, repeats, name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, int(repeats), axis=axis), x, name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    n = a.shape[axis]
+    if n == 0:
+        keep = np.zeros(0, dtype=bool)
+    else:
+        head = np.take(a, range(1, n), axis=axis) != np.take(a, range(0, n - 1), axis=axis)
+        while head.ndim > 1:
+            head = head.any(axis=tuple(d for d in range(head.ndim) if d != axis))
+            break
+        keep = np.concatenate([[True], np.atleast_1d(head).reshape(n - 1, -1).any(axis=-1)])
+    out = np.compress(keep, a, axis=axis)
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        rets.append(Tensor(jnp.asarray(np.cumsum(keep) - 1, dtype=np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.concatenate([idx, [n]]))
+        rets.append(Tensor(jnp.asarray(counts, dtype=np.int64)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x, name="as_real")
+
+
+def real(x, name=None):
+    return apply(jnp.real, x, name="real")
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x, name="imag")
+
+
+def conj(x, name=None):
+    return apply(jnp.conj, x, name="conj")
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes, starts, ends = _ilist(axes), _ilist(starts), _ilist(ends)
+
+    def f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            n = a.shape[ax]
+            s2, e2 = max(s + n, 0) if s < 0 else min(s, n), max(e + n, 0) if e < 0 else min(e, n)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+
+    return apply(f, x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = _ilist(axes), _ilist(starts), _ilist(ends), _ilist(strides)
+
+    def f(a):
+        idx = [py_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = py_slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply(f, x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ilist(shape)
+    offs = _ilist(offsets) if offsets is not None else (0,) * len(shp)
+
+    def f(a):
+        return jax.lax.dynamic_slice(a, offs, [a.shape[i] if s == -1 else s for i, s in enumerate(shp)])
+
+    return apply(f, x, name="crop")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_shard = (a >= lo) & (a < hi)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return apply_nondiff(f, input)

@@ -1,0 +1,415 @@
+"""Math ops (reference: /root/reference/python/paddle/tensor/math.py, ~7k LoC
+of wrappers over phi kernels). Here each op is a pure jnp function dispatched
+through the autograd engine; XLA supplies the TPU kernel and fusion."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import engine
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------- binary elementwise ----------------
+def add(x, y, name=None):
+    return apply(jnp.add, x, y, name="add")
+
+
+def subtract(x, y, name=None):
+    return apply(jnp.subtract, x, y, name="subtract")
+
+
+def multiply(x, y, name=None):
+    return apply(jnp.multiply, x, y, name="multiply")
+
+
+def divide(x, y, name=None):
+    return apply(jnp.divide, x, y, name="divide")
+
+
+def floor_divide(x, y, name=None):
+    return apply_nondiff(jnp.floor_divide, x, y, name="floor_divide")
+
+
+def mod(x, y, name=None):
+    return apply(jnp.mod, x, y, name="mod")
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return apply(jnp.power, x, y, name="pow")
+
+
+def maximum(x, y, name=None):
+    return apply(jnp.maximum, x, y, name="maximum")
+
+
+def minimum(x, y, name=None):
+    return apply(jnp.minimum, x, y, name="minimum")
+
+
+def fmax(x, y, name=None):
+    return apply(jnp.fmax, x, y, name="fmax")
+
+
+def fmin(x, y, name=None):
+    return apply(jnp.fmin, x, y, name="fmin")
+
+
+def atan2(x, y, name=None):
+    return apply(jnp.arctan2, x, y, name="atan2")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def hypot(x, y, name=None):
+    return apply(jnp.hypot, x, y, name="hypot")
+
+
+def logaddexp(x, y, name=None):
+    return apply(jnp.logaddexp, x, y, name="logaddexp")
+
+
+def heaviside(x, y, name=None):
+    return apply(jnp.heaviside, x, y, name="heaviside")
+
+
+def gcd(x, y, name=None):
+    return apply_nondiff(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return apply_nondiff(jnp.lcm, x, y)
+
+
+# ---------------- unary elementwise ----------------
+def neg(x, name=None):
+    return apply(jnp.negative, x, name="neg")
+
+
+def abs(x, name=None):
+    return apply(jnp.abs, x, name="abs")
+
+
+def exp(x, name=None):
+    return apply(jnp.exp, x, name="exp")
+
+
+def expm1(x, name=None):
+    return apply(jnp.expm1, x, name="expm1")
+
+
+def log(x, name=None):
+    return apply(jnp.log, x, name="log")
+
+
+def log2(x, name=None):
+    return apply(jnp.log2, x, name="log2")
+
+
+def log10(x, name=None):
+    return apply(jnp.log10, x, name="log10")
+
+
+def log1p(x, name=None):
+    return apply(jnp.log1p, x, name="log1p")
+
+
+def sqrt(x, name=None):
+    return apply(jnp.sqrt, x, name="sqrt")
+
+
+def rsqrt(x, name=None):
+    return apply(jax.lax.rsqrt, x, name="rsqrt")
+
+
+def square(x, name=None):
+    return apply(jnp.square, x, name="square")
+
+
+def reciprocal(x, name=None):
+    return apply(jnp.reciprocal, x, name="reciprocal")
+
+
+def sin(x, name=None):
+    return apply(jnp.sin, x, name="sin")
+
+
+def cos(x, name=None):
+    return apply(jnp.cos, x, name="cos")
+
+
+def tan(x, name=None):
+    return apply(jnp.tan, x, name="tan")
+
+
+def asin(x, name=None):
+    return apply(jnp.arcsin, x, name="asin")
+
+
+def acos(x, name=None):
+    return apply(jnp.arccos, x, name="acos")
+
+
+def atan(x, name=None):
+    return apply(jnp.arctan, x, name="atan")
+
+
+def sinh(x, name=None):
+    return apply(jnp.sinh, x, name="sinh")
+
+
+def cosh(x, name=None):
+    return apply(jnp.cosh, x, name="cosh")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, name="tanh")
+
+
+def asinh(x, name=None):
+    return apply(jnp.arcsinh, x, name="asinh")
+
+
+def acosh(x, name=None):
+    return apply(jnp.arccosh, x, name="acosh")
+
+
+def atanh(x, name=None):
+    return apply(jnp.arctanh, x, name="atanh")
+
+
+def erf(x, name=None):
+    return apply(jax.scipy.special.erf, x, name="erf")
+
+
+def erfinv(x, name=None):
+    return apply(jax.scipy.special.erfinv, x, name="erfinv")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def floor(x, name=None):
+    return apply(jnp.floor, x, name="floor")
+
+
+def ceil(x, name=None):
+    return apply(jnp.ceil, x, name="ceil")
+
+
+def round(x, name=None):
+    return apply(jnp.round, x, name="round")
+
+
+def trunc(x, name=None):
+    return apply(jnp.trunc, x, name="trunc")
+
+
+def frac(x, name=None):
+    return apply(lambda a: a - jnp.trunc(a), x, name="frac")
+
+
+def sign(x, name=None):
+    return apply(jnp.sign, x, name="sign")
+
+
+def digamma(x, name=None):
+    return apply(jax.scipy.special.digamma, x, name="digamma")
+
+
+def lgamma(x, name=None):
+    return apply(jax.scipy.special.gammaln, x, name="lgamma")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._value if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+
+    return apply(f, x, name="scale")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(*xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+
+    return apply(f, *inputs, name="multiplex")
+
+
+# ---------------- reductions ----------------
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.sum(a, axis=ax, dtype=dtype, keepdims=keepdim), x, name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x, name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.prod(a, axis=ax, dtype=dtype, keepdims=keepdim), x, name="prod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x, name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        return apply(lambda a: jnp.cumsum(a.reshape(-1), dtype=dtype), x, name="cumsum")
+    ax = int(axis)
+    return apply(lambda a: jnp.cumsum(a, axis=ax, dtype=dtype), x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    if dim is None:
+        return apply(lambda a: jnp.cumprod(a.reshape(-1), dtype=dtype), x, name="cumprod")
+    ax = int(dim)
+    return apply(lambda a: jnp.cumprod(a, axis=ax, dtype=dtype), x, name="cumprod")
+
+
+def _running_arg(x, axis, cmp):
+    """(values, indices) of the running max/min along `axis` via an
+    associative scan over (value, index) pairs."""
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = cmp(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    idx = jnp.broadcast_to(
+        jnp.arange(x.shape[axis]).reshape([-1 if d == axis % x.ndim else 1 for d in range(x.ndim)]),
+        x.shape,
+    )
+    return jax.lax.associative_scan(combine, (x, idx), axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    xv = x.reshape([-1]) if axis is None else x
+    ax = 0 if axis is None else int(axis)
+    vals = apply(lambda a: _running_arg(a, ax, lambda b, c: b >= c)[0], xv, name="cummax")
+    idx = apply_nondiff(lambda a: _running_arg(a, ax, lambda b, c: b >= c)[1].astype(jnp.int64), xv)
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    xv = x.reshape([-1]) if axis is None else x
+    ax = 0 if axis is None else int(axis)
+    vals = apply(lambda a: _running_arg(a, ax, lambda b, c: b <= c)[0], xv, name="cummin")
+    idx = apply_nondiff(lambda a: _running_arg(a, ax, lambda b, c: b <= c)[1].astype(jnp.int64), xv)
+    return vals, idx
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.nansum(a, axis=ax, dtype=dtype, keepdims=keepdim), x, name="sum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x, name="mean")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_nondiff(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *xs: jax.tree.reduce(jnp.add, list(xs)), *inputs, name="add_n")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y, name="matmul")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="matmul")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y, name="matmul")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x, name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x, name="diagonal")
+
+
+# ---------------- checks ----------------
+def isnan(x, name=None):
+    return apply_nondiff(jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return apply_nondiff(jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return apply_nondiff(jnp.isfinite, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x, name="nan_to_num")
+
+
+def increment(x, value=1.0, name=None):
+    x.set_value(x._value + value)
+    return x

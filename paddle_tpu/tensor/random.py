@@ -1,0 +1,137 @@
+"""Random ops (reference: /root/reference/python/paddle/tensor/random.py).
+
+All draws go through the global splittable PRNG (core/random.py), so the same
+code is reproducible eagerly and under jit (where `rng_guard` threads a traced
+key in)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dt
+from ..core import random as _rng
+from ..core.engine import apply
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dtype(dtype):
+    d = _dt.convert_dtype(dtype)
+    return d if d is not None else _dt.get_default_dtype()
+
+
+def seed(n):
+    return _rng.seed(n)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_rng.split_key(), _shape(shape), _dtype(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_rng.split_key(), _shape(shape), _dtype(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_rng.split_key(), shp) * s + m)
+    shp = _shape(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(_rng.split_key(), shp) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(_rng.split_key(), _shape(shape), _dtype(dtype),
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x.set_value(jax.random.uniform(_rng.split_key(), tuple(x.shape), x._value.dtype,
+                                   minval=min, maxval=max))
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_rng.split_key(), _shape(shape), int(low), int(high),
+                                     dtype=_dt.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dt.convert_dtype(dtype) or x._value.dtype
+    return Tensor(jax.random.randint(_rng.split_key(), tuple(x.shape), int(low), int(high)).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_rng.split_key(), int(n)).astype(_dt.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(a, 1e-38))
+    if replacement:
+        out = jax.random.categorical(_rng.split_key(), logits, axis=-1,
+                                     shape=(num_samples,) + a.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if a.ndim > 1 else out
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_rng.split_key(), a.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_rng.split_key(), a).astype(a.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x.set_value(jax.random.bernoulli(_rng.split_key(), p, tuple(x.shape)).astype(x._value.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_rng.split_key(), a).astype(a.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(_rng.split_key(), c, p).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x.set_value(jax.random.exponential(_rng.split_key(), tuple(x.shape), x._value.dtype) / lam)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.set_value(jax.random.normal(_rng.split_key(), tuple(x.shape), x._value.dtype) * std + mean)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    return Tensor(jax.random.normal(_rng.split_key(), _shape(shape), _dtype(dtype)) * std + mean)
+
+
+def shuffle(x, axis=0, name=None):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.permutation(_rng.split_key(), a, axis=axis, independent=False))

@@ -1,0 +1,126 @@
+"""Search/sort ops (reference: /root/reference/python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(jnp.int64)
+        out = jnp.argmax(a, axis=axis).astype(jnp.int64)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return apply_nondiff(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(jnp.int64)
+        out = jnp.argmin(a, axis=axis).astype(jnp.int64)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return apply_nondiff(f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply_nondiff(f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply(lambda a: jnp.sort(a, axis=axis, stable=stable, descending=descending),
+                 x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fv(a):
+        src = a if largest else -a
+        if axis in (-1, a.ndim - 1):
+            v, i = jax.lax.top_k(src, k)
+        else:
+            moved = jnp.moveaxis(src, axis, -1)
+            v, i = jax.lax.top_k(moved, k)
+            v, i = jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+        return (v if largest else -v), i.astype(jnp.int64)
+
+    vals = apply(lambda a: fv(a)[0], x, name="topk")
+    idx = apply_nondiff(lambda a: fv(a)[1], x)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(v, axis) if keepdim else v
+
+    vals = apply(f, x, name="kthvalue")
+    idx = apply_nondiff(
+        lambda a: jnp.take(jnp.argsort(a, axis=axis), k - 1, axis=axis).astype(jnp.int64), x)
+    if keepdim and idx.ndim < vals.ndim:
+        from .manipulation import unsqueeze
+        idx = unsqueeze(idx, axis)
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        # ties break toward the larger value, matching the reference kernel
+        best = uniq[len(counts) - 1 - np.argmax(counts[::-1])]
+        vals.append(best)
+        idxs.append(np.where(row == best)[0][-1])
+    out_shape = moved.shape[:-1]
+    v = np.array(vals).reshape(out_shape)
+    i = np.array(idxs).reshape(out_shape)
+    if keepdim:
+        v, i = np.expand_dims(v, axis), np.expand_dims(i, axis)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i, dtype=jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return apply_nondiff(lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
+                         sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _w
+    return _w(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    from .manipulation import nonzero as _nz
+    return _nz(x, as_tuple)

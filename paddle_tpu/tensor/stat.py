@@ -1,0 +1,61 @@
+"""Statistics ops (reference: /root/reference/python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.engine import apply
+from .math import mean  # noqa: F401 (re-export)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                 x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                 x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+
+    def f(a):
+        if mode == "min" or a.dtype in (jnp.int32, jnp.int64):
+            # lower median
+            n = a.size if ax is None else a.shape[ax]
+            k = (n - 1) // 2
+            s = jnp.sort(a.reshape(-1) if ax is None else a, axis=0 if ax is None else ax)
+            return jnp.take(s, k, axis=0 if ax is None else ax)
+        return jnp.median(a, axis=ax, keepdims=keepdim)
+
+    return apply(f, x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x, name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qv = jnp.asarray(q)
+    return apply(lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim, method=interpolation),
+                 x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qv = jnp.asarray(q)
+    return apply(lambda a: jnp.nanquantile(a, qv, axis=ax, keepdims=keepdim, method=interpolation),
+                 x, name="nanquantile")

@@ -1,0 +1,83 @@
+"""Global flag registry.
+
+Reference: `/root/reference/paddle/common/flags.h:38-104` (PD_DEFINE_* macros,
+~185 flags in common/flags.cc) + `paddle.get_flags/set_flags`. TPU-native:
+a plain python registry with FLAGS_* env pickup; XLA-level knobs are set via
+XLA_FLAGS by the launcher, not here.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    help: str
+    type: type
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def _coerce(ty, raw):
+    if ty is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def define_flag(name: str, default, help: str = ""):
+    """PD_DEFINE_* equivalent; env var FLAGS_<name> overrides the default."""
+    ty = type(default)
+    raw = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(ty, raw) if raw is not None else default
+    with _lock:
+        _REGISTRY[name] = _Flag(name, default, value, help, ty)
+    return value
+
+
+def get_flags(flags):
+    """paddle.get_flags."""
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        f = _REGISTRY.get(key)
+        out[n] = f.value if f else None
+    return out
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        with _lock:
+            f = _REGISTRY.get(key)
+            if f is None:
+                _REGISTRY[key] = _Flag(key, v, v, "", type(v))
+            else:
+                f.value = _coerce(f.type, v)
+
+
+def flag_value(name: str):
+    f = _REGISTRY.get(name)
+    return f.value if f else None
+
+
+# Core framework flags (subset of common/flags.cc relevant on TPU)
+define_flag("check_nan_inf", False, "check op outputs for NaN/Inf (debug)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: log stats")
+define_flag("use_flash_attention", True, "use the Pallas flash-attention kernel")
+define_flag("benchmark", False, "sync after each op for timing")
+define_flag("init_seed", 0, "global RNG seed at startup")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision")

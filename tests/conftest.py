@@ -1,0 +1,29 @@
+"""Test config: force an 8-device virtual CPU platform so mesh/sharding tests
+run without TPUs (SURVEY.md §4 'fake device' lesson — the reference uses a
+fake CPU custom-device plugin; we use XLA host platform device_count).
+
+The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu" at
+interpreter start; backend creation is lazy, so overriding the config back to
+"cpu" BEFORE any array is created keeps tests entirely off the TPU tunnel.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8 " +
+                      os.environ.get("XLA_FLAGS", ""))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu as pt
+    pt.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,153 @@
+"""Autograd engine tests (reference: test/legacy_test grad checks +
+test/autograd/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def t(a, sg=False):
+    return pt.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = t([2.0])
+        y = x * x + 3.0 * x  # dy/dx = 2x + 3 = 7
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+    def test_matmul_grad(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 2).astype(np.float32)
+        x, w = t(a), t(b)
+        loss = pt.sum(x @ w)
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 2)) @ b.T, rtol=1e-5)
+        np.testing.assert_allclose(w.grad.numpy(), a.T @ np.ones((3, 2)), rtol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = t([1.0, 2.0])
+        y1 = pt.sum(x * 2)
+        y2 = pt.sum(x * 3)
+        y1.backward()
+        y2.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_fanout(self):
+        x = t([3.0])
+        y = x * x  # reused twice
+        z = y + y
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_stop_gradient(self):
+        x = t([1.0], sg=True)
+        w = t([2.0])
+        y = x * w
+        y.backward()
+        assert x.grad is None
+        np.testing.assert_allclose(w.grad.numpy(), [1.0])
+
+    def test_detach(self):
+        x = t([2.0])
+        y = (x * x).detach() * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])  # only d(4*x)/dx
+
+    def test_no_grad(self):
+        x = t([1.0])
+        with pt.no_grad():
+            y = x * 2
+        assert y._node is None
+
+    def test_multi_output_op(self):
+        a = np.random.rand(4, 6).astype(np.float32)
+        x = t(a)
+        parts = pt.split(x, 2, axis=1)
+        loss = pt.sum(parts[0]) + 2 * pt.sum(parts[1])
+        loss.backward()
+        ref = np.concatenate([np.ones((4, 3)), 2 * np.ones((4, 3))], 1)
+        np.testing.assert_allclose(x.grad.numpy(), ref)
+
+    def test_numeric_grad_check(self):
+        # finite-difference check, OpTest style (op_test.py:3129)
+        a = np.random.rand(3, 3).astype(np.float32) + 0.5
+
+        def fwd_np(arr):
+            return np.sum(np.tanh(arr) * np.log(arr))
+
+        x = t(a)
+        loss = pt.sum(pt.tanh(x) * pt.log(x))
+        loss.backward()
+        eps = 1e-3
+        num = np.zeros_like(a)
+        for i in range(3):
+            for j in range(3):
+                ap, am = a.copy(), a.copy()
+                ap[i, j] += eps
+                am[i, j] -= eps
+                num[i, j] = (fwd_np(ap) - fwd_np(am)) / (2 * eps)
+        np.testing.assert_allclose(x.grad.numpy(), num, rtol=1e-2, atol=1e-3)
+
+    def test_getitem_grad(self):
+        x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        y = pt.sum(x[0] * 2)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [0, 0, 0]])
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = t([2.0])
+        y = x * x * x
+        (g,) = pt.grad(y, [x])
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+        assert x.grad is None  # .grad not polluted
+
+    def test_backward_api(self):
+        x = t([1.0, 1.0])
+        y = x * 4
+        pt.autograd.backward([y], [t([1.0, 2.0], sg=True)])
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(pt.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = t([3.0])
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestJitBridge:
+    def test_ops_under_jax_jit(self):
+        import jax
+
+        @jax.jit
+        def f(x):
+            return pt.sum(pt.tanh(x) * 2)
+
+        x = t(np.ones((2, 2)))
+        out = f(x)
+        np.testing.assert_allclose(np.asarray(out._value), 2 * 4 * np.tanh(1), rtol=1e-6)
+
+    def test_grad_through_functional(self):
+        import jax
+
+        def f(x):
+            return pt.sum(x * x)._value
+
+        g = jax.grad(f)(pt.to_tensor(np.array([3.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(g._value), [6.0])

@@ -1,0 +1,206 @@
+"""Op unit tests vs numpy references (reference test strategy: SURVEY.md §4,
+test/legacy_test/op_test.py — numpy forward reference + numeric grad check)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def t(a, stop_gradient=True):
+    return pt.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=stop_gradient)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert pt.zeros([2, 3]).numpy().tolist() == np.zeros((2, 3)).tolist()
+        assert pt.ones([2]).numpy().tolist() == [1, 1]
+        assert pt.full([2, 2], 7.0).numpy().tolist() == [[7, 7], [7, 7]]
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(pt.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+        np.testing.assert_allclose(pt.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5),
+                                   rtol=1e-6)
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_allclose(pt.eye(3).numpy(), np.eye(3))
+        x = np.random.rand(4, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.tril(t(x)).numpy(), np.tril(x))
+        np.testing.assert_allclose(pt.triu(t(x), 1).numpy(), np.triu(x, 1))
+
+    def test_to_tensor_dtypes(self):
+        assert pt.to_tensor([1, 2, 3]).dtype == pt.int64
+        assert pt.to_tensor([1.0, 2.0]).dtype == pt.float32
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a, b = np.random.rand(3, 4).astype(np.float32), np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose((t(a) + t(b)).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((t(a) - t(b)).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose((t(a) * t(b)).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((t(a) / (t(b) + 1)).numpy(), a / (b + 1), rtol=1e-5)
+        np.testing.assert_allclose((t(a) ** 2).numpy(), a ** 2, rtol=1e-5)
+        np.testing.assert_allclose((2.0 - t(a)).numpy(), 2.0 - a, rtol=1e-6)
+
+    def test_unary_ops(self):
+        a = np.random.rand(5).astype(np.float32) + 0.1
+        np.testing.assert_allclose(pt.exp(t(a)).numpy(), np.exp(a), rtol=1e-4)
+        np.testing.assert_allclose(pt.log(t(a)).numpy(), np.log(a), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(pt.sqrt(t(a)).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(pt.rsqrt(t(a)).numpy(), 1 / np.sqrt(a), rtol=1e-4)
+        np.testing.assert_allclose(pt.tanh(t(a)).numpy(), np.tanh(a), rtol=1e-5)
+        np.testing.assert_allclose(pt.sigmoid(t(a)).numpy(), 1 / (1 + np.exp(-a)), rtol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.rand(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(pt.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(pt.mean(t(a), axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(pt.max(t(a), axis=[0, 2]).numpy(), a.max((0, 2)), rtol=1e-6)
+        np.testing.assert_allclose(pt.prod(t(a), axis=-1).numpy(), a.prod(-1), rtol=1e-4)
+        np.testing.assert_allclose(pt.logsumexp(t(a), axis=0).numpy(),
+                                   np.log(np.exp(a).sum(0)), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.cumsum(t(a), axis=1).numpy(), np.cumsum(a, 1), rtol=1e-5)
+        np.testing.assert_allclose(pt.clip(t(a), -0.5, 0.5).numpy(), np.clip(a, -0.5, 0.5))
+
+    def test_cummax(self):
+        a = np.random.randn(8).astype(np.float32)
+        vals, idx = pt.cummax(t(a))
+        np.testing.assert_allclose(vals.numpy(), np.maximum.accumulate(a), rtol=1e-6)
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(pt.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose((t(a) @ t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(pt.matmul(t(a), t(b.T), transpose_y=True).numpy(),
+                                   a @ b, rtol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(pt.einsum("bij,bjk->bik", t(a), t(b)).numpy(),
+                                   np.einsum("bij,bjk->bik", a, b), rtol=1e-5)
+
+    def test_norm_solve(self):
+        a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+        b = np.random.rand(4, 2).astype(np.float32)
+        np.testing.assert_allclose(pt.linalg.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(pt.linalg.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-3)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.rand(5, 3).astype(np.float32)
+        u, s, vh = pt.linalg.svd(t(a))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), a, atol=1e-4)
+        q, r = pt.linalg.qr(t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        l = pt.linalg.cholesky(t(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        assert pt.reshape(t(a), [6, 4]).shape == [6, 4]
+        np.testing.assert_allclose(pt.transpose(t(a), [2, 0, 1]).numpy(),
+                                   a.transpose(2, 0, 1))
+        assert pt.flatten(t(a), 1).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(pt.concat([t(a), t(b)], axis=0).numpy(),
+                                   np.concatenate([a, b], 0))
+        np.testing.assert_allclose(pt.stack([t(a), t(b)], axis=1).numpy(),
+                                   np.stack([a, b], 1))
+        parts = pt.split(t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = pt.split(t(a), [1, 2], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        assert pt.squeeze(t(a)).shape == [3]
+        assert pt.unsqueeze(t(a), [0]).shape == [1, 1, 3, 1]
+        np.testing.assert_allclose(pt.tile(t(a), [2, 1, 1]).numpy(), np.tile(a, (2, 1, 1)))
+
+    def test_gather_scatter(self):
+        a = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(pt.gather(t(a), pt.to_tensor(idx)).numpy(), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = pt.scatter(t(a), pt.to_tensor(idx), t(upd))
+        ref = a.copy()
+        ref[idx] = 1.0
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_where_masked(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        out = pt.where(t(a) > 0, t(a), pt.zeros_like(t(a)))
+        np.testing.assert_allclose(out.numpy(), np.where(a > 0, a, 0))
+
+    def test_pad_roll_flip(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            pt.tensor.manipulation.pad(t(a), [1, 1, 2, 2]).numpy(),
+            np.pad(a, [(1, 1), (2, 2)]))
+        np.testing.assert_allclose(pt.roll(t(a), 1, axis=0).numpy(), np.roll(a, 1, 0))
+        np.testing.assert_allclose(pt.flip(t(a), [1]).numpy(), a[:, ::-1])
+
+    def test_indexing(self):
+        a = np.random.rand(4, 5).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose(x[1:3, ::2].numpy(), a[1:3, ::2])
+        x[0] = 0.0
+        assert x.numpy()[0].sum() == 0
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        a = np.random.rand(3, 6).astype(np.float32)
+        np.testing.assert_allclose(pt.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        vals, idx = pt.topk(t(a), 2, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        np.testing.assert_allclose(pt.sort(t(a), axis=1).numpy(), np.sort(a, 1))
+
+    def test_unique_nonzero(self):
+        a = np.array([3, 1, 2, 1, 3], np.int64)
+        np.testing.assert_allclose(pt.unique(pt.to_tensor(a)).numpy(), [1, 2, 3])
+        b = np.array([0.0, 1.0, 0.0, 2.0], np.float32)
+        nz = pt.nonzero(t(b))
+        np.testing.assert_allclose(nz.numpy().reshape(-1), [1, 3])
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        assert (t(a) < t(b)).numpy().tolist() == [True, False, False]
+        assert (t(a) == t(b)).numpy().tolist() == [False, True, False]
+        assert bool(pt.allclose(t(a), t(a)))
+
+    def test_any_all(self):
+        a = np.array([[True, False], [True, True]])
+        assert pt.any(pt.to_tensor(a)).numpy()
+        assert pt.all(pt.to_tensor(a), axis=1).numpy().tolist() == [False, True]
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        pt.seed(7)
+        a = pt.randn([3, 4])
+        pt.seed(7)
+        b = pt.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert pt.rand([2, 2]).shape == [2, 2]
+        r = pt.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = pt.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
