@@ -29,7 +29,8 @@ __all__ = ["Tensor", "Parameter", "to_tensor", "wrap_output"]
 
 
 class Tensor:
-    __slots__ = ("_value", "stop_gradient", "_grad_value", "_node", "name", "persistable", "__weakref__")
+    __slots__ = ("_value", "stop_gradient", "_grad_value", "_node", "name",
+                 "persistable", "_dist", "__weakref__")
 
     # make numpy defer to our __r*__ operators
     __array_priority__ = 100
@@ -45,6 +46,7 @@ class Tensor:
         self._node = _node  # (GradNode, out_index) or None
         self.name = name
         self.persistable = False
+        self._dist = None  # (ProcessMesh, [Placement]) for DistTensors
 
     # ---------------- basic metadata ----------------
     @property
@@ -76,6 +78,18 @@ class Tensor:
     @property
     def is_leaf(self):
         return self._node is None
+
+    # ---- DistTensor surface (paddle Tensor.is_dist/placements/process_mesh) ----
+    def is_dist(self):
+        return self._dist is not None
+
+    @property
+    def placements(self):
+        return list(self._dist[1]) if self._dist else None
+
+    @property
+    def process_mesh(self):
+        return self._dist[0] if self._dist else None
 
     def numel(self):
         return self.size

@@ -1,5 +1,76 @@
-"""paddle_tpu.distributed — SPMD auto-parallel over jax.sharding
-(reference: /root/reference/python/paddle/distributed/, 148k LoC; see
-SURVEY.md §2.2). Populated incrementally; env first."""
+"""paddle_tpu.distributed — SPMD auto-parallel over jax.sharding.
+
+Reference: /root/reference/python/paddle/distributed/ (148k LoC; SURVEY.md
+§2.2). The NCCL/store/process-group machinery collapses into mesh axes + XLA
+collectives; the semi-auto DistTensor API keeps full parity.
+"""
 from . import env  # noqa: F401
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, get_mesh, set_mesh, init_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn,
+    dtensor_from_local, dtensor_to_local, local_map, reshard, shard_dataloader,
+    shard_layer, shard_optimizer, shard_tensor, unshard_dtensor,
+)
+from .collective import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    all_to_all_single, barrier, batch_isend_irecv, broadcast,
+    destroy_process_group, gather, get_backend, get_group, irecv, isend,
+    new_group, recv, reduce, reduce_scatter, scatter, send, stream, wait,
+)
+from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from . import fleet  # noqa: F401
+
+
+class auto_parallel:
+    """namespace mirror of paddle.distributed.auto_parallel"""
+    from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+
+    @staticmethod
+    def set_mesh(mesh):
+        from .process_mesh import set_mesh as _sm
+        return _sm(mesh)
+
+    @staticmethod
+    def get_mesh():
+        from .process_mesh import get_mesh as _gm
+        return _gm()
+
+
+def is_initialized():
+    return env.is_initialized()
+
+
+def get_world_size_safe():
+    return env.get_world_size()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn (reference distributed/spawn.py). On TPU the
+    SPMD model is single-controller per host — spawn runs fn in subprocesses
+    for multi-host-shaped tests."""
+    import multiprocessing as mp
+    import os
+    if nprocs == -1:
+        nprocs = 1
+    procs = []
+    for rank in range(nprocs):
+        env_copy = dict(os.environ)
+        env_copy["PADDLE_TRAINER_ID"] = str(rank)
+        env_copy["PADDLE_TRAINERS_NUM"] = str(nprocs)
+
+        def runner(r=rank, e=env_copy):
+            os.environ.update(e)
+            func(*args)
+
+        p = mp.get_context("spawn").Process(target=runner, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process failed with exit code {p.exitcode}")
+    return procs

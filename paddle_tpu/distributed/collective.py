@@ -1,0 +1,393 @@
+"""Communication groups + collective API.
+
+Reference: /root/reference/python/paddle/distributed/communication/
+(all_reduce.py:29, stream/all_reduce.py:104, group.py:29 Group) over
+ProcessGroupNCCL (fluid/distributed/collective/process_group_nccl.h:37) and
+NCCLCommContext (phi/core/distributed/nccl_comm_context.h:40).
+
+TPU-native: there is no NCCL/store/process-group object — a Group is a MESH
+AXIS. Collectives are XLA ops:
+  * inside `shard_map`/jit traced code (tracer inputs) they lower to
+    lax.psum / all_gather / all_to_all / ppermute on the group's axis name,
+    compiled onto ICI by XLA;
+  * on eager DistTensors they run the same lax op through a one-op shard_map
+    over the group axis (single-controller SPMD semantics).
+The reference's CommTask watchdog (comm_task_manager.h) maps to the runtime's
+barrier timeout; coalescing/streams are XLA's scheduler's job.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .placement import Partial, Replicate, Shard, placements_to_spec
+from .process_mesh import ProcessMesh, get_mesh
+from .reshard import shard_map_compat
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
+           "all_reduce", "all_gather", "all_gather_object", "all_to_all",
+           "all_to_all_single", "broadcast", "reduce", "scatter", "gather",
+           "reduce_scatter", "send", "recv", "isend", "irecv", "barrier",
+           "batch_isend_irecv", "P2POp", "wait", "get_backend"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group == one axis of a device mesh."""
+
+    def __init__(self, gid, mesh: ProcessMesh, axis_name: str, ranks=None):
+        self.id = gid
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.ranks = ranks if ranks is not None else list(range(mesh.get_dim_size(axis_name)))
+        self.nranks = len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        from .env import get_rank
+        return get_rank() if self.nranks > 1 else 0
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name!r}, nranks={self.nranks})"
+
+
+_groups: dict[int, Group] = {}
+_next_gid = [0]
+
+
+def _world_group() -> Group:
+    if 0 not in _groups:
+        mesh = get_mesh()
+        if mesh is None:
+            from .process_mesh import init_mesh
+            mesh = init_mesh([-1], ["world"])
+        # world group spans the flattened mesh; use the first axis when 1-D
+        axis = mesh.dim_names[0] if mesh.ndim == 1 else tuple(mesh.dim_names)
+        _groups[0] = Group(0, mesh, axis, list(range(len(mesh.process_ids))))
+    return _groups[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None, mesh=None):
+    """Create a group. TPU-native: pass axis_name+mesh (a mesh axis IS the
+    group); plain rank lists build a sub-mesh over those devices."""
+    _next_gid[0] += 1
+    gid = _next_gid[0]
+    if axis_name is not None:
+        g = Group(gid, _as_mesh(mesh), axis_name, ranks)
+    else:
+        import numpy as np
+        ranks = list(ranks or range(jax.device_count()))
+        sub = ProcessMesh(np.asarray(ranks), ["g%d" % gid])
+        g = Group(gid, sub, "g%d" % gid, ranks)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0) -> Group:
+    return _groups.get(gid) or _world_group()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def _as_mesh(mesh):
+    if mesh is None:
+        return get_mesh()
+    return mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(mesh)
+
+
+def _is_tracer(t):
+    v = t._value if isinstance(t, Tensor) else t
+    return isinstance(v, jax.core.Tracer)
+
+
+def _group(group):
+    return group if isinstance(group, Group) else _world_group()
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda x, ax: jax.lax.psum(x, ax),
+    ReduceOp.MAX: lambda x, ax: jax.lax.pmax(x, ax),
+    ReduceOp.MIN: lambda x, ax: jax.lax.pmin(x, ax),
+    ReduceOp.PROD: lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)),
+    ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
+}
+
+
+def _run_spmd(fn, t: Tensor, group: Group, out_sharded_dim=None, in_sharded_dim=None):
+    """Run `fn(local) -> local` over the group axis: direct under a trace,
+    via shard_map on the group's mesh for eager DistTensors."""
+    if _is_tracer(t):
+        return Tensor(fn(t._value), stop_gradient=t.stop_gradient)
+    mesh = group.mesh
+    jm = mesh.jax_mesh
+    in_spec = P() if in_sharded_dim is None else P(
+        *([None] * in_sharded_dim + [group.axis_name]))
+    out_spec = P() if out_sharded_dim is None else P(
+        *([None] * out_sharded_dim + [group.axis_name]))
+    val = t._value
+    if not hasattr(val.sharding, "mesh") or val.sharding.mesh != jm:
+        from jax.sharding import NamedSharding
+        val = jax.device_put(val, NamedSharding(jm, in_spec))
+    out = shard_map_compat(fn, jm, (in_spec,), out_spec)(val)
+    res = Tensor(out, stop_gradient=t.stop_gradient)
+    return res
+
+
+class _Task:
+    """Completed-collective handle (XLA collectives are synchronous at the
+    program level; wait() is a no-op kept for ProcessGroup::Task parity)."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    red = _REDUCERS[op]
+    if _is_tracer(tensor):
+        tensor._value = red(tensor._value, g.axis_name)
+        return _Task()
+    # eager DistTensor: partial -> replicated is the real all-reduce
+    if tensor._dist is not None:
+        from .api import reshard
+        mesh, placements = tensor._dist
+        if any(isinstance(p, Partial) for p in placements):
+            out = reshard(tensor, mesh,
+                          [Replicate() if isinstance(p, Partial) else p for p in placements])
+            tensor._value, tensor._dist = out._value, out._dist
+            return _Task()
+    out = _run_spmd(lambda x: red(x, g.axis_name), tensor, g)
+    tensor._value = out._value
+    return _Task()
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = _group(group)
+    fn = lambda x: jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False)
+    if _is_tracer(tensor):
+        gathered = Tensor(fn(tensor._value))
+    else:
+        gathered = _run_spmd(fn, tensor, g)
+    if tensor_list is not None:
+        from ..tensor.manipulation import unbind
+        parts = unbind(gathered, 0)
+        tensor_list.clear()
+        tensor_list.extend(parts)
+    return gathered
+
+
+def all_gather_object(object_list, obj, group=None):
+    # single-controller: every rank is this process
+    g = _group(group)
+    object_list.clear()
+    object_list.extend([obj] * g.nranks)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _group(group)
+    from ..tensor.manipulation import stack, unbind
+    stacked = stack(list(in_tensor_list), 0)
+    fn = lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
+                                      tiled=True)
+    if _is_tracer(stacked):
+        out = Tensor(fn(stacked._value))
+    else:
+        out = _run_spmd(fn, stacked, g, in_sharded_dim=None, out_sharded_dim=None)
+    parts = unbind(out, 0)
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(parts)
+    return out
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None, in_split_sizes=None,
+                      group=None, sync_op=True):
+    g = _group(group)
+    fn = lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
+                                      tiled=True)
+    if _is_tracer(in_tensor):
+        res = Tensor(fn(in_tensor._value))
+    else:
+        res = _run_spmd(fn, in_tensor, g)
+    if out_tensor is not None:
+        out_tensor._value = res._value
+    return res
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+
+    def fn(x):
+        full = jax.lax.all_gather(x, g.axis_name, axis=0)
+        return full[src_in_group]
+
+    if _is_tracer(tensor):
+        tensor._value = fn(tensor._value)
+        return _Task()
+    out = _run_spmd(fn, tensor, g)
+    tensor._value = out._value
+    return _Task()
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    red = _REDUCERS[op]
+
+    def fn(x):
+        summed = red(x, g.axis_name)
+        keep = jax.lax.axis_index(g.axis_name) == dst
+        return jnp.where(keep, summed, x)
+
+    if _is_tracer(tensor):
+        tensor._value = fn(tensor._value)
+        return _Task()
+    out = _run_spmd(fn, tensor, g)
+    tensor._value = out._value
+    return _Task()
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _group(group)
+    from ..tensor.manipulation import stack
+    if tensor_list:
+        stacked = stack(list(tensor_list), 0)
+
+        def fn(x):
+            idx = jax.lax.axis_index(g.axis_name)
+            return jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+
+        if _is_tracer(stacked):
+            tensor._value = fn(stacked._value)
+        else:
+            out = _run_spmd(fn, stacked, g)
+            tensor._value = out._value
+    return _Task()
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    out = all_gather(gather_list, tensor, group, sync_op)
+    return _Task(out)
+
+
+def reduce_scatter(tensor, tensor_list_or_tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    from ..tensor.manipulation import concat
+    if isinstance(tensor_list_or_tensor, (list, tuple)):
+        src = concat(list(tensor_list_or_tensor), 0)
+    else:
+        src = tensor_list_or_tensor
+
+    def fn(x):
+        return jax.lax.psum_scatter(x, g.axis_name, scatter_dimension=0, tiled=True)
+
+    if _is_tracer(src):
+        res = Tensor(fn(src._value))
+    else:
+        res = _run_spmd(fn, src, g)
+    if tensor is not None:
+        tensor._value = res._value
+    return res
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """In-trace: ppermute to dst (paired with recv's permutation)."""
+    g = _group(group)
+    perm = [(g.rank if not _is_tracer(tensor) else 0, dst)]
+    if _is_tracer(tensor):
+        # inside shard_map the caller composes send/recv into a shift; expose
+        # the canonical ring shift helper instead
+        tensor._value = jax.lax.ppermute(
+            tensor._value, g.axis_name,
+            [(i, dst) for i in range(g.nranks)])
+        return _Task()
+    raise RuntimeError("eager point-to-point send/recv requires a traced SPMD "
+                       "region (shard_map); use p2p helpers in paddle_tpu.parallel")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return send(tensor, src, group, sync_op)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    return [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
+
+
+def barrier(group=None):
+    """Device-level barrier: a tiny psum forces a synchronization point."""
+    g = _group(group)
+    t = Tensor(jnp.zeros((), jnp.float32))
+    all_reduce(t, group=g)
+    jax.block_until_ready(t._value)
+    return _Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
+
+
+# stream.* namespace (reference communication/stream/*) — same ops; the
+# "stream" distinction does not exist under XLA's scheduler.
+class stream:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    alltoall = staticmethod(all_to_all)
+    alltoall_single = staticmethod(all_to_all_single)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

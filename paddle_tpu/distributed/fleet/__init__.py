@@ -1,0 +1,126 @@
+"""fleet facade (reference: /root/reference/python/paddle/distributed/fleet/
+fleet.py:151 init, :218 distributed_model, :1427 distributed_optimizer;
+DistributedStrategy base/distributed_strategy.py:284).
+
+TPU-native: fleet.init builds the hybrid ProcessMesh from strategy.hybrid_configs;
+distributed_model/distributed_optimizer wire the parallel wrappers in
+paddle_tpu.parallel. The protobuf strategy becomes a typed python config.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+from ..env import get_rank, get_world_size
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["DistributedStrategy", "init", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "worker_index",
+           "worker_num", "is_first_worker", "barrier_worker"]
+
+
+@dataclasses.dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    order: tuple = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class DistributedStrategy:
+    """Typed config (replaces distributed_strategy.proto:364)."""
+
+    def __init__(self):
+        self.hybrid_configs: dict[str, Any] = {}
+        self.amp = False
+        self.amp_configs: dict[str, Any] = {}
+        self.recompute = False
+        self.recompute_configs: dict[str, Any] = {}
+        self.sharding = False
+        self.sharding_configs: dict[str, Any] = {}
+        self.pipeline = False
+        self.pipeline_configs: dict[str, Any] = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs: dict[str, Any] = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: dict[str, Any] = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+_hcg: list = [None]
+_strategy: list = [None]
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """fleet.init — builds the hybrid topology mesh."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs or {}
+    dp = int(hc.get("dp_degree", 1))
+    mp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sh = int(hc.get("sharding_degree", 1))
+    sep = int(hc.get("sep_degree", 1))
+    world = get_world_size()
+    try:
+        import jax
+        world = max(world, jax.device_count())
+    except Exception:
+        pass
+    known = mp * pp * sh * sep
+    if dp * known != world and known <= world and world % known == 0:
+        dp = world // known
+    topo = CommunicateTopology(("data", "pipe", "sharding", "sep", "model"),
+                               (dp, pp, sh, sep, mp))
+    _hcg[0] = HybridCommunicateGroup(topo)
+    _strategy[0] = strategy
+    return _hcg[0]
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _hcg[0] is None:
+        init()
+    return _hcg[0]
+
+
+def distributed_model(model):
+    """Wrap per topology (reference fleet/model.py:32)."""
+    hcg = get_hybrid_communicate_group()
+    from ...parallel.pipeline_layer import PipelineLayer
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return model  # pipeline engine drives it
+    if hcg.get_data_parallel_world_size() > 1 and hcg.get_parallel_mode() == "collective":
+        from ..parallel import DataParallel
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap the optimizer for hybrid parallel (reference fleet.py:1427):
+    grad clip across mesh axes is automatic under GSPMD (global-norm reduction
+    spans the whole sharded pytree)."""
+    return optimizer
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()

@@ -1,0 +1,186 @@
+"""Hybrid-parallel topology.
+
+Reference: /root/reference/python/paddle/distributed/fleet/base/topology.py
+(CommunicateTopology :70, HybridCommunicateGroup :189 — the N-D cartesian
+process topology [dp, pp, sharding, sep, mp] with per-axis comm groups and
+p2p prev/next rings).
+
+TPU-native: the topology IS a `ProcessMesh` with those axis names; each
+"comm group" is a mesh axis (see collective.Group). Axis order matters for
+ICI locality: the fastest-varying (last) axes get nearest-neighbor links, so
+we order [dp, pp, sharding, sep, mp] like the reference — mp (heaviest
+traffic) innermost.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..collective import Group, new_group
+from ..env import get_rank
+from ..process_mesh import ProcessMesh, set_mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = int(np.prod(self._dims))
+        self._mesh_arr = np.arange(self._world).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._mesh_arr[coord])
+
+    def get_coord(self, rank):
+        idx = np.argwhere(self._mesh_arr == rank)[0]
+        import collections
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*idx.tolist())
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[ax] = index
+        return sorted(self._mesh_arr[tuple(sl)].reshape(-1).tolist())
+
+    def get_comm_list(self, axis_name):
+        """All groups along `axis_name`: list of rank-lists."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._mesh_arr, ax, -1)
+        return moved.reshape(-1, self._dims[ax]).tolist()
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)._asdict()
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:189. Builds the global ProcessMesh and exposes
+    per-axis groups; also publishes itself as the current mesh so DistTensor
+    APIs pick it up."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = get_rank()
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        # mesh axis names follow auto-parallel convention
+        rename = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                  "sep": "sep", "model": "mp"}
+        self._axis_names = [rename.get(n, n) for n in names]
+        self._mesh = ProcessMesh(np.arange(int(np.prod(dims))).reshape(dims),
+                                 self._axis_names)
+        set_mesh(self._mesh)
+        self._groups = {ax: new_group(axis_name=ax, mesh=self._mesh)
+                        for ax in self._axis_names}
+
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._mp_degree = topology.get_dim("model")
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def get_parallel_mode(self):
+        if self._mp_degree > 1 or self._pp_degree > 1 or self._sharding_degree > 1:
+            return "hybrid_parallel"
+        if self._dp_degree > 1:
+            return "collective"
+        return "single"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # ---- degrees ----
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ---- ranks within axes (single-controller: derived from global_rank) ----
+    def _coord(self):
+        return self._topo.get_coord(self.global_rank)
+
+    def get_data_parallel_rank(self):
+        return self._coord().data
+
+    def get_model_parallel_rank(self):
+        return self._coord().model
+
+    def get_stage_id(self):
+        return self._coord().pipe
+
+    def get_sharding_parallel_rank(self):
+        return self._coord().sharding
+
+    def get_sep_parallel_rank(self):
+        return getattr(self._coord(), "sep", 0)
+
+    # ---- groups ----
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups.get("sep")
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups["mp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # ---- p2p neighbors (pipeline ring) ----
+    def get_p2p_groups(self):
+        return None
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)

@@ -1,0 +1,102 @@
+"""init_parallel_env + DataParallel.
+
+Reference: /root/reference/python/paddle/distributed/parallel.py
+(init_parallel_env :978 — TCPStore rendezvous + ProcessGroupNCCL creation;
+DataParallel :219 — EagerReducer fused bucket allreduce).
+
+TPU-native: rendezvous is `jax.distributed.initialize` (coordination service
+— the TCPStore equivalent); after it, jax.devices() spans all hosts and ONE
+global mesh covers the slice. DataParallel needs no reducer: wrapping a model
+means sharding the batch on the 'dp' axis — under a jitted step XLA inserts
+the gradient reduce-scatter/all-reduce and overlaps it with the backward
+automatically (the EagerReducer's bucketing+overlap, done by the compiler).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+from .collective import Group, _world_group, all_reduce, get_group
+from .env import get_rank, get_world_size
+from .process_mesh import ProcessMesh, get_mesh, init_mesh
+
+__all__ = ["init_parallel_env", "DataParallel", "get_rank", "get_world_size"]
+
+_initialized = [False]
+
+
+def init_parallel_env():
+    """Initialize multi-host SPMD (reference parallel.py:978)."""
+    if _initialized[0]:
+        return get_group(0)
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if master and nnodes > 1 and get_world_size() > 1:
+        port = os.environ.get("MASTER_PORT")
+        addr = master if ":" in master or not port else f"{master}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=get_world_size(),
+            process_id=get_rank(),
+        )
+    if get_mesh() is None:
+        init_mesh([-1], ["world"])
+    os.environ["PADDLE_DIST_INITIALIZED"] = "1"
+    _initialized[0] = True
+    return _world_group()
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel. Under SPMD this is a thin wrapper: the real work
+    (gradient reduction) happens in the compiled train step via GSPMD when
+    batches are sharded on the dp axis; in pure-eager mode `apply_collective_grads`
+    all-reduces grads after backward (reference: reducer.cc semantics)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group or _world_group()
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._grad_sync_enabled
+            self._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                self._grad_sync_enabled = prev
+
+        return ctx()
+
+    def apply_collective_grads(self):
+        """Eager grad sync: average grads across the dp group."""
+        if not self._grad_sync_enabled or self.group.nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p._grad_value is not None:
+                g = Tensor(p._grad_value)
+                if g._dist or isinstance(g._value, jax.Array):
+                    from .collective import ReduceOp
+                    all_reduce(g, op=ReduceOp.AVG, group=self.group)
+                    p._grad_value = g._value
+
+    def scale_loss(self, loss):
+        return loss

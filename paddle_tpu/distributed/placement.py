@@ -1,0 +1,111 @@
+"""Placements: Shard / Replicate / Partial.
+
+Reference: /root/reference/paddle/phi/core/distributed/auto_parallel/placement_types.h
+and python/paddle/distributed/auto_parallel/placement_type.py.
+
+TPU-native mapping: a placements list (one entry per MESH dim) compiles to a
+`jax.sharding.PartitionSpec` (one entry per TENSOR dim). Partial cannot be
+expressed in a NamedSharding — a partial DistTensor physically holds
+per-device unreduced values under a replicated-looking sharding, and every
+transition out of Partial goes through `shard_map` collectives
+(see reshard.py), exactly how GSPMD tracks partial sums internally.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial",
+           "placements_to_spec", "spec_to_placements"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return self.__class__.__name__ + "()"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def placements_to_spec(mesh, placements, ndim: int) -> PartitionSpec:
+    """[per-mesh-dim placements] → PartitionSpec (per-tensor-dim mesh axes).
+    Partial mesh dims contribute nothing to the spec (data looks replicated)."""
+    entries: list = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[mesh_dim]
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = name
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (name,)
+            else:
+                entries[pl.dim] = (cur, name)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(mesh, spec: PartitionSpec, ndim: int):
+    """PartitionSpec → placements (loses Partial, which spec can't express)."""
+    placements = [Replicate() for _ in mesh.dim_names]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[list(mesh.dim_names).index(name)] = Shard(tdim)
+    return placements

@@ -1,0 +1,142 @@
+"""ProcessMesh — the device topology.
+
+Reference: /root/reference/python/paddle/distributed/auto_parallel/process_mesh.py:85
+and phi/core/distributed/auto_parallel/process_mesh.h.
+
+TPU-native: wraps `jax.sharding.Mesh` over the PJRT device array. Device order
+follows jax's topology-aware enumeration, so contiguous mesh dims ride ICI.
+A global "current mesh" is kept so layers can pick it up implicitly
+(reference: auto_parallel/api.py does the same with the default process mesh).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "init_mesh"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = list(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = list(range(int(np.prod(self._shape))))
+            return
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.reshape(-1).tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        devices = _devices_for_ids(self._process_ids)
+        self._jax_mesh = Mesh(np.asarray(devices).reshape(self._shape),
+                              tuple(self._dim_names))
+
+    # ---- paddle API surface ----
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh with `dim_name` first (or a slice at `index`)
+        (reference process_mesh.py:get_mesh_with_dim)."""
+        order = [dim_name] + [d for d in self._dim_names if d != dim_name]
+        perm = [self._dim_names.index(d) for d in order]
+        arr = np.transpose(self.mesh, perm)
+        if index is None:
+            return ProcessMesh(arr, order)
+        sub = arr[index]
+        return ProcessMesh(sub, order[1:])
+
+    def get_submesh_with_dim(self, dim_name):
+        """Split into sub-meshes along `dim_name`, return the one containing
+        the current process (multi-host) or the list (single-controller)."""
+        return self.get_mesh_with_dim(dim_name)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+
+
+def _devices_for_ids(ids):
+    devs = jax.devices()
+    n = len(devs)
+    return [devs[i % n] for i in ids]
+
+
+_state = threading.local()
+_state.stack = []
+_global_mesh = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    """paddle.distributed.auto_parallel.set_mesh equivalent."""
+    global _global_mesh
+    if not isinstance(mesh, ProcessMesh):
+        mesh = ProcessMesh(mesh)
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    stack = getattr(_state, "stack", None)
+    if stack:
+        return stack[-1]
+    return _global_mesh
+
+
+def init_mesh(shape, dim_names) -> ProcessMesh:
+    """Build a mesh over all visible devices with the given logical shape;
+    -1 entries are inferred (like reshape)."""
+    n = jax.device_count()
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    ids = np.arange(int(np.prod(shape))).reshape(shape)
+    mesh = ProcessMesh(ids, dim_names)
+    set_mesh(mesh)
+    return mesh

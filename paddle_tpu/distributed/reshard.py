@@ -1,0 +1,84 @@
+"""Reshard function library.
+
+Reference: /root/reference/paddle/phi/core/distributed/auto_parallel/reshard/
+(15 pair functions: r_to_s, s_to_r, p_to_r, s_to_p, r_to_p, s_to_s, nd-mesh,
+cross-mesh same_status, global↔sub-mesh; registry
+reshard_function_registry.h).
+
+TPU-native collapse: every transition with NO Partial involved is ONE generic
+`jax.device_put` to the target NamedSharding — XLA plans the all-gathers /
+all-to-alls / slices over ICI itself (this replaces r_to_s/s_to_r/s_to_s and
+all their nd-mesh variants). Partial transitions need real collectives and go
+through `shard_map` (check_vma=False, since partial data is physically
+"replicated but unreduced"):
+
+    p → r : psum over the partial mesh axes
+    p → s : psum_scatter (reduce-scatter) when sharding on the same axes
+    r → p : keep value on axis-index 0, zero elsewhere
+    s → p : all_gather then zero-mask (rare; parity with the reference)
+
+Cross-mesh (same_status) and global↔sub-mesh land with the pipeline layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .placement import Partial, Placement, Replicate, Shard, placements_to_spec
+
+__all__ = ["reshard_value", "partial_axes", "shard_map_compat"]
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check=False):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=check)
+
+
+def partial_axes(mesh, placements):
+    return tuple(mesh.dim_names[i] for i, pl in enumerate(placements)
+                 if isinstance(pl, Partial))
+
+
+def _spec(mesh, placements, ndim):
+    return placements_to_spec(mesh, placements, ndim)
+
+
+def reshard_value(value, mesh, src_placements, dst_placements):
+    """jnp array + src/dst placements → resharded jnp array."""
+    jm = mesh.jax_mesh
+    ndim = value.ndim
+    src_p = partial_axes(mesh, src_placements)
+    dst_p = partial_axes(mesh, dst_placements)
+    src_spec = _spec(mesh, src_placements, ndim)
+    dst_spec = _spec(mesh, dst_placements, ndim)
+
+    if not src_p and not dst_p:
+        # generic path: XLA plans the collective program
+        return jax.device_put(value, NamedSharding(jm, dst_spec))
+
+    if src_p and not dst_p:
+        # p_to_r / p_to_s (+ any simultaneous resharding of non-partial dims)
+        def fn(x):
+            return jax.lax.psum(x, src_p)
+
+        out = shard_map_compat(fn, jm, (src_spec,), src_spec)(value)
+        return jax.device_put(out, NamedSharding(jm, dst_spec))
+
+    if not src_p and dst_p:
+        # r_to_p / s_to_p: value survives only on index 0 of the partial axes
+        def fn(x):
+            keep = jnp.ones((), jnp.bool_)
+            for ax in dst_p:
+                keep = jnp.logical_and(keep, jax.lax.axis_index(ax) == 0)
+            return jnp.where(keep, x, jnp.zeros_like(x))
+
+        inter = jax.device_put(value, NamedSharding(jm, dst_spec))
+        return shard_map_compat(fn, jm, (dst_spec,), dst_spec)(inter)
+
+    # p -> p (possibly different non-partial layout): reduce then re-partialize
+    mid = reshard_value(value, mesh, src_placements,
+                        [Replicate() if isinstance(p, Partial) else p
+                         for p in src_placements])
+    return reshard_value(mid, mesh, [Replicate() if isinstance(p, Partial) else p
+                                     for p in src_placements], dst_placements)

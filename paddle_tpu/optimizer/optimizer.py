@@ -25,6 +25,24 @@ from .lr import LRScheduler
 __all__ = ["Optimizer"]
 
 
+def _common_mesh(values):
+    """The multi-device mesh shared by sharded values, if any."""
+    from jax.sharding import NamedSharding
+    for v in values:
+        sh = getattr(v, "sharding", None)
+        if isinstance(sh, NamedSharding) and len(sh.mesh.devices.reshape(-1)) > 1:
+            return sh.mesh
+    return None
+
+
+def _lift_to_mesh(v, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = getattr(v, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+        return v
+    return jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
@@ -150,6 +168,15 @@ class Optimizer:
         p_vals = [p._value for p in params]
         g_vals = [g._value for g in grads]
         states = [self._accumulators[id(p)] for p in params]
+
+        # mixed placements (some params sharded over a mesh, some on one
+        # device) can't enter one jit — lift stragglers to replicated
+        mesh = _common_mesh(p_vals)
+        if mesh is not None:
+            lift = lambda v: _lift_to_mesh(v, mesh)
+            p_vals = [lift(v) for v in p_vals]
+            g_vals = [lift(v) for v in g_vals]
+            states = [{k: lift(v) for k, v in st.items()} for st in states]
 
         if self._eager_step_fn is None:
             def fused(p_list, g_list, s_list, lr, step):

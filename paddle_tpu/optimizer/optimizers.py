@@ -52,10 +52,12 @@ class Adam(Optimizer):
         self._amsgrad = amsgrad
 
     def _init_one(self, p):
-        z = jnp.zeros_like(p, dtype=jnp.float32)
-        st = {"moment1": z, "moment2": z}
+        def z():
+            return jnp.zeros_like(p, dtype=jnp.float32)
+
+        st = {"moment1": z(), "moment2": z()}
         if self._amsgrad:
-            st["moment2_max"] = z
+            st["moment2_max"] = z()
         return st
 
     def _update_one(self, p, g, state, lr, step):
@@ -102,8 +104,8 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def _init_one(self, p):
-        z = jnp.zeros_like(p, dtype=jnp.float32)
-        return {"moment1": z, "moment2": z}
+        return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p, dtype=jnp.float32)}
 
     def _update_one(self, p, g, state, lr, step):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
@@ -151,10 +153,12 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def _init_one(self, p):
-        z = jnp.zeros_like(p, dtype=jnp.float32)
-        st = {"mean_square": z, "momentum": z}
+        def z():
+            return jnp.zeros_like(p, dtype=jnp.float32)
+
+        st = {"mean_square": z(), "momentum": z()}
         if self._centered:
-            st["mean_grad"] = z
+            st["mean_grad"] = z()
         return st
 
     def _update_one(self, p, g, state, lr, step):
@@ -180,8 +184,8 @@ class Adadelta(Optimizer):
         self._epsilon, self._rho = epsilon, rho
 
     def _init_one(self, p):
-        z = jnp.zeros_like(p, dtype=jnp.float32)
-        return {"avg_squared_grad": z, "avg_squared_update": z}
+        return {"avg_squared_grad": jnp.zeros_like(p, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p, dtype=jnp.float32)}
 
     def _update_one(self, p, g, state, lr, step):
         g32 = g.astype(jnp.float32)
@@ -202,8 +206,8 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _init_one(self, p):
-        z = jnp.zeros_like(p, dtype=jnp.float32)
-        return {"moment": z, "inf_norm": z}
+        return {"moment": jnp.zeros_like(p, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p, dtype=jnp.float32)}
 
     def _update_one(self, p, g, state, lr, step):
         g32 = g.astype(jnp.float32)

@@ -1,0 +1,152 @@
+"""Per-reshard-pair tests on an 8-device virtual mesh
+(reference: test/auto_parallel/reshard_{p_to_r,s_to_r,r_to_s,s_to_s,...}.py —
+one file per pair; here one test per pair)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Partial, Replicate, Shard
+
+
+@pytest.fixture
+def mesh1d():
+    return dist.ProcessMesh(np.arange(8), ["x"])
+
+
+@pytest.fixture
+def mesh2d():
+    return dist.ProcessMesh(np.arange(8).reshape(4, 2), ["x", "y"])
+
+
+def _global(t):
+    return np.asarray(dist.unshard_dtensor(t).numpy())
+
+
+class TestShardTensor:
+    def test_r_placement(self, mesh1d):
+        a = np.random.rand(8, 4).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Replicate()])
+        assert d.is_dist()
+        np.testing.assert_allclose(_global(d), a)
+
+    def test_s_placement(self, mesh1d):
+        a = np.random.rand(8, 4).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+        assert d.placements[0].is_shard(0)
+        # each device holds 1 row
+        assert d._value.addressable_shards[0].data.shape == (1, 4)
+        np.testing.assert_allclose(_global(d), a)
+
+    def test_2d_placement(self, mesh2d):
+        a = np.random.rand(8, 6).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh2d, [Shard(0), Shard(1)])
+        assert d._value.addressable_shards[0].data.shape == (2, 3)
+        np.testing.assert_allclose(_global(d), a)
+
+
+class TestReshardPairs:
+    def _roundtrip(self, mesh, src, dst, shape=(8, 4)):
+        a = np.random.rand(*shape).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh, src)
+        out = dist.reshard(d, mesh, dst)
+        return a, out
+
+    def test_r_to_s(self, mesh1d):
+        a, out = self._roundtrip(mesh1d, [Replicate()], [Shard(0)])
+        np.testing.assert_allclose(_global(out), a)
+        assert out._value.addressable_shards[0].data.shape == (1, 4)
+
+    def test_s_to_r(self, mesh1d):
+        a, out = self._roundtrip(mesh1d, [Shard(0)], [Replicate()])
+        np.testing.assert_allclose(_global(out), a)
+        assert out._value.addressable_shards[0].data.shape == (8, 4)
+
+    def test_s_to_s(self, mesh1d):
+        a, out = self._roundtrip(mesh1d, [Shard(0)], [Shard(1)], shape=(8, 8))
+        np.testing.assert_allclose(_global(out), a)
+        assert out._value.addressable_shards[0].data.shape == (8, 1)
+
+    def test_p_to_r(self, mesh1d):
+        # every device contributes the same local value -> sum = 8x
+        a = np.random.rand(4, 4).astype(np.float32)
+        d = dist.dtensor_from_local(pt.to_tensor(a), mesh1d, [Partial()])
+        out = dist.reshard(d, mesh1d, [Replicate()])
+        np.testing.assert_allclose(np.asarray(out.numpy()), a * 8, rtol=1e-5)
+
+    def test_p_to_s(self, mesh1d):
+        a = np.random.rand(8, 4).astype(np.float32)
+        d = dist.dtensor_from_local(pt.to_tensor(a), mesh1d, [Partial()])
+        out = dist.reshard(d, mesh1d, [Shard(0)])
+        np.testing.assert_allclose(_global(out), a * 8, rtol=1e-5)
+        assert out._value.addressable_shards[0].data.shape == (1, 4)
+
+    def test_r_to_p(self, mesh1d):
+        a = np.random.rand(4, 4).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Replicate()])
+        out = dist.reshard(d, mesh1d, [Partial()])
+        # partial->replicate must reproduce the original value
+        back = dist.reshard(out, mesh1d, [Replicate()])
+        np.testing.assert_allclose(np.asarray(back.numpy()), a, rtol=1e-5)
+
+    def test_nd_mesh_mixed(self, mesh2d):
+        a = np.random.rand(8, 6).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh2d, [Shard(0), Replicate()])
+        out = dist.reshard(d, mesh2d, [Replicate(), Shard(1)])
+        np.testing.assert_allclose(_global(out), a)
+
+    def test_nd_partial_axis(self, mesh2d):
+        a = np.random.rand(4, 6).astype(np.float32)
+        d = dist.dtensor_from_local(pt.to_tensor(a), mesh2d, [Partial(), Replicate()])
+        out = dist.reshard(d, mesh2d, [Replicate(), Replicate()])
+        np.testing.assert_allclose(np.asarray(out.numpy()), a * 4, rtol=1e-5)
+
+
+class TestDtensorLocal:
+    def test_from_local_sharded(self, mesh1d):
+        local = np.random.rand(2, 4).astype(np.float32)
+        d = dist.dtensor_from_local(pt.to_tensor(local), mesh1d, [Shard(0)])
+        assert d.shape == [16, 4]
+
+    def test_to_local(self, mesh1d):
+        a = np.random.rand(8, 4).astype(np.float32)
+        d = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+        local = dist.dtensor_to_local(d)
+        assert local.shape == [1, 4]
+
+
+class TestShardLayer:
+    def test_shard_layer_params(self, mesh1d):
+        import paddle_tpu.nn as nn
+        layer = nn.Linear(8, 8)
+
+        def shard_fn(name, sublayer, m):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is not None and p.ndim == 2:
+                    sublayer._parameters[pname] = dist.shard_tensor(p, m, [Shard(1)])
+
+        dist.shard_layer(layer, mesh1d, shard_fn)
+        assert layer.weight.is_dist()
+        assert layer.weight._value.addressable_shards[0].data.shape == (8, 1)
+        # forward still works, output correct
+        x = pt.randn([4, 8])
+        out = layer(x)
+        assert out.shape == [4, 8]
+
+    def test_shard_optimizer_states(self, mesh1d):
+        import paddle_tpu.nn as nn
+        layer = nn.Linear(8, 8)
+        dist.shard_layer(layer, mesh1d,
+                         lambda n, l, m: [l._parameters.__setitem__(
+                             pn, dist.shard_tensor(p, m, [Shard(0)]))
+                             for pn, p in list(l._parameters.items())
+                             if p is not None and p.ndim == 2])
+        opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=layer.parameters())
+        opt = dist.shard_optimizer(opt)
+        x = pt.randn([4, 8])
+        loss = pt.mean(layer(x) ** 2)
+        loss.backward()
+        opt.step()
+        # accumulators inherited the param sharding
+        st = opt._accumulators[id(layer.weight)]
+        assert st["moment1"].sharding.spec == layer.weight._value.sharding.spec
