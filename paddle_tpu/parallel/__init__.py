@@ -1,0 +1,20 @@
+"""paddle_tpu.parallel — hybrid-parallel building blocks.
+
+Reference: /root/reference/python/paddle/distributed/fleet/{layers/mpu,
+meta_parallel}/ (TP/SP/PP layer libraries, D9-D14 in SURVEY.md §2.2).
+TPU-native: every strategy is expressed as shardings over one global mesh —
+XLA inserts/overlaps the collectives the reference hand-codes.
+"""
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .sp_layers import (  # noqa: F401
+    AllGatherOp, ColumnSequenceParallelLinear, GatherOp, ReduceScatterOp,
+    RowSequenceParallelLinear, ScatterOp, mark_as_sequence_parallel_parameter,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .pipeline_layer import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel, pipeline_apply  # noqa: F401
+from .moe import MoELayer, NaiveGate, SwitchGate, GShardGate  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401

@@ -1,0 +1,165 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: /root/reference/python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding :47, ColumnParallelLinear :334, RowParallelLinear :541,
+ParallelCrossEntropy :742) and mp_ops.py (identity/allreduce PyLayers).
+
+TPU-native: the layer OWNS a sharded weight (DistTensor on the 'mp' axis) and
+states its output sharding with `with_sharding_constraint`; XLA GSPMD inserts
+the identity/all-reduce/all-gather pairs the reference implements as manual
+PyLayers — and overlaps them with compute. The same layer works eagerly
+(sharded jax.Arrays execute under computation-follows-sharding).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.engine import apply
+from ..core.tensor import Tensor
+from ..distributed.placement import Replicate, Shard
+from ..distributed.process_mesh import get_mesh
+from ..nn import functional as F
+from ..nn.initializer import XavierUniform
+from ..nn.layer.layers import Layer
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+           "ParallelCrossEntropy"]
+
+
+def _mp_axis(mp_group=None):
+    if mp_group is not None and getattr(mp_group, "axis_name", None):
+        return mp_group.axis_name
+    mesh = get_mesh()
+    if mesh is not None and "mp" in mesh.dim_names:
+        return "mp"
+    if mesh is not None and "tp" in mesh.dim_names:
+        return "tp"
+    return None
+
+
+def _constraint(x, spec_entries):
+    """Apply a sharding constraint when under jit over a mesh; no-op eager."""
+    val = x._value if isinstance(x, Tensor) else x
+    mesh = get_mesh()
+    if mesh is None or not isinstance(val, jax.core.Tracer):
+        return x
+    try:
+        out = jax.lax.with_sharding_constraint(
+            val, NamedSharding(mesh.jax_mesh, P(*spec_entries)))
+    except Exception:
+        return x
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        t._node = x._node
+        return t
+    return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._axis = _mp_axis(mp_group)
+        w = self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr,
+                                  default_initializer=XavierUniform())
+        if self._axis:
+            from ..distributed.api import shard_tensor
+            mesh = get_mesh()
+            placements = [Shard(0) if d == self._axis else Replicate()
+                          for d in mesh.dim_names]
+            w = shard_tensor(w, mesh, placements)
+        self.weight = w
+        self.weight.is_distributed = self._axis is not None
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """W:[in, out] sharded on out (columns) over mp; input replicated; output
+    column-sharded (gather_output=False) or gathered."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.gather_output = gather_output
+        w = self.create_parameter([in_features, out_features], attr=weight_attr,
+                                  default_initializer=XavierUniform())
+        b = self.create_parameter([out_features], attr=None, is_bias=True) if has_bias else None
+        if self._axis:
+            from ..distributed.api import shard_tensor
+            mesh = get_mesh()
+            w = shard_tensor(w, mesh, [Shard(1) if d == self._axis else Replicate()
+                                       for d in mesh.dim_names])
+            if b is not None:
+                b = shard_tensor(b, mesh, [Shard(0) if d == self._axis else Replicate()
+                                           for d in mesh.dim_names])
+        self.weight = w
+        if b is not None:
+            self.bias = b
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self._axis:
+            if self.gather_output:
+                out = _constraint(out, [None] * out.ndim)
+            else:
+                out = _constraint(out, [None] * (out.ndim - 1) + [self._axis])
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W:[in, out] sharded on in (rows) over mp; input row-sharded
+    (input_is_parallel) or auto-scattered; output needs the mp all-reduce,
+    which GSPMD emits from the contraction over a sharded dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.input_is_parallel = input_is_parallel
+        w = self.create_parameter([in_features, out_features], attr=weight_attr,
+                                  default_initializer=XavierUniform())
+        b = self.create_parameter([out_features], attr=None, is_bias=True) if has_bias else None
+        if self._axis:
+            from ..distributed.api import shard_tensor
+            mesh = get_mesh()
+            w = shard_tensor(w, mesh, [Shard(0) if d == self._axis else Replicate()
+                                       for d in mesh.dim_names])
+        self.weight = w
+        self.bias = b if b is not None else None
+
+    def forward(self, x):
+        if self._axis and self.input_is_parallel:
+            x = _constraint(x, [None] * (x.ndim - 1) + [self._axis])
+        out = F.linear(x, self.weight, self.bias)
+        if self._axis:
+            out = _constraint(out, [None] * out.ndim)  # after XLA's all-reduce
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over mp-sharded logits (reference mp_layers.py:742 —
+    the c_softmax_with_cross_entropy op). GSPMD derives the same
+    max/sum-psum pattern from the softmax over a sharded axis."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        from ..tensor.manipulation import unsqueeze
+        return unsqueeze(loss, -1)

@@ -1,0 +1,112 @@
+"""Pipeline-parallel execution over the 'pp' mesh axis.
+
+Reference: /root/reference/python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py (PipelineParallel :255, forward_backward_pipeline :575,
+train_batch :820; p2p pp_utils/p2p_communication.py) and the static schedule
+passes (distributed/passes/pipeline_scheduler_pass/*: FThenB/1F1B/VPP/ZBH1).
+
+TPU-native design (MPMD-in-SPMD): the stage loop is a `lax.scan` inside a
+`shard_map` manual over ONLY the 'pp' axis (dp/tp stay automatic — GSPMD
+keeps sharding them inside each stage). Activations move between neighbor
+stages with `lax.ppermute` — nearest-neighbor ICI hops. One scan step = one
+pipeline tick; M microbatches over S stages take M+S-1 ticks (GPipe/F-then-B;
+autodiff of the scan yields the mirrored backward schedule, and
+`jax.checkpoint` on the stage fn keeps memory at 1F1B level). Zero-bubble
+variants land as alternative schedules in a later round.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..distributed.process_mesh import ProcessMesh, get_mesh
+from ..nn.layer.layers import Layer
+
+__all__ = ["pipeline_apply", "stack_stage_params", "PipelineParallel"]
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: ProcessMesh,
+                   pp_axis: str = "pp", remat: bool = True):
+    """Run the stage-stacked pipeline.
+
+    stage_fn(params_of_one_stage, x) -> y with y.shape == x.shape (a
+    transformer trunk). stacked_params: pytree, leaves [S, ...] (stage-major),
+    ideally already sharded on the pp axis. microbatches: [M, mb, ...].
+    Returns [M, mb, ...] outputs (last stage's results, replicated over pp).
+    """
+    jm = mesh.jax_mesh
+    S = mesh.get_dim_size(pp_axis)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def local_fn(params_local, mbs):
+        params1 = jax.tree.map(lambda p: p[0], params_local)
+        idx = jax.lax.axis_index(pp_axis)
+        M = mbs.shape[0]
+        T = M + S - 1
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+        def body(carry, t):
+            state, out_acc = carry
+            mb_in = jnp.take(mbs, jnp.clip(t, 0, M - 1), axis=0)
+            inp = jnp.where(idx == 0, mb_in, state)
+            y = fn(params1, inp)
+            nxt = jax.lax.ppermute(y, pp_axis, fwd_perm)
+            mb_idx = t - (S - 1)
+            slot = jnp.clip(mb_idx, 0, M - 1)
+            valid = jnp.logical_and(idx == S - 1, mb_idx >= 0)
+            cur = jnp.take(out_acc, slot, axis=0)
+            upd = jnp.where(valid, y, cur)
+            out_acc = jax.lax.dynamic_update_index_in_dim(out_acc, upd, slot, 0)
+            return (nxt, out_acc), None
+
+        state0 = jnp.zeros_like(mbs[0])
+        out0 = jnp.zeros_like(mbs)
+        (_, outs), _ = jax.lax.scan(body, (state0, out0), jnp.arange(T))
+        # broadcast last stage's outputs to all pp ranks
+        mask = (idx == S - 1).astype(outs.dtype)
+        outs = jax.lax.psum(outs * mask, pp_axis)
+        return outs
+
+    in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params), P())
+    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
+                             axis_names=frozenset({pp_axis}), check_vma=False)
+    return shmapped(stacked_params, microbatches)
+
+
+def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp"):
+    """[per-stage param pytrees] → one stage-stacked pytree sharded on pp."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *stage_param_list)
+
+    def place(x):
+        spec = [pp_axis] + [None] * (x.ndim - 1)
+        return jax.device_put(x, NamedSharding(mesh.jax_mesh, P(*spec)))
+
+    return jax.tree.map(place, stacked)
+
+
+class PipelineParallel(Layer):
+    """Dygraph-style engine (reference pipeline_parallel.py:255): wraps a
+    PipelineLayer + optimizer and exposes train_batch(). The whole
+    forward+backward+update compiles into ONE XLA program per step."""
+
+    def __init__(self, layers, hcg=None, strategy=None, num_microbatches=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self.num_microbatches = num_microbatches or (
+            strategy.pipeline_configs.get("accumulate_steps", 1) if strategy else 1)
+        self._step_fn = None
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None, loss_fn=None):
+        """One pipelined training step. data: (inputs, labels) global batch."""
+        raise NotImplementedError(
+            "use models.trainer.Trainer with pipeline='pp' (functional step); "
+            "the imperative train_batch lands with the schedule zoo")

@@ -1,0 +1,93 @@
+"""Recompute / activation checkpointing.
+
+Reference: /root/reference/python/paddle/distributed/fleet/recompute/recompute.py
+(RecomputeFunction PyLayer :124, RNG-state swap :112, non-reentrant :319).
+
+TPU-native: under jit, `jax.checkpoint` (remat) IS recompute — XLA re-executes
+the region in backward, trading FLOPs for HBM. Eagerly, the tape node stores
+only the inputs and re-runs jax.vjp at backward time (no residuals held).
+RNG reproducibility: the region's PRNG key is captured and replayed.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import engine
+from ..core import random as _rng
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute equivalent."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    any_tracer = any(isinstance(a._value, jax.core.Tracer) for a in tensor_args)
+
+    # capture the RNG key the region will consume, so forward and the
+    # backward-time replay draw identical randomness
+    key = _rng.split_key() if preserve_rng_state else _rng.get_rng_state()
+
+    def pure_fn(*vals):
+        with _rng.rng_guard(key):
+            wrapped = []
+            it = iter(vals)
+            for a in args:
+                wrapped.append(Tensor(next(it)) if isinstance(a, Tensor) else a)
+            out = function(*wrapped, **kwargs)
+        return jax.tree.map(lambda t: t._value if isinstance(t, Tensor) else t, out,
+                            is_leaf=lambda x: isinstance(x, Tensor))
+
+    vals = [a._value for a in tensor_args]
+
+    if any_tracer:
+        # functional path: jax.checkpoint tells XLA to rematerialize
+        ck = jax.checkpoint(pure_fn)
+        out_vals = ck(*vals)
+        return jax.tree.map(Tensor, out_vals)
+
+    # eager path: run forward WITHOUT keeping vjp residuals; tape node
+    # recomputes jax.vjp(pure_fn) when the cotangent arrives
+    with engine.no_grad():
+        out_vals = pure_fn(*vals)
+
+    requires = engine.grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+    leaves, treedef = jax.tree.flatten(out_vals)
+    if not requires:
+        return jax.tree.unflatten(treedef, [Tensor(l) for l in leaves])
+
+    class _RecomputeVjp:
+        def __call__(self, cots):
+            _, vjp_fn = jax.vjp(pure_fn, *vals)
+            flat_cots = jax.tree.unflatten(treedef, list(cots))
+            return vjp_fn(flat_cots)
+
+    node = engine.GradNode(_RecomputeVjp(), tensor_args,
+                           [(l.shape, l.dtype) for l in leaves], name="recompute")
+    outs = [Tensor(l, stop_gradient=False, _node=(node, i)) for i, l in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, outs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_sequential — checkpoint a
+    Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+    out = args
+    i = 0
+    while i < len(layers):
+        chunk = layers[i:i + seg_size]
+
+        def seg_fn(*xs, chunk=chunk):
+            y = xs
+            for l in chunk:
+                y = (l(*y),) if not isinstance(y, tuple) else (l(*y),)
+            return y[0]
+
+        out = (recompute(seg_fn, *out, **kwargs),)
+        i += seg_size
+    return out[0]

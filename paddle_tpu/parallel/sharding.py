@@ -1,0 +1,39 @@
+"""Group-sharded (ZeRO) facade.
+
+Reference: /root/reference/python/paddle/distributed/sharding/group_sharded.py
+(group_sharded_parallel: stage os/os_g/p_g_os → GroupShardedStage2/3 +
+GroupShardedOptimizerStage2) and fleet DygraphShardingOptimizer.
+
+TPU-native: ZeRO == placements. Stage 1/2 shard optimizer states (and rely on
+GSPMD reduce-scattering grads into the sharded update inside the compiled
+step); stage 3 shards the parameters themselves (XLA all-gathers at use,
+discards after). See distributed.api.ShardingStage1/2/3 for the placement
+policies; this wraps them in the reference's facade signature.
+"""
+from __future__ import annotations
+
+from ..distributed.api import ShardingStage1, ShardingStage2, ShardingStage3, shard_optimizer
+from ..distributed.process_mesh import get_mesh
+
+__all__ = ["group_sharded_parallel"]
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None, sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3)."""
+    mesh = get_mesh()
+    axis = None
+    if group is not None and hasattr(group, "axis_name"):
+        axis = group.axis_name
+    elif mesh is not None:
+        for cand in ("sharding", "dp"):
+            if cand in mesh.dim_names:
+                axis = cand
+                break
+    stage = {"os": ShardingStage1, "os_g": ShardingStage2, "p_g_os": ShardingStage3}[level]
+    optimizer = shard_optimizer(optimizer, stage(mesh, axis))
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer

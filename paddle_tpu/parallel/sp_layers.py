@@ -1,0 +1,94 @@
+"""Megatron sequence parallelism.
+
+Reference: /root/reference/python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp
+PyLayers :85-127, ColumnSequenceParallelLinear :427, RowSequenceParallelLinear
+:562, SP-param allreduce hooks :192).
+
+TPU-native: "sequence parallel" is a sharding of the ACTIVATION's sequence dim
+on the mp axis between the TP blocks. The Scatter/Gather PyLayers become
+sharding constraints — GSPMD materializes them as the reduce-scatter /
+all-gather pair and fuses them with the adjacent matmuls.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from .mp_layers import ColumnParallelLinear, RowParallelLinear, _constraint, _mp_axis
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter"]
+
+
+def _seq_dim(x):
+    # [B, S, H] convention; scatter/gather act on dim 1 (dim 0 when 2-D)
+    return 1 if x.ndim >= 3 else 0
+
+
+class ScatterOp:
+    """Split along the sequence dim onto the mp axis (reference :85)."""
+
+    @staticmethod
+    def apply(x, axis=None):
+        ax = axis or _mp_axis()
+        if ax is None:
+            return x
+        d = _seq_dim(x)
+        spec = [None] * x.ndim
+        spec[d] = ax
+        return _constraint(x, spec)
+
+
+class GatherOp:
+    """Gather the sequence dim back (reference :104)."""
+
+    @staticmethod
+    def apply(x, axis=None):
+        return _constraint(x, [None] * x.ndim)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference :192 registers an allreduce hook for SP params (LayerNorm
+    weights that see only a sequence shard). Under GSPMD the gradient
+    contraction over the sharded seq dim already produces the psum, so this
+    only tags the param for inspection."""
+    param.sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """all-gather(seq) → column-parallel matmul (reference :427)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr, has_bias,
+                         gather_output, fuse_matmul_bias, mp_group, name)
+
+    def forward(self, x):
+        x = GatherOp.apply(x)  # seq all-gather before the column matmul
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """row-parallel matmul → reduce-scatter(seq) (reference :562)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr, has_bias,
+                         input_is_parallel, fuse_matmul_bias, mp_group, name)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        # reduce-scatter: output sequence dim sharded on mp
+        return ScatterOp.apply(out)
