@@ -1,0 +1,6 @@
+"""paddle_tpu.models — the model zoo (flagship: Llama family)."""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, llama_forward, llama_init_params, llama_loss,
+    shard_llama_params,
+)
+from .trainer import LlamaTrainStep  # noqa: F401

@@ -1,0 +1,437 @@
+"""Llama — the flagship model family.
+
+Reference capability: the reference trains Llama via its auto-parallel engine
+(/root/reference/test/auto_parallel/hybrid_strategy/semi_auto_llama.py, and
+PaddleNLP's LlamaForCausalLM on top of paddle.nn); SURVEY.md §6 sets the
+north-star benchmark (Llama-2 pretrain ≥45% MFU on v5p).
+
+TPU-native design (MaxText-shaped, not a torch translation):
+  * parameters live LAYER-STACKED ([L, ...] leading dim) in a flat dict —
+    one `lax.scan` runs the trunk (O(1) compile time in depth), and the same
+    tree re-chunks into [S, L/S, ...] for pipeline stages;
+  * sharding is declarative: PARAM_RULES maps param name → logical axes, and
+    `logical_to_mesh` resolves them onto whatever mesh axes exist
+    ('dp'/'fsdp'/'pp'/'tp'/'sp'/'ep') — GSPMD inserts all collectives;
+  * attention uses the Pallas flash kernel on TPU (ops/flash_attention),
+    bf16 activations with fp32 RMSNorm/softmax/rope;
+  * activations carry constraints: batch on dp, sequence on sp/tp (Megatron
+    SP), heads on tp.
+The eager `LlamaForCausalLM` Layer wraps the same functions for paddle-style
+use (loss.backward(), generate()).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import dtypes as _dt
+from ..core.engine import apply
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["LlamaConfig", "llama_init_params", "llama_forward", "llama_loss",
+           "LlamaForCausalLM", "shard_llama_params", "llama_param_specs"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    # MoE variant (Mixtral/DeepSeekMoE class)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int | None = None
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+                 max_position_embeddings=128, dtype=jnp.float32)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(**{**dict(hidden_size=4096, intermediate_size=11008,
+                             num_hidden_layers=32, num_attention_heads=32,
+                             num_key_value_heads=32), **kw})
+
+    @classmethod
+    def llama2_13b(cls, **kw):
+        return cls(**{**dict(hidden_size=5120, intermediate_size=13824,
+                             num_hidden_layers=40, num_attention_heads=40,
+                             num_key_value_heads=40), **kw})
+
+
+# logical axis name → candidate mesh axes, first present wins
+# (MaxText-style logical sharding rules)
+LOGICAL_RULES = {
+    "vocab": ("tp", "mp"),
+    "embed": (),                # hidden dim of embeddings: replicated
+    "hidden": (),               # residual stream
+    "heads": ("tp", "mp"),      # attention heads / ffn columns
+    "kv_heads": ("tp", "mp"),
+    "mlp": ("tp", "mp"),
+    "layers": ("pp",),          # only used by the pipeline chunking
+    "fsdp": ("fsdp", "sharding", "dp"),
+    "expert": ("ep", "dp"),
+    "batch": ("dp", "fsdp"),
+    "seq": ("sp", "tp", "mp"),  # sequence (Megatron-SP / context parallel)
+}
+
+# param name → logical axes per dim (leading 'stack' dim for layer-stacked
+# params is added automatically)
+PARAM_RULES = {
+    "embed_tokens": ("vocab", "embed"),
+    "wq": ("fsdp", "heads"),
+    "wk": ("fsdp", "kv_heads"),
+    "wv": ("fsdp", "kv_heads"),
+    "wo": ("heads", "fsdp"),
+    "w_gate": ("fsdp", "mlp"),
+    "w_up": ("fsdp", "mlp"),
+    "w_down": ("mlp", "fsdp"),
+    "ln1": ("embed",),
+    "ln2": ("embed",),
+    "norm": ("embed",),
+    "lm_head": ("embed", "vocab"),
+    # MoE
+    "gate_w": ("embed", None),
+    "moe_w_gate": ("expert", "fsdp", "mlp"),
+    "moe_w_up": ("expert", "fsdp", "mlp"),
+    "moe_w_down": ("expert", "mlp", "fsdp"),
+}
+
+
+def _resolve_axis(logical, mesh_axes):
+    if logical is None:
+        return None
+    for cand in LOGICAL_RULES.get(logical, ()):
+        if cand in mesh_axes:
+            return cand
+    return None
+
+
+def llama_param_specs(config: LlamaConfig, mesh_axes, stacked: bool = True):
+    """name → PartitionSpec (with the [L] stack dim unsharded, or 'pp' for
+    pipeline chunked trees)."""
+    specs = {}
+    per_layer = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln1", "ln2",
+                 "gate_w", "moe_w_gate", "moe_w_up", "moe_w_down"}
+    for name, logical in PARAM_RULES.items():
+        entries = [_resolve_axis(l, mesh_axes) for l in logical]
+        if name in per_layer and stacked:
+            entries = [None] + entries
+        specs[name] = P(*entries)
+    return specs
+
+
+def _act_spec(mesh_axes, kind):
+    """Activation constraint specs: kind ∈ {'btd','bsd_seq','logits'}."""
+    b = _resolve_axis("batch", mesh_axes)
+    s = _resolve_axis("seq", mesh_axes)
+    h = _resolve_axis("heads", mesh_axes)
+    if kind == "btd":
+        return P(b, None, None)
+    if kind == "btd_seq":  # Megatron-SP region
+        return P(b, s, None)
+    if kind == "bthd":
+        return P(b, None, h, None)
+    if kind == "logits":
+        return P(b, None, _resolve_axis("vocab", mesh_axes))
+    return P()
+
+
+def llama_init_params(config: LlamaConfig, key=None, mesh=None):
+    """Initialize the layer-stacked parameter tree (optionally pre-sharded)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    c = config
+    L, D, F, V = c.num_hidden_layers, c.hidden_size, c.intermediate_size, c.vocab_size
+    H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    ks = jax.random.split(key, 16)
+    std = 0.02
+
+    def init(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(c.dtype)
+
+    params = {
+        "embed_tokens": init(ks[0], (V, D)),
+        "wq": init(ks[1], (L, D, H * hd)),
+        "wk": init(ks[2], (L, D, KV * hd)),
+        "wv": init(ks[3], (L, D, KV * hd)),
+        "wo": init(ks[4], (L, H * hd, D)),
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "norm": jnp.ones((D,), jnp.float32),
+    }
+    if c.num_experts > 0:
+        E = c.num_experts
+        Fm = c.moe_intermediate_size or F
+        params["gate_w"] = init(ks[5], (L, D, E)).astype(jnp.float32)
+        params["moe_w_gate"] = init(ks[6], (L, E, D, Fm))
+        params["moe_w_up"] = init(ks[7], (L, E, D, Fm))
+        params["moe_w_down"] = init(ks[8], (L, E, Fm, D))
+    else:
+        params["w_gate"] = init(ks[5], (L, D, F))
+        params["w_up"] = init(ks[6], (L, D, F))
+        params["w_down"] = init(ks[7], (L, F, D))
+    if not c.tie_word_embeddings:
+        params["lm_head"] = init(ks[9], (D, V))
+    if mesh is not None:
+        params = shard_llama_params(params, config, mesh)
+    return params
+
+
+def shard_llama_params(params, config, mesh):
+    jm = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
+    axes = set(jm.axis_names)
+    specs = llama_param_specs(config, axes)
+
+    def place(name, v):
+        spec = specs.get(name)
+        if spec is None:
+            return v
+        # adapt spec for MoE 4-D stacked params ([L, E, ...])
+        entries = list(spec)
+        if name.startswith("moe_") and len(entries) == v.ndim - 1:
+            entries = [None] + entries
+        entries = entries[:v.ndim] + [None] * max(0, v.ndim - len(entries))
+        # drop shardings that don't divide or reuse an axis already used
+        clean, used = [], set()
+        for d, e in enumerate(entries):
+            if e is not None and (e in used or v.shape[d] % jm.shape[e] != 0):
+                e = None
+            if e is not None:
+                used.add(e)
+            clean.append(e)
+        return jax.device_put(v, NamedSharding(jm, P(*clean)))
+
+    return {k: place(k, v) for k, v in params.items()}
+
+
+def _rope(q, k, positions, theta, head_dim):
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?,T,hd/2]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+
+    def rot(x):
+        # x: [B, T, H, hd]; sin/cos: [B, T, hd/2] -> [B, T, 1, hd/2]
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        si = sin[:, :, None, :]
+        co = cos[:, :, None, :]
+        return jnp.concatenate([x1 * co - x2 * si, x2 * co + x1 * si], axis=-1)
+
+    return rot(q).astype(q.dtype), rot(k).astype(k.dtype)
+
+
+def _rmsnorm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v, config, use_flash=True):
+    """q:[B,T,H,hd] k,v:[B,T,KV,hd] causal."""
+    H, KV = config.num_attention_heads, config.num_key_value_heads
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if use_flash:
+        from ..ops.flash_attention import flash_attention_tpu_available, _fa_reference
+        if flash_attention_tpu_available() and q.shape[1] % 128 == 0 \
+                and config.head_dim % 128 == 0:
+            from ..ops.flash_attention import _flash_fwd_bwd
+            return _flash_fwd_bwd(q, k, v, True, min(512, q.shape[1]), min(512, k.shape[1]))
+    scale = 1.0 / math.sqrt(config.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    T, S_ = logits.shape[-2], logits.shape[-1]
+    mask = jnp.tril(jnp.ones((T, S_), bool), k=S_ - T)
+    logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _moe_block(x, gate_w, w_gate, w_up, w_down, config):
+    """x:[B,T,D]; expert weights [E,...]. GShard top-k dense dispatch."""
+    B, T, D = x.shape
+    E, k = config.num_experts, config.num_experts_per_tok
+    tokens = x.reshape(-1, D)
+    n = tokens.shape[0]
+    capacity = max(int(1.25 * n * k / E), 4)
+    logits = (tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)
+    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+    flat = onehot.transpose(1, 0, 2).reshape(-1, E)
+    pos = (jnp.cumsum(flat, axis=0) - flat)
+    pos = jnp.sum(pos * flat, -1).reshape(k, -1).T.astype(jnp.int32)
+    keep = pos < capacity
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity, dtype=jnp.float32)
+    disp = jnp.einsum("tke,tkc->tec", onehot * keep[..., None], pos_oh)
+    comb = jnp.einsum("tk,tke,tkc->tec", gate_vals * keep, onehot, pos_oh)
+    xin = jnp.einsum("tec,td->ecd", disp, tokens.astype(jnp.float32)).astype(x.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, w_gate)) * \
+        jnp.einsum("ecd,edf->ecf", xin, w_up)
+    out_e = jnp.einsum("ecf,efd->ecd", h, w_down)
+    out = jnp.einsum("tec,ecd->td", comb.astype(x.dtype), out_e)
+    aux = jnp.sum(jnp.mean(probs, 0) * jnp.mean(onehot[:, 0, :], 0)) * E
+    return out.reshape(B, T, D), aux
+
+
+def _decoder_layer(x, lp, config, mesh, positions):
+    """One decoder block; lp: this layer's params (no stack dim).
+    `mesh` (a jax Mesh or None) drives activation sharding constraints."""
+    c = config
+    mesh_axes = set(mesh.axis_names) if mesh is not None else set()
+
+    def cst(v, kind):
+        if mesh is not None and isinstance(v, jax.core.Tracer):
+            try:
+                return jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, _act_spec(mesh_axes, kind)))
+            except Exception:
+                return v
+        return v
+
+    x = cst(x, "btd_seq")  # Megatron-SP: residual stream sharded on seq
+    h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
+    B, T, D = h.shape
+    q = (h @ lp["wq"]).reshape(B, T, c.num_attention_heads, c.head_dim)
+    k = (h @ lp["wk"]).reshape(B, T, c.num_key_value_heads, c.head_dim)
+    v = (h @ lp["wv"]).reshape(B, T, c.num_key_value_heads, c.head_dim)
+    q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+    q = cst(q, "bthd")  # heads sharded on tp (attention region: seq gathered)
+    att = _attention(q, k, v, c)
+    x = x + (att.reshape(B, T, -1) @ lp["wo"])
+    x = cst(x, "btd_seq")
+
+    h2 = _rmsnorm(x, lp["ln2"], c.rms_norm_eps)
+    if c.num_experts > 0:
+        moe_out, aux = _moe_block(h2, lp["gate_w"], lp["moe_w_gate"], lp["moe_w_up"],
+                                  lp["moe_w_down"], c)
+        x = x + moe_out
+        return x, aux
+    ff = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
+    x = x + (ff @ lp["w_down"])
+    return x, jnp.zeros((), jnp.float32)
+
+
+def llama_trunk(x, stacked_layer_params, config, mesh=None, positions=None,
+                remat=True):
+    """Scan the decoder stack over layer-stacked params."""
+    if positions is None:
+        positions = jnp.arange(x.shape[1])[None, :].astype(jnp.int32)
+        positions = jnp.broadcast_to(positions, (x.shape[0], x.shape[1]))
+
+    def body(carry, lp):
+        y, aux = _decoder_layer(carry, lp, config, mesh, positions)
+        return y, aux
+
+    fn = jax.checkpoint(body) if remat else body
+    x, auxes = jax.lax.scan(fn, x, stacked_layer_params)
+    return x, jnp.sum(auxes)
+
+
+_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "ln1", "ln2",
+               "gate_w", "moe_w_gate", "moe_w_up", "moe_w_down")
+
+
+def split_layer_params(params):
+    layer = {k: v for k, v in params.items() if k in _LAYER_KEYS}
+    other = {k: v for k, v in params.items() if k not in _LAYER_KEYS}
+    return layer, other
+
+
+def llama_forward(params, tokens, config: LlamaConfig, mesh=None, remat=True):
+    """tokens [B, T] int32 → logits [B, T, V] (compute dtype per config)."""
+    layer_p, other = split_layer_params(params)
+    x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(config.dtype)
+    x, aux = llama_trunk(x, layer_p, config, mesh, remat=remat)
+    x = _rmsnorm(x, other["norm"], config.rms_norm_eps)
+    head = other.get("lm_head")
+    if head is None:
+        head = other["embed_tokens"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return logits, aux
+
+
+def llama_loss(params, tokens, labels, config: LlamaConfig, mesh=None, remat=True,
+               aux_weight=0.01):
+    logits, aux = llama_forward(params, tokens, config, mesh, remat)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if config.num_experts > 0:
+        loss = loss + aux_weight * aux
+    return loss
+
+
+class LlamaForCausalLM(Layer):
+    """Paddle-style eager wrapper over the functional core."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        params = llama_init_params(config)
+        for k, v in params.items():
+            self.add_parameter(k, Parameter(v, name=k))
+
+    def _param_tree(self):
+        return {k: p._value for k, p in self._parameters.items()}
+
+    def forward(self, input_ids, labels=None):
+        cfg = self.config
+
+        def f(*vals):
+            names = list(self._parameters.keys())
+            tree = dict(zip(names, vals[:-1])) if labels is None else \
+                dict(zip(names, vals[:-2]))
+            if labels is None:
+                logits, _ = llama_forward(tree, vals[-1], cfg, remat=False)
+                return logits
+            return llama_loss(tree, vals[-2], vals[-1], cfg, remat=False)
+
+        plist = list(self._parameters.values())
+        if labels is None:
+            return apply(f, *plist, input_ids, name="llama")
+        return apply(f, *plist, input_ids, labels, name="llama")
+
+    @jax.profiler.annotate_function
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0):
+        """Greedy/sampled decode (KV-cache decode path lands with the
+        inference milestone; this recomputes the prefix)."""
+        from ..core import random as _rng
+        toks = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        params = self._param_tree()
+        for _ in range(max_new_tokens):
+            logits, _ = llama_forward(params, toks, self.config, remat=False)
+            last = logits[:, -1, :]
+            if temperature > 0:
+                nxt = jax.random.categorical(_rng.split_key(), last / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            toks = jnp.concatenate([toks, nxt[:, None].astype(toks.dtype)], axis=1)
+        return Tensor(toks)

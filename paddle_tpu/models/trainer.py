@@ -1,0 +1,134 @@
+"""Sharded training step builder for the model zoo.
+
+This is the user-facing analog of the reference's auto-parallel engine
+(`Engine._parallel_pir`, SURVEY.md §3.5): given a mesh and a config it emits
+ONE jitted SPMD program containing forward, backward, optimizer update —
+with parameter/optimizer buffers donated, bf16 compute, remat, and:
+  * dp/fsdp: batch sharded, ZeRO via param/opt-state placements
+  * tp/sp: Megatron shardings from llama PARAM_RULES + activation constraints
+  * pp: the trunk runs through parallel.pipeline_apply (shard_map over 'pp')
+  * ep: MoE expert dim sharded (XLA all-to-alls)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..distributed.process_mesh import ProcessMesh
+from ..optimizer import AdamW, Optimizer
+from . import llama as L
+
+__all__ = ["LlamaTrainStep"]
+
+
+class LlamaTrainStep:
+    """step = LlamaTrainStep(config, mesh, optimizer); loss = step(tokens, labels)"""
+
+    def __init__(self, config: L.LlamaConfig, mesh: ProcessMesh | None = None,
+                 optimizer: Optimizer | None = None, num_microbatches: int = 1,
+                 remat: bool = True, seed: int = 0):
+        self.config = config
+        self.mesh = mesh
+        self.optimizer = optimizer or AdamW(learning_rate=3e-4, weight_decay=0.1)
+        self.num_microbatches = num_microbatches
+        self.remat = remat
+        jm = mesh.jax_mesh if mesh is not None else None
+        self._jm = jm
+
+        params = L.llama_init_params(config, jax.random.PRNGKey(seed), mesh=mesh)
+        self._params = params
+        self._opt_state = self.optimizer.init_state(params)
+        self._step_i = 0
+
+        use_pp = jm is not None and "pp" in jm.axis_names and jm.shape["pp"] > 1
+        self.use_pp = use_pp
+
+        cfg, opt, mb, do_remat = config, self.optimizer, num_microbatches, remat
+
+        if not use_pp:
+            def loss_fn(p, tokens, labels):
+                return L.llama_loss(p, tokens, labels, cfg, mesh=jm, remat=do_remat)
+        else:
+            S = jm.shape["pp"]
+            assert config.num_hidden_layers % S == 0, "layers % pp != 0"
+            assert mb >= 1
+            Lps = config.num_hidden_layers // S
+            from ..parallel.pipeline_parallel import pipeline_apply
+
+            def loss_fn(p, tokens, labels):
+                layer_p, other = L.split_layer_params(p)
+                # [L, ...] -> [S, L/S, ...], stage-major, sharded on pp
+                chunked = jax.tree.map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        v.reshape((S, Lps) + v.shape[1:]),
+                        NamedSharding(jm, P("pp"))),
+                    layer_p)
+                x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(cfg.dtype)
+                B = x.shape[0]
+                assert B % mb == 0, "batch % microbatches != 0"
+                mbs = x.reshape((mb, B // mb) + x.shape[1:])
+                positions = jnp.arange(x.shape[1])[None, :].astype(jnp.int32)
+                positions = jnp.broadcast_to(positions, (B // mb, x.shape[1]))
+
+                def stage_fn(sp, act):
+                    def body(carry, lp):
+                        y, aux = L._decoder_layer(carry, lp, cfg, None, positions)
+                        return y, aux
+
+                    body_fn = jax.checkpoint(body) if do_remat else body
+                    out, _ = jax.lax.scan(body_fn, act, sp)
+                    return out
+
+                outs = pipeline_apply(stage_fn, chunked, mbs, mesh, "pp",
+                                      remat=False)
+                x = outs.reshape((B,) + outs.shape[2:])
+                x = L._rmsnorm(x, other["norm"], cfg.rms_norm_eps)
+                head = other.get("lm_head")
+                if head is None:
+                    head = other["embed_tokens"].T
+                logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                                         axis=-1)[..., 0]
+                mask = (labels >= 0).astype(jnp.float32)
+                return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        def step_fn(p, opt_state, tokens, labels, lr, step_i):
+            loss, grads = jax.value_and_grad(loss_fn)(p, tokens, labels)
+            new_p, new_s = opt.apply_gradients(grads, p, opt_state, lr=lr, step=step_i)
+            return loss, new_p, new_s
+
+        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def data_sharding(self, ndim=2):
+        if self._jm is None:
+            return None
+        axes = set(self._jm.axis_names)
+        b = L._resolve_axis("batch", axes)
+        return NamedSharding(self._jm, P(b, *([None] * (ndim - 1))))
+
+    def __call__(self, tokens, labels):
+        if hasattr(tokens, "_value"):
+            tokens = tokens._value
+        if hasattr(labels, "_value"):
+            labels = labels._value
+        tokens = jnp.asarray(tokens, jnp.int32)
+        labels = jnp.asarray(labels, jnp.int32)
+        if self._jm is not None:
+            sh = self.data_sharding(tokens.ndim)
+            tokens = jax.device_put(tokens, sh)
+            labels = jax.device_put(labels, sh)
+        self._step_i += 1
+        loss, self._params, self._opt_state = self._jitted(
+            self._params, self._opt_state, tokens, labels,
+            jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step_i))
+        return loss
+
+    @property
+    def params(self):
+        return self._params
