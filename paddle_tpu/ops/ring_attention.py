@@ -1,0 +1,155 @@
+"""Ring attention — context parallelism over the sequence dimension.
+
+Reference capability: ABSENT in the reference snapshot (SURVEY.md D27: no
+ring/Ulysses/context-parallel — only the 'sep' topology axis and Megatron-SP
+scaffolding). This fills that gap TPU-natively, following the Ring Attention
+pattern (Liu et al.) mapped to ICI:
+
+  * q/k/v are sharded on the sequence dim over a mesh axis ('sep'/'cp'/'sp');
+  * inside `shard_map`, each step computes one (q-block × kv-block) tile with
+    ONLINE-SOFTMAX accumulation (m, l, acc), then `ppermute`s the kv block to
+    the ring neighbor — compute overlaps the ICI transfer;
+  * causal blocks that are fully masked are skipped by zero-masking (XLA
+    still schedules the ring hop, keeping the schedule static);
+  * fully differentiable (autodiff through scan+ppermute), with
+    `jax.checkpoint` on the tile so backward recomputes per-block.
+
+Also exports `ulysses_attention`: the all-to-all head-scatter alternative
+(DeepSpeed-Ulysses style) — seq-sharded → head-sharded → full attention →
+back, two all_to_alls on ICI.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.engine import apply
+from ..core.tensor import Tensor
+
+__all__ = ["ring_attention", "ulysses_attention", "ring_attention_local"]
+
+
+def _tile(q, k, v, q_off, k_off, causal, scale):
+    """One attention tile in fp32: returns (acc, m, l) contributions.
+    q:[B,Tq,H,D] k,v:[B,Tk,H,D]; offsets are global token offsets."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])
+        kpos = k_off + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B,H,Tq]
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return acc, m_safe, l, jnp.isfinite(m)
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
+                         remat: bool = True):
+    """The shard_map-local body: q/k/v are LOCAL seq blocks [B, Tl, H, D];
+    runs the ring over `axis_name`. Returns local output block."""
+    S = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    Tl = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    perm = [(i, (i + 1) % S) for i in range(S)]  # kv travels forward
+
+    def step(carry, t):
+        kb, vb, acc, m, l, seen = carry
+        src = (idx - t) % S  # whose kv block we currently hold
+        a_t, m_t, l_t, valid = _tile(q, kb, vb, idx * Tl, src * Tl, causal, scale)
+        # online merge
+        m_new = jnp.maximum(m, m_t)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_t - m_new)
+        has = valid  # [B,H,Tq]: row has any unmasked key in this tile
+        alpha = jnp.where(seen, alpha, 0.0)
+        beta = jnp.where(has, beta, 0.0)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
+            a_t * beta.transpose(0, 2, 1)[..., None]
+        l = l * alpha + l_t * beta
+        m = jnp.where(has | seen, m_new, m)
+        seen = seen | has
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, acc, m, l, seen), None
+
+    step_fn = jax.checkpoint(step) if remat else step
+    B, _, H, D = q.shape
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    seen0 = jnp.zeros((B, H, Tl), bool)
+    (_, _, acc, m, l, _), _ = jax.lax.scan(step_fn, (k, v, acc0, m0, l0, seen0),
+                                           jnp.arange(S))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(query, key, value, mesh=None, seq_axis: str = "sep",
+                   causal: bool = False):
+    """Global [B, T, H, D] tensors (seq sharded or shardable on `seq_axis`) →
+    attention output with the same sharding. Eager DistTensors and jit both."""
+    from ..distributed.process_mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    jm = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
+    spec = P(None, seq_axis)
+
+    def f(q, k, v):
+        local = jax.shard_map(
+            functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal),
+            mesh=jm, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names=frozenset({seq_axis}), check_vma=False)
+        return local(q, k, v)
+
+    return apply(f, query, key, value, name="flash_attention")
+
+
+def ulysses_attention(query, key, value, mesh=None, seq_axis: str = "sep",
+                      causal: bool = False):
+    """DeepSpeed-Ulysses style: all-to-all seq→heads, full attention locally,
+    all-to-all back. Needs num_heads % axis_size == 0."""
+    from ..distributed.process_mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    jm = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
+    spec = P(None, seq_axis)
+
+    def local_fn(q, k, v):
+        # [B, Tl, H, D] -> all_to_all -> [B, T, H/S, D]
+        def scatter_heads(x):
+            return jax.lax.all_to_all(x, seq_axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        def gather_seq(x):
+            return jax.lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        scale = 1.0 / math.sqrt(qh.shape[-1])
+        s = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * scale
+        if causal:
+            T = s.shape[-1]
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+        return gather_seq(out.astype(q.dtype))
+
+    def f(q, k, v):
+        return jax.shard_map(local_fn, mesh=jm, in_specs=(spec, spec, spec),
+                             out_specs=spec, axis_names=frozenset({seq_axis}),
+                             check_vma=False)(q, k, v)
+
+    return apply(f, query, key, value, name="flash_attention")
