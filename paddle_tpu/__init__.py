@@ -38,6 +38,10 @@ from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import framework  # noqa: F401
+from . import vision  # noqa: F401
+from . import hapi  # noqa: F401
+from . import models  # noqa: F401
+from .hapi import Model  # noqa: F401
 from .framework import (  # noqa: F401
     save, load, set_device, get_device, device_count, is_compiled_with_cuda,
     is_compiled_with_xpu, is_compiled_with_rocm, in_dynamic_mode, CPUPlace,

@@ -1,0 +1,169 @@
+"""paddle.Model — the high-level fit/evaluate/predict API.
+
+Reference: /root/reference/python/paddle/hapi/model.py:1472 (Model: prepare,
+fit, evaluate, predict, save/load, callbacks).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.engine import no_grad
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+
+    # ---------------- core steps ----------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*inputs)
+        losses = self._loss(*(_to_list(outputs) + labels)) if self._loss else outputs
+        total = losses if isinstance(losses, Tensor) else sum(_to_list(losses))
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(l.numpy()) for l in _to_list(losses)], metrics) if metrics \
+            else [float(l.numpy()) for l in _to_list(losses)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with no_grad():
+            outputs = self.network(*inputs)
+            losses = self._loss(*(_to_list(outputs) + labels)) if self._loss else outputs
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(l.numpy()) for l in _to_list(losses)], metrics) if metrics \
+            else [float(l.numpy()) for l in _to_list(losses)]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with no_grad():
+            out = self.network(*_to_list(inputs))
+        return [o.numpy() for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        for m in self._metrics:
+            inp = _to_list(outputs) + labels
+            correct = m.compute(*inp)
+            res.append(m.update(correct))
+        return res
+
+    # ---------------- loops ----------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
+            num_workers=num_workers)
+        cbks = CallbackList(_to_list(callbacks) or
+                            ([ProgBarLogger(log_freq, verbose)] if verbose else []))
+        cbks.set_model(self)
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+                inputs, labels = batch[:-1], batch[-1:]
+                logs = {"step": step}
+                cbks.on_train_batch_begin(step, logs)
+                out = self.train_batch(inputs, labels,
+                                       update=(it + 1) % accumulate_grad_batches == 0)
+                loss_vals = out[0] if isinstance(out, tuple) else out
+                logs["loss"] = loss_vals
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            cbks.on_epoch_end(epoch, {})
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+            out = self.eval_batch(batch[:-1], batch[-1:])
+            loss_vals = out[0] if isinstance(out, tuple) else out
+            losses.append(loss_vals)
+        result = {"loss": list(np.mean(np.asarray(losses), axis=0))}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+            outs.append(self.predict_batch(batch[:1]))
+        if stack_outputs:
+            n_out = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n_out)]
+        return outs
+
+    # ---------------- persistence ----------------
+    def save(self, path, training=True):
+        from ..framework import save
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

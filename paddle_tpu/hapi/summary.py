@@ -1,0 +1,27 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    print("-" * (width + 40))
+    print(f"{'Param':<{width}}{'Shape':<24}{'Count':>12}")
+    print("-" * (width + 40))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    print("-" * (width + 40))
+    print(f"Total params: {total:,}  Trainable: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

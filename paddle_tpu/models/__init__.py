@@ -4,3 +4,5 @@ from .llama import (  # noqa: F401
     shard_llama_params,
 )
 from .trainer import LlamaTrainStep  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .bert import BertConfig, BertForPretraining, BertForSequenceClassification, BertModel  # noqa: F401

@@ -1,0 +1,123 @@
+"""BERT/ERNIE-class encoder (reference capability: ERNIE-3.0 fine-tune is a
+BASELINE.md config; built on the reference's nn.TransformerEncoder)."""
+from __future__ import annotations
+
+import dataclasses
+
+import paddle_tpu as pt
+from ..nn import (Dropout, Embedding, Layer, LayerNorm, Linear, Tanh,
+                  TransformerEncoder, TransformerEncoderLayer)
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForPretraining"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                 num_attention_heads=2, intermediate_size=64,
+                 max_position_embeddings=64, hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)
+        d.update(kw)
+        return cls(**d)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings, c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size, c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        t = input_ids.shape[1]
+        pos = pt.arange(0, t, dtype="int64").unsqueeze([0])
+        if token_type_ids is None:
+            token_type_ids = pt.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids) + self.position_embeddings(pos)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob,
+            layer_norm_eps=c.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, c.num_hidden_layers)
+        self.pooler_dense = Linear(c.hidden_size, c.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # [B, T] 1/0 -> additive [B, 1, 1, T]
+            mask = (1.0 - attention_mask.astype("float32")) * -1e4
+            mask = mask.unsqueeze([1, 2])
+        seq = self.encoder(x, src_mask=mask)
+        pooled = self.pooler_act(self.pooler_dense(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class BertForPretraining(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_dense = Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.nsp = Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                mlm_labels=None, nsp_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_dense(seq)))
+        from ..tensor.manipulation import t_
+        mlm_logits = F.linear(h, t_(self.bert.embeddings.word_embeddings.weight))
+        nsp_logits = self.nsp(pooled)
+        if mlm_labels is not None:
+            loss = F.cross_entropy(mlm_logits.reshape([-1, self.config.vocab_size]),
+                                   mlm_labels.reshape([-1]), ignore_index=-100)
+            if nsp_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+            return loss
+        return mlm_logits, nsp_logits

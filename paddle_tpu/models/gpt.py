@@ -1,0 +1,106 @@
+"""GPT-2/3 class decoder-only LM on the nn.Layer stack
+(reference capability: PaddleNLP GPT on the reference's nn; exercises
+TransformerDecoder-style blocks, learned positions, pre-LN)."""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import paddle_tpu as pt
+from ..core.tensor import Tensor
+from ..nn import (Dropout, Embedding, GELU, Layer, LayerList, LayerNorm, Linear)
+from ..nn import functional as F
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                 num_attention_heads=2, intermediate_size=64,
+                 max_position_embeddings=64, hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)
+        d.update(kw)
+        return cls(**d)
+
+
+class GPTBlock(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+        self.ln_2 = LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+        self.c = c
+        h = c.hidden_size
+        self.qkv = Linear(h, 3 * h)
+        self.proj = Linear(h, h)
+        self.fc_in = Linear(h, c.intermediate_size)
+        self.fc_out = Linear(c.intermediate_size, h)
+        self.act = GELU(approximate=True)
+        self.drop = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, x):
+        c = self.c
+        b, t, h = x.shape
+        nh = c.num_attention_heads
+        qkv = self.qkv(self.ln_1(x)).reshape([b, t, 3, nh, h // nh])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=c.attention_probs_dropout_prob,
+            training=self.training)
+        x = x + self.drop(self.proj(att.reshape([b, t, h])))
+        x = x + self.drop(self.fc_out(self.act(self.fc_in(self.ln_2(x)))))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.wte = Embedding(c.vocab_size, c.hidden_size)
+        self.wpe = Embedding(c.max_position_embeddings, c.hidden_size)
+        self.drop = Dropout(c.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(c) for _ in range(c.num_hidden_layers)])
+        self.ln_f = LayerNorm(c.hidden_size, c.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        t = input_ids.shape[1]
+        pos = pt.arange(0, t, dtype="int64").unsqueeze([0])
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        logits = F.linear(hidden, _tied_head(self.gpt.wte.weight))
+        if labels is not None:
+            loss = F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
+                                   labels.reshape([-1]))
+            return loss
+        return logits
+
+
+def _tied_head(embed_weight):
+    from ..tensor.manipulation import t_
+    return t_(embed_weight)

@@ -1,0 +1,79 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/). Zero-egress
+image: synthetic in-memory datasets for pipelines/tests; file-backed loaders
+accept pre-downloaded archives."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["FakeData", "MNIST", "Cifar10"]
+
+
+class FakeData(Dataset):
+    """Synthetic classification dataset (deterministic per index)."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class _ArrayDataset(Dataset):
+    def __init__(self, images, labels, transform=None):
+        self.images = images
+        self.labels = labels
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], np.int64(self.labels[idx])
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(_ArrayDataset):
+    """Loads from a local .npz (keys: x_train/y_train/x_test/y_test) — no
+    download in a zero-egress build; falls back to synthetic data."""
+
+    def __init__(self, image_path=None, mode="train", transform=None, download=False):
+        if image_path:
+            d = np.load(image_path)
+            x = d[f"x_{mode}"].astype(np.float32)
+            y = d[f"y_{mode}"]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1024 if mode == "train" else 256
+            x = rng.rand(n, 28, 28).astype(np.float32)
+            y = rng.randint(0, 10, n)
+        super().__init__(x, y, transform)
+
+
+class Cifar10(_ArrayDataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False):
+        if data_file:
+            d = np.load(data_file)
+            x = d[f"x_{mode}"].astype(np.float32)
+            y = d[f"y_{mode}"]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1024 if mode == "train" else 256
+            x = rng.rand(n, 3, 32, 32).astype(np.float32)
+            y = rng.randint(0, 10, n)
+        super().__init__(x, y, transform)

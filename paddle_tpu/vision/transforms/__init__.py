@@ -1,0 +1,159 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) — numpy
+host-side pipeline (composes with DataLoader prefetch threads)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img, dtype=np.float32)
+        if a.max() > 1.5:
+            a = a / 255.0
+        if a.ndim == 2:
+            a = a[..., None]
+        if self.data_format == "CHW":
+            a = np.transpose(a, (2, 0, 1))
+        import paddle_tpu as pt
+        return pt.to_tensor(a)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        import paddle_tpu as pt
+        a = img.numpy() if hasattr(img, "numpy") else np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        a = (a - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return pt.to_tensor(a.astype(np.float32)) if hasattr(img, "numpy") else a
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def __call__(self, img):
+        a = np.asarray(img, np.float32)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        if chw:
+            a = np.transpose(a, (1, 2, 0))
+        import jax
+        import jax.numpy as jnp
+        out = np.asarray(jax.image.resize(jnp.asarray(a), self.size + a.shape[2:],
+                                          method="bilinear"))
+        if chw:
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        h, w = (a.shape[1], a.shape[2]) if a.shape[0] in (1, 3) and a.ndim == 3 \
+            else (a.shape[0], a.shape[1])
+        th, tw = self.size
+        i, j = max((h - th) // 2, 0), max((w - tw) // 2, 0)
+        if a.ndim == 3 and a.shape[0] in (1, 3):
+            return a[:, i:i + th, j:j + tw]
+        return a[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        if self.padding:
+            pads = [(0, 0), (self.padding, self.padding), (self.padding, self.padding)] \
+                if chw else [(self.padding, self.padding)] * 2 + [(0, 0)] * (a.ndim - 2)
+            a = np.pad(a, pads)
+        h, w = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        if chw:
+            return a[:, i:i + th, j:j + tw]
+        return a[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() < self.prob:
+            return a[..., ::-1].copy() if a.ndim == 3 and a.shape[0] in (1, 3) \
+                else a[:, ::-1].copy()
+        return a
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() < self.prob:
+            return a[:, ::-1].copy() if a.ndim == 3 and a.shape[0] in (1, 3) \
+                else a[::-1].copy()
+        return a
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        a = np.asarray(img, np.float32)
+        factor = 1.0 + np.random.uniform(-self.value, self.value)
+        return np.clip(a * factor, 0, 255 if a.max() > 1.5 else 1.0)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        p = self.padding
+        if a.ndim == 3 and a.shape[0] in (1, 3):
+            return np.pad(a, [(0, 0), (p, p), (p, p)])
+        return np.pad(a, [(p, p), (p, p)] + [(0, 0)] * (a.ndim - 2))

@@ -1,0 +1,88 @@
+"""Model zoo + hapi Model tests (reference: test/legacy_test model tests +
+hapi tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+class TestResNet:
+    def test_resnet18_forward_backward(self):
+        from paddle_tpu.vision.models import resnet18
+        net = resnet18(num_classes=10)
+        x = pt.randn([2, 3, 32, 32])
+        out = net(x)
+        assert out.shape == [2, 10]
+        loss = pt.mean(out ** 2)
+        loss.backward()
+        assert net.conv1.weight._grad_value is not None
+
+    def test_bn_running_stats_update(self):
+        from paddle_tpu.vision.models import resnet18
+        net = resnet18(num_classes=4)
+        net.train()
+        before = net.bn1._mean.numpy().copy()
+        _ = net(pt.randn([2, 3, 32, 32]))
+        after = net.bn1._mean.numpy()
+        assert not np.allclose(before, after)
+
+
+class TestGPTBert:
+    def test_gpt_loss_backward(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        toks = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+        loss = model(toks, labels=toks)
+        loss.backward()
+        assert loss.size == 1
+        assert model.gpt.wte.weight._grad_value is not None
+
+    def test_bert_classification(self):
+        from paddle_tpu.models import BertConfig, BertForSequenceClassification
+        cfg = BertConfig.tiny()
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        toks = pt.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+        labels = pt.to_tensor(np.array([0, 2]))
+        loss = model(toks, labels=labels)
+        loss.backward()
+        logits = model(toks)
+        assert logits.shape == [2, 3]
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict(self, tmp_path):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Flatten(), nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        model = pt.Model(net)
+        opt = pt.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), pt.metric.Accuracy())
+
+        x = pt.to_tensor(np.random.rand(64, 16).astype(np.float32))
+        y = pt.to_tensor(np.random.randint(0, 4, (64,)))
+        ds = TensorDataset([x, y])
+        model.fit(ds, batch_size=16, epochs=2, verbose=0)
+        res = model.evaluate(ds, batch_size=16, verbose=0)
+        assert "acc" in res and "loss" in res
+        preds = model.predict(ds, batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 4)
+        model.save(str(tmp_path / "ckpt"))
+        model.load(str(tmp_path / "ckpt"))
+
+    def test_fit_learns(self):
+        import paddle_tpu.nn as nn
+        pt.seed(0)
+        w_true = np.random.rand(8, 1).astype(np.float32)
+        x = np.random.rand(256, 8).astype(np.float32)
+        y = x @ w_true
+        net = nn.Linear(8, 1)
+        model = pt.Model(net)
+        model.prepare(pt.optimizer.Adam(learning_rate=5e-2,
+                                        parameters=net.parameters()),
+                      nn.MSELoss())
+        ds = TensorDataset([pt.to_tensor(x), pt.to_tensor(y)])
+        model.fit(ds, batch_size=64, epochs=30, verbose=0)
+        res = model.evaluate(ds, batch_size=64, verbose=0)
+        assert res["loss"][0] < 0.1
