@@ -21,6 +21,8 @@ from .collective import (  # noqa: F401
 )
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
 
 
 class auto_parallel:

@@ -1,0 +1,3 @@
+from .save_state_dict import save_state_dict  # noqa: F401
+from .load_state_dict import load_state_dict  # noqa: F401
+from .metadata import Metadata, LocalTensorMetadata  # noqa: F401

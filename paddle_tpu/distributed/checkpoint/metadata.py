@@ -1,0 +1,46 @@
+"""Checkpoint metadata (reference:
+/root/reference/python/paddle/distributed/checkpoint/metadata.py —
+LocalTensorMetadata/LocalTensorIndex/Metadata describing which global offsets
+each stored shard covers, enabling cross-topology re-sharded load)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class LocalTensorMetadata:
+    global_offset: tuple
+    local_shape: tuple
+    dtype: str
+
+
+@dataclasses.dataclass
+class Metadata:
+    """name → list of (file, LocalTensorMetadata) describing all stored shards."""
+    state_dict_metadata: dict = dataclasses.field(default_factory=dict)
+    storage_metadata: dict = dataclasses.field(default_factory=dict)
+    flat_mapping: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self):
+        return {
+            "state_dict_metadata": {
+                k: [dataclasses.asdict(m) for m in v]
+                for k, v in self.state_dict_metadata.items()
+            },
+            "storage_metadata": self.storage_metadata,
+            "flat_mapping": self.flat_mapping,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            state_dict_metadata={
+                k: [LocalTensorMetadata(tuple(m["global_offset"]),
+                                        tuple(m["local_shape"]), m["dtype"])
+                    for m in v]
+                for k, v in d.get("state_dict_metadata", {}).items()
+            },
+            storage_metadata=d.get("storage_metadata", {}),
+            flat_mapping=d.get("flat_mapping", {}),
+        )

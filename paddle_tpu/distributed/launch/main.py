@@ -1,0 +1,120 @@
+"""python -m paddle_tpu.distributed.launch — multi-host process launcher.
+
+Reference: /root/reference/python/paddle/distributed/launch/main.py:23 +
+controllers/ (pod build, env contract PADDLE_TRAINER_ID/_ENDPOINTS/_MASTER,
+watch/restart loop, master KV server or etcd).
+
+TPU-native: on TPU pods there is ONE process per host (SPMD single-controller)
+and the rendezvous is JAX's coordination service — so the launcher's job is:
+set the env contract, start the local trainer process(es), supervise
+(restart-on-failure, the reference's ControllerBase.watch), and on multi-host
+point everyone at the coordinator. CPU multi-process simulation (`--nproc`)
+spawns N local ranks for the multi-node-shaped tests (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["main", "launch"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator address host:port")
+    p.add_argument("--nnodes", type=int, default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", "-1")))
+    p.add_argument("--nproc_per_node", "--nproc", type=int, default=1,
+                   help="local processes (1 on TPU hosts; N for CPU simulation)")
+    p.add_argument("--devices", default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="restart budget on non-zero exit (elastic-lite)")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(args, local_rank: int, world: int, base_rank: int):
+    env = dict(os.environ)
+    rank = base_rank + local_rank
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_JOB_ID": args.job_id,
+    })
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        host, _, port = args.master.partition(":")
+        env.setdefault("MASTER_ADDR", host)
+        if port:
+            env.setdefault("MASTER_PORT", port)
+    if args.nproc_per_node > 1:
+        # CPU simulation: give each rank its own virtual device set
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+    stdout = stderr = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "ab")
+        stderr = subprocess.STDOUT
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    node_rank = args.rank if args.rank >= 0 else 0
+    world = args.nnodes * args.nproc_per_node
+    base = node_rank * args.nproc_per_node
+
+    restarts = 0
+    while True:
+        procs = [_spawn(args, i, world, base) for i in range(args.nproc_per_node)]
+
+        def kill_all(*_):
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+        signal.signal(signal.SIGTERM, kill_all)
+        # supervision loop (reference controller.py:87 watch)
+        failed = None
+        while True:
+            alive = 0
+            for p in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0 and failed is None:
+                    failed = rc
+            if failed is not None:
+                kill_all()
+                break
+            if alive == 0:
+                return 0
+            time.sleep(0.5)
+        if restarts < args.max_restarts:
+            restarts += 1
+            print(f"[launch] rank failed (exit {failed}); restart "
+                  f"{restarts}/{args.max_restarts}", file=sys.stderr)
+            continue
+        return failed or 1
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,469 @@
+"""paddle_tpu.distribution (reference: /root/reference/python/paddle/distribution/
+— ~9k LoC of probability distributions). Math delegated to jax.scipy; sampling
+uses the global splittable PRNG."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _rng
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Gamma", "Dirichlet", "Exponential", "Laplace", "LogNormal",
+           "Gumbel", "Multinomial", "Geometric", "Cauchy", "StudentT", "Poisson",
+           "Binomial", "kl_divergence", "register_kl"]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        from ..tensor.math import exp
+        return exp(lp)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        z = jax.random.normal(_rng.split_key(), shp)
+        return Tensor(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                      + jnp.zeros(self.batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=(), seed=0):
+        return Tensor(jnp.exp(super().sample(shape)._value))
+
+    def log_prob(self, value):
+        v = _v(value)
+        logv = jnp.log(v)
+        base = super().log_prob(Tensor(logv))._value
+        return Tensor(base - logv)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_rng.split_key(), shp)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v <= self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None and probs is None:
+            self.logits = _v(logits)
+        else:
+            self.logits = jnp.log(jnp.maximum(_v(probs if probs is not None else logits), 1e-38))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(_rng.split_key(), self.logits,
+                                             shape=shp).astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _v(probs)
+            self.logits = jnp.log(self.probs_ / (1 - self.probs_))
+        else:
+            self.logits = _v(logits)
+            self.probs_ = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(_rng.split_key(), self.probs_, shp)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log(jnp.maximum(self.probs_, 1e-38))
+                      + (1 - v) * jnp.log(jnp.maximum(1 - self.probs_, 1e-38)))
+
+    def entropy(self):
+        p = self.probs_
+        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-38))
+                        + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-38))))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(_rng.split_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import betaln
+        return Tensor((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(_rng.split_key(), self.concentration, shp) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_rng.split_key(), self.concentration, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
+                      + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(_rng.split_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(_rng.split_key(), shp))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(_rng.split_key(), shp))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + float(np.euler_gamma))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=(), seed=0):
+        n = self.total_count
+        logits = jnp.log(jnp.maximum(self.probs_, 1e-38))
+        shp = tuple(shape) + self.batch_shape
+        draws = jax.random.categorical(_rng.split_key(), logits,
+                                       shape=(n,) + shp)
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        logp = jnp.log(jnp.maximum(self.probs_, 1e-38))
+        return Tensor(gammaln(v.sum(-1) + 1) - jnp.sum(gammaln(v + 1), -1)
+                      + jnp.sum(v * logp, -1))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_rng.split_key(), shp)
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(_rng.split_key(), shp))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _v(df)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.t(_rng.split_key(), self.df, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        z = (_v(value) - self.loc) / self.scale
+        d = self.df
+        return Tensor(gammaln((d + 1) / 2) - gammaln(d / 2)
+                      - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                      - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.poisson(_rng.split_key(), self.rate, shp)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _v(total_count)
+        self.probs_ = _v(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape, self.probs_.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.binomial(_rng.split_key(), self.total_count,
+                                          self.probs_, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        n, p = self.total_count, self.probs_
+        return Tensor(gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                      + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+# ---------------- KL registry ----------------
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (pc, qc), f in _KL_REGISTRY.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(f"KL({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return Tensor(jnp.log(q.scale / p.scale) + (var_p + (p.loc - q.loc) ** 2)
+                  / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp, qq = p.probs_, q.probs_
+    return Tensor(pp * (jnp.log(jnp.maximum(pp, 1e-38)) - jnp.log(jnp.maximum(qq, 1e-38)))
+                  + (1 - pp) * (jnp.log(jnp.maximum(1 - pp, 1e-38))
+                                - jnp.log(jnp.maximum(1 - qq, 1e-38))))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return Tensor(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1)

@@ -1,0 +1,207 @@
+"""paddle_tpu.profiler.
+
+Reference: /root/reference/python/paddle/profiler/profiler.py:358 (Profiler
+with scheduler windows, chrome-trace export via the C++ host/CUPTI tracers —
+SURVEY.md §5.1).
+
+TPU-native: device tracing is jax.profiler (XPlane → TensorBoard/Perfetto);
+`RecordEvent` ≈ jax.profiler.TraceAnnotation; the host-side event recorder is
+a light python timer tree for summary() tables. The chrome-trace file comes
+from jax's trace dump (perfetto-compatible).
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import threading
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Window scheduler (reference profiler.py make_scheduler)."""
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        period = closed + ready + record
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period if period else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+_events = threading.local()
+
+
+def _tree():
+    if not hasattr(_events, "stack"):
+        _events.stack = []
+        _events.totals = defaultdict(lambda: [0.0, 0])
+    return _events
+
+
+class RecordEvent:
+    """Host-side scoped event: feeds summary() and annotates the device trace
+    (reference phi/api/profiler/event_tracing.h RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        tls = _tree()
+        tls.stack.append((self.name, time.perf_counter()))
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+        tls = _tree()
+        name, t0 = tls.stack.pop()
+        tot = tls.totals[name]
+        tot[0] += time.perf_counter() - t0
+        tot[1] += 1
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo)
+        else:
+            self._scheduler = None
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._export_dir = None
+        self._step = 0
+        self._tracing = False
+        self._trace_dir = None
+        self._step_times = []
+        self._t_last = None
+
+    def start(self):
+        self._t_last = time.perf_counter()
+        if not self._timer_only:
+            self._maybe_transition(first=True)
+
+    def stop(self):
+        self._stop_trace()
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+        if self._export_dir and self._trace_dir is None:
+            pass
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+        self._step += 1
+        if not self._timer_only:
+            self._maybe_transition()
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        arr = np.asarray(self._step_times[-10:])
+        return (f"avg step: {arr.mean() * 1e3:.2f} ms "
+                f"(min {arr.min() * 1e3:.2f}, max {arr.max() * 1e3:.2f})")
+
+    def _maybe_transition(self, first=False):
+        if self._scheduler is None:
+            if first:
+                self._start_trace()
+            return
+        state = self._scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+        else:
+            self._stop_trace()
+
+    def _start_trace(self):
+        if not self._tracing:
+            self._trace_dir = self._export_dir or os.environ.get(
+                "PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_trace")
+            try:
+                jax.profiler.start_trace(self._trace_dir)
+                self._tracing = True
+            except Exception:
+                self._tracing = False
+
+    def _stop_trace(self):
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        tls = _tree()
+        if not tls.totals:
+            print("(no host events recorded — wrap regions in profiler.RecordEvent)")
+            return
+        rows = sorted(tls.totals.items(), key=lambda kv: -kv[1][0])
+        print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}")
+        for name, (tot, calls) in rows:
+            print(f"{name:<40}{calls:>8}{tot * 1e3:>12.3f}{tot / calls * 1e3:>12.3f}")
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("open the XPlane/perfetto trace in TensorBoard")

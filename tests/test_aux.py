@@ -1,0 +1,150 @@
+"""Aux subsystem tests: sharded checkpoint (incl. cross-topology load),
+launcher CLI, profiler, flags, distributions, save/load."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Replicate, Shard
+
+
+class TestShardedCheckpoint:
+    def test_save_load_roundtrip_sharded(self, tmp_path):
+        mesh = dist.ProcessMesh(np.arange(8), ["x"])
+        a = np.random.rand(16, 4).astype(np.float32)
+        t = dist.shard_tensor(pt.to_tensor(a), mesh, [Shard(0)])
+        sd = {"w": t}
+        dist.checkpoint.save_state_dict(sd, str(tmp_path))
+        assert (tmp_path / "metadata.json").exists()
+
+        target = dist.shard_tensor(pt.zeros([16, 4]), mesh, [Shard(0)])
+        out = {"w": target}
+        dist.checkpoint.load_state_dict(out, str(tmp_path))
+        np.testing.assert_allclose(
+            np.asarray(dist.unshard_dtensor(out["w"]).numpy()), a)
+
+    def test_cross_topology_load(self, tmp_path):
+        # save sharded on x(8), load sharded on 2D mesh with different placement
+        mesh1 = dist.ProcessMesh(np.arange(8), ["x"])
+        a = np.random.rand(8, 8).astype(np.float32)
+        sd = {"w": dist.shard_tensor(pt.to_tensor(a), mesh1, [Shard(0)])}
+        dist.checkpoint.save_state_dict(sd, str(tmp_path))
+
+        mesh2 = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["a", "b"])
+        tgt = {"w": dist.shard_tensor(pt.zeros([8, 8]), mesh2, [Replicate(), Shard(1)])}
+        dist.checkpoint.load_state_dict(tgt, str(tmp_path))
+        np.testing.assert_allclose(
+            np.asarray(dist.unshard_dtensor(tgt["w"]).numpy()), a)
+
+    def test_async_save(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint.save_state_dict import wait_async_save
+        sd = {"v": pt.to_tensor(np.arange(8, dtype=np.float32))}
+        dist.checkpoint.save_state_dict(sd, str(tmp_path), async_save=True)
+        wait_async_save()
+        out = {"v": pt.zeros([8])}
+        dist.checkpoint.load_state_dict(out, str(tmp_path))
+        np.testing.assert_allclose(out["v"].numpy(), np.arange(8))
+
+
+class TestLauncher:
+    def test_launch_two_ranks(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "world = os.environ['PADDLE_TRAINERS_NUM']\n"
+            "print(f'rank {rank}/{world}')\n")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", str(script)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        out = r.stdout
+        assert "rank 0/2" in out and "rank 1/2" in out
+
+    def test_launch_restart_budget(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--max_restarts", "1", str(script)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd="/root/repo")
+        assert r.returncode == 3
+        assert "restart 1/1" in r.stderr
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self, capsys):
+        import paddle_tpu.profiler as prof
+        with prof.RecordEvent("matmul_region"):
+            _ = pt.matmul(pt.randn([32, 32]), pt.randn([32, 32]))
+        p = prof.Profiler(timer_only=True)
+        p.start()
+        for _ in range(3):
+            p.step()
+        p.stop()
+        assert "avg step" in p.step_info()
+        p.summary()
+        assert "matmul_region" in capsys.readouterr().out
+
+    def test_scheduler_windows(self):
+        import paddle_tpu.profiler as prof
+        sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(4)]
+        assert states[0] == prof.ProfilerState.CLOSED
+        assert states[1] == prof.ProfilerState.READY
+        assert states[3] == prof.ProfilerState.RECORD_AND_RETURN
+
+
+class TestFlags:
+    def test_get_set_flags(self):
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        assert pt.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestDistributions:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+        d = Normal(0.0, 1.0)
+        s = d.sample([1000])
+        assert abs(float(s.numpy().mean())) < 0.2
+        lp = d.log_prob(pt.to_tensor(0.0))
+        np.testing.assert_allclose(float(lp.numpy()), -0.5 * np.log(2 * np.pi),
+                                   rtol=1e-5)
+
+    def test_categorical_kl(self):
+        from paddle_tpu.distribution import Categorical, kl_divergence
+        p = Categorical(logits=np.array([1.0, 2.0, 3.0], np.float32))
+        q = Categorical(logits=np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(float(kl_divergence(p, q).numpy()), 0.0, atol=1e-6)
+
+    def test_beta_gamma(self):
+        from paddle_tpu.distribution import Beta, Gamma
+        b = Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(b.mean.numpy()), 0.4, rtol=1e-5)
+        g = Gamma(2.0, 2.0)
+        s = g.sample([2000])
+        assert abs(float(s.numpy().mean()) - 1.0) < 0.15
+
+
+class TestSaveLoad:
+    def test_paddle_save_load(self, tmp_path):
+        obj = {"w": pt.randn([3, 3]), "step": 7, "nested": {"b": pt.ones([2])}}
+        p = str(tmp_path / "model.pdparams")
+        pt.save(obj, p)
+        loaded = pt.load(p)
+        assert loaded["step"] == 7
+        np.testing.assert_allclose(loaded["w"].numpy(), obj["w"].numpy())
+        np.testing.assert_allclose(loaded["nested"]["b"].numpy(), [1, 1])
