@@ -1,0 +1,188 @@
+// Native data-pipeline core.
+//
+// Reference capability: the reference's C++ data feeding stack
+// (/root/reference/paddle/fluid/framework/data_feed.cc — multi-threaded
+// channel-based feeders; io/dataloader C++ workers). TPU-native design: LLM
+// pretraining wants packed token batches [B, T+1] sliced from a memory-mapped
+// token file at memory bandwidth, overlapped with device compute. This
+// module:
+//   * mmaps a token corpus (uint16 or int32 tokens),
+//   * runs N producer threads cutting random (seeded, reproducible) windows,
+//   * fills a lock-protected ring of pre-allocated batch buffers,
+//   * hands buffers to Python zero-copy via ctypes (int32 out).
+//
+// Exposed C ABI (ctypes): ptdf_open / ptdf_next / ptdf_close / ptdf_len.
+// Build: make -C paddle_tpu/io/native  (g++ -O3 -shared -fPIC).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Batch {
+  std::vector<int32_t> data;  // [B, T+1]
+};
+
+struct Loader {
+  // mmap state
+  int fd = -1;
+  void* map = nullptr;
+  size_t file_bytes = 0;
+  size_t n_tokens = 0;
+  int token_bytes = 2;  // 2 = uint16, 4 = int32
+
+  // config
+  int64_t batch = 0;
+  int64_t seqlen = 0;  // returns seqlen+1 tokens per row
+  uint64_t seed = 0;
+
+  // ring of ready batches
+  std::queue<Batch*> ready;
+  std::queue<Batch*> free_list;
+  std::vector<Batch> pool;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> counter{0};
+
+  ~Loader() {
+    stop.store(true);
+    cv_free.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers) {
+      if (t.joinable()) t.join();
+    }
+    if (map && map != MAP_FAILED) munmap(map, file_bytes);
+    if (fd >= 0) close(fd);
+  }
+
+  inline int32_t token_at(size_t i) const {
+    if (token_bytes == 2) {
+      return static_cast<int32_t>(
+          reinterpret_cast<const uint16_t*>(map)[i]);
+    }
+    return reinterpret_cast<const int32_t*>(map)[i];
+  }
+
+  void produce() {
+    while (!stop.load()) {
+      Batch* b = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] { return stop.load() || !free_list.empty(); });
+        if (stop.load()) return;
+        b = free_list.front();
+        free_list.pop();
+      }
+      const uint64_t idx = counter.fetch_add(1);
+      // one deterministic RNG stream per batch index (reproducible under any
+      // thread schedule — the reference's per-worker seeds are schedule-
+      // dependent)
+      std::mt19937_64 rng(seed * 0x9E3779B97F4A7C15ULL + idx);
+      const size_t row = static_cast<size_t>(seqlen) + 1;
+      const size_t max_start = n_tokens > row ? n_tokens - row : 0;
+      std::uniform_int_distribution<size_t> dist(0, max_start);
+      for (int64_t r = 0; r < batch; ++r) {
+        const size_t start = dist(rng);
+        int32_t* out = b->data.data() + r * row;
+        if (token_bytes == 4) {
+          std::memcpy(out, reinterpret_cast<const int32_t*>(map) + start,
+                      row * sizeof(int32_t));
+        } else {
+          const uint16_t* src = reinterpret_cast<const uint16_t*>(map) + start;
+          for (size_t i = 0; i < row; ++i) out[i] = src[i];
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready.push(b);
+      }
+      cv_ready.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptdf_open(const char* path, int64_t batch, int64_t seqlen,
+                uint64_t seed, int token_bytes, int n_threads, int ring) {
+  auto* L = new Loader();
+  L->fd = ::open(path, O_RDONLY);
+  if (L->fd < 0) {
+    delete L;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(L->fd, &st) != 0 || st.st_size <= 0) {
+    delete L;
+    return nullptr;
+  }
+  L->file_bytes = static_cast<size_t>(st.st_size);
+  L->token_bytes = token_bytes == 4 ? 4 : 2;
+  L->n_tokens = L->file_bytes / L->token_bytes;
+  L->map = mmap(nullptr, L->file_bytes, PROT_READ, MAP_PRIVATE, L->fd, 0);
+  if (L->map == MAP_FAILED) {
+    delete L;
+    return nullptr;
+  }
+  madvise(L->map, L->file_bytes, MADV_RANDOM);
+  L->batch = batch;
+  L->seqlen = seqlen;
+  L->seed = seed;
+
+  if (ring < 2) ring = 2;
+  L->pool.resize(ring);
+  for (auto& b : L->pool) {
+    b.data.resize(static_cast<size_t>(batch) * (seqlen + 1));
+    L->free_list.push(&b);
+  }
+  if (n_threads < 1) n_threads = 1;
+  for (int i = 0; i < n_threads; ++i) {
+    L->workers.emplace_back([L] { L->produce(); });
+  }
+  return L;
+}
+
+// Copies the next ready batch into out[B * (T+1)] (int32). Returns 0 on
+// success, -1 when closed.
+int ptdf_next(void* handle, int32_t* out) {
+  auto* L = static_cast<Loader*>(handle);
+  Batch* b = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_ready.wait(lk, [&] { return L->stop.load() || !L->ready.empty(); });
+    if (L->stop.load() && L->ready.empty()) return -1;
+    b = L->ready.front();
+    L->ready.pop();
+  }
+  std::memcpy(out, b->data.data(), b->data.size() * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_list.push(b);
+  }
+  L->cv_free.notify_one();
+  return 0;
+}
+
+int64_t ptdf_len(void* handle) {
+  return static_cast<int64_t>(static_cast<Loader*>(handle)->n_tokens);
+}
+
+void ptdf_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"
