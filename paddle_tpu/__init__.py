@@ -41,6 +41,14 @@ from . import framework  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from . import models  # noqa: F401
+from . import fft  # noqa: F401
+from . import static  # noqa: F401
+from . import inference  # noqa: F401
+from . import incubate  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import profiler  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework import (  # noqa: F401
     save, load, set_device, get_device, device_count, is_compiled_with_cuda,

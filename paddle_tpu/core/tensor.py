@@ -412,3 +412,21 @@ def _param_unflatten(aux, children):
 
 jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
 jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
+
+# jax.export (save_inference_model) must serialize PyTreeDefs containing
+# Tensors; aux data is (stop_gradient, name) / (name, trainable)
+try:
+    jax.export.register_pytree_node_serialization(
+        Tensor,
+        serialized_name="paddle_tpu.Tensor",
+        serialize_auxdata=lambda aux: repr(aux).encode(),
+        deserialize_auxdata=lambda b: eval(b.decode()),  # noqa: S307 (own repr)
+    )
+    jax.export.register_pytree_node_serialization(
+        Parameter,
+        serialized_name="paddle_tpu.Parameter",
+        serialize_auxdata=lambda aux: repr(aux).encode(),
+        deserialize_auxdata=lambda b: eval(b.decode()),  # noqa: S307
+    )
+except (AttributeError, Exception):
+    pass

@@ -1,0 +1,88 @@
+"""paddle.fft (reference: /root/reference/python/paddle/fft.py — ~1.6k LoC of
+wrappers over phi fft kernels; here jnp.fft → XLA's FFT)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.engine import apply
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return norm if norm in ("forward", "ortho") else "backward"
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply(lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(int(n), d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(int(n), d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x, name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x, name="fftshift")

@@ -1,0 +1,66 @@
+"""incubate.asp — 2:4 structured sparsity (reference: python/paddle/incubate/asp/
+— mask calculation + optimizer decoration; Ampere-specific kernels have no TPU
+analog, so masks are applied as elementwise multiply which XLA fuses)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+_excluded: set = set()
+_masks: dict = {}
+
+
+def calculate_density(x):
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float((a != 0).sum() / a.size)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """2:4 mask: keep the n largest-|w| of every m consecutive weights."""
+    a = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    flat = np.abs(a).reshape(-1, m)
+    order = np.argsort(-flat, axis=1)
+    mask = np.zeros_like(flat)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = 1.0
+    return Tensor(mask.reshape(a.shape).astype(a.dtype))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to all eligible weights in place."""
+    for name, p in model.named_parameters():
+        if name in _excluded or p.ndim < 2 or p.shape[-1] % m != 0:
+            continue
+        mask = create_mask(p, mask_algo, n, m)
+        p.set_value(p._value * mask._value)
+        _masks[name] = mask
+    return _masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after the update (the reference's
+    OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        params = optimizer._parameter_list or []
+        for p in params:
+            mask = _masks.get(p.name)
+            if mask is not None:
+                p.set_value(p._value * mask._value)
+
+    optimizer.step = step
+    return optimizer

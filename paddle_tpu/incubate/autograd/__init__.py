@@ -1,0 +1,110 @@
+"""incubate.autograd — higher-order AD (reference: python/paddle/incubate/
+autograd/: Jacobian/Hessian, jvp/vjp, prim decomposition). Delegates to jax's
+native transforms, which ARE the primitive system the reference builds
+(fluid/primitive + decomposition)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim", "disable_prim",
+           "prim_enabled", "forward_grad", "grad"]
+
+
+def _wrap_fn(func):
+    def pure(*vals):
+        args = [Tensor(v) for v in vals]
+        out = func(*args)
+        return jax.tree.map(lambda t: t._value if isinstance(t, Tensor) else t,
+                            out, is_leaf=lambda x: isinstance(x, Tensor))
+
+    return pure
+
+
+def _vals(xs):
+    if isinstance(xs, Tensor):
+        return (xs._value,), True
+    return tuple(x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in xs), False
+
+
+def jvp(func, xs, v=None):
+    vals, single = _vals(xs)
+    tangents, _ = _vals(v) if v is not None else (tuple(jnp.ones_like(a) for a in vals), single)
+    out, out_tangent = jax.jvp(_wrap_fn(func), vals, tangents)
+    return jax.tree.map(Tensor, out), jax.tree.map(Tensor, out_tangent)
+
+
+def vjp(func, xs, v=None):
+    vals, single = _vals(xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *vals)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        cot, _ = _vals(v)
+        cot = cot[0] if not isinstance(out, tuple) else cot
+    grads = vjp_fn(cot)
+    grads_t = [Tensor(g) for g in grads]
+    return jax.tree.map(Tensor, out), (grads_t[0] if single else grads_t)
+
+
+class Jacobian:
+    """Reference incubate/autograd/functional.py Jacobian — lazy full matrix."""
+
+    def __init__(self, func, xs, is_batched=False):
+        vals, self._single = _vals(xs)
+        fn = _wrap_fn(func)
+        if is_batched:
+            jac = jax.vmap(jax.jacrev(lambda *a: fn(*a)))(*vals)
+        else:
+            jac = jax.jacrev(fn)(*vals) if len(vals) > 1 else jax.jacrev(fn)(vals[0])
+        self._jac = jac
+
+    def __getitem__(self, idx):
+        j = self._jac
+        if isinstance(j, (tuple, list)):
+            j = j[0]
+        return Tensor(jnp.asarray(j)[idx])
+
+    @property
+    def shape(self):
+        j = self._jac[0] if isinstance(self._jac, (tuple, list)) else self._jac
+        return list(j.shape)
+
+    def numpy(self):
+        j = self._jac[0] if isinstance(self._jac, (tuple, list)) else self._jac
+        return np.asarray(j)
+
+
+class Hessian(Jacobian):
+    def __init__(self, func, xs, is_batched=False):
+        vals, self._single = _vals(xs)
+        fn = _wrap_fn(func)
+        h = jax.hessian(fn)(vals[0]) if len(vals) == 1 else jax.hessian(fn)(*vals)
+        self._jac = h
+
+
+_prim = [False]
+
+
+def enable_prim():
+    _prim[0] = True
+
+
+def disable_prim():
+    _prim[0] = False
+
+
+def prim_enabled():
+    return _prim[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    raise NotImplementedError("use incubate.autograd.jvp")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ...autograd import grad as _g
+    return _g(outputs, inputs, grad_outputs)

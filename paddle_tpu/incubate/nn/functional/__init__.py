@@ -1,0 +1,105 @@
+"""incubate.nn.functional — fused functional ops."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.engine import apply
+from ....nn import functional as F
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+           "fused_linear", "fused_bias_act", "swiglu", "fused_dropout_add",
+           "flash_attention", "fused_linear_activation"]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=None, **kw):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=1, **kw):
+    return F.layer_norm(x, x.shape[begin_norm_axis:], norm_weight, norm_bias, epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py;
+    SPMD rule spmd_rules/fused_rope.cc. Layout [B, T, H, D]."""
+    import math
+
+    def rope(x, sin_v, cos_v):
+        def f(a, s, c):
+            if use_neox_rotary_style:
+                a1, a2 = jnp.split(a.astype(jnp.float32), 2, axis=-1)
+                s_ = s[:, :, None, :a1.shape[-1]]
+                c_ = c[:, :, None, :a1.shape[-1]]
+                return jnp.concatenate([a1 * c_ - a2 * s_, a2 * c_ + a1 * s_],
+                                       axis=-1).astype(a.dtype)
+            a_even = a[..., 0::2].astype(jnp.float32)
+            a_odd = a[..., 1::2].astype(jnp.float32)
+            s_ = s[:, :, None, ::2]
+            c_ = c[:, :, None, ::2]
+            out = jnp.stack([a_even * c_ - a_odd * s_, a_odd * c_ + a_even * s_],
+                            axis=-1)
+            return out.reshape(a.shape).astype(a.dtype)
+
+        return apply(f, x, sin_v, cos_v, name="fused_rope")
+
+    if sin is None or cos is None:
+        t = q.shape[1]
+        d = q.shape[-1]
+        freqs = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        pos = jnp.arange(t, dtype=jnp.float32)
+        ang = pos[:, None] * freqs[None, :]
+        full = jnp.concatenate([ang, ang], axis=-1)
+        from ....core.tensor import Tensor
+        sin = Tensor(jnp.sin(full)[None])
+        cos = Tensor(jnp.cos(full)[None])
+
+    outs = [rope(q, sin, cos)]
+    if k is not None:
+        outs.append(rope(k, sin, cos))
+    if v is not None:
+        outs.append(v)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ....tensor.manipulation import t_
+        weight = t_(weight)
+    return F.linear(x, weight, bias)
+
+
+fused_linear_activation = fused_linear
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    return getattr(F, act_method)(x)
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply(f, x, name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, **kw):
+    return F.flash_attention(query, key, value, dropout=dropout, causal=causal,
+                             return_softmax=return_softmax)

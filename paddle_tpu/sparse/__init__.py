@@ -1,0 +1,89 @@
+"""paddle_tpu.sparse (reference: /root/reference/python/paddle/sparse/ — COO/CSR
+tensors + sparse kernels). TPU-native: jax.experimental.sparse BCOO (XLA has
+no CSR TPU kernels; BCOO ops lower to gather/scatter/segment-sum)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseTensor", "matmul",
+           "add", "multiply", "relu", "to_dense"]
+
+
+class SparseTensor(Tensor):
+    """COO tensor wrapping jax BCOO; .to_dense()/.values()/.indices() as the
+    reference (phi SparseCooTensor)."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+
+    @property
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense(), stop_gradient=self.stop_gradient)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = np.asarray(indices._value if isinstance(indices, Tensor) else indices)
+    val = np.asarray(values._value if isinstance(values, Tensor) else values)
+    b = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx.T)),
+                     shape=tuple(shape) if shape else tuple(idx.max(1) + 1))
+    return SparseTensor(b, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    vals = np.asarray(values._value if isinstance(values, Tensor) else values)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), vals, shape,
+                             stop_gradient=stop_gradient)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseTensor) else x
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseTensor):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(x._bcoo @ yv)
+    return Tensor((x._value if isinstance(x, Tensor) else x) @ to_dense(y)._value)
+
+
+def add(x, y, name=None):
+    return Tensor(to_dense(x)._value + to_dense(y)._value)
+
+
+def multiply(x, y, name=None):
+    return Tensor(to_dense(x)._value * to_dense(y)._value)
+
+
+def relu(x, name=None):
+    if isinstance(x, SparseTensor):
+        b = jsparse.BCOO((jax.nn.relu(x._bcoo.data), x._bcoo.indices),
+                         shape=x._bcoo.shape)
+        return SparseTensor(b)
+    return Tensor(jax.nn.relu(x._value))

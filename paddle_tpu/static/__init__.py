@@ -1,0 +1,193 @@
+"""paddle_tpu.static — static-graph compatibility layer.
+
+Reference: /root/reference/python/paddle/static/ (Program/Executor/
+program_guard, save/load_inference_model, static.nn).
+
+TPU-native redesign: there is no separate ProgramDesc/PIR program object —
+"static mode" IS a traced, compiled function (jax.jit of the same eager ops;
+see paddle_tpu.jit). This module keeps the reference's *workflow* API:
+  * InputSpec declares abstract inputs,
+  * Executor.run compiles-and-runs a python callable ("program") with feeds,
+  * save/load_inference_model serialize via jax.export (StableHLO bytes) +
+    params — the analog of the reference's inference Program + AnalysisConfig.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dt
+from ..core.tensor import Tensor
+
+__all__ = ["InputSpec", "Program", "default_main_program", "default_startup_program",
+           "program_guard", "Executor", "data", "save_inference_model",
+           "load_inference_model", "name_scope", "py_func", "nn"]
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in (shape or ()))
+        self.dtype = _dt.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), tensor.dtype, name or tensor.name)
+
+    def to_abstract(self, batch=1):
+        shape = tuple(batch if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+
+class Program:
+    """A captured callable + its input specs (replaces ProgramDesc/PIR
+    Program: the executable artifact is XLA's, not ours)."""
+
+    def __init__(self, fn: Callable | None = None, input_specs=None):
+        self.fn = fn
+        self.input_specs = list(input_specs or [])
+        self._feed_names = [s.name for s in self.input_specs]
+        self._fetch = None
+
+    def clone(self, for_test=False):
+        return Program(self.fn, self.input_specs)
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return f"Program(fn={getattr(self.fn, '__name__', None)}, inputs={self._feed_names})"
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev = (_main_program, _startup_program)
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declares a program input (returns its InputSpec; in trace-based static
+    mode the "variable" is just the spec)."""
+    spec = InputSpec(shape, dtype, name)
+    _main_program.input_specs.append(spec)
+    _main_program._feed_names.append(name)
+    return spec
+
+
+class Executor:
+    """Reference: python/paddle/base/executor.py:1234. run() jit-compiles the
+    program's callable against the feed shapes (cached) and executes."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program or _main_program
+        feed = feed or {}
+        if program.fn is None:
+            raise ValueError("Program has no callable; build one with "
+                             "paddle_tpu.jit.to_static or Program(fn=...)")
+        names = [s.name for s in program.input_specs] or list(feed.keys())
+        args = tuple(jnp.asarray(np.asarray(feed[n])) for n in names)
+        key = (id(program), tuple((a.shape, str(a.dtype)) for a in args))
+        if key not in self._cache:
+            self._cache[key] = jax.jit(program.fn)
+        out = self._cache[key](*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        outs = [o._value if isinstance(o, Tensor) else o for o in outs]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize a compiled inference function: StableHLO via jax.export +
+    pickled params. Reference: static/io.py save_inference_model."""
+    program = program or _main_program
+    if program.fn is None:
+        raise ValueError("no program callable to export")
+    specs = feed_vars if feed_vars and isinstance(feed_vars[0], InputSpec) \
+        else program.input_specs
+    abstract = [s.to_abstract() for s in specs]
+    exported = jax.export.export(jax.jit(program.fn))(*abstract)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"input_specs": [(s.shape, _dt.dtype_name(s.dtype), s.name)
+                                     for s in specs]}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_fn-like callable)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        blob = f.read()
+    exported = jax.export.deserialize(blob)
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+
+    def fn(*args):
+        return exported.call(*args)
+
+    specs = [InputSpec(s, d, n) for s, d, n in meta["input_specs"]]
+    prog = Program(fn, specs)
+    return prog, [s.name for s in specs], fn
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("py_func: wrap host code with jax.pure_callback")
+
+
+class nn:
+    """static.nn op aliases — same functional ops serve both modes."""
+    from ..nn import functional as _F
+
+    fc = staticmethod(lambda x, size, **kw: _not_impl())
+
+    @staticmethod
+    def embedding(input, size, **kw):
+        raise NotImplementedError("use paddle_tpu.nn.Embedding in both modes")
+
+
+def _not_impl():
+    raise NotImplementedError("legacy static.nn builders: use paddle_tpu.nn "
+                              "layers (they trace under jit)")

@@ -1,0 +1,135 @@
+"""fft / static+inference / incubate / sparse / quantization tests."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = pt.randn([4, 16])
+        f = pt.fft.fft(x.astype("complex64"))
+        back = pt.fft.ifft(f)
+        np.testing.assert_allclose(np.real(back.numpy()), x.numpy(), atol=1e-5)
+
+    def test_rfft_grad(self):
+        x = pt.to_tensor(np.random.rand(8).astype(np.float32), stop_gradient=False)
+        y = pt.fft.rfft(x)
+        loss = pt.sum(pt.tensor.math.abs(y) ** 2)
+        loss.backward()
+        assert x.grad is not None
+
+
+class TestStaticInference:
+    def test_executor_run(self):
+        from paddle_tpu.static import Executor, InputSpec, Program
+
+        def prog_fn(a, b):
+            return pt.Tensor(a) @ pt.Tensor(b)
+
+        prog = Program(prog_fn, [InputSpec([2, 3], "float32", "a"),
+                                 InputSpec([3, 2], "float32", "b")])
+        exe = Executor()
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        (out,) = exe.run(prog, feed={"a": a, "b": b})
+        np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+    def test_save_load_inference_model(self, tmp_path):
+        from paddle_tpu.static import (InputSpec, Program, load_inference_model,
+                                       save_inference_model)
+
+        def fn(x):
+            return pt.tanh(pt.Tensor(x)) * 2
+
+        prog = Program(fn, [InputSpec([4], "float32", "x")])
+        prefix = str(tmp_path / "model")
+        save_inference_model(prefix, prog.input_specs, None, program=prog)
+        prog2, feeds, fn2 = load_inference_model(prefix)
+        x = np.random.rand(4).astype(np.float32)
+        out = fn2(jnp.asarray(x))
+        out = out[0] if isinstance(out, (tuple, list)) else out
+        np.testing.assert_allclose(np.asarray(out), np.tanh(x) * 2, rtol=1e-6)
+
+    def test_predictor(self):
+        from paddle_tpu.inference import Predictor
+
+        def fwd(x):
+            return x * 2 + 1
+
+        p = Predictor(fwd, example_args=[np.zeros(3, np.float32)])
+        (out,) = p.run([np.ones(3, np.float32)])
+        np.testing.assert_allclose(out, [3, 3, 3])
+
+
+class TestIncubate:
+    def test_fused_rope_matches_manual(self):
+        from paddle_tpu.incubate.nn.functional import fused_rotary_position_embedding
+        q = pt.randn([2, 8, 2, 16])
+        out = fused_rotary_position_embedding(q)
+        assert out.shape == [2, 8, 2, 16]
+        # position 0 is identity under rope
+        np.testing.assert_allclose(out.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-5)
+
+    def test_swiglu(self):
+        from paddle_tpu.incubate.nn.functional import swiglu
+        x = pt.randn([4, 8])
+        out = swiglu(x)
+        assert out.shape == [4, 4]
+
+    def test_jacobian_hessian(self):
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+
+        def f(x):
+            return pt.sum(x * x)
+
+        x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        jac = Jacobian(f, x)
+        np.testing.assert_allclose(jac.numpy(), [2.0, 4.0], rtol=1e-6)
+        h = Hessian(f, x)
+        np.testing.assert_allclose(h.numpy(), 2 * np.eye(2), rtol=1e-6)
+
+    def test_asp_mask(self):
+        from paddle_tpu.incubate.asp import calculate_density, create_mask
+        w = pt.randn([8, 8])
+        m = create_mask(w)
+        assert abs(calculate_density(m) - 0.5) < 1e-6
+        # every group of 4 has exactly 2 nonzeros
+        groups = m.numpy().reshape(-1, 4)
+        assert (groups.sum(1) == 2).all()
+
+
+class TestSparse:
+    def test_coo_roundtrip_matmul(self):
+        import paddle_tpu.sparse as sp
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        s = sp.sparse_coo_tensor(idx, vals, [3, 3])
+        dense = s.to_dense().numpy()
+        assert dense[0, 1] == 1.0 and dense[2, 2] == 3.0
+        assert s.nnz == 3
+        y = np.random.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(sp.matmul(s, pt.to_tensor(y)).numpy(),
+                                   dense @ y, rtol=1e-5)
+
+
+class TestQuantization:
+    def test_fake_quant_ste(self):
+        from paddle_tpu.quantization import fake_quant
+        x = pt.to_tensor(np.linspace(-1, 1, 11).astype(np.float32),
+                         stop_gradient=False)
+        q = fake_quant(x, pt.to_tensor(np.float32(1.0)), bits=4)
+        loss = pt.sum(q)
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(11))  # STE passthrough
+
+    def test_qat_wraps(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT, QuantConfig
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+        qat = QAT(QuantConfig())
+        net = qat.quantize(net)
+        out = net(pt.randn([2, 4]))
+        assert out.shape == [2, 4]
