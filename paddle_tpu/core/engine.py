@@ -203,9 +203,12 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
     track = grad_enabled() and any_requires and not any_tracer
     if not track:
         out = f(*arrs)
+        if not any_tracer:
+            _check_nan_inf(name, out)
         return wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
 
     out, vjp_fn = jax.vjp(f, *arrs)
+    _check_nan_inf(name, out)
     leaves, treedef = jax.tree.flatten(out)
     node = GradNode(
         _TreeVjp(vjp_fn, treedef),
@@ -228,6 +231,28 @@ class _TreeVjp:
 
     def __call__(self, flat_cots):
         return self.vjp_fn(jax.tree.unflatten(self.treedef, list(flat_cots)))
+
+
+def _check_nan_inf(op_name: str, out):
+    """FLAGS_check_nan_inf watchdog (reference:
+    fluid/framework/details/nan_inf_utils_detail.h hooked into executors/eager;
+    here hooked into the dispatch chokepoint, eager only — under jit use
+    jax_debug_nans)."""
+    from ..utils.flags import flag_value
+
+    if not flag_value("check_nan_inf"):
+        return
+    import numpy as np
+
+    for leaf in jax.tree.leaves(out):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            bad = int(jnp.sum(~jnp.isfinite(leaf)))
+            if bad:
+                level = flag_value("check_nan_inf_level") or 0
+                msg = f"[check_nan_inf] op={op_name or '?'}: {bad} non-finite values"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                print(msg)
 
 
 def apply_nondiff(fn: Callable, *args, name: str = "", **static_kwargs):

@@ -1,0 +1,125 @@
+"""Auto-tuner — black-box search over parallelism configs.
+
+Reference: /root/reference/python/paddle/distributed/auto_tuner/
+(tuner.py:21 AutoTuner, search.py grid/gbs search, prune.py rule pruning,
+cost_model.py, memory_cost_model.py; launched via `launch --auto_tuner_json`).
+
+TPU-native: candidates are (dp, mp, pp, sharding-stage, micro-batch, remat)
+tuples constrained to the mesh size; pruning uses the same divisibility and
+memory heuristics; each trial times the USER-SUPPLIED trial_fn (typically a
+few steps of a jitted train step on one config) instead of relaunching
+training jobs — single-controller SPMD lets us retune in-process.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable
+
+__all__ = ["AutoTuner", "Candidate", "default_candidates", "prune_by_memory",
+           "HistoryRecorder"]
+
+
+@dataclasses.dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding_stage: int = 0
+    micro_batch: int = 1
+    recompute: bool = True
+
+    def degree(self):
+        return self.dp * self.mp * self.pp
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+def default_candidates(n_devices: int, global_batch: int, tuner_cfg=None):
+    """Grid over factorizations of n_devices (reference search.py GridSearch)."""
+    cands = []
+    for dp, mp, pp in _factor3(n_devices):
+        for stage in (0, 1, 2, 3):
+            if stage and dp == 1:
+                continue
+            for mb in (m for m in (1, 2, 4, 8) if global_batch % (m * dp) == 0):
+                if pp > 1 and mb == 1:
+                    continue
+                for rc in (True, False):
+                    cands.append(Candidate(dp, mp, pp, stage, mb, rc))
+    return cands
+
+
+def _factor3(n):
+    out = []
+    for a in range(1, n + 1):
+        if n % a:
+            continue
+        for b in range(1, n // a + 1):
+            if (n // a) % b:
+                continue
+            out.append((a, b, n // (a * b)))
+    return out
+
+
+def prune_by_memory(cands, model_params: int, hbm_bytes_per_chip: float,
+                    bytes_per_param: float = 18.0):
+    """Reference memory_cost_model.py heuristic: params+grads+opt(≈18B/param
+    fp32-master Adam) must fit after dp-sharding (stage>=1) and pp splitting."""
+    out = []
+    for c in cands:
+        shard_div = c.dp if c.sharding_stage >= 1 else 1
+        per_chip = model_params * bytes_per_param / (c.pp * c.mp * shard_div)
+        if per_chip < hbm_bytes_per_chip * 0.9:
+            out.append(c)
+    return out
+
+
+class HistoryRecorder:
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def add(self, cand: Candidate, metric: float, error: str | None = None):
+        self.records.append({**cand.as_dict(), "metric": metric, "error": error})
+
+    def best(self):
+        ok = [r for r in self.records if r["error"] is None]
+        return max(ok, key=lambda r: r["metric"]) if ok else None
+
+
+class AutoTuner:
+    """tuner = AutoTuner(trial_fn, n_devices, global_batch); best = tuner.tune()
+
+    trial_fn(candidate) -> throughput metric (higher better); raise to mark
+    the config infeasible (OOM etc.).
+    """
+
+    def __init__(self, trial_fn: Callable[[Candidate], float], n_devices: int,
+                 global_batch: int, model_params: int = 0,
+                 hbm_bytes_per_chip: float = 16e9, max_trials: int = 0,
+                 candidates=None):
+        self.trial_fn = trial_fn
+        self.candidates = list(candidates if candidates is not None else
+                               default_candidates(n_devices, global_batch))
+        if model_params:
+            self.candidates = prune_by_memory(self.candidates, model_params,
+                                              hbm_bytes_per_chip)
+        self.max_trials = max_trials or len(self.candidates)
+        self.history = HistoryRecorder()
+
+    def tune(self, verbose: bool = False):
+        for cand in self.candidates[: self.max_trials]:
+            t0 = time.perf_counter()
+            try:
+                metric = float(self.trial_fn(cand))
+                self.history.add(cand, metric)
+                if verbose:
+                    print(f"[auto_tuner] {cand.as_dict()} -> {metric:.1f} "
+                          f"({time.perf_counter() - t0:.1f}s)")
+            except Exception as e:  # infeasible config
+                self.history.add(cand, float("-inf"), error=str(e)[:200])
+                if verbose:
+                    print(f"[auto_tuner] {cand.as_dict()} failed: {e}")
+        return self.history.best()

@@ -1,0 +1,83 @@
+"""Custom op plugin tests (reference: test/custom_op/ — PD_BUILD_OP ops built
+and loaded at runtime)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestPallasStyleCustomOp:
+    def test_register_with_vjp(self):
+        import jax.numpy as jnp
+        from paddle_tpu.utils.cpp_extension import register_custom_op
+
+        def cube(x):
+            return x ** 3
+
+        def cube_vjp(res, cot):
+            (x,) = res
+            return (3 * x ** 2 * cot,)
+
+        op = register_custom_op("my_cube", cube, vjp=cube_vjp)
+        x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [8.0])
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+class TestCppCustomOp:
+    def test_build_and_run(self, tmp_path):
+        src = tmp_path / "relu6_op.cc"
+        src.write_text(
+            'extern "C" void my_relu6(const float* in, float* out, long long n) {\n'
+            "  for (long long i = 0; i < n; ++i) {\n"
+            "    float v = in[i] < 0 ? 0.0f : in[i];\n"
+            "    out[i] = v > 6.0f ? 6.0f : v;\n"
+            "  }\n"
+            "}\n")
+        from paddle_tpu.utils.cpp_extension import load
+        op = load("my_relu6", str(src), build_directory=str(tmp_path))
+        x = pt.to_tensor(np.array([-1.0, 3.0, 9.0], np.float32))
+        out = op(x)
+        np.testing.assert_allclose(out.numpy(), [0.0, 3.0, 6.0])
+
+
+class TestAutoTuner:
+    def test_search_and_prune(self):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner, Candidate
+
+        def trial(c: Candidate):
+            # synthetic cost: favor dp=4, mp=2, stage 1, remat off
+            score = 100.0
+            score -= abs(c.dp - 4) * 10 + abs(c.mp - 2) * 5 + (c.pp - 1) * 3
+            score += 5 * (c.sharding_stage == 1)
+            score += 2 * (not c.recompute)
+            if c.mp == 8:
+                raise MemoryError("oom")
+            return score
+
+        tuner = AutoTuner(trial, n_devices=8, global_batch=32)
+        best = tuner.tune()
+        assert best is not None
+        assert best["dp"] == 4 and best["mp"] == 2
+        assert any(r["error"] for r in tuner.history.records)
+
+    def test_memory_prune(self):
+        from paddle_tpu.distributed.auto_tuner import (Candidate,
+                                                       prune_by_memory)
+        cands = [Candidate(dp=1), Candidate(dp=8, sharding_stage=1)]
+        kept = prune_by_memory(cands, model_params=2_000_000_000,
+                               hbm_bytes_per_chip=16e9)
+        assert all(c.sharding_stage == 1 for c in kept)
+
+
+class TestNanInfWatchdog:
+    def test_raises_on_nan(self):
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = pt.to_tensor(np.array([1.0, 0.0], np.float32), stop_gradient=False)
+            with pytest.raises(FloatingPointError):
+                _ = pt.log(x - 1.0)  # log(-1) -> nan
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
