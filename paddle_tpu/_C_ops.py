@@ -1,0 +1,27 @@
+"""paddle._C_ops compatibility namespace.
+
+Reference: the generated op entry points (`paddle._C_ops.<op>` — dygraph
+fast path / PIR builder, fluid/pybind eager_op_function.cc). Here every op in
+the registry is reachable by name; __getattr__ resolves lazily so custom ops
+registered later are visible too.
+"""
+from __future__ import annotations
+
+from .core.tensor import _OPS_CACHE, _ops
+
+
+def __getattr__(name):
+    table = _ops()
+    if name in table:
+        return table[name]
+    # common alias spellings used by reference callers
+    aliases = {
+        "elementwise_add": "add", "elementwise_sub": "subtract",
+        "elementwise_mul": "multiply", "elementwise_div": "divide",
+        "reduce_sum": "sum", "reduce_mean": "mean", "reduce_max": "max",
+        "reduce_min": "min", "reduce_prod": "prod",
+        "fill_constant": "full", "lookup_table_v2": "embedding",
+    }
+    if name in aliases and aliases[name] in table:
+        return table[aliases[name]]
+    raise AttributeError(f"_C_ops has no op {name!r}")

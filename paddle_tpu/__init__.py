@@ -49,6 +49,8 @@ from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
 from . import profiler  # noqa: F401
+from . import device  # noqa: F401
+from . import _C_ops  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework import (  # noqa: F401
     save, load, set_device, get_device, device_count, is_compiled_with_cuda,

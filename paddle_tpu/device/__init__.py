@@ -1,0 +1,120 @@
+"""paddle_tpu.device (reference: /root/reference/python/paddle/device/ —
+device management, cuda streams/events/graphs API). On TPU, streams and CUDA
+graphs are XLA-internal; the API surface is kept with synchronization
+semantics where meaningful."""
+from __future__ import annotations
+
+import jax
+
+from ..framework import get_device, set_device  # noqa: F401
+
+__all__ = ["get_device", "set_device", "get_all_device_type",
+           "get_available_device", "get_available_custom_device", "synchronize",
+           "device_count", "cuda", "is_compiled_with_cuda", "Stream", "Event"]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (XLA: sync via a trivial
+    transfer barrier)."""
+    try:
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:
+        pass
+
+
+class Stream:
+    """CUDA-stream API shim: XLA owns scheduling; recording/waiting are
+    no-ops that preserve program order (already guaranteed)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+class cuda:
+    """paddle.device.cuda namespace shim (memory stats map to PJRT)."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream()
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+        return contextlib.nullcontext()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def empty_cache():
+        pass
