@@ -50,6 +50,10 @@ from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
 from . import _C_ops  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework import (  # noqa: F401

@@ -1,0 +1,4 @@
+"""paddle_tpu.audio (reference: /root/reference/python/paddle/audio/ —
+spectral features + functional windows). jnp.fft-backed, MXU/VPU-friendly."""
+from . import features  # noqa: F401
+from . import functional  # noqa: F401

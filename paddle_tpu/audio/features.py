@@ -1,0 +1,93 @@
+"""audio.features — Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC
+layers (reference: python/paddle/audio/features/layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.engine import apply
+from ..nn.layer.layers import Layer
+from .functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None, window="hann",
+                 power=2.0, center=True, pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window", get_window(window, self.win_length,
+                                                  dtype="float32")._value)
+
+    def forward(self, x):
+        n_fft, hop, win = self.n_fft, self.hop_length, self.win_length
+        wval = self.window._value
+        center, pad_mode, power = self.center, self.pad_mode, self.power
+
+        def f(a, w):
+            if center:
+                pads = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+                a = jnp.pad(a, pads, mode="reflect" if pad_mode == "reflect" else "constant")
+            T = a.shape[-1]
+            n_frames = 1 + (T - n_fft) // hop
+            idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+            frames = a[..., idx]  # [..., frames, n_fft]
+            wfull = jnp.zeros(n_fft).at[(n_fft - win) // 2:(n_fft - win) // 2 + win].set(w)
+            spec = jnp.fft.rfft(frames * wfull, axis=-1)
+            mag = jnp.abs(spec) ** power
+            return jnp.swapaxes(mag, -1, -2)  # [..., freq, frames]
+
+        return apply(f, x, self.window, name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.register_buffer("fbank", compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)._value)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+
+        def f(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return apply(f, spec, self.fbank, name="mel")
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__(*args, **kw)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None, n_mels=64,
+                 f_min=50.0, f_max=None, top_db=None, **kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft, hop_length=hop_length,
+                                        n_mels=n_mels, f_min=f_min, f_max=f_max,
+                                        top_db=top_db)
+        self.register_buffer("dct", create_dct(n_mfcc, n_mels)._value)
+
+    def forward(self, x):
+        lm = self.logmel(x)
+
+        def f(m, d):
+            return jnp.einsum("mk,...mt->...kt", d, m)
+
+        return apply(f, lm, self.dct, name="mfcc")

@@ -1,0 +1,142 @@
+"""paddle_tpu.geometric (reference: /root/reference/python/paddle/geometric/ —
+GNN message passing: send_u_recv/send_ue_recv/segment ops). TPU-native:
+jax segment ops — static-shaped scatter-reduce the MXU/VPU handles well."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min", "reindex_graph", "sample_neighbors"]
+
+
+def _num_segments(count, data_len):
+    return int(count) if count is not None else None
+
+
+def segment_sum(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(jnp.max(s)) + 1 if not isinstance(s, jax.core.Tracer) else d.shape[0]
+        return jax.ops.segment_sum(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply(f, data, segment_ids, name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(jnp.max(s)) + 1 if not isinstance(s, jax.core.Tracer) else d.shape[0]
+        s32 = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s32, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],) + (1,) * (d.ndim - 1),
+                                           d.dtype), s32, num_segments=n)
+        return tot / jnp.maximum(cnt, 1)
+
+    return apply(f, data, segment_ids, name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(jnp.max(s)) + 1 if not isinstance(s, jax.core.Tracer) else d.shape[0]
+        return jax.ops.segment_max(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply(f, data, segment_ids, name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(jnp.max(s)) + 1 if not isinstance(s, jax.core.Tracer) else d.shape[0]
+        return jax.ops.segment_min(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply(f, data, segment_ids, name="segment_min")
+
+
+_REDUCES = {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+            "min": jax.ops.segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather x[src], scatter-reduce to dst (reference geometric/message_passing)."""
+
+    def f(xv, src, dst):
+        n = out_size or xv.shape[0]
+        msgs = jnp.take(xv, src.astype(jnp.int32), axis=0)
+        dst32 = dst.astype(jnp.int32)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, dst32, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1),
+                                               msgs.dtype), dst32, num_segments=n)
+            return tot / jnp.maximum(cnt, 1)
+        return _REDUCES[reduce_op](msgs, dst32, num_segments=n)
+
+    return apply(f, x, src_index, dst_index, name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """Node-edge fused message passing."""
+
+    def f(xv, yv, src, dst):
+        n = out_size or xv.shape[0]
+        msgs = jnp.take(xv, src.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            msgs = msgs + yv
+        elif message_op in ("mul", "multiply"):
+            msgs = msgs * yv
+        else:
+            raise ValueError(message_op)
+        dst32 = dst.astype(jnp.int32)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, dst32, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1),
+                                               msgs.dtype), dst32, num_segments=n)
+            return tot / jnp.maximum(cnt, 1)
+        return _REDUCES[reduce_op](msgs, dst32, num_segments=n)
+
+    return apply(f, x, y, src_index, dst_index, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    def f(xv, yv, src, dst):
+        xs = jnp.take(xv, src.astype(jnp.int32), axis=0)
+        yd = jnp.take(yv, dst.astype(jnp.int32), axis=0)
+        return xs + yd if message_op == "add" else xs * yd
+
+    return apply(f, x, y, src_index, dst_index, name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    import numpy as np
+    xa = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor) else neighbors)
+    nodes = np.concatenate([xa, nb])
+    uniq, inv = np.unique(nodes, return_inverse=True)
+    # order: x first, then new neighbor ids (paddle semantics)
+    order = {}
+    out_nodes = []
+    for v in nodes:
+        if v not in order:
+            order[v] = len(order)
+            out_nodes.append(v)
+    remap = np.vectorize(order.get)
+    return (Tensor(jnp.asarray(remap(nb))), Tensor(jnp.asarray(np.asarray(out_nodes))),
+            Tensor(jnp.asarray(remap(xa))))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    import numpy as np
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor) else input_nodes)
+    out_n, out_count = [], []
+    for v in nodes:
+        nbrs = r[cp[v]:cp[v + 1]]
+        if 0 < sample_size < len(nbrs):
+            nbrs = np.random.choice(nbrs, sample_size, replace=False)
+        out_n.append(nbrs)
+        out_count.append(len(nbrs))
+    return (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else np.zeros(0, np.int64))),
+            Tensor(jnp.asarray(np.asarray(out_count, np.int64))))

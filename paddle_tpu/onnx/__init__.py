@@ -1,0 +1,30 @@
+"""paddle_tpu.onnx (reference: paddle.onnx.export hooks to paddle2onnx).
+
+TPU-native deployment path is StableHLO (`static.save_inference_model` via
+jax.export) — the portable compiled format for XLA runtimes. ONNX export of a
+traced function would go StableHLO→ONNX with an external converter; we export
+the StableHLO artifact and metadata here."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """Exports the model as a StableHLO artifact + params (ONNX conversion
+    requires an external StableHLO->ONNX converter; none is vendored)."""
+    from ..static import InputSpec, Program, save_inference_model
+
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(s.shape, s.dtype)
+             for s in input_spec]
+
+    def fn(*args):
+        from ..core.tensor import Tensor
+        return layer(*[Tensor(a) for a in args])
+
+    prog = Program(fn, specs)
+    save_inference_model(path, specs, None, program=prog)
+    from ..framework import save
+    save(layer.state_dict(), path + ".pdparams")
+    return path

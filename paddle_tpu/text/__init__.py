@@ -1,0 +1,56 @@
+"""paddle_tpu.text (reference: /root/reference/python/paddle/text/ —
+viterbi_decode + dataset helpers)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference text/viterbi_decode.py). potentials
+    [B, T, N], transitions [N, N] (+2 if bos/eos tags)."""
+
+    def f(emis, trans):
+        B, T, N = emis.shape
+
+        def step(carry, e_t):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + trans[None] + e_t[:, None, :]
+            best = jnp.max(cand, axis=1)
+            back = jnp.argmax(cand, axis=1)
+            return best, back
+
+        init = emis[:, 0]
+        score, backs = jax.lax.scan(step, init, jnp.swapaxes(emis[:, 1:], 0, 1))
+        last = jnp.argmax(score, axis=-1)  # [B]
+
+        def backtrack(carry, bp_t):
+            tag = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan: outputs[i] = tag_{i+1}, final carry = tag_0
+        first, tail = jax.lax.scan(backtrack, last, backs, reverse=True)
+        path = jnp.concatenate([first[None], tail], axis=0)  # [T, B]
+        return jnp.max(score, -1), jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    scores = apply(lambda e, t: f(e, t)[0], potentials, transition_params,
+                   name="viterbi")
+    paths = apply_nondiff(lambda e, t: f(e, t)[1], potentials, transition_params)
+    return scores, paths
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,108 @@
+"""Collective API tests inside shard_map regions (reference:
+test/collective/test_collective_*_api.py — numeric checks per collective)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+
+
+@pytest.fixture
+def world():
+    mesh = dist.set_mesh(dist.ProcessMesh(np.arange(8), ["world"]))
+    group = dist.new_group(axis_name="world", mesh=mesh)
+    return mesh, group
+
+
+def _shard_map(mesh, fn, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh.jax_mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+class TestCollectivesInSPMD:
+    def test_all_reduce(self, world):
+        mesh, group = world
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def body(a):
+            t = pt.Tensor(a)
+            dist.all_reduce(t, group=group)
+            return t._value
+
+        out = _shard_map(mesh, body, (P("world"),), P("world"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), x.sum()))
+
+    def test_all_gather(self, world):
+        mesh, group = world
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def body(a):
+            g = dist.all_gather(None, pt.Tensor(a), group=group)
+            return g._value.reshape(1, -1)
+
+        out = _shard_map(mesh, body, (P("world"),), P("world"))(jnp.asarray(x))
+        for r in range(8):
+            np.testing.assert_allclose(np.asarray(out)[r], np.arange(8))
+
+    def test_reduce_scatter(self, world):
+        mesh, group = world
+        x = np.ones((8, 8), np.float32)
+
+        def body(a):
+            out = dist.reduce_scatter(None, pt.Tensor(a[0]), group=group)
+            return out._value[None]
+
+        out = _shard_map(mesh, body, (P("world"),), P("world"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out).reshape(-1), np.full(8, 8.0))
+
+    def test_broadcast(self, world):
+        mesh, group = world
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def body(a):
+            t = pt.Tensor(a)
+            dist.broadcast(t, src=3, group=group)
+            return t._value
+
+        out = _shard_map(mesh, body, (P("world"),), P("world"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+    def test_all_to_all_single(self, world):
+        mesh, group = world
+        # rank r sends value r to every rank; after a2a each rank holds 0..7
+        x = np.repeat(np.arange(8, dtype=np.float32), 8).reshape(64, 1)
+
+        def body(a):
+            out = dist.all_to_all_single(None, pt.Tensor(a), group=group)
+            return out._value
+
+        out = _shard_map(mesh, body, (P("world"),), P("world"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out).reshape(8, 8)[0], np.arange(8))
+
+    def test_reduce_to_dst(self, world):
+        mesh, group = world
+        x = np.ones((8, 1), np.float32)
+
+        def body(a):
+            t = pt.Tensor(a)
+            dist.reduce(t, dst=2, op=dist.ReduceOp.SUM, group=group)
+            return t._value
+
+        out = np.asarray(_shard_map(mesh, body, (P("world"),), P("world"))(jnp.asarray(x)))
+        assert out[2, 0] == 8.0
+        assert out[0, 0] == 1.0  # non-dst keeps local value
+
+    def test_eager_partial_allreduce(self, world):
+        mesh, group = world
+        local = np.random.rand(4).astype(np.float32)
+        t = dist.dtensor_from_local(pt.to_tensor(local), mesh, [dist.Partial()])
+        dist.all_reduce(t)
+        np.testing.assert_allclose(np.asarray(t.numpy()), local * 8, rtol=1e-5)
+
+    def test_barrier(self, world):
+        mesh, group = world
+        dist.barrier(group)  # must not hang

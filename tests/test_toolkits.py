@@ -1,0 +1,58 @@
+"""audio / geometric / text toolkit tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestAudio:
+    def test_spectrogram_shapes(self):
+        from paddle_tpu.audio.features import MFCC, MelSpectrogram, Spectrogram
+        x = pt.randn([2, 2048])
+        spec = Spectrogram(n_fft=256)(x)
+        assert spec.shape[0] == 2 and spec.shape[1] == 129
+        mel = MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[1] == 32
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_window_matches_numpy(self):
+        from paddle_tpu.audio.functional import get_window
+        w = get_window("hann", 16).numpy()
+        ref = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(16) / 16)
+        np.testing.assert_allclose(w, ref, atol=1e-12)
+
+
+class TestGeometric:
+    def test_send_u_recv(self):
+        import paddle_tpu.geometric as G
+        x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        src = pt.to_tensor(np.array([0, 1, 2, 0]))
+        dst = pt.to_tensor(np.array([1, 2, 1, 0]))
+        out = G.send_u_recv(x, src, dst, reduce_op="sum")
+        ref = np.zeros((4, 3), np.float32)
+        xa = x.numpy()
+        for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+            ref[d] += xa[s]
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_segment_ops(self):
+        import paddle_tpu.geometric as G
+        data = pt.to_tensor(np.array([[1.0], [2.0], [3.0], [4.0]], np.float32))
+        seg = pt.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(G.segment_sum(data, seg).numpy()[:2],
+                                   [[3.0], [7.0]])
+        np.testing.assert_allclose(G.segment_mean(data, seg).numpy()[:2],
+                                   [[1.5], [3.5]])
+        np.testing.assert_allclose(G.segment_max(data, seg).numpy()[:2],
+                                   [[2.0], [4.0]])
+
+
+class TestText:
+    def test_viterbi_simple(self):
+        from paddle_tpu.text import viterbi_decode
+        # 2 tags; strong diagonal transitions
+        emis = pt.to_tensor(np.array([[[5.0, 0], [5.0, 0], [0, 5.0]]], np.float32))
+        trans = pt.to_tensor(np.zeros((2, 2), np.float32))
+        scores, path = viterbi_decode(emis, trans)
+        np.testing.assert_array_equal(path.numpy()[0], [0, 0, 1])
