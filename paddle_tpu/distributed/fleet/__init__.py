@@ -13,6 +13,10 @@ import os
 from typing import Any
 
 from ..env import get_rank, get_world_size
+from . import elastic  # noqa: F401
+from . import layers  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup
 
 __all__ = ["DistributedStrategy", "init", "get_hybrid_communicate_group",

@@ -1,0 +1,122 @@
+"""Elastic training manager.
+
+Reference: /root/reference/python/paddle/distributed/fleet/elastic/manager.py
+(ElasticManager :125 — etcd leases as heartbeats, np-change watch, scale
+up/down, relaunch; ElasticLevel/ElasticStatus :44,:49).
+
+TPU-native: etcd isn't vendored; membership runs over a SHARED DIRECTORY
+(NFS/GCS-fuse on real pods): each node maintains a heartbeat file with a
+TTL; the manager watches membership, decides scale/restart, and signals the
+launcher (which owns process supervision). The decision logic mirrors the
+reference; the transport is pluggable (subclass Registry for etcd/redis).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+
+__all__ = ["ElasticLevel", "ElasticStatus", "FileRegistry", "ElasticManager"]
+
+
+class ElasticLevel(enum.IntEnum):
+    FAULT_TOLERANCE = 1  # fixed np, restart on failure
+    ELASTIC = 2          # np range, scale up/down
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class FileRegistry:
+    """Heartbeat registry over a shared directory."""
+
+    def __init__(self, root: str, job_id: str, ttl: float = 10.0):
+        self.dir = os.path.join(root, job_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.ttl = ttl
+
+    def heartbeat(self, node_id: str, info=None):
+        path = os.path.join(self.dir, f"{node_id}.hb")
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "info": info or {}}, f)
+
+    def alive_nodes(self):
+        now = time.time()
+        out = []
+        for fn in os.listdir(self.dir):
+            if not fn.endswith(".hb"):
+                continue
+            try:
+                with open(os.path.join(self.dir, fn)) as f:
+                    rec = json.load(f)
+                if now - rec["ts"] <= self.ttl:
+                    out.append(fn[:-3])
+            except Exception:
+                continue
+        return sorted(out)
+
+    def leave(self, node_id: str):
+        try:
+            os.remove(os.path.join(self.dir, f"{node_id}.hb"))
+        except OSError:
+            pass
+
+
+class ElasticManager:
+    def __init__(self, node_id: str, np: int, min_np: int | None = None,
+                 max_np: int | None = None, registry: FileRegistry | None = None,
+                 root: str = "/tmp/paddle_tpu_elastic", job_id: str = "default",
+                 heartbeat_interval: float = 2.0):
+        self.node_id = node_id
+        self.np = np
+        self.min_np = min_np or np
+        self.max_np = max_np or np
+        self.level = (ElasticLevel.ELASTIC if self.min_np != self.max_np
+                      else ElasticLevel.FAULT_TOLERANCE)
+        self.registry = registry or FileRegistry(root, job_id)
+        self.interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_membership: tuple = ()
+
+    # ---- lifecycle ----
+    def start(self):
+        self.registry.heartbeat(self.node_id)
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                self.registry.heartbeat(self.node_id)
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self.registry.leave(self.node_id)
+
+    # ---- decisions (reference manager.py watch loop) ----
+    def watch(self) -> ElasticStatus:
+        alive = tuple(self.registry.alive_nodes())
+        changed = alive != self._last_membership and self._last_membership != ()
+        self._last_membership = alive
+        n = len(alive)
+        if n >= self.np and not changed:
+            return ElasticStatus.HOLD
+        if n < self.min_np:
+            # not enough nodes: hold (fault-tolerance waits for rejoin)
+            return ElasticStatus.HOLD if self.level == ElasticLevel.FAULT_TOLERANCE \
+                else ElasticStatus.HOLD
+        if changed and self.min_np <= n <= self.max_np:
+            self.np = n
+            return ElasticStatus.RESTART  # relaunch with new world size
+        return ElasticStatus.HOLD
+
+    def world_hosts(self):
+        return list(self._last_membership or self.registry.alive_nodes())

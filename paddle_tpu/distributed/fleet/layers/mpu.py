@@ -1,0 +1,42 @@
+"""fleet.layers.mpu compatibility (reference: fleet/layers/mpu/)."""
+from ....parallel.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ....core import random as _rng
+
+
+class RNGStatesTracker:
+    """Reference mpu/random.py RNGStatesTracker — named RNG states so TP ranks
+    draw identical/distinct randomness as required. Over jax keys: named keys
+    derived by fold_in."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        import jax
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            key = self._states.get(name)
+            if key is None:
+                yield
+                return
+            with _rng.rng_guard(key):
+                yield
+            # persist advanced state
+            self._states[name] = _rng.get_rng_state()
+
+        return ctx()
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER

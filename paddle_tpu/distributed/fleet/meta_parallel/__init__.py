@@ -1,0 +1,25 @@
+"""fleet.meta_parallel compatibility namespace (reference:
+python/paddle/distributed/fleet/meta_parallel/) — maps onto paddle_tpu.parallel."""
+from ....parallel.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ....parallel.pipeline_layer import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from ....parallel.pipeline_parallel import PipelineParallel  # noqa: F401
+from ...parallel import DataParallel  # noqa: F401
+
+
+class TensorParallel:
+    """Reference meta_parallel/tensor_parallel.py:28 — wrapper that broadcasts
+    params inside the tp group at init. Under single-controller SPMD params
+    are globally consistent by construction, so this is the identity wrapper."""
+
+    def __new__(cls, layers, hcg=None, **kwargs):
+        return layers
+
+
+class SegmentParallel:
+    """Reference meta_parallel/segment_parallel.py:26 (sep axis wrapper)."""
+
+    def __new__(cls, layers, hcg=None, **kwargs):
+        return layers

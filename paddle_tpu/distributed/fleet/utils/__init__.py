@@ -1,0 +1,28 @@
+"""fleet.utils compatibility (reference: python/paddle/distributed/fleet/utils/)."""
+from ....parallel.recompute import recompute, recompute_sequential  # noqa: F401
+from ....parallel import sp_layers as sequence_parallel_utils  # noqa: F401
+
+
+class LocalFS:
+    def ls_dir(self, path):
+        import os
+        dirs, files = [], []
+        for n in os.listdir(path):
+            import os.path as osp
+            (dirs if osp.isdir(osp.join(path, n)) else files).append(n)
+        return dirs, files
+
+    def is_exist(self, path):
+        import os
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        import os
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        import shutil, os
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)

@@ -148,3 +148,31 @@ class TestSaveLoad:
         assert loaded["step"] == 7
         np.testing.assert_allclose(loaded["w"].numpy(), obj["w"].numpy())
         np.testing.assert_allclose(loaded["nested"]["b"].numpy(), [1, 1])
+
+
+class TestElastic:
+    def test_membership_and_scale(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus,
+                                                          FileRegistry)
+        reg = FileRegistry(str(tmp_path), "job1", ttl=5.0)
+        m = ElasticManager("node0", np=2, min_np=1, max_np=3, registry=reg,
+                           heartbeat_interval=0.1)
+        m.start()
+        assert m.watch() in (ElasticStatus.HOLD,)
+        # second node joins
+        reg.heartbeat("node1")
+        st = m.watch()
+        assert st in (ElasticStatus.RESTART, ElasticStatus.HOLD)
+        assert "node1" in m.world_hosts()
+        m.stop()
+
+    def test_fleet_namespaces(self):
+        import paddle_tpu.distributed.fleet as fleet
+        assert fleet.meta_parallel.ColumnParallelLinear is not None
+        assert callable(fleet.utils.recompute)
+        tracker = fleet.layers.mpu.get_rng_state_tracker()
+        tracker.add("model-parallel-rng", 42)
+        with tracker.rng_state():
+            import paddle_tpu as pt
+            _ = pt.randn([2])
