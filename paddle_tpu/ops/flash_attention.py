@@ -56,18 +56,18 @@ def flash_attention(query, key, value, causal: bool = False, block_q: int = 512,
 
 
 # ---------------- pallas kernel ----------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_fwd_bwd(q, k, v, causal, block_q, block_k):
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_fwd_bwd(q, k, v, causal, block_q, block_k, interpret=False):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret=False):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, res, dout):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
     # blockwise recompute backward in fp32 via XLA (Pallas bwd kernel lands in
     # a later round; recompute keeps memory at O(L) not O(L^2) via remat)
@@ -81,8 +81,9 @@ def _flash_bwd_rule(causal, block_q, block_k, res, dout):
 _flash_fwd_bwd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
-    """Tiled online-softmax forward in Pallas."""
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
+    """Tiled online-softmax forward in Pallas (interpret=True runs the same
+    kernel on CPU for correctness tests without a TPU)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -162,6 +163,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
+        ) if not interpret else None,
+        interpret=interpret,
     )(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2), lse
