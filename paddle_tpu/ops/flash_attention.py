@@ -23,6 +23,11 @@ from ..core.tensor import Tensor
 
 _MIN_BLOCK = 128
 
+# index-map constant: with jax_enable_x64 a literal 0 traces as i64, which
+# Mosaic cannot legalize in BlockSpec index maps
+import numpy as _np
+_i0 = _np.int32(0)
+
 
 def flash_attention_tpu_available() -> bool:
     try:
@@ -37,8 +42,15 @@ def _fa_reference(q, k, v, causal):
     if causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-        logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        # mask-aware softmax that keeps logits finite: fully-masked rows (L>S
+        # bottom-right causal) get all-zero probs — and defined gradients —
+        # instead of softmax(-inf row)=nan, matching the kernel's forward
+        m = jnp.max(jnp.where(mask, logits, -jnp.inf), axis=-1, keepdims=True)
+        m = jnp.where(jnp.isneginf(m), 0.0, m)
+        p = jnp.where(mask, jnp.exp(logits - m), 0.0)
+        probs = (p / jnp.maximum(p.sum(-1, keepdims=True), 1e-30)).astype(q.dtype)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhls,bshd->blhd", probs, v)
 
 
@@ -104,8 +116,10 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
             l_i[:] = jnp.zeros_like(l_i)
 
         if causal:
-            # skip fully-masked kv blocks
-            run = (ki * block_k) <= (qi * block_q + block_q - 1)
+            # bottom-right-aligned causal (row r sees cols <= r + S - L, the
+            # flash-attn convention; matches _fa_reference's tril offset):
+            # skip kv blocks that are fully masked for every row in the block
+            run = (ki * block_k) <= (qi * block_q + block_q - 1 + S - L)
         else:
             run = ki >= 0
 
@@ -119,11 +133,14 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
             if causal:
                 rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
                 cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                s = jnp.where(rows >= cols, s, -jnp.inf)
+                s = jnp.where(rows + (S - L) >= cols, s, -jnp.inf)
             m_prev = m_i[:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-            p = jnp.exp(s - m_new[:, None])
-            alpha = jnp.exp(m_prev - m_new)
+            # rows with no visible kv yet keep m=-inf; exp against 0 avoids
+            # the -inf - -inf = nan path while leaving p/alpha exactly 0
+            safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            p = jnp.exp(s - safe_m[:, None])
+            alpha = jnp.exp(m_prev - safe_m)
             l_i[:] = l_i[:] * alpha + jnp.sum(p, axis=1)
             acc[:] = acc[:] * alpha[:, None] + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -133,7 +150,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
         def _fin():
             denom = jnp.maximum(l_i[:], 1e-30)
             o_ref[0, 0] = (acc[:] / denom[:, None]).astype(o_ref.dtype)
-            lse_ref[0, 0] = m_i[:] + jnp.log(denom)
+            lse_ref[0, 0] = (m_i[:] + jnp.log(denom))[:, None]
 
     # layout: [B, H, L, D] for clean blocking
     qt = jnp.swapaxes(q, 1, 2)
@@ -144,17 +161,19 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
         kernel,
         grid=(B, H, grid_q, grid_k),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, _i0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, _i0)),
+            # lse carried as [..., 1] — Mosaic requires the last two block dims
+            # to be (8k, 128k) or equal to the array dims; (block_q, 1) is legal
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, _i0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, L, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, L), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, L, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -166,4 +185,4 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
         ) if not interpret else None,
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2), lse
+    return jnp.swapaxes(out, 1, 2), lse[..., 0]
