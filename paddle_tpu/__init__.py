@@ -55,6 +55,11 @@ from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import _C_ops  # noqa: F401
+from . import signal  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import hub  # noqa: F401
+from .batch import batch  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework import (  # noqa: F401
     save, load, set_device, get_device, device_count, is_compiled_with_cuda,

@@ -1,0 +1,23 @@
+"""paddle.batch — batched-reader decorator over generator readers
+(reference: /root/reference/python/paddle/batch.py:26)."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Wrap a sample generator into a mini-batch generator."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size should be a positive value, but got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -27,6 +27,28 @@ from . import engine
 
 __all__ = ["Tensor", "Parameter", "to_tensor", "wrap_output"]
 
+_ON_TPU = None  # lazy: backend choice is one-shot, so cache after first query
+
+
+def _asarray_device_safe(value, dtype=None):
+    """jnp.asarray that never materialises f64/c128 on a TPU backend (TPU has
+    no 64-bit float support; with jax_enable_x64 a numpy float64 input would
+    otherwise try to create an f64 device buffer and fail at transfer)."""
+    global _ON_TPU
+    if _ON_TPU is None:
+        try:
+            _ON_TPU = jax.default_backend() == "tpu"
+        except Exception:
+            _ON_TPU = False
+    if _ON_TPU and dtype is None:
+        a = np.asarray(value)
+        if a.dtype == np.float64:
+            dtype = jnp.float32
+        elif a.dtype == np.complex128:
+            dtype = jnp.complex64
+        value = a
+    return jnp.asarray(value, dtype=dtype)
+
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad_value", "_node", "name",
@@ -39,7 +61,7 @@ class Tensor:
         if isinstance(value, Tensor):
             value = value._value
         elif not isinstance(value, (jax.Array, jax.core.Tracer)):
-            value = jnp.asarray(value)
+            value = _asarray_device_safe(value)
         self._value = value
         self.stop_gradient = stop_gradient
         self._grad_value = None
@@ -384,7 +406,10 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
             dtype = _dt.get_default_dtype()
         elif a.dtype == np.int64 and not isinstance(data, np.ndarray):
             dtype = _dt.int64
-    arr = jnp.asarray(val, dtype=dtype)
+    if isinstance(val, (jax.Array, jax.core.Tracer)):
+        arr = jnp.asarray(val, dtype=dtype)
+    else:
+        arr = _asarray_device_safe(val, dtype=dtype)
     return Tensor(arr, stop_gradient=stop_gradient)
 
 

@@ -16,6 +16,89 @@ def _norm(norm):
     return norm if norm in ("forward", "ortho") else "backward"
 
 
+_ON_TPU = None
+
+
+def _on_tpu() -> bool:
+    global _ON_TPU
+    if _ON_TPU is None:
+        import jax
+        try:
+            _ON_TPU = jax.default_backend() == "tpu"
+        except Exception:
+            _ON_TPU = False
+    return _ON_TPU
+
+
+def irfft_array(a, n=None, axis=-1, norm="backward"):
+    """irfft that lowers on TPU: XLA's TPU backend implements C2C FFT but not
+    IRFFT, so on TPU we rebuild the full Hermitian spectrum and take
+    ifft(...).real — same result, one C2C FFT instead of a C2R kernel."""
+    if not _on_tpu():
+        return jnp.fft.irfft(a, n=n, axis=axis, norm=_norm(norm))
+    f = a.shape[axis]
+    if n is None:
+        n = 2 * (f - 1)
+    if n < 1:
+        raise ValueError(f"Invalid number of FFT data points ({n}) specified.")
+    one_sided = min(f, n // 2 + 1)
+    sl = [slice(None)] * a.ndim
+    sl[axis] = slice(0, one_sided)
+    head = a[tuple(sl)]
+    if one_sided < n // 2 + 1:  # zero-pad the missing high frequencies
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (0, n // 2 + 1 - one_sided)
+        head = jnp.pad(head, pad)
+    sl[axis] = slice(1, n - (n // 2 + 1) + 1)
+    tail = jnp.conj(jnp.flip(head[tuple(sl)], axis=axis))
+    full = jnp.concatenate([head, tail], axis=axis)
+    return jnp.fft.ifft(full, axis=axis, norm=_norm(norm)).real
+
+
+def irfftn_array(a, s=None, axes=None, norm="backward"):
+    """irfftn with the TPU IRFFT workaround: C2C ifft on the leading axes,
+    then the Hermitian-expanded irfft_array on the (real) last axis."""
+    if not _on_tpu():
+        return jnp.fft.irfftn(a, s=s, axes=axes, norm=_norm(norm))
+    if axes is None:
+        axes = list(range(a.ndim)) if s is None else list(range(a.ndim - len(s), a.ndim))
+    for ax in axes:
+        if not -a.ndim <= ax < a.ndim:
+            raise ValueError(f"axis {ax} is out of bounds for array of dimension {a.ndim}")
+    axes = [ax % a.ndim for ax in axes]
+    if len(set(axes)) != len(axes):
+        raise ValueError(f"repeated axes in {axes}")
+    n_real = None if s is None else s[-1]
+    if len(axes) > 1:
+        a = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=axes[:-1],
+                          norm=_norm(norm))
+    return irfft_array(a, n=n_real, axis=axes[-1], norm=norm)
+
+
+def hfft_array(a, n=None, axis=-1, norm="backward"):
+    if not _on_tpu():
+        return jnp.fft.hfft(a, n=n, axis=axis, norm=_norm(norm))
+    a = jnp.asarray(a)
+    if n is None:
+        n = 2 * (a.shape[axis] - 1)
+    base = irfft_array(jnp.conj(a), n=n, axis=axis, norm="backward")
+    nm = _norm(norm)
+    scale = n if nm == "backward" else (jnp.sqrt(jnp.asarray(n, base.dtype)) if nm == "ortho" else 1)
+    return base * scale
+
+
+def ihfft_array(a, n=None, axis=-1, norm="backward"):
+    if not _on_tpu():
+        return jnp.fft.ihfft(a, n=n, axis=axis, norm=_norm(norm))
+    a = jnp.asarray(a)
+    if n is None:
+        n = a.shape[axis]
+    base = jnp.conj(jnp.fft.rfft(a, n=n, axis=axis, norm="backward"))
+    nm = _norm(norm)
+    scale = n if nm == "backward" else (jnp.sqrt(jnp.asarray(float(n), jnp.real(base).dtype)) if nm == "ortho" else 1)
+    return base / scale
+
+
 def fft(x, n=None, axis=-1, norm="backward", name=None):
     return apply(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
 
@@ -29,15 +112,15 @@ def rfft(x, n=None, axis=-1, norm="backward", name=None):
 
 
 def irfft(x, n=None, axis=-1, norm="backward", name=None):
-    return apply(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+    return apply(lambda a: irfft_array(a, n=n, axis=axis, norm=norm), x, name="fft")
 
 
 def hfft(x, n=None, axis=-1, norm="backward", name=None):
-    return apply(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+    return apply(lambda a: hfft_array(a, n=n, axis=axis, norm=norm), x, name="fft")
 
 
 def ihfft(x, n=None, axis=-1, norm="backward", name=None):
-    return apply(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=_norm(norm)), x, name="fft")
+    return apply(lambda a: ihfft_array(a, n=n, axis=axis, norm=norm), x, name="fft")
 
 
 def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
@@ -53,7 +136,7 @@ def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
 
 
 def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+    return apply(lambda a: irfftn_array(a, s=s, axes=axes, norm=norm), x, name="fft")
 
 
 def fftn(x, s=None, axes=None, norm="backward", name=None):
@@ -69,7 +152,7 @@ def rfftn(x, s=None, axes=None, norm="backward", name=None):
 
 
 def irfftn(x, s=None, axes=None, norm="backward", name=None):
-    return apply(lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=_norm(norm)), x, name="fft")
+    return apply(lambda a: irfftn_array(a, s=s, axes=axes, norm=norm), x, name="fft")
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

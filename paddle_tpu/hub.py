@@ -1,0 +1,72 @@
+"""paddle.hub — load entrypoints from a repo's hubconf.py.
+
+Reference: /root/reference/python/paddle/hub.py (list/help/load over
+github/gitee/local sources). This build fully supports ``source='local'``;
+remote sources raise (no network egress on TPU pods — fetch the repo
+yourself and point hub at the checkout).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _check_source(source: str) -> None:
+    if source not in ("local",):
+        raise ValueError(
+            f"Unknown source '{source}': this TPU build supports source='local' "
+            "only (no network egress); clone the repo and pass its path.")
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"{MODULE_HUBCONF} not found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(module, VAR_DEPENDENCY, None)
+    if deps:
+        missing = [d for d in deps if importlib.util.find_spec(d) is None]
+        if missing:
+            raise RuntimeError(f"Missing dependencies from hubconf: {missing}")
+    return module
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """List callable entrypoints defined in the repo's hubconf.py."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    return [name for name, fn in vars(module).items()
+            if callable(fn) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github", force_reload: bool = False):
+    """Return the docstring of an entrypoint."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable entrypoint '{model}' in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github", force_reload: bool = False,
+         **kwargs):
+    """Instantiate an entrypoint: calls hubconf.<model>(**kwargs)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable entrypoint '{model}' in {repo_dir}")
+    return fn(**kwargs)

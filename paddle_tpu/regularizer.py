@@ -1,0 +1,58 @@
+"""paddle.regularizer — weight-decay regularizers attached to parameters or
+optimizers.
+
+Reference: /root/reference/python/paddle/regularizer.py (L1Decay:51,
+L2Decay:169 — appended to the gradient inside the optimizer's backward pass).
+Here a regularizer is a pure `grad_term(param)` function; the optimizer adds
+it to the gradient pytree before the update, so it fuses into the one
+donated XLA update step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class. Subclasses implement grad_term(param) -> addition to grad."""
+
+    def grad_term(self, param):
+        raise NotImplementedError
+
+    def __call__(self, param):
+        return self.grad_term(param)
+
+
+class L1Decay(WeightDecayRegularizer):
+    r"""loss += coeff * sum(|param|); grad += coeff * sign(param)."""
+
+    def __init__(self, coeff: float = 0.0) -> None:
+        self.coeff = float(coeff)
+        self._coeff = float(coeff)  # paddle-internal alias some code reads
+
+    def grad_term(self, param):
+        return self.coeff * jnp.sign(param)
+
+    def loss_term(self, param):
+        return self.coeff * jnp.sum(jnp.abs(param))
+
+    def __str__(self) -> str:
+        return f"L1Decay, coeff={self.coeff}"
+
+
+class L2Decay(WeightDecayRegularizer):
+    r"""loss += 0.5 * coeff * sum(param^2); grad += coeff * param."""
+
+    def __init__(self, coeff: float = 0.0) -> None:
+        self.coeff = float(coeff)
+        self._coeff = float(coeff)
+
+    def grad_term(self, param):
+        return self.coeff * param
+
+    def loss_term(self, param):
+        return 0.5 * self.coeff * jnp.sum(jnp.square(param))
+
+    def __str__(self) -> str:
+        return f"L2Decay, coeff={self.coeff}"

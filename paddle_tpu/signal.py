@@ -1,0 +1,144 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference: /root/reference/python/paddle/signal.py (frame:42, overlap_add:167,
+stft:272, istft:449 — wrappers over phi frame/overlap_add kernels + fft).
+Here the whole pipeline is expressed as gather/scatter + jnp.fft so XLA fuses
+the framing with the FFT; everything is jit- and grad-compatible.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.engine import apply
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_impl(a, frame_length, hop_length, axis):
+    if axis not in (-1, a.ndim - 1, 0):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    seq_axis = 0 if axis == 0 else a.ndim - 1
+    n = a.shape[seq_axis]
+    if frame_length > n:
+        raise ValueError(f"frame_length ({frame_length}) > sequence length ({n})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [F, L]
+    frames = jnp.take(a, idx, axis=seq_axis)
+    if axis == 0:
+        return frames  # [num_frames, frame_length, ...]
+    # [..., F, L] -> [..., L, F] = [..., frame_length, num_frames]
+    return jnp.swapaxes(frames, -1, -2)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice input into (possibly overlapping) frames.
+
+    axis=-1: [..., seq_length] -> [..., frame_length, num_frames]
+    axis=0:  [seq_length, ...] -> [num_frames, frame_length, ...]
+    """
+    return apply(lambda a: _frame_impl(a, int(frame_length), int(hop_length), axis),
+                 x, name="frame")
+
+
+def _overlap_add_impl(a, hop_length, axis):
+    if axis not in (-1, a.ndim - 1, 0):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    if axis == 0:
+        a = jnp.moveaxis(a, (0, 1), (-1, -2))  # [F, L, ...] -> [..., L, F]
+    frame_length, num_frames = a.shape[-2], a.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = (starts[None, :] + jnp.arange(frame_length)[:, None]).reshape(-1)  # [L*F]
+    flat = a.reshape(a.shape[:-2] + (frame_length * num_frames,))
+    out = jnp.zeros(a.shape[:-2] + (out_len,), dtype=a.dtype)
+    out = out.at[..., idx].add(flat)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Reconstruct a signal from frames by summing overlapping windows.
+
+    axis=-1: [..., frame_length, num_frames] -> [..., seq_length]
+    axis=0:  [num_frames, frame_length, ...] -> [seq_length, ...]
+    """
+    return apply(lambda a: _overlap_add_impl(a, int(hop_length), axis),
+                 x, name="overlap_add")
+
+
+def _pad_center(w, n_fft):
+    pad = n_fft - w.shape[0]
+    lo = pad // 2
+    return jnp.pad(w, (lo, pad - lo))
+
+
+def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+         center: bool = True, pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform: [..., T] -> [..., freqs, num_frames]."""
+    hop_length = n_fft // 4 if hop_length is None else int(hop_length)
+    win_length = n_fft if win_length is None else int(win_length)
+    win = None if window is None else (window._value if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def f(a, w=win):
+        if jnp.iscomplexobj(a) and onesided:
+            raise ValueError("onesided=True is not supported for complex inputs")
+        real_dtype = jnp.finfo(a.dtype).dtype if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.real(jnp.zeros((), a.dtype)).dtype
+        if w is None:
+            w = jnp.ones((win_length,), dtype=real_dtype)
+        w = _pad_center(w.astype(real_dtype), n_fft)
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                        mode=pad_mode)
+        frames = _frame_impl(a, n_fft, hop_length, -1)          # [..., n_fft, F]
+        frames = frames * w[:, None]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, dtype=spec.real.dtype))
+        return spec
+
+    return apply(f, x, name="stft")
+
+
+def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+          center: bool = True, normalized: bool = False, onesided: bool = True,
+          length=None, return_complex: bool = False, name=None):
+    """Inverse STFT (least-squares / NOLA-normalised overlap-add)."""
+    hop_length = n_fft // 4 if hop_length is None else int(hop_length)
+    win_length = n_fft if win_length is None else int(win_length)
+    win = None if window is None else (window._value if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def f(spec, w=win):
+        real_dtype = jnp.real(jnp.zeros((), spec.dtype)).dtype
+        if w is None:
+            w = jnp.ones((win_length,), dtype=real_dtype)
+        w = _pad_center(w.astype(real_dtype), n_fft)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, dtype=real_dtype))
+        if onesided:
+            from .fft import irfft_array
+            frames = irfft_array(spec, n=n_fft, axis=-2)         # [..., n_fft, F]
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        sig = _overlap_add_impl(frames * w[:, None], hop_length, -1)
+        # NOLA normalisation: divide by the summed squared window envelope.
+        num_frames = spec.shape[-1]
+        env = _overlap_add_impl(
+            jnp.broadcast_to((w * w)[:, None], (n_fft, num_frames)), hop_length, -1)
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply(f, x, name="istft")

@@ -1,0 +1,21 @@
+"""paddle.sysconfig — include/lib dirs for building native extensions against
+the framework (reference: /root/reference/python/paddle/sysconfig.py:22,41)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the C headers for native extensions
+    (the ctypes ABI used by paddle_tpu/io/native and custom host ops)."""
+    return os.path.join(_PKG_DIR, "io", "native")
+
+
+def get_lib() -> str:
+    """Directory containing compiled native libraries (built on demand by
+    utils.cpp_extension; empty until first build)."""
+    return os.path.join(_PKG_DIR, "io", "native")

@@ -1,0 +1,155 @@
+"""paddle.signal / regularizer / batch / hub / sysconfig tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestSignal:
+    def test_frame_matches_manual(self):
+        x = pt.to_tensor(np.arange(10, dtype=np.float32))
+        f = pt.signal.frame(x, frame_length=4, hop_length=2)
+        assert f.shape == [4, 4]
+        fa = f.numpy()
+        xa = x.numpy()
+        for t in range(4):
+            np.testing.assert_allclose(fa[:, t], xa[2 * t: 2 * t + 4])
+
+    def test_frame_axis0(self):
+        x = pt.to_tensor(np.arange(20, dtype=np.float32).reshape(10, 2))
+        f = pt.signal.frame(x, frame_length=4, hop_length=3, axis=0)
+        assert f.shape == [3, 4, 2]
+        np.testing.assert_allclose(f.numpy()[1], x.numpy()[3:7])
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = pt.to_tensor(np.random.RandomState(0).randn(16).astype(np.float32))
+        f = pt.signal.frame(x, frame_length=4, hop_length=4)
+        y = pt.signal.overlap_add(f, hop_length=4)
+        np.testing.assert_allclose(y.numpy(), x.numpy(), atol=1e-6)
+
+    def test_overlap_add_sums_overlaps(self):
+        frames = pt.to_tensor(np.ones((4, 3), np.float32))
+        y = pt.signal.overlap_add(frames, hop_length=2)
+        # length = 2*2+4 = 8; middle samples covered by 2 frames
+        np.testing.assert_allclose(y.numpy(),
+                                   [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(1)
+        x = pt.to_tensor(rng.randn(2, 512).astype(np.float32))
+        from paddle_tpu.audio.functional import get_window
+        w = get_window("hann", 128)
+        spec = pt.signal.stft(x, n_fft=128, hop_length=32, window=w)
+        assert spec.shape == [2, 65, 17]
+        assert "complex" in str(spec.dtype)
+        back = pt.signal.istft(spec, n_fft=128, hop_length=32, window=w,
+                               length=512)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-4)
+
+    def test_stft_matches_naive_dft(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(256).astype(np.float32)
+        spec = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=16,
+                              center=False).numpy()
+        # naive: frame t covers x[16t : 16t+64], rectangular window
+        for t in [0, 3, 7]:
+            ref = np.fft.rfft(x[16 * t: 16 * t + 64])
+            np.testing.assert_allclose(spec[:, t], ref, atol=1e-4)
+
+
+class TestRegularizer:
+    def test_l2_grad_term(self):
+        r = pt.regularizer.L2Decay(0.1)
+        p = np.array([1.0, -2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(r.grad_term(p)), 0.1 * p, atol=1e-7)
+
+    def test_l1_grad_term(self):
+        r = pt.regularizer.L1Decay(0.5)
+        p = np.array([1.0, -2.0, 0.0], np.float32)
+        np.testing.assert_allclose(np.asarray(r.grad_term(p)), [0.5, -0.5, 0.0])
+
+
+class TestBatchReader:
+    def test_batch(self):
+        def reader():
+            yield from range(7)
+        out = list(pt.batch(reader, batch_size=3)())
+        assert out == [[0, 1, 2], [3, 4, 5], [6]]
+        out = list(pt.batch(reader, batch_size=3, drop_last=True)())
+        assert out == [[0, 1, 2], [3, 4, 5]]
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def toy(scale=2):\n"
+            "    '''a toy entrypoint'''\n"
+            "    return scale * 21\n")
+        assert "toy" in pt.hub.list(str(tmp_path), source="local")
+        assert "toy entrypoint" in pt.hub.help(str(tmp_path), "toy", source="local")
+        assert pt.hub.load(str(tmp_path), "toy", source="local", scale=2) == 42
+
+    def test_remote_rejected(self):
+        with pytest.raises(ValueError):
+            pt.hub.list("owner/repo", source="github")
+
+
+def test_sysconfig_paths_exist():
+    assert os.path.isdir(pt.sysconfig.get_include())
+
+
+class TestTpuIrfftFallback:
+    """XLA's TPU backend has no IRFFT kernel; fft.py rebuilds the Hermitian
+    spectrum and uses C2C ifft instead. Force that codepath on CPU and check
+    it against numpy."""
+
+    @pytest.fixture(autouse=True)
+    def _force_tpu_path(self, monkeypatch):
+        import paddle_tpu.fft as F
+        monkeypatch.setattr(F, "_on_tpu", lambda: True)
+
+    def test_irfft_even_odd_norms(self):
+        from paddle_tpu.fft import irfft_array
+        rng = np.random.RandomState(0)
+        for n in (64, 63):
+            spec = np.fft.rfft(rng.randn(3, n)).astype(np.complex64)
+            for norm in ("backward", "ortho", "forward"):
+                got = np.asarray(irfft_array(spec, n=n, axis=-1, norm=norm))
+                ref = np.fft.irfft(spec, n=n, axis=-1, norm=norm)
+                np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_irfft_axis_and_truncation(self):
+        from paddle_tpu.fft import irfft_array
+        rng = np.random.RandomState(1)
+        spec = np.fft.rfft(rng.randn(5, 32), axis=-1).astype(np.complex64)  # [5,17]
+        got = np.asarray(irfft_array(spec.T, n=32, axis=0))
+        np.testing.assert_allclose(got, np.fft.irfft(spec, n=32, axis=-1).T, atol=1e-5)
+        # n smaller / larger than 2*(f-1)
+        for n in (24, 40):
+            got = np.asarray(irfft_array(spec, n=n, axis=-1))
+            np.testing.assert_allclose(got, np.fft.irfft(spec, n=n, axis=-1), atol=1e-5)
+
+    def test_hfft_ihfft(self):
+        from paddle_tpu.fft import hfft_array, ihfft_array
+        rng = np.random.RandomState(2)
+        a = (rng.randn(4, 17) + 1j * rng.randn(4, 17)).astype(np.complex64)
+        r = rng.randn(4, 32).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            np.testing.assert_allclose(np.asarray(hfft_array(a, norm=norm)),
+                                       np.fft.hfft(a, norm=norm), atol=1e-3)
+            np.testing.assert_allclose(np.asarray(ihfft_array(r, norm=norm)),
+                                       np.fft.ihfft(r, norm=norm), atol=1e-5)
+
+    def test_irfftn(self):
+        from paddle_tpu.fft import irfftn_array
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 8, 16)
+        spec = np.fft.rfftn(x, axes=(1, 2)).astype(np.complex64)
+        got = np.asarray(irfftn_array(spec, s=(8, 16), axes=(1, 2)))
+        np.testing.assert_allclose(got, np.fft.irfftn(spec, s=(8, 16), axes=(1, 2)),
+                                   atol=1e-4)
+        got2 = np.asarray(irfftn_array(spec, axes=(1, 2)))
+        np.testing.assert_allclose(got2, np.fft.irfftn(spec, axes=(1, 2)), atol=1e-4)
