@@ -31,12 +31,16 @@ class LlamaTrainStep:
 
     def __init__(self, config: L.LlamaConfig, mesh: ProcessMesh | None = None,
                  optimizer: Optimizer | None = None, num_microbatches: int = 1,
-                 remat: bool = True, seed: int = 0):
+                 remat: bool = True, seed: int = 0, pp_schedule: str = "gpipe"):
         self.config = config
         self.mesh = mesh
         self.optimizer = optimizer or AdamW(learning_rate=3e-4, weight_decay=0.1)
         self.num_microbatches = num_microbatches
         self.remat = remat
+        sched = pp_schedule.lower()
+        if sched not in ("gpipe", "fthenb", "1f1b"):
+            raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
+        self.pp_schedule = "1f1b" if sched == "1f1b" else "gpipe"
         jm = mesh.jax_mesh if mesh is not None else None
         self._jm = jm
 
@@ -50,47 +54,34 @@ class LlamaTrainStep:
 
         cfg, opt, mb, do_remat = config, self.optimizer, num_microbatches, remat
 
-        if not use_pp:
-            def loss_fn(p, tokens, labels):
-                return L.llama_loss(p, tokens, labels, cfg, mesh=jm, remat=do_remat)
-        else:
+        if use_pp:
             S = jm.shape["pp"]
             assert config.num_hidden_layers % S == 0, "layers % pp != 0"
             assert mb >= 1
             Lps = config.num_hidden_layers // S
-            from ..parallel.pipeline_parallel import pipeline_apply
 
-            def loss_fn(p, tokens, labels):
-                layer_p, other = L.split_layer_params(p)
+            def chunk_params(layer_p):
                 # [L, ...] -> [S, L/S, ...], stage-major, sharded on pp
-                chunked = jax.tree.map(
+                return jax.tree.map(
                     lambda v: jax.lax.with_sharding_constraint(
                         v.reshape((S, Lps) + v.shape[1:]),
                         NamedSharding(jm, P("pp"))),
                     layer_p)
-                x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(cfg.dtype)
-                B = x.shape[0]
-                assert B % mb == 0, "batch % microbatches != 0"
-                mbs = x.reshape((mb, B // mb) + x.shape[1:])
-                positions = jnp.arange(x.shape[1])[None, :].astype(jnp.int32)
-                positions = jnp.broadcast_to(positions, (B // mb, x.shape[1]))
 
+            def make_stage_fn(positions):
                 def stage_fn(sp, act):
-                    def body(carry, lp):
-                        y, aux = L._decoder_layer(carry, lp, cfg, None, positions)
+                    def body(carry, lpar):
+                        y, aux = L._decoder_layer(carry, lpar, cfg, None, positions)
                         return y, aux
 
                     body_fn = jax.checkpoint(body) if do_remat else body
                     out, _ = jax.lax.scan(body_fn, act, sp)
                     return out
+                return stage_fn
 
-                outs = pipeline_apply(stage_fn, chunked, mbs, mesh, "pp",
-                                      remat=False)
-                x = outs.reshape((B,) + outs.shape[2:])
-                x = L._rmsnorm(x, other["norm"], cfg.rms_norm_eps)
-                head = other.get("lm_head")
-                if head is None:
-                    head = other["embed_tokens"].T
+            def head_loss(norm_w, head, x, labels):
+                # rmsnorm -> lm head -> masked-mean token cross-entropy
+                x = L._rmsnorm(x, norm_w, cfg.rms_norm_eps)
                 logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
@@ -98,8 +89,80 @@ class LlamaTrainStep:
                 mask = (labels >= 0).astype(jnp.float32)
                 return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
+            def positions_for(rows, Tlen):
+                pos = jnp.arange(Tlen)[None, :].astype(jnp.int32)
+                return jnp.broadcast_to(pos, (rows, Tlen))
+
+        if not use_pp:
+            def loss_fn(p, tokens, labels):
+                return L.llama_loss(p, tokens, labels, cfg, mesh=jm, remat=do_remat)
+
+            def value_and_grad_fn(p, tokens, labels):
+                return jax.value_and_grad(loss_fn)(p, tokens, labels)
+        elif self.pp_schedule == "gpipe":
+            from ..parallel.pipeline_parallel import pipeline_apply
+
+            def loss_fn(p, tokens, labels):
+                layer_p, other = L.split_layer_params(p)
+                chunked = chunk_params(layer_p)
+                x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(cfg.dtype)
+                B = x.shape[0]
+                assert B % mb == 0, "batch % microbatches != 0"
+                mbs = x.reshape((mb, B // mb) + x.shape[1:])
+                outs = pipeline_apply(make_stage_fn(positions_for(B // mb, x.shape[1])),
+                                      chunked, mbs, mesh, "pp", remat=False)
+                x = outs.reshape((B,) + outs.shape[2:])
+                head = other.get("lm_head")
+                if head is None:
+                    head = other["embed_tokens"].T
+                return head_loss(other["norm"], head, x, labels)
+
+            def value_and_grad_fn(p, tokens, labels):
+                return jax.value_and_grad(loss_fn)(p, tokens, labels)
+        else:  # 1f1b
+            # Explicit 1F1B: grads come from the schedule primitive, not
+            # jax.grad — activation memory bounded by pipeline depth, not by
+            # accumulate_steps. Loss is the mean of per-microbatch means
+            # (identical to the global token mean when every microbatch
+            # carries the same number of unmasked tokens).
+            from ..parallel.pipeline_parallel import pipeline_train_1f1b
+
+            def value_and_grad_fn(p, tokens, labels):
+                layer_p, other = L.split_layer_params(p)
+                chunked = chunk_params(layer_p)
+                B, Tlen = tokens.shape
+                assert B % mb == 0, "batch % microbatches != 0"
+
+                tied = other.get("lm_head") is None
+                head = other["embed_tokens"].T if tied else other["lm_head"]
+                lp = {"norm": other["norm"], "head": head}
+
+                def loss_fn_pp(lp_, y, lbl):
+                    return head_loss(lp_["norm"], lp_["head"], y, lbl)
+
+                def embed_fn(emb):
+                    x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+                    return x.reshape((mb, B // mb) + x.shape[1:])
+
+                mbs, embed_pull = jax.vjp(embed_fn, other["embed_tokens"])
+                lbls = labels.reshape((mb, B // mb, Tlen))
+
+                loss, g_stack, g_lp, g_mbs = pipeline_train_1f1b(
+                    make_stage_fn(positions_for(B // mb, Tlen)), loss_fn_pp,
+                    chunked, lp, mbs, lbls, mesh, "pp")
+                (d_emb,) = embed_pull(g_mbs)
+                grads = jax.tree.map(
+                    lambda v: v.reshape((S * Lps,) + v.shape[2:]), g_stack)
+                grads["norm"] = g_lp["norm"]
+                if tied:
+                    grads["embed_tokens"] = d_emb + g_lp["head"].T
+                else:
+                    grads["embed_tokens"] = d_emb
+                    grads["lm_head"] = g_lp["head"]
+                return loss, grads
+
         def step_fn(p, opt_state, tokens, labels, lr, step_i):
-            loss, grads = jax.value_and_grad(loss_fn)(p, tokens, labels)
+            loss, grads = value_and_grad_fn(p, tokens, labels)
             new_p, new_s = opt.apply_gradients(grads, p, opt_state, lr=lr, step=step_i)
             return loss, new_p, new_s
 

@@ -11,8 +11,28 @@ keeps sharding them inside each stage). Activations move between neighbor
 stages with `lax.ppermute` — nearest-neighbor ICI hops. One scan step = one
 pipeline tick; M microbatches over S stages take M+S-1 ticks (GPipe/F-then-B;
 autodiff of the scan yields the mirrored backward schedule, and
-`jax.checkpoint` on the stage fn keeps memory at 1F1B level). Zero-bubble
-variants land as alternative schedules in a later round.
+`jax.checkpoint` on the stage fn keeps memory at 1F1B level).
+
+Schedule zoo (reference: distributed/passes/pipeline_scheduler_pass/*
+{pipeline_fthenb,pipeline_1f1b,pipeline_vpp,pipeline_zero_bubble}.py and the
+dygraph engine meta_parallel/pipeline_parallel.py:255):
+
+* `pipeline_apply`            — FThenB/GPipe: fwd scan, autodiff bwd scan.
+* `pipeline_train_1f1b`       — explicit 1F1B: ONE scan whose tick does a
+  masked forward AND a masked backward; stage inputs live in a ring buffer of
+  depth min(M, 2S-1) instead of M, so activation memory is bounded by the
+  pipeline depth, not the accumulation count (the reference's motivation for
+  1F1B). Backward rebuilds the stage vjp from the saved input (recompute),
+  which is the reference's recompute+1F1B pairing.
+* `pipeline_apply_interleaved` — VPP/circular: each rank owns V chunks
+  (chunk j on rank j%S), microbatches circle the ring V times; bubble
+  fraction drops from (S-1)/(M+S-1) to (S-1)/(M*V+S-1).
+
+Zero-bubble (ZBH1/ZB-VPP) splits backward into dgrad/wgrad to fill bubbles
+with weight-grad work. In this compiled SPMD formulation each tick is one
+fused XLA program in which the weight-grad matmuls are already scheduled by
+the compiler alongside dgrad; a separate W-pass would add ticks, not remove
+bubble — so ZBH1 intentionally collapses into `pipeline_train_1f1b` here.
 """
 from __future__ import annotations
 
@@ -27,7 +47,8 @@ from ..core.tensor import Tensor
 from ..distributed.process_mesh import ProcessMesh, get_mesh
 from ..nn.layer.layers import Layer
 
-__all__ = ["pipeline_apply", "stack_stage_params", "PipelineParallel"]
+__all__ = ["pipeline_apply", "pipeline_train_1f1b", "pipeline_apply_interleaved",
+           "stack_stage_params", "PipelineParallel"]
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: ProcessMesh,
@@ -78,6 +99,201 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: Proce
     return shmapped(stacked_params, microbatches)
 
 
+def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
+                        loss_params, microbatches, labels, mesh: ProcessMesh,
+                        pp_axis: str = "pp", remat: bool = False):
+    """Explicit compiled 1F1B schedule: loss + grads in one scan.
+
+    remat defaults to False: the schedule already rebuilds each stage's vjp
+    from the saved input within the tick, so jax.checkpoint adds a third
+    stage-forward per tick without reducing peak memory. Set remat=True only
+    to shrink WITHIN-stage residuals when a single stage is itself deep.
+
+    stage_fn(stage_params, x) -> y (same shape as x).
+    loss_fn(loss_params, y, label_mb) -> scalar (mean over the microbatch);
+    runs only on the last stage (real branch via lax.cond, not masking).
+    stacked_params: pytree, leaves [S, ...] sharded on pp_axis.
+    microbatches: [M, mb, ...]; labels: [M, mb, ...].
+
+    Returns (mean_loss, grads_stacked [S,...], grads_loss_params, grads_mbs
+    [M, mb, ...]) — grads_mbs lets the caller chain backward into whatever
+    produced the microbatch activations (e.g. an embedding outside the trunk).
+
+    Tick t: stage s forwards microbatch m_f = t - s and backwards
+    m_b = t - (2S-2-s); on the last stage m_f == m_b, so forward, loss and
+    backward of one microbatch fuse into a single tick (the 1F1B steady
+    state). Stage inputs wait in a ring buffer of depth min(M, 2S-1); the
+    backward vjp is rebuilt from the saved input (recompute).
+    """
+    jm = mesh.jax_mesh
+    S = mesh.get_dim_size(pp_axis)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    M = microbatches.shape[0]
+    W = min(M, 2 * S - 1)
+    T = M + 2 * S - 2
+    inv_m = 1.0 / M
+
+    def local_fn(params_local, lp, mbs, lbls):
+        params1 = jax.tree.map(lambda p: p[0], params_local)
+        idx = jax.lax.axis_index(pp_axis)
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+        zero_lp_grad = jax.tree.map(jnp.zeros_like, lp)
+
+        def last_tick(p, x_in, lbl, dy_in):
+            # forward + loss + backward of the SAME microbatch in one tick
+            def g(p_, x_, lp_):
+                return loss_fn(lp_, fn(p_, x_), lbl)
+            loss_m, pull = jax.vjp(g, p, x_in, lp)
+            dp, dx, dlp = pull(jnp.asarray(inv_m, loss_m.dtype))
+            y_send = jnp.zeros_like(x_in)  # no stage after the last one
+            return y_send, loss_m * inv_m, dp, dx, dlp
+
+        def mid_tick(p, x_in, x_saved, dy_in):
+            y = fn(p, x_in)
+            _, pull = jax.vjp(lambda p_, x_: fn(p_, x_), p, x_saved)
+            dp, dx = pull(dy_in)
+            return y, jnp.zeros((), jnp.float32), dp, dx, zero_lp_grad
+
+        def body(carry, t):
+            fwd_state, bwd_state, act_buf, grad_acc, lp_grad, dmbs, loss_acc = carry
+            m_f = t - idx
+            fwd_valid = jnp.logical_and(m_f >= 0, m_f < M)
+            m_b = t - (2 * S - 2 - idx)
+            bwd_valid = jnp.logical_and(m_b >= 0, m_b < M)
+
+            mb_in = jnp.take(mbs, jnp.clip(m_f, 0, M - 1), axis=0)
+            x_in = jnp.where(idx == 0, mb_in, fwd_state)
+            lbl = jnp.take(lbls, jnp.clip(m_f, 0, M - 1), axis=0)
+
+            # save this tick's input before the read (last stage reads the
+            # slot it just wrote: m_f == m_b there)
+            slot_f = jnp.clip(m_f, 0, M - 1) % W
+            cur = jnp.take(act_buf, slot_f, axis=0)
+            act_buf = jax.lax.dynamic_update_index_in_dim(
+                act_buf, jnp.where(fwd_valid, x_in, cur), slot_f, 0)
+            x_saved = jnp.take(act_buf, jnp.clip(m_b, 0, M - 1) % W, axis=0)
+
+            y, loss_m, dp, dx, dlp = jax.lax.cond(
+                idx == S - 1,
+                lambda: last_tick(params1, x_in, lbl, bwd_state),
+                lambda: mid_tick(params1, x_in, x_saved, bwd_state))
+
+            grad_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
+                grad_acc, dp)
+            lp_grad = jax.tree.map(
+                lambda a, g: a + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
+                lp_grad, dlp)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(fwd_valid, idx == S - 1), loss_m, 0.0)
+
+            # input-side cotangent: stage 0's backward is d(microbatch m_b)
+            slot_b = jnp.clip(m_b, 0, M - 1)
+            dm_cur = jnp.take(dmbs, slot_b, axis=0)
+            write_dm = jnp.logical_and(bwd_valid, idx == 0)
+            dmbs = jax.lax.dynamic_update_index_in_dim(
+                dmbs, jnp.where(write_dm, dx.astype(dmbs.dtype), dm_cur), slot_b, 0)
+
+            fwd_state = jax.lax.ppermute(y, pp_axis, fwd_perm)
+            bwd_state = jax.lax.ppermute(
+                jnp.where(bwd_valid, dx, jnp.zeros_like(dx)), pp_axis, bwd_perm)
+            return (fwd_state, bwd_state, act_buf, grad_acc, lp_grad, dmbs,
+                    loss_acc), None
+
+        zeros_mb = jnp.zeros_like(mbs[0])
+        carry0 = (zeros_mb, zeros_mb, jnp.zeros((W,) + mbs.shape[1:], mbs.dtype),
+                  jax.tree.map(jnp.zeros_like, params1), zero_lp_grad,
+                  jnp.zeros_like(mbs), jnp.zeros((), jnp.float32))
+        (_, _, _, grad_acc, lp_grad, dmbs, loss_acc), _ = jax.lax.scan(
+            body, carry0, jnp.arange(T))
+
+        idx_f = jax.lax.axis_index(pp_axis)
+        loss = jax.lax.psum(jnp.where(idx_f == S - 1, loss_acc, 0.0), pp_axis)
+        lp_grad = jax.tree.map(lambda g: jax.lax.psum(g, pp_axis), lp_grad)
+        mask0 = (idx_f == 0)
+        dmbs = jax.lax.psum(jnp.where(mask0, dmbs, jnp.zeros_like(dmbs)), pp_axis)
+        grads_stacked = jax.tree.map(lambda g: g[None], grad_acc)
+        return loss, grads_stacked, lp_grad, dmbs
+
+    in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                jax.tree.map(lambda _: P(), loss_params), P(), P())
+    out_specs = (P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                 jax.tree.map(lambda _: P(), loss_params), P())
+    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs,
+                             out_specs=out_specs,
+                             axis_names=frozenset({pp_axis}), check_vma=False)
+    return shmapped(stacked_params, loss_params, microbatches, labels)
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
+                               mesh: ProcessMesh, num_chunks: int,
+                               pp_axis: str = "pp", remat: bool = True):
+    """VPP/circular forward schedule (differentiable; autodiff mirrors it).
+
+    stacked_params: pytree, leaves [V, S, ...] — chunk j = v*S + r lives on
+    rank r = j % S at local slot v = j // S; axis 1 sharded on pp_axis. Each
+    microbatch traverses chunks 0..V*S-1, circling the ring V times
+    (ppermute with wrap-around S-1 -> 0). Microbatches are injected in
+    groups of S, one group per V ring laps, so every rank runs exactly one
+    chunk per tick: T = M*V + S - 1 vs GPipe's (M + S - 1) ticks of
+    V-times-larger stages — the warmup bubble shrinks by ~V.
+
+    microbatches: [M, mb, ...] with M % S == 0. Returns [M, mb, ...].
+    """
+    jm = mesh.jax_mesh
+    S = mesh.get_dim_size(pp_axis)
+    V = int(num_chunks)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    M = microbatches.shape[0]
+    if M % S != 0:
+        raise ValueError(f"num microbatches ({M}) must be a multiple of pp ({S})")
+    SV = S * V
+    T = M * V + S - 1
+
+    def local_fn(params_local, mbs):
+        # local leaves are [V, 1, ...] — drop the sharded rank axis
+        pv = jax.tree.map(lambda p: p[:, 0], params_local)
+        r = jax.lax.axis_index(pp_axis)
+        ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def body(carry, t):
+            state, out_acc = carry
+            mmod = (t - r) % S
+            base = t - mmod                      # multiple of S once valid
+            j = base % SV                        # chunk index; j % S == r
+            g = base // SV                       # microbatch group
+            m = g * S + mmod
+            v = j // S
+            valid = jnp.logical_and(base >= 0, jnp.logical_and(m >= 0, m < M))
+
+            inject = jnp.logical_and(j == 0, valid)
+            mb_in = jnp.take(mbs, jnp.clip(m, 0, M - 1), axis=0)
+            x_in = jnp.where(inject, mb_in, state)
+
+            p_t = jax.tree.map(lambda p: jnp.take(p, v, axis=0), pv)
+            y = fn(p_t, x_in)
+
+            done = jnp.logical_and(j == SV - 1, valid)  # rank S-1 only
+            slot = jnp.clip(m, 0, M - 1)
+            cur = jnp.take(out_acc, slot, axis=0)
+            out_acc = jax.lax.dynamic_update_index_in_dim(
+                out_acc, jnp.where(done, y, cur), slot, 0)
+
+            state = jax.lax.ppermute(y, pp_axis, ring)
+            return (state, out_acc), None
+
+        carry0 = (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
+        (_, outs), _ = jax.lax.scan(body, carry0, jnp.arange(T))
+        mask = (jax.lax.axis_index(pp_axis) == S - 1).astype(outs.dtype)
+        return jax.lax.psum(outs * mask, pp_axis)
+
+    in_specs = (jax.tree.map(lambda _: P(None, pp_axis), stacked_params), P())
+    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
+                             axis_names=frozenset({pp_axis}), check_vma=False)
+    return shmapped(stacked_params, microbatches)
+
+
 def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp"):
     """[per-stage param pytrees] → one stage-stacked pytree sharded on pp."""
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *stage_param_list)
@@ -91,8 +307,9 @@ def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp")
 
 class PipelineParallel(Layer):
     """Dygraph-style engine (reference pipeline_parallel.py:255): wraps a
-    PipelineLayer + optimizer and exposes train_batch(). The whole
-    forward+backward+update compiles into ONE XLA program per step."""
+    PipelineLayer + optimizer and exposes train_batch() with eager
+    microbatch accumulation. The compiled overlapping schedules are
+    `pipeline_apply` / `pipeline_train_1f1b` (used by models.trainer)."""
 
     def __init__(self, layers, hcg=None, strategy=None, num_microbatches=None):
         super().__init__()
@@ -100,13 +317,46 @@ class PipelineParallel(Layer):
         self._hcg = hcg
         self.num_microbatches = num_microbatches or (
             strategy.pipeline_configs.get("accumulate_steps", 1) if strategy else 1)
-        self._step_fn = None
 
     def forward(self, x):
         return self._layers(x)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None, loss_fn=None):
-        """One pipelined training step. data: (inputs, labels) global batch."""
-        raise NotImplementedError(
-            "use models.trainer.Trainer with pipeline='pp' (functional step); "
-            "the imperative train_batch lands with the schedule zoo")
+        """One training step over `num_microbatches` (reference
+        pipeline_parallel.py:820). Eager semantics: microbatches run
+        sequentially with gradient accumulation — numerically identical to
+        the pipelined schedule (on a single host there is no stage overlap
+        to exploit; the compiled overlapping schedules live in
+        `pipeline_train_1f1b` / `pipeline_apply` and models.trainer).
+        Returns the mean microbatch loss."""
+        inputs, labels = data
+        loss_fn = loss_fn or getattr(self._layers, "_loss_fn", None)
+        if loss_fn is None:
+            raise ValueError("train_batch needs a loss_fn (argument or "
+                             "PipelineLayer(loss_fn=...))")
+        M = self.num_microbatches
+        B = inputs.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch size {B} not divisible by "
+                             f"num_microbatches {M}")
+        mb = B // M
+        total = None
+        for m in range(M):
+            x_mb = inputs[m * mb:(m + 1) * mb]
+            y_mb = labels[m * mb:(m + 1) * mb]
+            out = self._layers(x_mb)
+            loss = loss_fn(out, y_mb) * (1.0 / M)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total

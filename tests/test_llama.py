@@ -103,6 +103,21 @@ class TestLlamaSharded:
         l_pp = float(pp(toks, labels))
         np.testing.assert_allclose(l_plain, l_pp, rtol=1e-4)
 
+    def test_pp_1f1b_matches_no_pp(self):
+        # explicit-1F1B schedule: loss AND the trained state must agree with
+        # the plain single-program step (labels all valid -> identical loss
+        # semantics), across two steps so the gradient path is exercised.
+        cfg = LlamaConfig.tiny(num_hidden_layers=4)
+        toks, labels = _batch(cfg, b=4, t=16, seed=7)
+        plain = LlamaTrainStep(cfg, mesh=None, remat=False, seed=13)
+        mesh = dist.ProcessMesh(np.arange(4), ["pp"])
+        pp = LlamaTrainStep(cfg, mesh=mesh, num_microbatches=2, remat=False,
+                            seed=13, pp_schedule="1f1b")
+        for _ in range(2):
+            l_plain = float(plain(toks, labels))
+            l_pp = float(pp(toks, labels))
+            np.testing.assert_allclose(l_plain, l_pp, rtol=2e-4)
+
     def test_moe_ep_train_step(self):
         mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "tp"])
         cfg = LlamaConfig.tiny(num_experts=4, num_experts_per_tok=2)
