@@ -260,9 +260,10 @@ def _attention(q, k, v, config, use_flash=True):
     if use_flash:
         from ..ops.flash_attention import flash_attention_tpu_available, _fa_reference
         if flash_attention_tpu_available() and q.shape[1] % 128 == 0 \
-                and config.head_dim % 128 == 0:
-            from ..ops.flash_attention import _flash_fwd_bwd
-            return _flash_fwd_bwd(q, k, v, True, min(512, q.shape[1]), min(512, k.shape[1]))
+                and k.shape[1] % 128 == 0 and config.head_dim % 128 == 0:
+            from ..ops.flash_attention import _fit_block, _flash_fwd_bwd
+            return _flash_fwd_bwd(q, k, v, True, _fit_block(512, q.shape[1]),
+                                  _fit_block(512, k.shape[1]))
     scale = 1.0 / math.sqrt(config.head_dim)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     T, S_ = logits.shape[-2], logits.shape[-1]

@@ -36,19 +36,40 @@ def flash_attention_tpu_available() -> bool:
         return False
 
 
+def _block_run(qi, ki, block_q, block_k, L, S, causal):
+    """Causal block-skip: does block (qi, ki) contain any visible entry?
+    Bottom-right-aligned convention: row r sees cols <= r + S - L. Shared by
+    the forward and both backward kernels so the convention cannot diverge."""
+    if causal:
+        return (ki * block_k) <= (qi * block_q + block_q - 1 + S - L)
+    return ki >= 0
+
+
+def _causal_mask_scores(s, qi, ki, block_q, block_k, L, S):
+    """Apply the in-block bottom-right causal mask to a score tile."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows + (S - L) >= cols, s, -jnp.inf)
+
+
+def masked_softmax(logits, mask):
+    """Softmax along the last axis where fully-masked rows (e.g. the L>S head
+    of a bottom-right causal mask) get all-zero probs — and defined
+    gradients — instead of softmax(-inf row)=nan. Matches the Pallas
+    forward's handling of rows with no visible kv."""
+    m = jnp.max(jnp.where(mask, logits, -jnp.inf), axis=-1, keepdims=True)
+    m = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(mask, jnp.exp(logits - m), 0.0)
+    return p / jnp.maximum(p.sum(-1, keepdims=True), 1e-30)
+
+
 def _fa_reference(q, k, v, causal):
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * scale
     if causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-        # mask-aware softmax that keeps logits finite: fully-masked rows (L>S
-        # bottom-right causal) get all-zero probs — and defined gradients —
-        # instead of softmax(-inf row)=nan, matching the kernel's forward
-        m = jnp.max(jnp.where(mask, logits, -jnp.inf), axis=-1, keepdims=True)
-        m = jnp.where(jnp.isneginf(m), 0.0, m)
-        p = jnp.where(mask, jnp.exp(logits - m), 0.0)
-        probs = (p / jnp.maximum(p.sum(-1, keepdims=True), 1e-30)).astype(q.dtype)
+        probs = masked_softmax(logits, mask).astype(q.dtype)
     else:
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhls,bshd->blhd", probs, v)
@@ -62,9 +83,20 @@ def flash_attention(query, key, value, causal: bool = False, block_q: int = 512,
         L, S, D = q.shape[1], k.shape[1], q.shape[-1]
         if (L % _MIN_BLOCK) or (S % _MIN_BLOCK) or (D % 128) or not flash_attention_tpu_available():
             return _fa_reference(q, k, v, causal)
-        return _flash_fwd_bwd(q, k, v, causal, min(block_q, L), min(block_k, S))
+        return _flash_fwd_bwd(q, k, v, causal, _fit_block(block_q, L),
+                              _fit_block(block_k, S))
 
     return apply(f, query, key, value, name="flash_attention")
+
+
+def _fit_block(requested: int, length: int) -> int:
+    """Largest multiple of _MIN_BLOCK that divides `length` and is <= requested
+    (the grid fully tiles the sequence — no truncated tail)."""
+    b = max(min(requested, length), _MIN_BLOCK)
+    b -= b % _MIN_BLOCK
+    while length % b:
+        b -= _MIN_BLOCK
+    return b
 
 
 # ---------------- pallas kernel ----------------
@@ -81,16 +113,160 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret=False):
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
-    # blockwise recompute backward in fp32 via XLA (Pallas bwd kernel lands in
-    # a later round; recompute keeps memory at O(L) not O(L^2) via remat)
-    def attn(q_, k_, v_):
-        return _fa_reference(q_, k_, v_, causal)
-
-    _, vjp = jax.vjp(attn, q, k, v)
-    return vjp(dout)
+    return _flash_bwd_impl(q, k, v, out, lse, dout, causal, block_q, block_k,
+                           interpret)
 
 
 _flash_fwd_bwd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, dout, causal, block_q, block_k,
+                    interpret=False):
+    """Flash-attention-2 backward as two Pallas kernels.
+
+    Recomputes p = exp(q k^T * scale - lse) blockwise from the saved lse, so
+    nothing O(L*S) is ever materialised:
+      delta = rowsum(dout * out)                 (precomputed, [B,H,L])
+      dp = dout v^T;  ds = p * (dp - delta)
+      dq = ds k * scale   (kernel 1: q-block rows, accumulate over kv blocks)
+      dk = ds^T q * scale; dv = p^T dout
+                          (kernel 2: kv-block rows, accumulate over q blocks)
+    The causal block-skip condition matches the forward kernel's.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, L, H, D = q.shape
+    S = k.shape[1]
+    assert L % block_q == 0 and S % block_k == 0, \
+        f"blocks must tile the sequences: {L}%{block_q}, {S}%{block_k}"
+    scale = 1.0 / math.sqrt(D)
+    grid_q = L // block_q
+    grid_k = S // block_k
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    dot = jnp.swapaxes(dout, 1, 2)                  # [B, H, L, D]
+    delta = jnp.sum(dot.astype(jnp.float32) * jnp.swapaxes(out, 1, 2).astype(jnp.float32),
+                    axis=-1, keepdims=True)          # [B, H, L, 1]
+    lse4 = lse[..., None]                            # [B, H, L, 1]
+
+    def block_run(qi, ki):
+        return _block_run(qi, ki, block_q, block_k, L, S, causal)
+
+    def p_and_ds(qb, kb, vb, dob, lseb, deltab, qi, ki):
+        # qb [bq, D] f32 (pre-scaled), others f32; returns p, ds [bq, bk]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask_scores(s, qi, ki, block_q, block_k, L, S)
+        safe_lse = jnp.where(jnp.isneginf(lseb), 0.0, lseb)
+        p = jnp.exp(s - safe_lse)                    # masked entries: exp(-inf)=0
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - deltab)
+        return p, ds
+
+    # ---- kernel 1: dq (rows = q blocks, reduce over kv blocks) ----
+    def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc):
+        qi, ki = pl.program_id(2), pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc[:] = jnp.zeros_like(acc)
+
+        @pl.when(block_run(qi, ki))
+        def _body():
+            qb = q_ref[0, 0].astype(jnp.float32) * scale
+            kb = k_ref[0, 0].astype(jnp.float32)
+            vb = v_ref[0, 0].astype(jnp.float32)
+            dob = do_ref[0, 0].astype(jnp.float32)
+            _, ds = p_and_ds(qb, kb, vb, dob, lse_ref[0, 0], dl_ref[0, 0], qi, ki)
+            acc[:] += jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32) * scale
+
+        @pl.when(ki == grid_k - 1)
+        def _fin():
+            dq_ref[0, 0] = acc[:].astype(dq_ref.dtype)
+
+    dqt = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, grid_q, grid_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, _i0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, _i0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, _i0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse4, delta)
+
+    # ---- kernel 2: dk, dv (rows = kv blocks, reduce over q blocks) ----
+    def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref,
+                   acc_dk, acc_dv):
+        ki, qi = pl.program_id(2), pl.program_id(3)
+
+        @pl.when(qi == 0)
+        def _init():
+            acc_dk[:] = jnp.zeros_like(acc_dk)
+            acc_dv[:] = jnp.zeros_like(acc_dv)
+
+        @pl.when(block_run(qi, ki))
+        def _body():
+            qb = q_ref[0, 0].astype(jnp.float32) * scale
+            kb = k_ref[0, 0].astype(jnp.float32)
+            vb = v_ref[0, 0].astype(jnp.float32)
+            dob = do_ref[0, 0].astype(jnp.float32)
+            p, ds = p_and_ds(qb, kb, vb, dob, lse_ref[0, 0], dl_ref[0, 0], qi, ki)
+            acc_dv[:] += jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+            # qb is pre-scaled, so ds^T @ qb already carries the 1/sqrt(D)
+            acc_dk[:] += jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+
+        @pl.when(qi == grid_q - 1)
+        def _fin():
+            dk_ref[0, 0] = acc_dk[:].astype(dk_ref.dtype)
+            dv_ref[0, 0] = acc_dv[:].astype(dv_ref.dtype)
+
+    dkt, dvt = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, grid_k, grid_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, _i0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qi: (b, h, qi, _i0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qi: (b, h, qi, _i0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, _i0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, _i0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse4, delta)
+
+    return (jnp.swapaxes(dqt, 1, 2), jnp.swapaxes(dkt, 1, 2),
+            jnp.swapaxes(dvt, 1, 2))
 
 
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
@@ -101,6 +277,8 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
 
     B, L, H, D = q.shape
     S = k.shape[1]
+    assert L % block_q == 0 and S % block_k == 0, \
+        f"blocks must tile the sequences: {L}%{block_q}, {S}%{block_k}"
     scale = 1.0 / math.sqrt(D)
     grid_q = L // block_q
     grid_k = S // block_k
@@ -115,15 +293,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
             m_i[:] = jnp.full_like(m_i, -jnp.inf)
             l_i[:] = jnp.zeros_like(l_i)
 
-        if causal:
-            # bottom-right-aligned causal (row r sees cols <= r + S - L, the
-            # flash-attn convention; matches _fa_reference's tril offset):
-            # skip kv blocks that are fully masked for every row in the block
-            run = (ki * block_k) <= (qi * block_q + block_q - 1 + S - L)
-        else:
-            run = ki >= 0
-
-        @pl.when(run)
+        @pl.when(_block_run(qi, ki, block_q, block_k, L, S, causal))
         def _body():
             qb = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
             kb = k_ref[0, 0].astype(jnp.float32)          # [block_k, D]
@@ -131,9 +301,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
             s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                s = jnp.where(rows + (S - L) >= cols, s, -jnp.inf)
+                s = _causal_mask_scores(s, qi, ki, block_q, block_k, L, S)
             m_prev = m_i[:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
             # rows with no visible kv yet keep m=-inf; exp against 0 avoids
