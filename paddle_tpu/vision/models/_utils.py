@@ -1,0 +1,22 @@
+"""Shared building blocks for the vision model zoo (the reference centralises
+this as vision/ops ConvNormActivation; see
+/root/reference/python/paddle/vision/ops.py)."""
+from __future__ import annotations
+
+from ...nn import BatchNorm2D, Conv2D, ReLU, Sequential
+
+
+def conv_norm_act(in_ch, out_ch, kernel, stride=1, padding=None, groups=1,
+                  act=ReLU, bias=False):
+    """Conv2D -> BatchNorm2D -> activation. padding=None means 'same-ish'
+    ((kernel-1)//2, the zoo-wide convention); act=None drops the activation;
+    act may be a Layer class or a factory."""
+    if padding is None:
+        padding = (kernel - 1) // 2 if isinstance(kernel, int) else \
+            tuple((k - 1) // 2 for k in kernel)
+    layers = [Conv2D(in_ch, out_ch, kernel, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False if not bias else None),
+              BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)

@@ -1,0 +1,40 @@
+"""AlexNet (reference: /root/reference/python/paddle/vision/models/alexnet.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, Conv2D, Dropout, Layer, Linear,
+                   MaxPool2D, ReLU, Sequential)
+from ...tensor.manipulation import flatten
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(Layer):
+    def __init__(self, num_classes: int = 1000) -> None:
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2),
+        )
+        if num_classes > 0:
+            self.avgpool = AdaptiveAvgPool2D((6, 6))
+            self.classifier = Sequential(
+                Dropout(0.5), Linear(256 * 6 * 6, 4096), ReLU(),
+                Dropout(0.5), Linear(4096, 4096), ReLU(),
+                Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.avgpool(x)
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def alexnet(pretrained: bool = False, **kwargs):
+    return AlexNet(**kwargs)

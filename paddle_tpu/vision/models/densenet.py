@@ -1,0 +1,108 @@
+"""DenseNet (reference:
+/root/reference/python/paddle/vision/models/densenet.py — dense blocks with
+bottleneck layers and transition downsampling; layers ∈ {121,161,169,201,264})."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Layer, Linear, MaxPool2D, ReLU, Sequential)
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_ARCH = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseLayer(Layer):
+    def __init__(self, in_ch, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = BatchNorm2D(in_ch)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(in_ch, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return concat([x, y], axis=1)
+
+
+class Transition(Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.bn = BatchNorm2D(in_ch)
+        self.relu = ReLU()
+        self.conv = Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers: int = 121, bn_size: int = 4, dropout: float = 0.0,
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        assert layers in _ARCH, f"supported layers: {sorted(_ARCH)}, got {layers}"
+        num_init, growth, block_cfg = _ARCH[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1))
+        blocks = []
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(block_cfg) - 1:
+                blocks.append(Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_final = BatchNorm2D(ch)
+        self.relu_final = ReLU()
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu_final(self.bn_final(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

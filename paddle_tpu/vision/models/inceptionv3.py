@@ -1,0 +1,138 @@
+"""Inception-v3 (reference:
+/root/reference/python/paddle/vision/models/inceptionv3.py — InceptionA-E
+blocks with factorised 7x1/1x7 and 3x1/1x3 convolutions, 299x299 input)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, Dropout, Layer, Linear,
+                   MaxPool2D, Sequential)
+from ...tensor.manipulation import concat, flatten
+from ._utils import conv_norm_act
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _conv_bn(in_ch, out_ch, kernel, stride=1, padding=0):
+    return conv_norm_act(in_ch, out_ch, kernel, stride=stride, padding=padding)
+
+
+class InceptionA(Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1 = _conv_bn(in_ch, 64, 1)
+        self.b5 = Sequential(_conv_bn(in_ch, 48, 1), _conv_bn(48, 64, 5, padding=2))
+        self.b3 = Sequential(_conv_bn(in_ch, 64, 1), _conv_bn(64, 96, 3, padding=1),
+                             _conv_bn(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _conv_bn(in_ch, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionB(Layer):
+    """grid reduction 35->17"""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _conv_bn(in_ch, 384, 3, stride=2)
+        self.b3dbl = Sequential(_conv_bn(in_ch, 64, 1),
+                                _conv_bn(64, 96, 3, padding=1),
+                                _conv_bn(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3dbl(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, in_ch, ch7):
+        super().__init__()
+        self.b1 = _conv_bn(in_ch, 192, 1)
+        self.b7 = Sequential(_conv_bn(in_ch, ch7, 1),
+                             _conv_bn(ch7, ch7, (1, 7), padding=(0, 3)),
+                             _conv_bn(ch7, 192, (7, 1), padding=(3, 0)))
+        self.b7dbl = Sequential(
+            _conv_bn(in_ch, ch7, 1),
+            _conv_bn(ch7, ch7, (7, 1), padding=(3, 0)),
+            _conv_bn(ch7, ch7, (1, 7), padding=(0, 3)),
+            _conv_bn(ch7, ch7, (7, 1), padding=(3, 0)),
+            _conv_bn(ch7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _conv_bn(in_ch, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7dbl(x), self.bp(x)], axis=1)
+
+
+class InceptionD(Layer):
+    """grid reduction 17->8"""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = Sequential(_conv_bn(in_ch, 192, 1), _conv_bn(192, 320, 3, stride=2))
+        self.b7x3 = Sequential(_conv_bn(in_ch, 192, 1),
+                               _conv_bn(192, 192, (1, 7), padding=(0, 3)),
+                               _conv_bn(192, 192, (7, 1), padding=(3, 0)),
+                               _conv_bn(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _conv_bn(in_ch, 320, 1)
+        self.b3_stem = _conv_bn(in_ch, 384, 1)
+        self.b3_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b3dbl_stem = Sequential(_conv_bn(in_ch, 448, 1),
+                                     _conv_bn(448, 384, 3, padding=1))
+        self.b3dbl_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3dbl_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _conv_bn(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3dbl_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s), self.b3_b(s)], axis=1),
+                       concat([self.b3dbl_a(d), self.b3dbl_b(d)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _conv_bn(3, 32, 3, stride=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x)
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

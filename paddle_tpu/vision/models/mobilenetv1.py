@@ -1,0 +1,60 @@
+"""MobileNetV1 (reference:
+/root/reference/python/paddle/vision/models/mobilenetv1.py — depthwise
+separable conv stacks; depthwise convs lower to grouped
+lax.conv_general_dilated, which XLA maps onto the TPU convolution units)."""
+from __future__ import annotations
+
+from ...nn import AdaptiveAvgPool2D, Layer, Linear, Sequential
+from ...tensor.manipulation import flatten
+from ._utils import conv_norm_act as _conv_bn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, in_ch, out1, out2, num_groups, stride, scale):
+        super().__init__()
+        self.dw = _conv_bn(int(in_ch * scale), int(out1 * scale), 3, stride=stride,
+                           groups=int(num_groups * scale))
+        self.pw = _conv_bn(int(out1 * scale), int(out2 * scale), 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, int(32 * scale), 3, stride=2)
+        cfg = [  # in, out1, out2, groups, stride
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2), (128, 128, 128, 128, 1),
+            (128, 128, 256, 128, 2), (256, 256, 256, 256, 1),
+            (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1),
+            (512, 512, 1024, 512, 2), (1024, 1024, 1024, 1024, 1),
+        ]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(i, o1, o2, g, s, scale) for i, o1, o2, g, s in cfg])
+        if with_pool:
+            self.pool2d_avg = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,149 @@
+"""MobileNetV3 Small/Large (reference:
+/root/reference/python/paddle/vision/models/mobilenetv3.py — bneck blocks
+with squeeze-excitation, hardswish; config rows are
+(in, kernel, expanded, out, use_se, activation, stride))."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Hardsigmoid, Hardswish, Layer, Linear, ReLU, Sequential)
+from ...tensor.manipulation import flatten
+from ._utils import conv_norm_act
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _act(name):
+    return Hardswish() if name == "hardswish" else ReLU()
+
+
+def _conv_bn_act(in_ch, out_ch, kernel, stride=1, groups=1, act="hardswish"):
+    return conv_norm_act(in_ch, out_ch, kernel, stride=stride, groups=groups,
+                         act=lambda: _act(act))
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(ch, squeeze_ch, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze_ch, ch, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_ch, kernel, expanded, out_ch, use_se, act, stride,
+                 scale=1.0):
+        super().__init__()
+        in_ch = _make_divisible(in_ch * scale)
+        expanded = _make_divisible(expanded * scale)
+        out_ch = _make_divisible(out_ch * scale)
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expanded != in_ch:
+            layers.append(_conv_bn_act(in_ch, expanded, 1, act=act))
+        layers.append(_conv_bn_act(expanded, expanded, kernel, stride=stride,
+                                   groups=expanded, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(expanded,
+                                            _make_divisible(expanded // 4)))
+        layers += [Conv2D(expanded, out_ch, 1, bias_attr=False),
+                   BatchNorm2D(out_ch)]
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3(Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        first = _make_divisible(config[0][0] * scale)
+        layers = [_conv_bn_act(3, first, 3, stride=2, act="hardswish")]
+        for (in_ch, k, exp, out_ch, se, act, s) in config:
+            layers.append(InvertedResidual(in_ch, k, exp, out_ch, se, act, s,
+                                           scale))
+        last_in = _make_divisible(config[-1][3] * scale)
+        last_exp = 6 * last_in
+        layers.append(_conv_bn_act(last_in, last_exp, 1, act="hardswish"))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_exp, last_channel), Hardswish(), Dropout(0.2),
+                Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+_SMALL = [
+    (16, 3, 16, 16, True, "relu", 2),
+    (16, 3, 72, 24, False, "relu", 2),
+    (24, 3, 88, 24, False, "relu", 1),
+    (24, 5, 96, 40, True, "hardswish", 2),
+    (40, 5, 240, 40, True, "hardswish", 1),
+    (40, 5, 240, 40, True, "hardswish", 1),
+    (40, 5, 120, 48, True, "hardswish", 1),
+    (48, 5, 144, 48, True, "hardswish", 1),
+    (48, 5, 288, 96, True, "hardswish", 2),
+    (96, 5, 576, 96, True, "hardswish", 1),
+    (96, 5, 576, 96, True, "hardswish", 1),
+]
+
+_LARGE = [
+    (16, 3, 16, 16, False, "relu", 1),
+    (16, 3, 64, 24, False, "relu", 2),
+    (24, 3, 72, 24, False, "relu", 1),
+    (24, 5, 72, 40, True, "relu", 2),
+    (40, 5, 120, 40, True, "relu", 1),
+    (40, 5, 120, 40, True, "relu", 1),
+    (40, 3, 240, 80, False, "hardswish", 2),
+    (80, 3, 200, 80, False, "hardswish", 1),
+    (80, 3, 184, 80, False, "hardswish", 1),
+    (80, 3, 184, 80, False, "hardswish", 1),
+    (80, 3, 480, 112, True, "hardswish", 1),
+    (112, 3, 672, 112, True, "hardswish", 1),
+    (112, 5, 672, 160, True, "hardswish", 2),
+    (160, 5, 960, 160, True, "hardswish", 1),
+    (160, 5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, last_channel=_make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, last_channel=_make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

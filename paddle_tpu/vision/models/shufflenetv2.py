@@ -1,0 +1,144 @@
+"""ShuffleNetV2 (reference:
+/root/reference/python/paddle/vision/models/shufflenetv2.py — channel-shuffle
+units; scales x0_25..x2_0 plus the swish variant)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.engine import apply
+from ...nn import (AdaptiveAvgPool2D, Layer, Linear, MaxPool2D, ReLU,
+                   Sequential, Swish)
+from ...tensor.manipulation import concat, flatten, split
+from ._utils import conv_norm_act
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 224, 488, 976, 2048],
+}
+_REPEATS = [4, 8, 4]
+
+
+def channel_shuffle(x, groups: int):
+    def f(a):
+        b, c, h, w = a.shape
+        a = a.reshape(b, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(b, c, h, w)
+
+    return apply(f, x, name="channel_shuffle")
+
+
+def _conv_bn(in_ch, out_ch, kernel, stride=1, groups=1, act=ReLU):
+    return conv_norm_act(in_ch, out_ch, kernel, stride=stride, groups=groups,
+                         act=act)
+
+
+class InvertedResidual(Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, ch, act=ReLU):
+        super().__init__()
+        half = ch // 2
+        self.branch = Sequential(
+            _conv_bn(half, half, 1, act=act),
+            _conv_bn(half, half, 3, groups=half, act=None),
+            _conv_bn(half, half, 1, act=act),
+        )
+
+    def forward(self, x):
+        x1, x2 = split(x, 2, axis=1)
+        out = concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(Layer):
+    """stride-2 downsampling unit: both branches transform, then shuffle."""
+
+    def __init__(self, in_ch, out_ch, act=ReLU):
+        super().__init__()
+        half = out_ch // 2
+        self.branch1 = Sequential(
+            _conv_bn(in_ch, in_ch, 3, stride=2, groups=in_ch, act=None),
+            _conv_bn(in_ch, half, 1, act=act),
+        )
+        self.branch2 = Sequential(
+            _conv_bn(in_ch, half, 1, act=act),
+            _conv_bn(half, half, 3, stride=2, groups=half, act=None),
+            _conv_bn(half, half, 1, act=act),
+        )
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        assert scale in _STAGE_OUT, f"supported scales: {sorted(_STAGE_OUT)}"
+        out_ch = _STAGE_OUT[scale]
+        act_cls = Swish if act == "swish" else ReLU
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, out_ch[0], 3, stride=2, act=act_cls)
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_ch = out_ch[0]
+        for stage_id, rep in enumerate(_REPEATS):
+            oc = out_ch[stage_id + 1]
+            stages.append(InvertedResidualDS(in_ch, oc, act_cls))
+            for _ in range(rep - 1):
+                stages.append(InvertedResidual(oc, act_cls))
+            in_ch = oc
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(in_ch, out_ch[-1], 1, act=act_cls)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(out_ch[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

@@ -1,0 +1,76 @@
+"""SqueezeNet v1.0/v1.1 (reference:
+/root/reference/python/paddle/vision/models/squeezenet.py — Fire modules)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, Conv2D, Dropout, Layer,
+                   MaxPool2D, ReLU, Sequential)
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(Layer):
+    def __init__(self, in_ch, squeeze, expand1x1, expand3x3):
+        super().__init__()
+        self.squeeze = Conv2D(in_ch, squeeze, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze, expand1x1, 1)
+        self.expand3x3 = Conv2D(squeeze, expand3x3, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1x1(x)),
+                       self.relu(self.expand3x3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.version = str(version)
+        if self.version not in ("1.0", "1.1"):
+            raise ValueError(
+                f"supported versions are '1.0' and '1.1', got {version!r}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if self.version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2, padding=1), ReLU(), MaxPool2D(3, 2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64), MaxPool2D(3, 2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128), MaxPool2D(3, 2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier_conv = Conv2D(512, num_classes, 1)
+            self.dropout = Dropout(0.5)
+            self.relu_out = ReLU()
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.relu_out(self.classifier_conv(self.dropout(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
