@@ -6,3 +6,7 @@ from .llama import (  # noqa: F401
 from .trainer import LlamaTrainStep  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .bert import BertConfig, BertForPretraining, BertForSequenceClassification, BertModel  # noqa: F401
+from .diffusion import (  # noqa: F401
+    UNetConfig, UNetTrainStep, unet_apply, unet_init_params, ddpm_betas,
+    ddpm_add_noise, ddim_step,
+)

@@ -1,0 +1,354 @@
+"""Stable-Diffusion-class conditional UNet + diffusion schedulers, TPU-first.
+
+Capability target: BASELINE.md configs[2] — "Stable Diffusion 2.1 UNet train,
+sharded" (the reference ecosystem serves this via ppdiffusers'
+UNet2DConditionModel on top of paddle.nn; here the UNet is a functional
+params-pytree model like models/llama.py so one jitted train step carries
+fwd+bwd+update with donation, and dp/tp sharding is a placement choice).
+
+Architecture (SD-2.1 shape, scaled by `UNetConfig`):
+  timestep sinusoidal embedding -> MLP; down path of ResBlocks (+ spatial
+  self-attn and text cross-attn at the configured levels) with stride-2
+  downsample; mid Res-Attn-Res; up path with U-skip concats; GroupNorm/SiLU
+  conv head. Convs are NCHW `lax.conv_general_dilated` (MXU); attention
+  flattens the grid to tokens and reuses plain dot-product attention (XLA
+  fuses; flash kernel unnecessary at 64x64 latents).
+
+Schedulers: DDPM q(x_t|x_0) add_noise for training, DDIM deterministic
+sampling step for inference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["UNetConfig", "unet_init_params", "unet_apply", "ddpm_betas",
+           "ddpm_add_noise", "ddim_step", "UNetTrainStep"]
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_channels: Sequence[int] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    attn_levels: Sequence[int] = (0, 1, 2)   # levels with self+cross attention
+    num_heads: int = 8
+    context_dim: int = 1024                  # text-encoder width (SD2.1: 1024)
+    groups: int = 32
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(in_channels=4, out_channels=4, block_channels=(32, 64),
+                 layers_per_block=1, attn_levels=(1,), num_heads=2,
+                 context_dim=32, groups=8)
+        d.update(kw)
+        return cls(**d)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = 1.0 / math.sqrt(fan_in)
+    return (jax.random.uniform(key, (cout, cin, kh, kw), jnp.float32,
+                               -std, std)).astype(dtype)
+
+
+def _lin_init(key, cin, cout, dtype):
+    std = 1.0 / math.sqrt(cin)
+    return (jax.random.uniform(key, (cin, cout), jnp.float32, -std, std)).astype(dtype)
+
+
+class _KeyGen:
+    def __init__(self, key):
+        self.key = key
+
+    def __call__(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def _res_block(kg, cin, cout, temb_dim, cfg):
+    return {
+        "conv1": _conv_init(kg(), 3, 3, cin, cout, cfg.dtype),
+        "b1": jnp.zeros((cout,), cfg.dtype),
+        "conv2": _conv_init(kg(), 3, 3, cout, cout, cfg.dtype),
+        "b2": jnp.zeros((cout,), cfg.dtype),
+        "temb": _lin_init(kg(), temb_dim, cout, cfg.dtype),
+        "temb_b": jnp.zeros((cout,), cfg.dtype),
+        "gn1": jnp.ones((cin,), cfg.dtype), "gn1b": jnp.zeros((cin,), cfg.dtype),
+        "gn2": jnp.ones((cout,), cfg.dtype), "gn2b": jnp.zeros((cout,), cfg.dtype),
+        "skip": _conv_init(kg(), 1, 1, cin, cout, cfg.dtype) if cin != cout else None,
+    }
+
+
+def _attn_block(kg, ch, cfg):
+    return {
+        "gn": jnp.ones((ch,), cfg.dtype), "gnb": jnp.zeros((ch,), cfg.dtype),
+        # self-attention
+        "q": _lin_init(kg(), ch, ch, cfg.dtype),
+        "k": _lin_init(kg(), ch, ch, cfg.dtype),
+        "v": _lin_init(kg(), ch, ch, cfg.dtype),
+        "o": _lin_init(kg(), ch, ch, cfg.dtype),
+        # cross-attention on text context
+        "cq": _lin_init(kg(), ch, ch, cfg.dtype),
+        "ck": _lin_init(kg(), cfg.context_dim, ch, cfg.dtype),
+        "cv": _lin_init(kg(), cfg.context_dim, ch, cfg.dtype),
+        "co": _lin_init(kg(), ch, ch, cfg.dtype),
+        # geglu feed-forward
+        "ff1": _lin_init(kg(), ch, ch * 8, cfg.dtype),
+        "ff2": _lin_init(kg(), ch * 4, ch, cfg.dtype),
+        "ln1": jnp.ones((ch,), cfg.dtype), "ln2": jnp.ones((ch,), cfg.dtype),
+        "ln3": jnp.ones((ch,), cfg.dtype),
+    }
+
+
+def unet_init_params(config: UNetConfig, key=None):
+    cfg = config
+    kg = _KeyGen(key if key is not None else jax.random.PRNGKey(0))
+    ch0 = cfg.block_channels[0]
+    temb_dim = ch0 * 4
+    p = {
+        "conv_in": _conv_init(kg(), 3, 3, cfg.in_channels, ch0, cfg.dtype),
+        "conv_in_b": jnp.zeros((ch0,), cfg.dtype),
+        "t1": _lin_init(kg(), ch0, temb_dim, cfg.dtype),
+        "t1b": jnp.zeros((temb_dim,), cfg.dtype),
+        "t2": _lin_init(kg(), temb_dim, temb_dim, cfg.dtype),
+        "t2b": jnp.zeros((temb_dim,), cfg.dtype),
+        "down": [], "up": [],
+        "gn_out": jnp.ones((ch0,), cfg.dtype),
+        "gn_out_b": jnp.zeros((ch0,), cfg.dtype),
+        "conv_out": _conv_init(kg(), 3, 3, ch0, cfg.out_channels, cfg.dtype),
+        "conv_out_b": jnp.zeros((cfg.out_channels,), cfg.dtype),
+    }
+    # down path (track skip channels for the up path)
+    skips = [ch0]
+    cin = ch0
+    for lvl, ch in enumerate(cfg.block_channels):
+        blocks = []
+        for _ in range(cfg.layers_per_block):
+            blk = {"res": _res_block(kg, cin, ch, temb_dim, cfg)}
+            if lvl in cfg.attn_levels:
+                blk["attn"] = _attn_block(kg, ch, cfg)
+            blocks.append(blk)
+            cin = ch
+            skips.append(ch)
+        down = {"blocks": blocks}
+        if lvl != len(cfg.block_channels) - 1:
+            down["downsample"] = _conv_init(kg(), 3, 3, ch, ch, cfg.dtype)
+            down["downsample_b"] = jnp.zeros((ch,), cfg.dtype)
+            skips.append(ch)
+        p["down"].append(down)
+    # mid
+    mid_ch = cfg.block_channels[-1]
+    p["mid"] = {"res1": _res_block(kg, mid_ch, mid_ch, temb_dim, cfg),
+                "attn": _attn_block(kg, mid_ch, cfg),
+                "res2": _res_block(kg, mid_ch, mid_ch, temb_dim, cfg)}
+    # up path (mirror, consuming skips)
+    cin = mid_ch
+    for lvl in reversed(range(len(cfg.block_channels))):
+        ch = cfg.block_channels[lvl]
+        blocks = []
+        for _ in range(cfg.layers_per_block + 1):
+            skip_ch = skips.pop()
+            blk = {"res": _res_block(kg, cin + skip_ch, ch, temb_dim, cfg)}
+            if lvl in cfg.attn_levels:
+                blk["attn"] = _attn_block(kg, ch, cfg)
+            blocks.append(blk)
+            cin = ch
+        up = {"blocks": blocks}
+        if lvl != 0:
+            up["upsample"] = _conv_init(kg(), 3, 3, ch, ch, cfg.dtype)
+            up["upsample_b"] = jnp.zeros((ch,), cfg.dtype)
+        p["up"].append(up)
+    return p
+
+
+# ---------------- apply ----------------
+
+def _conv(x, w, b, stride=1, padding=1):
+    y = jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(padding, padding), (padding, padding)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return y + b[None, :, None, None]
+
+
+def _group_norm(x, gamma, beta, groups, eps=1e-5):
+    B, C, H, W = x.shape
+    g = x.reshape(B, groups, C // groups, H, W).astype(jnp.float32)
+    mean = g.mean(axis=(2, 3, 4), keepdims=True)
+    var = g.var(axis=(2, 3, 4), keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + eps)
+    out = g.reshape(B, C, H, W).astype(x.dtype)
+    return out * gamma[None, :, None, None] + beta[None, :, None, None]
+
+
+def _timestep_embedding(t, dim):
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def _apply_res(p, x, temb, groups):
+    h = _group_norm(x, p["gn1"], p["gn1b"], min(groups, x.shape[1]))
+    h = _conv(jax.nn.silu(h), p["conv1"], p["b1"])
+    h = h + (jax.nn.silu(temb) @ p["temb"] + p["temb_b"])[:, :, None, None]
+    h = _group_norm(h, p["gn2"], p["gn2b"], min(groups, h.shape[1]))
+    h = _conv(jax.nn.silu(h), p["conv2"], p["b2"])
+    skip = x if p["skip"] is None else jax.lax.conv_general_dilated(
+        x, p["skip"], (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return h + skip
+
+
+def _mha(q, k, v, heads):
+    B, Lq, C = q.shape
+    Lk = k.shape[1]
+    hd = C // heads
+    q = q.reshape(B, Lq, heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, Lk, heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, Lk, heads, hd).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+    a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+    return o.transpose(0, 2, 1, 3).reshape(B, Lq, C)
+
+
+def _layer_norm(x, g, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return (((x32 - mu) * jax.lax.rsqrt(var + eps)) * g).astype(x.dtype)
+
+
+def _apply_attn(p, x, context, heads, groups):
+    B, C, H, W = x.shape
+    h = _group_norm(x, p["gn"], p["gnb"], min(groups, C))
+    tokens = h.reshape(B, C, H * W).transpose(0, 2, 1)        # [B, HW, C]
+    t = _layer_norm(tokens, p["ln1"])
+    tokens = tokens + _mha(t @ p["q"], t @ p["k"], t @ p["v"], heads) @ p["o"]
+    t = _layer_norm(tokens, p["ln2"])
+    tokens = tokens + _mha(t @ p["cq"], context @ p["ck"], context @ p["cv"],
+                           heads) @ p["co"]
+    t = _layer_norm(tokens, p["ln3"])
+    a, b = jnp.split(t @ p["ff1"], 2, axis=-1)
+    tokens = tokens + (a * jax.nn.gelu(b)) @ p["ff2"]
+    return x + tokens.transpose(0, 2, 1).reshape(B, C, H, W)
+
+
+def unet_apply(params, x, t, context, config: UNetConfig):
+    """x [B, C, H, W] latents, t [B] int timesteps, context [B, L, D_ctx]."""
+    cfg = config
+    ch0 = cfg.block_channels[0]
+    temb = _timestep_embedding(t, ch0).astype(x.dtype)
+    temb = jax.nn.silu(temb @ params["t1"] + params["t1b"])
+    temb = temb @ params["t2"] + params["t2b"]
+
+    h = _conv(x, params["conv_in"], params["conv_in_b"])
+    skips = [h]
+    for lvl, down in enumerate(params["down"]):
+        for blk in down["blocks"]:
+            h = _apply_res(blk["res"], h, temb, cfg.groups)
+            if "attn" in blk:
+                h = _apply_attn(blk["attn"], h, context, cfg.num_heads, cfg.groups)
+            skips.append(h)
+        if "downsample" in down:
+            h = _conv(h, down["downsample"], down["downsample_b"], stride=2)
+            skips.append(h)
+
+    h = _apply_res(params["mid"]["res1"], h, temb, cfg.groups)
+    h = _apply_attn(params["mid"]["attn"], h, context, cfg.num_heads, cfg.groups)
+    h = _apply_res(params["mid"]["res2"], h, temb, cfg.groups)
+
+    for i, up in enumerate(params["up"]):
+        for blk in up["blocks"]:
+            h = jnp.concatenate([h, skips.pop()], axis=1)
+            h = _apply_res(blk["res"], h, temb, cfg.groups)
+            if "attn" in blk:
+                h = _apply_attn(blk["attn"], h, context, cfg.num_heads, cfg.groups)
+        if "upsample" in up:
+            B, C, H, W = h.shape
+            h = jax.image.resize(h, (B, C, H * 2, W * 2), "nearest")
+            h = _conv(h, up["upsample"], up["upsample_b"])
+
+    h = _group_norm(h, params["gn_out"], params["gn_out_b"], min(cfg.groups, h.shape[1]))
+    return _conv(jax.nn.silu(h), params["conv_out"], params["conv_out_b"])
+
+
+# ---------------- schedulers ----------------
+
+def ddpm_betas(num_steps=1000, beta_start=0.00085, beta_end=0.012):
+    """SD's scaled-linear schedule."""
+    return jnp.linspace(beta_start ** 0.5, beta_end ** 0.5, num_steps) ** 2
+
+
+def ddpm_add_noise(x0, noise, t, betas):
+    """q(x_t | x_0): sqrt(abar_t) x0 + sqrt(1-abar_t) eps."""
+    abar = jnp.cumprod(1.0 - betas)
+    a = abar[t].astype(x0.dtype)
+    while a.ndim < x0.ndim:
+        a = a[..., None]
+    return jnp.sqrt(a) * x0 + jnp.sqrt(1.0 - a) * noise
+
+
+def ddim_step(x_t, eps_pred, t, t_prev, betas):
+    """Deterministic DDIM x_t -> x_{t_prev} from the eps prediction."""
+    abar = jnp.cumprod(1.0 - betas)
+    a_t = abar[t]
+    a_p = jnp.where(t_prev >= 0, abar[jnp.maximum(t_prev, 0)], 1.0)
+    x0 = (x_t - jnp.sqrt(1.0 - a_t) * eps_pred) / jnp.sqrt(a_t)
+    return jnp.sqrt(a_p) * x0 + jnp.sqrt(1.0 - a_p) * eps_pred
+
+
+# ---------------- train step ----------------
+
+class UNetTrainStep:
+    """One jitted, donated step of eps-prediction training (the SD pretrain
+    objective): loss = mse(unet(x_t, t, ctx), eps)."""
+
+    def __init__(self, config: UNetConfig, optimizer=None, seed=0,
+                 num_train_timesteps=1000):
+        from ..optimizer import AdamW
+        self.config = config
+        self.optimizer = optimizer or AdamW(learning_rate=1e-4)
+        self.betas = ddpm_betas(num_train_timesteps)
+        self.num_train_timesteps = num_train_timesteps
+        self._params = unet_init_params(config, jax.random.PRNGKey(seed))
+        self._opt_state = self.optimizer.init_state(self._params)
+        self._step_i = 0
+        cfg, opt, betas = config, self.optimizer, self.betas
+
+        def loss_fn(p, x0, ctx, noise, t):
+            x_t = ddpm_add_noise(x0, noise, t, betas)
+            pred = unet_apply(p, x_t, t, ctx, cfg)
+            return jnp.mean((pred.astype(jnp.float32) - noise.astype(jnp.float32)) ** 2)
+
+        def step_fn(p, opt_state, x0, ctx, noise, t, lr, step_i):
+            loss, grads = jax.value_and_grad(loss_fn)(p, x0, ctx, noise, t)
+            new_p, new_s = opt.apply_gradients(grads, p, opt_state, lr=lr,
+                                               step=step_i)
+            return loss, new_p, new_s
+
+        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+        self._key = jax.random.PRNGKey(seed + 1)
+
+    def __call__(self, x0, context):
+        x0 = jnp.asarray(getattr(x0, "_value", x0))
+        context = jnp.asarray(getattr(context, "_value", context))
+        self._key, k1, k2 = jax.random.split(self._key, 3)
+        noise = jax.random.normal(k1, x0.shape, x0.dtype)
+        t = jax.random.randint(k2, (x0.shape[0],), 0, self.num_train_timesteps)
+        self._step_i += 1
+        loss, self._params, self._opt_state = self._jitted(
+            self._params, self._opt_state, x0, context, noise, t,
+            jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step_i))
+        return loss
+
+    @property
+    def params(self):
+        return self._params
