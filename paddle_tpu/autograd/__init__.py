@@ -33,25 +33,33 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     single = isinstance(inputs, Tensor)
     inputs = [inputs] if single else list(inputs)
+    if no_grad_vars is not None and isinstance(no_grad_vars, Tensor):
+        no_grad_vars = [no_grad_vars]
 
-    # stash current .grad of inputs, run backward, read the fresh grads
-    saved = [t._grad_value for t in inputs]
-    for t in inputs:
-        t._grad_value = None
     retain = bool(retain_graph) if retain_graph is not None else create_graph
-    engine.backward(outputs, grad_outputs if grad_outputs is None else list(grad_outputs),
-                    retain_graph=retain)
+    captured = engine.backward(
+        outputs,
+        grad_outputs if grad_outputs is None else list(grad_outputs),
+        retain_graph=retain,
+        create_graph=create_graph,
+        capture=inputs,
+        accumulate_leaf=not only_inputs,
+        no_grad_vars=no_grad_vars,
+    )
     grads = []
-    for t, s in zip(inputs, saved):
-        g = t._grad_value
+    for i, t in enumerate(inputs):
+        g = captured.get(id(t))
         if g is None and not allow_unused:
-            g_t = Tensor(jax.numpy.zeros(t.shape, t.dtype))
+            raise ValueError(
+                f"inputs[{i}] is not reachable from outputs in the recorded "
+                "graph; pass allow_unused=True to get None for unused inputs")
         elif g is None:
             g_t = None
+        elif isinstance(g, Tensor):
+            g_t = g  # create_graph path: carries the tape for grad-of-grad
         else:
             g_t = Tensor(g)
         grads.append(g_t)
-        t._grad_value = s
     return grads[0] if single else grads
 
 

@@ -69,17 +69,26 @@ class GradNode:
     `vjp_fn` is the closure returned by jax.vjp (holds residual device
     buffers). `inputs` are the input Tensors (or None for non-tensor args);
     `out_meta` is (shape, dtype) per output for zero-cotangent synthesis.
+    `fn`/`raw_args` keep the pure forward so `create_graph=True` can re-derive
+    the backward *through the tape* (reference keeps per-op double-grad nodes,
+    fluid/eager/general_grad.h; here the vjp is re-traced under `apply`).
+    `hooks` maps output index -> list of grad hooks (reference
+    fluid/eager/grad_node_info.h GradientHooks).
     """
 
-    __slots__ = ("id", "vjp_fn", "inputs", "out_meta", "cotangents", "name", "__weakref__")
+    __slots__ = ("id", "vjp_fn", "inputs", "out_meta", "cotangents", "name",
+                 "fn", "raw_args", "hooks", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_meta, name=""):
+    def __init__(self, vjp_fn, inputs, out_meta, name="", fn=None, raw_args=None):
         self.id = next(_node_counter)
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_meta = out_meta  # list of (shape, dtype)
         self.cotangents: list = [None] * len(out_meta)
         self.name = name
+        self.fn = fn
+        self.raw_args = raw_args
+        self.hooks: dict[int, list] = {}
 
     def ready_cotangents(self):
         cots = []
@@ -91,18 +100,92 @@ class GradNode:
         return cots
 
 
+class RemovableHandle:
+    """Handle returned by Tensor.register_hook."""
+
+    __slots__ = ("_store", "_hook")
+
+    def __init__(self, store, hook):
+        self._store = store
+        self._hook = hook
+
+    def remove(self):
+        try:
+            self._store.remove(self._hook)
+        except ValueError:
+            pass
+
+
 def _accum(a, b):
     if a is None:
         return b
     return a + b
 
 
-def backward(tensors: Sequence, grad_tensors: Sequence | None = None, retain_graph: bool = False):
+def _run_hooks(hooks, g):
+    """Apply grad hooks; each sees a Tensor and may return a replacement.
+    The replacement is coerced back to the incoming grad's representation
+    (Tensor under create_graph, raw array otherwise)."""
+    from .tensor import Tensor
+
+    for h in hooks:
+        arg = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+        res = h(arg)
+        if res is not None:
+            if isinstance(g, Tensor):
+                g = res if isinstance(res, Tensor) else Tensor(
+                    jnp.asarray(res), stop_gradient=True)
+            else:
+                g = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+    return g
+
+
+def _compute_needed(starts, target_tensor_ids):
+    """GeneralGrad-style pruning (reference fluid/eager/general_grad.h):
+    a node needs to pop only if its vjp contributes to a capture target —
+    i.e. one of its inputs IS a target, or a descendant node is needed.
+    Iterative post-order DFS; the tape is acyclic (ids topologically ordered)."""
+    memo: dict[int, bool] = {}
+    stack = [(n, 0) for n in starts]
+    while stack:
+        n, phase = stack.pop()
+        if phase == 0:
+            if n.id in memo:
+                continue
+            memo[n.id] = False  # provisional; finalized in phase 1
+            stack.append((n, 1))
+            for inp in n.inputs:
+                if inp is not None and inp._node is not None \
+                        and inp._node[0].id not in memo:
+                    stack.append((inp._node[0], 0))
+        else:
+            res = False
+            for inp in n.inputs:
+                if inp is None:
+                    continue
+                if id(inp) in target_tensor_ids:
+                    res = True
+                elif inp._node is not None and memo.get(inp._node[0].id):
+                    res = True
+            memo[n.id] = res
+    return memo
+
+
+def backward(tensors: Sequence, grad_tensors: Sequence | None = None,
+             retain_graph: bool = False, create_graph: bool = False,
+             capture: Sequence | None = None, accumulate_leaf: bool = True,
+             no_grad_vars: Sequence | None = None):
     """Run reverse accumulation from `tensors`.
 
     Mirrors `egr::Backward` (reference fluid/eager/backward.cc:439): seed
     cotangents, walk producing nodes in reverse creation order (creation order
     is a valid topological order for a tape), accumulate into leaf `.grad`.
+
+    `capture`: tensors (leaf or intermediate) whose grads are collected and
+    returned in a dict keyed by id() — the GeneralGrad path behind
+    paddle.grad (reference fluid/eager/general_grad.h). Captured tensors do
+    not have `.grad` written. With `create_graph` the walk re-derives each
+    node's vjp through `apply` so returned grads carry a tape for grad-of-grad.
     """
     from .tensor import Tensor  # local import to avoid cycle
 
@@ -110,23 +193,61 @@ def backward(tensors: Sequence, grad_tensors: Sequence | None = None, retain_gra
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
 
+    capture_ids = {id(t) for t in capture} if capture else set()
+    no_grad_ids = {id(t) for t in no_grad_vars} if no_grad_vars else set()
+    captured: dict[int, Any] = {}
+    pending_leaf: dict[int, list] = {}  # id -> [tensor, grad]
+
+    # capture slots on producer nodes: node_id -> (node, [(idx, tensor)])
+    slot_captures: dict[int, tuple] = {}
+    if capture:
+        for t in capture:
+            if isinstance(t, Tensor) and t._node is not None:
+                node, idx = t._node
+                slot_captures.setdefault(node.id, (node, []))[1].append((idx, t))
+
     heap: list[tuple[int, GradNode]] = []
     in_heap: dict[int, GradNode] = {}
+    touched: dict[int, GradNode] = {}  # every node that received a cotangent
+
+    # GeneralGrad pruning: with a capture set and only_inputs semantics, walk
+    # only nodes whose vjp feeds a capture target, not the whole tape below.
+    needed = None
+    if capture_ids and not accumulate_leaf:
+        starts = [t._node[0] for t in tensors if t._node is not None]
+        needed = _compute_needed(starts, capture_ids)
+
+    def gadd(a, b):
+        if create_graph:
+            if b is not None and not isinstance(b, Tensor):
+                b = Tensor(b, stop_gradient=True)
+        return _accum(a, b)
 
     def seed(t: Tensor, g):
         node_ref = t._node
         if node_ref is None:
-            if not t.stop_gradient:
-                t._grad_value = _accum(t._grad_value, g)
+            if id(t) in capture_ids:
+                captured[id(t)] = gadd(captured.get(id(t)), g)
+                return
+            if not t.stop_gradient and accumulate_leaf:
+                cur = pending_leaf.get(id(t))
+                if cur is None:
+                    pending_leaf[id(t)] = [t, gadd(None, g)]
+                else:
+                    cur[1] = gadd(cur[1], g)
             return
         node, idx = node_ref
-        node.cotangents[idx] = _accum(node.cotangents[idx], g)
+        node.cotangents[idx] = gadd(node.cotangents[idx], g)
+        touched[node.id] = node
+        if needed is not None and not needed.get(node.id) \
+                and node.id not in slot_captures:
+            return  # pruned: cotangent kept for end-of-walk capture collection
         if node.id not in in_heap:
             in_heap[node.id] = node
             heapq.heappush(heap, (-node.id, node))
 
     for t, g in zip(tensors, grad_tensors):
-        if t.stop_gradient and t._node is None:
+        if t.stop_gradient and t._node is None and id(t) not in capture_ids:
             continue
         if g is None:
             if t.size != 1:
@@ -136,28 +257,131 @@ def backward(tensors: Sequence, grad_tensors: Sequence | None = None, retain_gra
                 )
             g = jnp.ones(t.shape, t.dtype)
         else:
-            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            g = g._value if isinstance(g, Tensor) and not create_graph else g
+            if not isinstance(g, Tensor):
+                g = jnp.asarray(g)
         seed(t, g)
+
+    def collect_slots(node, post_hook_cots):
+        entry = slot_captures.get(node.id)
+        if entry is None:
+            return
+        for idx, t in entry[1]:
+            if post_hook_cots[idx] is not None:
+                captured[id(t)] = post_hook_cots[idx]
 
     while heap:
         _, node = heapq.heappop(heap)
         del in_heap[node.id]
-        cots = node.ready_cotangents()
-        in_grads = node.vjp_fn(cots)
-        for inp, g in zip(node.inputs, in_grads):
-            if inp is None or g is None:
-                continue
-            # jax uses float0 for non-differentiable (integer) inputs
-            if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
-                continue
-            if inp.stop_gradient:
-                continue
-            seed(inp, g)
-        if not retain_graph:
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad node '{node.name}' was already released; pass "
+                "retain_graph=True to backward through a graph twice")
+        for idx, hooks in node.hooks.items():
+            if hooks and node.cotangents[idx] is not None:
+                node.cotangents[idx] = _run_hooks(hooks, node.cotangents[idx])
+        collect_slots(node, node.cotangents)
+        prune_vjp = needed is not None and not needed.get(node.id)
+        if not prune_vjp:
+            cots = node.ready_cotangents()
+            if create_graph:
+                if node.fn is None:
+                    raise RuntimeError(
+                        f"create_graph=True through node '{node.name}' is not "
+                        "supported: it has no re-traceable forward (PyLayer/"
+                        "recompute nodes); detach or use jax transforms for "
+                        "higher-order gradients through it")
+                _backward_node_tracked(node, cots, seed, no_grad_ids)
+            else:
+                raw_cots = [c._value if isinstance(c, Tensor) else c
+                            for c in cots]
+                in_grads = node.vjp_fn(raw_cots)
+                for inp, g in zip(node.inputs, in_grads):
+                    if inp is None or g is None:
+                        continue
+                    # jax uses float0 for non-differentiable (integer) inputs
+                    if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                        continue
+                    if inp.stop_gradient or id(inp) in no_grad_ids:
+                        continue
+                    seed(inp, g)
+        node.cotangents = [None] * len(node.out_meta)
+        if not (retain_graph or create_graph):
             node.vjp_fn = None
-            node.cotangents = [None] * len(node.out_meta)
-        else:
-            node.cotangents = [None] * len(node.out_meta)
+            node.fn = None
+            node.raw_args = None
+
+    # capture slots on producer nodes that never popped (pruned producers):
+    # the cotangent is complete once all consumers popped — read it now.
+    for node_id, (node, slots) in slot_captures.items():
+        for idx, t in slots:
+            if id(t) in captured or node.cotangents[idx] is None:
+                continue
+            g = node.cotangents[idx]
+            hooks = node.hooks.get(idx)
+            if hooks:
+                g = _run_hooks(hooks, g)
+            captured[id(t)] = g
+
+    # clear cotangents of seeded-but-pruned nodes so a later retain_graph
+    # backward doesn't double-count stale contributions; release pruned
+    # nodes' closures too (they pin vjp residual buffers) when the graph
+    # is being consumed
+    for node in touched.values():
+        node.cotangents = [None] * len(node.out_meta)
+        if not (retain_graph or create_graph):
+            node.vjp_fn = None
+            node.fn = None
+            node.raw_args = None
+
+    for t, g in pending_leaf.values():
+        if t._hooks:
+            g = _run_hooks(t._hooks, g)
+        raw = g._value if isinstance(g, Tensor) else g
+        t._grad_value = _accum(t._grad_value, raw)
+
+    # captured leaves: fire their hooks on the returned grad as well
+    if capture:
+        for t in capture:
+            if isinstance(t, Tensor) and t._node is None and t._hooks \
+                    and id(t) in captured:
+                captured[id(t)] = _run_hooks(t._hooks, captured[id(t)])
+
+    return captured
+
+
+def _backward_node_tracked(node: GradNode, cots, seed, no_grad_ids=frozenset()):
+    """create_graph path: recompute this node's vjp under `apply` so the
+    produced input-grads are themselves recorded on the tape (the residual
+    dependence on the node inputs is re-expressed by re-tracing jax.vjp)."""
+    tpos = [i for i, inp in enumerate(node.inputs) if inp is not None]
+    sel = [i for i in tpos
+           if not node.inputs[i].stop_gradient
+           and id(node.inputs[i]) not in no_grad_ids
+           and jnp.issubdtype(node.inputs[i].dtype, jnp.inexact)]
+    if not sel:
+        return
+    fn_, raw, treedef = node.fn, node.raw_args, getattr(node.vjp_fn, "treedef", None)
+    nt = len(tpos)
+
+    def grad_fn(*xs, _fn=fn_, _raw=tuple(raw), _tpos=tuple(tpos),
+                _sel=tuple(sel), _td=treedef, _nt=nt):
+        args = list(_raw)
+        for p, v in zip(_tpos, xs[:_nt]):
+            args[p] = v
+        cot_leaves = list(xs[_nt:])
+        cot_tree = jax.tree.unflatten(_td, cot_leaves) if _td is not None else (
+            cot_leaves[0] if len(cot_leaves) == 1 else tuple(cot_leaves))
+        _, vf = jax.vjp(_fn, *args)
+        gs = vf(cot_tree)
+        return tuple(gs[i] for i in _sel)
+
+    ins = [node.inputs[i] for i in tpos] + list(cots)
+    outs = apply(grad_fn, *ins, name=("grad_" + (node.name or "op")))
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for i, g in zip(sel, outs):
+        seed(node.inputs[i], g)
 
 
 def _is_tracer(x) -> bool:
@@ -215,6 +439,8 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         tensor_inputs,
         [(l.shape, l.dtype) for l in leaves],
         name=name,
+        fn=f,
+        raw_args=arrs,
     )
     out_tensors = [Tensor(l, stop_gradient=False, _node=(node, i)) for i, l in enumerate(leaves)]
     return jax.tree.unflatten(treedef, out_tensors)

@@ -52,7 +52,7 @@ def _asarray_device_safe(value, dtype=None):
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad_value", "_node", "name",
-                 "persistable", "_dist", "__weakref__")
+                 "persistable", "_dist", "_hooks", "__weakref__")
 
     # make numpy defer to our __r*__ operators
     __array_priority__ = 100
@@ -69,6 +69,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._dist = None  # (ProcessMesh, [Placement]) for DistTensors
+        self._hooks = []  # leaf grad hooks (register_hook)
 
     # ---------------- basic metadata ----------------
     @property
@@ -197,8 +198,22 @@ class Tensor:
         return self
 
     def register_hook(self, hook):
-        # grad hook: applied when backward seeds this tensor's grad
-        raise NotImplementedError("tensor-level grad hooks land with the hook milestone")
+        """Register a grad hook fired when this tensor's gradient is computed
+        during backward; the hook receives the (fully accumulated) grad Tensor
+        and may return a replacement (reference:
+        fluid/eager/grad_node_info.h GradientHooks, hook ordering in
+        tensor_patch_methods.py register_hook)."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a grad hook on a Tensor with "
+                "stop_gradient=True — it will never receive a gradient")
+        if self._node is not None:
+            node, idx = self._node
+            store = node.hooks.setdefault(idx, [])
+        else:
+            store = self._hooks
+        store.append(hook)
+        return engine.RemovableHandle(store, hook)
 
     # ---------------- mutation (leaf/in-place semantics) ----------------
     def set_value(self, value):

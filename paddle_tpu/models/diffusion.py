@@ -245,6 +245,10 @@ def unet_apply(params, x, t, context, config: UNetConfig):
     """x [B, C, H, W] latents, t [B] int timesteps, context [B, L, D_ctx]."""
     cfg = config
     ch0 = cfg.block_channels[0]
+    # Compute in the param dtype: under jax_enable_x64 caller-supplied arrays
+    # (jax.random / numpy) default to f64, which conv rejects against f32 weights.
+    x = x.astype(cfg.dtype)
+    context = context.astype(cfg.dtype)
     temb = _timestep_embedding(t, ch0).astype(x.dtype)
     temb = jax.nn.silu(temb @ params["t1"] + params["t1b"])
     temb = temb @ params["t2"] + params["t2b"]

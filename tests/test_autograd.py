@@ -151,3 +151,114 @@ class TestJitBridge:
 
         g = jax.grad(f)(pt.to_tensor(np.array([3.0], np.float32)))
         np.testing.assert_allclose(np.asarray(g._value), [6.0])
+
+
+class TestGradHooks:
+    def test_leaf_hook_observes_grad(self):
+        x = t([1.0, 2.0])
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        y = pt.sum(x * 3.0)
+        y.backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0, 3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_leaf_hook_replaces_grad(self):
+        x = t([1.0, 2.0])
+        x.register_hook(lambda g: g * 2.0)
+        pt.sum(x * 3.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_intermediate_hook(self):
+        x = t([2.0])
+        y = x * x          # dy/dx = 2x
+        y.register_hook(lambda g: g * 10.0)
+        z = y * 3.0        # dz/dy = 3
+        z.backward()
+        # dz/dx = 3 * 10(hook) * 2x = 120
+        np.testing.assert_allclose(x.grad.numpy(), [120.0], rtol=1e-6)
+
+    def test_hook_accumulated_before_fire(self):
+        # the hook must see the FULLY accumulated grad (both consumers)
+        x = t([1.0])
+        y = x * 2.0
+        seen = []
+        y.register_hook(lambda g: seen.append(float(g.numpy()[0])))
+        z = y + y          # dz/dy = 2 (two paths of 1)
+        z.backward()
+        assert seen == [2.0]
+
+    def test_hook_removal(self):
+        x = t([1.0])
+        h = x.register_hook(lambda g: g * 100.0)
+        h.remove()
+        pt.sum(x * 3.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    def test_hooked_capture_returns_hooked_grad(self):
+        # paddle.grad w.r.t. a hooked tensor must reflect the hook
+        x = t([2.0])
+        y = x * 3.0
+        y.register_hook(lambda g: g * 10.0)
+        z = y * y
+        (gy,) = pt.autograd.grad(z, [y])
+        np.testing.assert_allclose(gy.numpy(), [120.0], rtol=1e-6)
+
+    def test_grad_unused_raises(self):
+        x, u = t([2.0]), t([7.0])
+        z = pt.sum(x * x)
+        with pytest.raises(ValueError):
+            pt.autograd.grad(z, [u])
+
+    def test_hook_requires_grad(self):
+        x = pt.to_tensor(np.zeros(2, np.float32), stop_gradient=True)
+        with pytest.raises(RuntimeError):
+            x.register_hook(lambda g: g)
+
+
+class TestDoubleGrad:
+    def test_second_order_scalar(self):
+        x = t([2.0])
+        y = x * x * x  # y = x^3, y' = 3x^2, y'' = 6x
+        (g1,) = pt.autograd.grad(y, [x], create_graph=True)
+        assert not g1.stop_gradient
+        np.testing.assert_allclose(g1.numpy(), [12.0], rtol=1e-6)
+        (g2,) = pt.autograd.grad(g1, [x])
+        np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)  # 6x = 12
+
+    def test_second_order_matmul(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        x = t(a)
+        y = pt.sum(x * x * x)  # sum x^3 elementwise
+        (g1,) = pt.autograd.grad(y, [x], create_graph=True)
+        g1s = pt.sum(g1 * g1)  # sum (3x^2)^2 -> d/dx = 2*(3x^2)*6x = 36x^3
+        (g2,) = pt.autograd.grad(g1s, [x])
+        np.testing.assert_allclose(g2.numpy(), 36 * a ** 3, rtol=1e-4)
+
+    def test_grad_wrt_intermediate(self):
+        x = t([2.0])
+        y = x * 3.0
+        z = y * y  # dz/dy = 2y = 12
+        (gy,) = pt.autograd.grad(z, [y])
+        np.testing.assert_allclose(gy.numpy(), [12.0], rtol=1e-6)
+
+    def test_grad_only_inputs_leaves_others_untouched(self):
+        x, w = t([2.0]), t([5.0])
+        z = pt.sum(x * w)
+        (gx,) = pt.autograd.grad(z, [x])
+        np.testing.assert_allclose(gx.numpy(), [5.0])
+        assert w.grad is None  # only_inputs=True must not write w.grad
+
+    def test_grad_allow_unused(self):
+        x, u = t([2.0]), t([7.0])
+        z = pt.sum(x * x)
+        gx, gu = pt.autograd.grad(z, [x, u], allow_unused=True)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert gu is None
+
+    def test_no_grad_vars(self):
+        x, w = t([2.0]), t([5.0])
+        z = pt.sum(x * w * w)
+        (gx,) = pt.autograd.grad(z, [x], no_grad_vars=[w])
+        np.testing.assert_allclose(gx.numpy(), [25.0])
