@@ -1,12 +1,27 @@
-"""Headline benchmark: Llama pretrain step throughput on the local chip.
+"""Headline benchmark: Llama pretrain step throughput on the local TPU chip.
 
 Prints ONE JSON line: tokens/sec/chip + MFU on the flagship train step
-(fwd+bwd+AdamW, bf16 compute, remat, donation). vs_baseline = MFU / 0.45
-(the BASELINE.md north-star MFU target).
+(fwd+bwd+AdamW, bf16 compute, Pallas flash attention, remat, donation).
+vs_baseline = MFU / 0.45 (the BASELINE.md north-star MFU target).
+
+A TPU is REQUIRED: if no TPU is reachable the bench prints an error JSON line
+and exits nonzero (VERDICT r1 weak #1 — never silently bench CPU). Set
+BENCH_ALLOW_CPU=1 to run the tiny CPU smoke sizing locally; that run still
+reports vs_baseline=0 and device=cpu so it can never masquerade as a TPU
+number.
+
+MFU accounting (GQA-aware, fwd+bwd):
+  matmul flops/token      = 6 * N_params            (fwd 2N + bwd 4N)
+  attention flops/token   = 6 * layers * H_q * head_dim * T   (causal:
+    fwd qk^T + pv = 2 * (2 * H_q*head_dim * T) * 1/2; bwd = 2x fwd)
+  GQA enters through N_params (smaller wk/wv) while score/value matmuls
+  scale with the QUERY head count — jnp.repeat'ed kv does not add flops.
+Remat recompute is NOT counted (model flops, not hardware flops).
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -15,6 +30,8 @@ import numpy as np
 
 def peak_bf16_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
+    # order matters: "v5 lite"/"v5e" must match before the bare "v5"
+    # (v5p chips report device_kind "TPU v5")
     table = {
         "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
         "v5p": 459e12, "v5": 459e12,
@@ -26,26 +43,33 @@ def peak_bf16_flops(device) -> float:
     return 197e12  # assume v5e-class
 
 
-def _tpu_reachable(timeout_s: int = 180) -> bool:
+def _tpu_reachable(timeout_s: int = 240) -> bool:
     """Probe TPU client creation in a child so a wedged tunnel can't hang the
-    bench; fall back to CPU when unreachable."""
-    import os
+    bench process itself."""
     import subprocess
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return False
     try:
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; d=jax.devices(); import sys; sys.exit(0 if d else 1)"],
+             "import jax; import sys; sys.exit(0 if jax.default_backend() == 'tpu' else 1)"],
             timeout=timeout_s, capture_output=True)
         return r.returncode == 0
     except Exception:
         return False
 
 
-def main():
-    import os
-    if not _tpu_reachable():
+def main() -> int:
+    on_tpu = _tpu_reachable()
+    if not on_tpu:
+        if os.environ.get("BENCH_ALLOW_CPU") != "1":
+            print(json.dumps({
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                "error": "tpu unreachable — refusing to bench CPU "
+                         "(set BENCH_ALLOW_CPU=1 for a local smoke run)",
+            }))
+            return 1
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -56,16 +80,18 @@ def main():
     from paddle_tpu.models import LlamaConfig, LlamaTrainStep
 
     dev = jax.devices()[0]
-    on_tpu = jax.default_backend() not in ("cpu",)
+    on_tpu = jax.default_backend() == "tpu"
 
     if on_tpu:
+        # ~850M-param llama sized for one 16GB v5e chip with AdamW f32
+        # moments: head_dim 128 (Pallas flash path), seq 2048, bf16, remat.
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
-            num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=16,
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=14, num_attention_heads=16, num_key_value_heads=16,
             max_position_embeddings=2048, dtype=jnp.bfloat16)
-        B, T = 8, 2048
-        iters = 10
-    else:  # CI/CPU smoke sizing
+        B, T = 4, 2048
+        iters = 20
+    else:  # explicit CPU smoke sizing (BENCH_ALLOW_CPU=1)
         cfg = LlamaConfig.tiny()
         B, T = 4, 64
         iters = 3
@@ -75,8 +101,7 @@ def main():
     toks = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
     labels = np.roll(toks, -1, axis=1)
 
-    # param count for MFU accounting
-    n_params = sum(int(np.prod(v.shape)) for v in step.params.values())
+    n_params = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(step.params))
 
     # warmup / compile
     for _ in range(2):
@@ -90,10 +115,12 @@ def main():
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_sec = B * T / dt
-    flops_per_token = 6.0 * n_params  # + attention flops
-    attn_flops = 12.0 * cfg.num_hidden_layers * cfg.hidden_size * T  # per token
-    model_flops = (flops_per_token + attn_flops) * tokens_per_sec
-    mfu = model_flops / peak_bf16_flops(dev) if on_tpu else 0.0
+    attn_flops_per_token = 6.0 * cfg.num_hidden_layers * \
+        cfg.num_attention_heads * cfg.head_dim * T
+    flops_per_token = 6.0 * n_params + attn_flops_per_token
+    model_flops = flops_per_token * tokens_per_sec
+    peak = peak_bf16_flops(dev)
+    mfu = model_flops / peak if on_tpu else 0.0
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -102,6 +129,8 @@ def main():
         "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
         "extra": {
             "mfu": round(mfu, 4),
+            "model_tflops_per_sec": round(model_flops / 1e12, 2),
+            "peak_tflops": round(peak / 1e12, 1),
             "params": n_params,
             "batch": B, "seq": T,
             "step_ms": round(dt * 1e3, 2),
@@ -109,6 +138,7 @@ def main():
             "loss": float(jax.device_get(loss)),
         },
     }))
+    return 0
 
 
 if __name__ == "__main__":

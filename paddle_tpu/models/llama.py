@@ -258,12 +258,10 @@ def _attention(q, k, v, config, use_flash=True):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     if use_flash:
-        from ..ops.flash_attention import flash_attention_tpu_available, _fa_reference
-        if flash_attention_tpu_available() and q.shape[1] % 128 == 0 \
-                and k.shape[1] % 128 == 0 and config.head_dim % 128 == 0:
-            from ..ops.flash_attention import _fit_block, _flash_fwd_bwd
-            return _flash_fwd_bwd(q, k, v, True, _fit_block(512, q.shape[1]),
-                                  _fit_block(512, k.shape[1]))
+        # Pallas kernel on TPU, XLA reference otherwise — the fallback
+        # predicate lives in flash_attention_raw, not here
+        from ..ops.flash_attention import flash_attention_raw
+        return flash_attention_raw(q, k, v, causal=True)
     scale = 1.0 / math.sqrt(config.head_dim)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     T, S_ = logits.shape[-2], logits.shape[-1]

@@ -75,16 +75,34 @@ def _fa_reference(q, k, v, causal):
     return jnp.einsum("bhls,bshd->blhd", probs, v)
 
 
+def flash_attention_raw(q, k, v, causal: bool = False, block_q: int = 512,
+                        block_k: int = 512):
+    """Raw-jnp-array flash attention ([B, L, H, D] in/out) — the shared entry
+    for the Tensor API and model code. Falls back to the XLA path for
+    small/ragged sequence lengths or off-TPU."""
+    L, S, D = q.shape[1], k.shape[1], q.shape[-1]
+    if (L % _MIN_BLOCK) or (S % _MIN_BLOCK) or not flash_attention_tpu_available():
+        return _fa_reference(q, k, v, causal)
+    bq, bk = _fit_block(block_q, L), _fit_block(block_k, S)
+    if D % 128 == 0:
+        return _flash_fwd_bwd(q, k, v, causal, bq, bk)
+    # head_dim 64 (GPT-2 / tiny-llama class): zero-pad D to the 128-lane
+    # MXU tile — zero columns contribute nothing to q·k and produce zero
+    # output/grad columns, so padding + slicing is exact. The softmax
+    # scale must use the TRUE head dim, passed via sm_scale.
+    D_pad = -(-D // 128) * 128
+    pad = [(0, 0)] * 3 + [(0, D_pad - D)]
+    out = _flash_fwd_bwd(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                         causal, bq, bk, False, 1.0 / math.sqrt(D))
+    return out[..., :D]
+
+
 def flash_attention(query, key, value, causal: bool = False, block_q: int = 512,
                     block_k: int = 512):
     """[B, L, H, D] in/out. Falls back to the XLA path for small/ragged shapes."""
 
     def f(q, k, v):
-        L, S, D = q.shape[1], k.shape[1], q.shape[-1]
-        if (L % _MIN_BLOCK) or (S % _MIN_BLOCK) or (D % 128) or not flash_attention_tpu_available():
-            return _fa_reference(q, k, v, causal)
-        return _flash_fwd_bwd(q, k, v, causal, _fit_block(block_q, L),
-                              _fit_block(block_k, S))
+        return flash_attention_raw(q, k, v, causal, block_q, block_k)
 
     return apply(f, query, key, value, name="flash_attention")
 
@@ -100,28 +118,32 @@ def _fit_block(requested: int, length: int) -> int:
 
 
 # ---------------- pallas kernel ----------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_fwd_bwd(q, k, v, causal, block_q, block_k, interpret=False):
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_fwd_bwd(q, k, v, causal, block_q, block_k, interpret=False,
+                   sm_scale=None):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                             sm_scale)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret=False):
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret=False,
+                    sm_scale=None):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                               sm_scale)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, dout):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, sm_scale, res, dout):
     q, k, v, out, lse = res
     return _flash_bwd_impl(q, k, v, out, lse, dout, causal, block_q, block_k,
-                           interpret)
+                           interpret, sm_scale)
 
 
 _flash_fwd_bwd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def _flash_bwd_impl(q, k, v, out, lse, dout, causal, block_q, block_k,
-                    interpret=False):
+                    interpret=False, sm_scale=None):
     """Flash-attention-2 backward as two Pallas kernels.
 
     Recomputes p = exp(q k^T * scale - lse) blockwise from the saved lse, so
@@ -140,7 +162,7 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, causal, block_q, block_k,
     S = k.shape[1]
     assert L % block_q == 0 and S % block_k == 0, \
         f"blocks must tile the sequences: {L}%{block_q}, {S}%{block_k}"
-    scale = 1.0 / math.sqrt(D)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     grid_q = L // block_q
     grid_k = S // block_k
 
@@ -269,7 +291,8 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, causal, block_q, block_k,
             jnp.swapaxes(dvt, 1, 2))
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False,
+                    sm_scale=None):
     """Tiled online-softmax forward in Pallas (interpret=True runs the same
     kernel on CPU for correctness tests without a TPU)."""
     from jax.experimental import pallas as pl
@@ -279,7 +302,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret=False):
     S = k.shape[1]
     assert L % block_q == 0 and S % block_k == 0, \
         f"blocks must tile the sequences: {L}%{block_q}, {S}%{block_k}"
-    scale = 1.0 / math.sqrt(D)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     grid_q = L // block_q
     grid_k = S // block_k
 
