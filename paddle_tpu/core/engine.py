@@ -60,6 +60,28 @@ def enable_grad():
         tls.grad_enabled = prev
 
 
+def buffer_capture_enabled() -> bool:
+    """True inside a functional train step that reads updated buffer values
+    (running stats etc.) back out of the swapped Layer state after forward."""
+    return getattr(_tls(), "buffer_capture", False)
+
+
+@contextlib.contextmanager
+def buffer_capture():
+    """Allow stateful buffer updates (e.g. batch-norm running stats) to write
+    TRACER values during a traced forward: the surrounding _swapped_state
+    restores the originals on exit, and the train step returns the captured
+    values as new buffer state — the functional analog of the reference's
+    in-place running-stat kernels."""
+    tls = _tls()
+    prev = getattr(tls, "buffer_capture", False)
+    tls.buffer_capture = True
+    try:
+        yield
+    finally:
+        tls.buffer_capture = prev
+
+
 _node_counter = itertools.count()
 
 

@@ -28,13 +28,37 @@ def seed(n: int):
     return n
 
 
+class _Forbidden:
+    """Sentinel generator: any random draw in this region is a bug."""
+
+    def __init__(self, reason):
+        self.reason = reason
+
+
 def split_key():
     """Draw a fresh subkey from the top-of-stack generator (stateful split)."""
     tls = _tls()
     key = tls.stack[-1]
+    if isinstance(key, _Forbidden):
+        raise RuntimeError(
+            f"random draw inside {key.reason}: this region compiles without "
+            "a per-step RNG, so a mask/sample here would be baked at trace "
+            "time (set dropout p=0 or move the random op outside)")
     key, sub = jax.random.split(key)
     tls.stack[-1] = key
     return sub
+
+
+@contextlib.contextmanager
+def forbid_rng(reason: str):
+    """Any split_key() under this context raises — used by compiled regions
+    that cannot thread a per-step key (e.g. pipeline schedules)."""
+    tls = _tls()
+    tls.stack.append(_Forbidden(reason))
+    try:
+        yield
+    finally:
+        tls.stack.pop()
 
 
 @contextlib.contextmanager

@@ -20,6 +20,7 @@ from .collective import (  # noqa: F401
     new_group, recv, reduce, reduce_scatter, scatter, send, stream, wait,
 )
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from .engine import Engine, PipelinePlan, Strategy as EngineStrategy  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
@@ -28,6 +29,7 @@ from . import launch  # noqa: F401
 class auto_parallel:
     """namespace mirror of paddle.distributed.auto_parallel"""
     from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+    from .engine import Engine, PipelinePlan, Strategy  # noqa: F401
 
     @staticmethod
     def set_mesh(mesh):

@@ -76,10 +76,13 @@ class GPTModel(Layer):
         self.h = LayerList([GPTBlock(c) for _ in range(c.num_hidden_layers)])
         self.ln_f = LayerNorm(c.hidden_size, c.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def embed(self, input_ids):
         t = input_ids.shape[1]
         pos = pt.arange(0, t, dtype="int64").unsqueeze([0])
-        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+    def forward(self, input_ids):
+        x = self.embed(input_ids)
         for block in self.h:
             x = block(x)
         return self.ln_f(x)
@@ -91,14 +94,32 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(config)
         self.config = config
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.gpt(input_ids)
+    def _head(self, hidden, labels=None):
+        """ln_f is applied by GPTModel.forward in the plain path and by the
+        pipeline head after the trunk — callers pass POST-ln_f hidden."""
         logits = F.linear(hidden, _tied_head(self.gpt.wte.weight))
         if labels is not None:
-            loss = F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
+            return F.cross_entropy(logits.reshape([-1, self.config.vocab_size]),
                                    labels.reshape([-1]))
-            return loss
         return logits
+
+    def forward(self, input_ids, labels=None):
+        return self._head(self.gpt(input_ids), labels)
+
+    def pipeline_plan(self):
+        """SPMD pipeline split for dist.Engine: embedding → GPTBlock stack →
+        ln_f + tied head + loss (the analog of the reference's
+        GPTForCausalLMPipe LayerDesc rewrite) — shares GPTModel.embed and
+        _head with the plain forward so the paths cannot drift."""
+        from ..distributed.engine import PipelinePlan
+
+        def embed(model, input_ids):
+            return model.gpt.embed(input_ids)
+
+        def head(model, x, labels):
+            return model._head(model.gpt.ln_f(x), labels)
+
+        return PipelinePlan(embed=embed, blocks_attr="gpt.h", head=head)
 
 
 def _tied_head(embed_weight):

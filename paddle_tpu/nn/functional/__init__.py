@@ -586,8 +586,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
             out = out + b.astype(jnp.float32).reshape(shape)
         return out.astype(a.dtype)
 
-    # running-stat update (eager side effect, matches reference kernel)
-    if use_batch_stats and not isinstance(x._value, jax.core.Tracer):
+    # running-stat update: eager side effect (matches the reference kernel),
+    # or — under a functional train step's buffer_capture — a tracer write
+    # that the step reads back as new buffer state before the swap restores
+    from ...core import engine as _engine
+    if use_batch_stats and (not isinstance(x._value, jax.core.Tracer)
+                            or _engine.buffer_capture_enabled()):
         ch_axis = x.ndim - 1 if channels_last else 1
         axes = tuple(d for d in range(x.ndim) if d != ch_axis)
         a32 = x._value.astype(jnp.float32)
