@@ -432,7 +432,7 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
     any_tracer = False
     for a in args:
         if isinstance(a, Tensor):
-            arrs.append(a._value)
+            arrs.append(_reduced_if_partial(a))
             tensor_inputs.append(a)
             if not a.stop_gradient:
                 any_requires = True
@@ -451,7 +451,8 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         out = f(*arrs)
         if not any_tracer:
             _check_nan_inf(name, out)
-        return wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
+        wrapped = wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
+        return _propagate_dist(wrapped, tensor_inputs)
 
     out, vjp_fn = jax.vjp(f, *arrs)
     _check_nan_inf(name, out)
@@ -465,7 +466,56 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         raw_args=arrs,
     )
     out_tensors = [Tensor(l, stop_gradient=False, _node=(node, i)) for i, l in enumerate(leaves)]
-    return jax.tree.unflatten(treedef, out_tensors)
+    return _propagate_dist(jax.tree.unflatten(treedef, out_tensors), tensor_inputs)
+
+
+def _reduced_if_partial(t):
+    """Partial inputs are REDUCED at dispatch (the reference's generated dist
+    branch likewise reshards inputs to the placements InferSpmd demands before
+    running the local kernel) — ops never see unreduced values, so their
+    results are numerically global."""
+    dist = getattr(t, "_dist", None)
+    if dist is None:
+        return t._value
+    mesh, placements = dist
+    from ..distributed.placement import Partial, replicate_partials
+    if not any(isinstance(p, Partial) for p in placements):
+        return t._value
+    from ..distributed.reshard import reshard_value
+    return reshard_value(t._value, mesh, placements,
+                         replicate_partials(placements))
+
+
+def _propagate_dist(out_tree, tensor_inputs):
+    """Eager dist-attr propagation: outputs of ops on DistTensors carry the
+    mesh + placements derived from the result array's GSPMD sharding.
+
+    The reference threads dist_attrs through every generated op's dist branch
+    (phi/api/generator/dist_api_gen.py:49-201); here the XLA
+    computation-follows-sharding rule has already placed the output, so the
+    placements are read BACK from `out.sharding`. Partial cannot appear in an
+    output: partial INPUTS are reduced at dispatch (_reduced_if_partial) and
+    eager ops complete their own reductions."""
+    src = None
+    for t in tensor_inputs:
+        if t is not None and getattr(t, "_dist", None) is not None:
+            src = t
+            break
+    if src is None:
+        return out_tree
+    mesh = src._dist[0]
+    from .tensor import Tensor  # local import to avoid cycle
+    from ..distributed.placement import spec_to_placements
+
+    def setd(t):
+        if isinstance(t, Tensor) and isinstance(t._value, jax.Array):
+            sh = getattr(t._value, "sharding", None)
+            if isinstance(sh, jax.sharding.NamedSharding) and sh.mesh == mesh.jax_mesh:
+                t._dist = (mesh, spec_to_placements(mesh, sh.spec, t._value.ndim))
+        return t
+
+    jax.tree.map(setd, out_tree, is_leaf=lambda x: isinstance(x, Tensor))
+    return out_tree
 
 
 class _TreeVjp:

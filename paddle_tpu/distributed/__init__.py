@@ -10,8 +10,9 @@ from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh, get_mesh, set_mesh, init_mesh  # noqa: F401
 from .api import (  # noqa: F401
     ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn,
-    dtensor_from_local, dtensor_to_local, local_map, reshard, shard_dataloader,
-    shard_layer, shard_optimizer, shard_tensor, unshard_dtensor,
+    dtensor_from_local, dtensor_to_local, local_map, moe_global_mesh_tensor,
+    moe_sub_mesh_tensors, reshard, shard_dataloader, shard_layer,
+    shard_optimizer, shard_tensor, split_mesh, unshard_dtensor,
 )
 from .collective import (  # noqa: F401
     P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,

@@ -25,14 +25,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Parameter, Tensor
 from .placement import (Partial, Placement, Replicate, Shard, placements_to_spec,
-                        spec_to_placements)
+                        replicate_partials, spec_to_placements)
 from .process_mesh import ProcessMesh, get_mesh
 from .reshard import partial_axes, reshard_value, shard_map_compat
 
 __all__ = ["shard_tensor", "reshard", "dtensor_from_local", "dtensor_to_local",
            "shard_layer", "shard_optimizer", "shard_dataloader", "unshard_dtensor",
            "dtensor_from_fn", "ShardingStage1", "ShardingStage2", "ShardingStage3",
-           "shard_master_weight", "local_map"]
+           "shard_master_weight", "local_map", "split_mesh",
+           "moe_global_mesh_tensor", "moe_sub_mesh_tensors"]
 
 
 def _as_mesh(mesh) -> ProcessMesh:
@@ -53,7 +54,7 @@ def shard_tensor(data, mesh=None, placements=None, dtype=None, place=None,
     src = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
     val = src._value
     if any(isinstance(p, Partial) for p in placements):
-        rep = [Replicate() if isinstance(p, Partial) else p for p in placements]
+        rep = replicate_partials(placements)
         out_val = reshard_value(
             jax.device_put(val, NamedSharding(mesh.jax_mesh,
                                               placements_to_spec(mesh, rep, val.ndim))),
@@ -79,13 +80,38 @@ def reshard(dist_tensor, mesh=None, placements=None):
         return shard_tensor(t, mesh, placements)
     src_mesh, src_placements = t._dist
     if src_mesh != mesh:
-        # cross-mesh (same_status) — valid only when the device sets match
-        if sorted(src_mesh.process_ids) != sorted(mesh.process_ids):
-            raise NotImplementedError("cross-mesh reshard over disjoint devices "
-                                      "lands with the pipeline layer")
+        return _cross_mesh_reshard(t, src_mesh, src_placements, mesh, placements)
     new_val = reshard_value(t._value, mesh, src_placements, placements)
     out = Tensor(new_val, stop_gradient=t.stop_gradient, name=t.name)
     out._dist = (mesh, placements)
+    return out
+
+
+def _cross_mesh_reshard(t, src_mesh, src_placements, dst_mesh, dst_placements):
+    """Move a DistTensor between DIFFERENT meshes — same device set
+    (same_status relayout), overlapping, or fully disjoint devices
+    (pipeline-stage / MoE sub-meshes), and global↔sub-mesh transitions.
+
+    Reference: paddle/phi/core/distributed/auto_parallel/reshard/
+    same_status_reshard_function.cc (p2p send/recv per rank pair) and
+    global_and_sub_mesh_reshard_function.cc. TPU-native: the value is a
+    single-controller GLOBAL jax.Array, so the transfer is one
+    `jax.device_put` onto the target mesh's NamedSharding — XLA/PJRT plans
+    the device-to-device copies (ICI hops when both meshes live in one
+    slice). Partial states are reduced on the source mesh first and
+    re-established on the target afterwards, since partial values are only
+    meaningful relative to their own mesh's axes."""
+    src_rep = replicate_partials(src_placements)
+    val = t._value
+    if list(src_placements) != src_rep:
+        val = reshard_value(val, src_mesh, src_placements, src_rep)
+    dst_rep = replicate_partials(dst_placements)
+    spec = placements_to_spec(dst_mesh, dst_rep, val.ndim)
+    val = jax.device_put(val, NamedSharding(dst_mesh.jax_mesh, spec))
+    if list(dst_placements) != dst_rep:
+        val = reshard_value(val, dst_mesh, dst_rep, dst_placements)
+    out = Tensor(val, stop_gradient=t.stop_gradient, name=t.name)
+    out._dist = (dst_mesh, list(dst_placements))
     return out
 
 
@@ -287,6 +313,123 @@ def shard_dataloader(dataloader, meshes=None, shard_dims=None, is_dataset_splitt
                     batch, is_leaf=lambda x: isinstance(x, Tensor))
 
     return _ShardedLoader(dataloader)
+
+
+# ---------------- MoE sub-mesh APIs ----------------
+def split_mesh(global_mesh: ProcessMesh, sub_mesh_dim: int):
+    """Split a mesh into sub-meshes along one dim (reference
+    auto_parallel/api.py:411 split_mesh)."""
+    shape = global_mesh.shape
+    nd = len(shape)
+    if sub_mesh_dim >= nd or (sub_mesh_dim < 0 and -sub_mesh_dim > nd):
+        raise ValueError(f"sub_mesh_dim {sub_mesh_dim} out of range for {shape}")
+    if sub_mesh_dim < 0:
+        sub_mesh_dim += nd
+    ids = np.asarray(global_mesh.process_ids).reshape(shape)
+    names = [d for i, d in enumerate(global_mesh.dim_names) if i != sub_mesh_dim]
+    return [ProcessMesh(np.squeeze(piece, axis=sub_mesh_dim), names)
+            for piece in np.split(ids, shape[sub_mesh_dim], axis=sub_mesh_dim)]
+
+
+def _local_placements_for_split(placements, sub_mesh_dim):
+    local = [p for i, p in enumerate(placements) if i != sub_mesh_dim]
+    return local
+
+
+def moe_sub_mesh_tensors(dist_tensor, global_mesh=None, local_mesh_dim=None,
+                         global_placements=None):
+    """Global DistTensor → one DistTensor per sub-mesh along `local_mesh_dim`
+    (reference auto_parallel/api.py:604): the EP entry point — each expert
+    group gets its slice of the global tensor on its own sub-mesh."""
+    from ..core.engine import apply
+    mesh = _as_mesh(global_mesh)
+    t = dist_tensor
+    placements = list(global_placements if global_placements is not None
+                      else (t._dist[1] if t._dist else []))
+    nd = len(mesh.shape)
+    if len(placements) != nd:
+        raise ValueError(f"need one placement per mesh dim: got "
+                         f"{len(placements)} for a {nd}-d mesh")
+    dim = local_mesh_dim if local_mesh_dim is not None else -1
+    dim = dim + nd if dim < 0 else dim
+    sub_meshes = split_mesh(mesh, dim)
+    local_placements = _local_placements_for_split(placements, dim)
+    n = mesh.shape[dim]
+    split_pl = placements[dim]
+    if isinstance(split_pl, Shard) and t._value.shape[split_pl.dim] % n != 0:
+        raise ValueError(
+            f"tensor dim {split_pl.dim} (size {t._value.shape[split_pl.dim]}) "
+            f"not divisible by the {n} sub-meshes along mesh dim {dim}")
+    outs = []
+    for i, sm in enumerate(sub_meshes):
+        if isinstance(split_pl, Shard):
+            d = split_pl.dim
+            size = t._value.shape[d] // n
+
+            def piece(x, i=i, d=d, size=size):
+                return jax.lax.slice_in_dim(x, i * size, (i + 1) * size, axis=d)
+
+            local = apply(piece, t, name="moe_sub_mesh_slice")
+        else:
+            # tracked identity so backward reaches the global tensor
+            local = apply(lambda x: x, t, name="moe_sub_mesh_identity")
+        spec = placements_to_spec(sm, local_placements, local._value.ndim)
+
+        def put(x, sm=sm, spec=spec):
+            return jax.device_put(x, NamedSharding(sm.jax_mesh, spec))
+
+        local = apply(put, local, name="moe_sub_mesh_put")
+        local.stop_gradient = t.stop_gradient
+        local._dist = (sm, list(local_placements))
+        outs.append(local)
+    return outs
+
+
+def moe_global_mesh_tensor(local_tensor_list, mesh=None, placements=None,
+                           local_mesh_dim=-1):
+    """Per-sub-mesh local DistTensors → ONE global DistTensor on `mesh`
+    (reference auto_parallel/api.py:463): reassembles expert-group tensors
+    along `local_mesh_dim` (concat when that dim is Shard, first-replica
+    otherwise)."""
+    mesh = _as_mesh(mesh)
+    placements = list(placements or [])
+    nd = len(mesh.shape)
+    dim = local_mesh_dim + nd if local_mesh_dim < 0 else local_mesh_dim
+    split_pl = placements[dim] if dim < len(placements) else Replicate()
+    from ..core.engine import apply
+
+    rep = NamedSharding(mesh.jax_mesh, P())
+    if isinstance(split_pl, Shard):
+        d = split_pl.dim
+
+        def assemble(*vals):
+            # locals live on per-sub-mesh device sets: hop each onto the
+            # global mesh before concatenating
+            return jnp.concatenate([jax.device_put(v, rep) for v in vals],
+                                   axis=d)
+    else:
+        def assemble(*vals):
+            # replicated split: locals are copies of one logical tensor —
+            # average so every local receives an equal backward share
+            hopped = [jax.device_put(v, rep) for v in vals]
+            return sum(hopped) / len(hopped)
+
+    out = apply(assemble, *local_tensor_list, name="moe_global_assemble")
+
+    dst_rep = replicate_partials(placements)
+    spec = placements_to_spec(mesh, dst_rep, out._value.ndim)
+
+    def put(x):
+        out_v = jax.device_put(x, NamedSharding(mesh.jax_mesh, spec))
+        if dst_rep != placements:
+            out_v = reshard_value(out_v, mesh, dst_rep, placements)
+        return out_v
+
+    out = apply(put, out, name="moe_global_put")
+    out.stop_gradient = all(getattr(t, "stop_gradient", True)
+                            for t in local_tensor_list)
+    out._dist = (mesh, placements)
+    return out
 
 
 def local_map(fn, out_placements, in_placements=None, process_mesh=None,

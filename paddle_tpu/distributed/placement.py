@@ -15,7 +15,7 @@ from __future__ import annotations
 from jax.sharding import PartitionSpec
 
 __all__ = ["Placement", "Shard", "Replicate", "Partial",
-           "placements_to_spec", "spec_to_placements"]
+           "placements_to_spec", "spec_to_placements", "replicate_partials"]
 
 
 class Placement:
@@ -78,6 +78,12 @@ class Partial(Placement):
 
     def __repr__(self):
         return f"Partial(reduce_type={self.reduce_type})"
+
+
+def replicate_partials(placements):
+    """Placements with every Partial rewritten to Replicate (the layout a
+    partial tensor has AFTER its pending reduction)."""
+    return [Replicate() if isinstance(p, Partial) else p for p in placements]
 
 
 def placements_to_spec(mesh, placements, ndim: int) -> PartitionSpec:

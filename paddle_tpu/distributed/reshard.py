@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .placement import Partial, Placement, Replicate, Shard, placements_to_spec
+from .placement import (Partial, Placement, Replicate, Shard, placements_to_spec,
+                        replicate_partials)
 
 __all__ = ["reshard_value", "partial_axes", "shard_map_compat"]
 
@@ -77,8 +78,6 @@ def reshard_value(value, mesh, src_placements, dst_placements):
         return shard_map_compat(fn, jm, (dst_spec,), dst_spec)(inter)
 
     # p -> p (possibly different non-partial layout): reduce then re-partialize
-    mid = reshard_value(value, mesh, src_placements,
-                        [Replicate() if isinstance(p, Partial) else p
-                         for p in src_placements])
-    return reshard_value(mid, mesh, [Replicate() if isinstance(p, Partial) else p
-                                     for p in src_placements], dst_placements)
+    mid_placements = replicate_partials(src_placements)
+    mid = reshard_value(value, mesh, src_placements, mid_placements)
+    return reshard_value(mid, mesh, mid_placements, dst_placements)
