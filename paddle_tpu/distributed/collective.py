@@ -156,7 +156,33 @@ def _run_spmd(fn, t: Tensor, group: Group, out_sharded_dim=None, in_sharded_dim=
     val = t._value
     if not hasattr(val.sharding, "mesh") or val.sharding.mesh != jm:
         from jax.sharding import NamedSharding
-        val = jax.device_put(val, NamedSharding(jm, in_spec))
+        sh = NamedSharding(jm, in_spec)
+        if jax.process_count() > 1:
+            # multi-controller: each process CONTRIBUTES ITS OWN value (the
+            # rank-local tensor of the collective) — device_put would assert
+            # cross-process equality, so assemble per-device from the local
+            # host value instead
+            if in_spec != P():
+                raise NotImplementedError(
+                    "multi-process eager collectives with sharded inputs: "
+                    "build the global tensor with dtensor_from_local first")
+            if jax.local_device_count() > 1:
+                # replicating the per-PROCESS value onto L local devices
+                # would over-count it L times in the psum
+                raise NotImplementedError(
+                    "multi-process eager collectives with >1 local device: "
+                    "build the global tensor with dtensor_from_local and "
+                    "explicit placements (one contribution per device)")
+            if isinstance(val, jax.Array) and not val.is_fully_addressable:
+                raise NotImplementedError(
+                    "input spans non-addressable devices of a different "
+                    "mesh: reshard it onto the group's mesh first")
+            from .api import _from_local_shards
+            import numpy as _np
+            local_np = _np.asarray(val)
+            val = _from_local_shards(local_np, mesh, in_spec, local_np.shape)
+        else:
+            val = jax.device_put(val, sh)
     out = shard_map_compat(fn, jm, (in_spec,), out_spec)(val)
     res = Tensor(out, stop_gradient=t.stop_gradient)
     return res
@@ -365,16 +391,22 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
-    """Device-level barrier: a tiny psum forces a synchronization point."""
+    """Device-level barrier: a tiny psum forces a synchronization point.
+    Watched: a peer that never arrives produces a named timeout error
+    (comm_watchdog), not an eternal hang."""
+    from .comm_watchdog import watch
     g = _group(group)
-    t = Tensor(jnp.zeros((), jnp.float32))
-    all_reduce(t, group=g)
-    jax.block_until_ready(t._value)
+    with watch("barrier", group=g):
+        t = Tensor(jnp.zeros((), jnp.float32))
+        all_reduce(t, group=g)
+        jax.block_until_ready(t._value)
     return _Task()
 
 
 def wait(tensor, group=None, use_calc_stream=True):
-    jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
+    from .comm_watchdog import watch
+    with watch("wait", group=group):
+        jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
 
 
 # stream.* namespace (reference communication/stream/*) — same ops; the

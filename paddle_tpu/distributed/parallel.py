@@ -29,19 +29,29 @@ _initialized = [False]
 
 
 def init_parallel_env():
-    """Initialize multi-host SPMD (reference parallel.py:978)."""
+    """Initialize multi-process SPMD (reference parallel.py:978).
+
+    Rendezvous = jax.distributed.initialize (the coordination service is the
+    TCPStore analog): every process of a >1-world job joins, after which
+    jax.devices() spans all processes and one global mesh covers the job.
+    The join is watchdog-guarded — a missing peer produces a named timeout,
+    not a silent hang."""
     if _initialized[0]:
         return get_group(0)
     master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if master and nnodes > 1 and get_world_size() > 1:
+    world = get_world_size()
+    already = jax.distributed.is_initialized() \
+        if hasattr(jax.distributed, "is_initialized") else False
+    if master and world > 1 and not already:
         port = os.environ.get("MASTER_PORT")
         addr = master if ":" in master or not port else f"{master}:{port}"
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=get_world_size(),
-            process_id=get_rank(),
-        )
+        from .comm_watchdog import watch
+        with watch("init_parallel_env/rendezvous"):
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=world,
+                process_id=get_rank(),
+            )
     if get_mesh() is None:
         init_mesh([-1], ["world"])
     os.environ["PADDLE_DIST_INITIALIZED"] = "1"

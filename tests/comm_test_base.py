@@ -1,0 +1,67 @@
+"""Multi-process communication test harness.
+
+Reference pattern: test/collective/test_communication_api_base.py:28
+(CommunicationTestDistBase) — spawn N local processes under the launcher env
+contract, each joins the rendezvous, runs the collective script, and the
+parent asserts success. TPU-native: processes are plain python subprocesses
+on the XLA CPU backend; rendezvous is jax.distributed.initialize through
+paddle_tpu's init_parallel_env; collectives ride gloo cross-process.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class CommunicationTestDistBase:
+    """run_test_case spawns `nproc` ranks of `script` with the
+    PADDLE_TRAINER_* env contract and asserts every rank exits 0."""
+
+    def run_test_case(self, script: str, nproc: int = 2, timeout: int = 180,
+                      extra_env: dict | None = None, expect_fail: bool = False):
+        port = free_port()
+        procs = []
+        for r in range(nproc):
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+            repo_root = os.path.dirname(HERE)
+            env.update({
+                "PADDLE_TRAINER_ID": str(r),
+                "PADDLE_TRAINERS_NUM": str(nproc),
+                "PADDLE_MASTER": f"127.0.0.1:{port}",
+                "PADDLE_NNODES": str(nproc),
+                "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            })
+            env.update(extra_env or {})
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(HERE, "mp_runners", script)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs, codes = [], []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                try:
+                    out, _ = p.communicate(timeout=10)
+                except Exception:
+                    out = ""
+                out = (out or "") + "\n<TIMEOUT: harness killed the rank>"
+            outs.append(out)
+            codes.append(p.returncode)
+        if not expect_fail:
+            for r, (c, o) in enumerate(zip(codes, outs)):
+                assert c == 0, f"rank {r} exited {c}:\n{o[-3000:]}"
+        return codes, outs

@@ -1,0 +1,39 @@
+"""Rank script: cross-process point-to-point (ring shift via ppermute inside
+shard_map over the world mesh) — the traced send/recv path."""
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    mesh = dist.get_mesh()
+    jm = mesh.jax_mesh
+    ax = mesh.dim_names[0]
+
+    local = np.array([float(rank)], np.float32)
+    glob = jax.make_array_from_callback(
+        local.shape, jax.sharding.NamedSharding(jm, P()), lambda idx: local[idx])
+
+    def shift(x):
+        # send to (i+1) % world: every rank receives its LEFT neighbor's value
+        return jax.lax.ppermute(x, ax, [(i, (i + 1) % world) for i in range(world)])
+
+    out = jax.shard_map(shift, mesh=jm, in_specs=P(), out_specs=P(),
+                        check_vma=False)(glob)
+    got = float(np.asarray(out.addressable_shards[0].data)[0])
+    expect = float((rank - 1) % world)
+    assert got == expect, f"rank {rank}: got {got} expect {expect}"
+    print(f"rank {rank}: P2P_OK", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Rank script: deliberately wedge a barrier — rank 1 never joins.
+
+The comm watchdog on the joining ranks must produce a NAMED timeout error
+(op + group + stacks) and abort with exit 124 instead of hanging forever
+(reference CommTask::IsTimeout/AbortComm behavior)."""
+import os
+import sys
+import time
+
+os.environ["FLAGS_comm_timeout_s"] = "6"
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    if dist.get_rank() == 1:
+        time.sleep(20)  # long past rank 0's 6s watchdog: never joins in time
+        return 0
+    dist.barrier()  # wedges -> watchdog must abort with exit 124
+    print("UNREACHABLE: barrier returned", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
