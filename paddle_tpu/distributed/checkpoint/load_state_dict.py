@@ -21,10 +21,45 @@ from ...core.tensor import Tensor
 from .metadata import Metadata
 
 
+def _np_dtype(name):
+    """Stored dtype name → numpy dtype (ml_dtypes covers bf16/f8)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# bit-view integer dtypes the saver used for low-precision storage
+from .metadata import VIEW_DTYPES as _VIEW_OF
+
+
+def _latest_metadata(path, unique_id):
+    if unique_id is not None:
+        return os.path.join(path, f"{int(unique_id)}_metadata.json")
+    best, best_fn = -1, None
+    for fn in os.listdir(path):
+        if fn.endswith("_metadata.json"):
+            try:
+                uid = int(fn.split("_")[0])
+            except ValueError:
+                continue
+            if uid > best:
+                best, best_fn = uid, fn
+    if best_fn is None:
+        # pre-generation layout
+        legacy = os.path.join(path, "metadata.json")
+        if os.path.exists(legacy):
+            return legacy
+        raise FileNotFoundError(f"no checkpoint metadata in {path}")
+    return os.path.join(path, best_fn)
+
+
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, offload=False):
-    """Fills `state_dict`'s tensors in place from the checkpoint at `path`."""
-    with open(os.path.join(path, "metadata.json")) as f:
+    """Fills `state_dict`'s tensors in place from the checkpoint at `path`
+    (latest generation unless unique_id pins one)."""
+    with open(_latest_metadata(path, unique_id)) as f:
         meta = Metadata.from_dict(json.load(f))
 
     files: dict[str, np.lib.npyio.NpzFile] = {}
@@ -39,12 +74,19 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         shards = meta.state_dict_metadata.get(name)
         if not shards:
             continue
-        # global shape = max extent over shards
-        ndim = len(shards[0].local_shape)
-        gshape = tuple(max(m.global_offset[d] + m.local_shape[d] for m in shards)
-                       for d in range(ndim))
-        dtype = np.dtype(shards[0].dtype) if shards[0].dtype != "bfloat16" else None
-        full = np.zeros(gshape, dtype=dtype or np.float32)
+        stored_dtype = _np_dtype(shards[0].dtype)
+        # authoritative global shape from metadata; pre-r2 checkpoints fall
+        # back to max-extent inference (wrong if a shard is missing — which
+        # now raises below instead of zero-filling silently)
+        if shards[0].global_shape is not None:
+            gshape = tuple(shards[0].global_shape)
+        else:
+            ndim = len(shards[0].local_shape)
+            gshape = tuple(
+                max(m.global_offset[d] + m.local_shape[d] for m in shards)
+                for d in range(ndim))
+        full = np.zeros(gshape, dtype=stored_dtype)
+        covered = np.zeros(gshape, dtype=bool) if gshape else None
         for m in shards:
             key = f"{name}@{'_'.join(map(str, m.global_offset))}"
             fn = meta.storage_metadata.get(key)
@@ -52,21 +94,34 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 key = f"{name}@full"
                 fn = meta.storage_metadata.get(key)
             if fn is None:
-                continue
+                raise KeyError(
+                    f"checkpoint corrupt: no storage entry for shard {key!r}")
             data = np.asarray(get_file(fn)[key])
-            sl = tuple(slice(o, o + s) for o, s in zip(m.global_offset, m.local_shape))
+            view = _VIEW_OF.get(m.dtype)
+            if view is not None and data.dtype == view:
+                data = data.view(_np_dtype(m.dtype))
+            sl = tuple(slice(o, o + s)
+                       for o, s in zip(m.global_offset, m.local_shape))
             full[sl] = data
+            if covered is not None:
+                covered[sl] = True
+        if covered is not None and not covered.all():
+            raise ValueError(
+                f"checkpoint for {name!r} does not cover the full global "
+                f"shape {gshape}: a shard is missing")
 
         target = holder._value if isinstance(holder, Tensor) else holder
-        if isinstance(target, jax.Array):
-            arr = jax.device_put(full.astype(target.dtype), target.sharding)
-        else:
-            arr = np.asarray(full)
         if isinstance(holder, Tensor):
-            holder._value = arr
+            holder._value = jax.device_put(full.astype(target.dtype),
+                                           target.sharding) \
+                if isinstance(target, jax.Array) else np.asarray(full)
+        elif isinstance(target, np.ndarray):
+            np.copyto(target, full.astype(target.dtype))
         else:
-            # plain array holder: write back via dict interface (caller keyed)
-            pass
+            raise TypeError(
+                f"state_dict[{name!r}] holder of type {type(holder).__name__} "
+                "cannot receive a loaded value in place: pass Tensors or "
+                "numpy arrays (bare jax.Array holders are immutable)")
     for f in files.values():
         f.close()
     return state_dict

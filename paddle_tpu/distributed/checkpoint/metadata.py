@@ -7,12 +7,25 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+import numpy as np
+
+# low-precision dtypes npz can't serialize directly: stored as same-width
+# integer bit-views; the true dtype travels in LocalTensorMetadata.dtype and
+# load re-views. ONE table shared by saver and loader (drift would silently
+# corrupt values).
+VIEW_DTYPES = {"bfloat16": np.uint16, "float8_e4m3fn": np.uint8,
+               "float8_e5m2": np.uint8}
+
 
 @dataclasses.dataclass
 class LocalTensorMetadata:
     global_offset: tuple
     local_shape: tuple
     dtype: str
+    # authoritative full-tensor shape (a missing shard must not shrink the
+    # reconstructed tensor); None only in pre-r2 checkpoints, where load
+    # falls back to max-extent inference
+    global_shape: tuple | None = None
 
 
 @dataclasses.dataclass
@@ -36,8 +49,11 @@ class Metadata:
     def from_dict(cls, d):
         return cls(
             state_dict_metadata={
-                k: [LocalTensorMetadata(tuple(m["global_offset"]),
-                                        tuple(m["local_shape"]), m["dtype"])
+                k: [LocalTensorMetadata(
+                        tuple(m["global_offset"]), tuple(m["local_shape"]),
+                        m["dtype"],
+                        tuple(m["global_shape"]) if m.get("global_shape")
+                        else None)
                     for m in v]
                 for k, v in d.get("state_dict_metadata", {}).items()
             },

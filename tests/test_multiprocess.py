@@ -30,6 +30,14 @@ class TestMultiProcessCollectives(CommunicationTestDistBase):
         assert all("P2P_OK" in o for o in outs)
 
 
+class TestMultiProcessCheckpoint(CommunicationTestDistBase):
+    def test_sharded_save_load_2proc(self, tmp_path):
+        codes, outs = self.run_test_case(
+            "checkpoint_mp.py", nproc=2,
+            extra_env={"CKPT_PATH": str(tmp_path)})
+        assert all("CKPT_OK" in o for o in outs)
+
+
 class TestCommWatchdog(CommunicationTestDistBase):
     def test_hung_barrier_dies_with_named_error(self):
         codes, outs = self.run_test_case("watchdog_hang.py", nproc=2,
