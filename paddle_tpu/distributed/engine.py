@@ -47,6 +47,7 @@ class Strategy:
     num_microbatches: int = 1          # grad accumulation / pp microbatches
     pp_schedule: str = "1f1b"          # gpipe | 1f1b | vpp
     pp_num_chunks: int = 1             # VPP virtual chunks per rank
+    pp_layer_counts: tuple | None = None  # uneven per-stage layer counts
     remat: bool = False                # checkpoint each pp stage / mb step
     data_axes: tuple = ("dp", "fsdp", "sharding")  # batch sharded on first hit
     fsdp_axes: tuple = ("fsdp", "sharding")        # dim-0 param sharding axes
@@ -136,19 +137,58 @@ class Engine:
         self.plan = plan
 
         self._nlayers = 0
+        self._pp_vpp = False
+        self._pp_counts = None  # per-stage layer counts (uneven segmentation)
         if self.use_pp:
             self._check_pp_dropout_free(model)
-            # internal pp layout: block params live stacked+chunked
-            # [S, L/S, ...] under "_blocks.<subkey>", sharded on 'pp' AT REST —
-            # no per-step restack, and each device holds only its stages
+            # internal pp layout: block params live stacked+chunked under
+            # "_blocks.<subkey>", sharded on 'pp' AT REST — no per-step
+            # restack, and each device holds only its stages.
+            #   gpipe/1f1b: [S, Lmax, ...] (zero-padded when layers % S != 0,
+            #     reference SegmentLayers pp_layers.py:257 semantics)
+            #   vpp:        [V, S, L/(S*V), ...] (chunk j = v*S + s)
             stacked, other, nlayers = self._stack_blocks(params)
             self._nlayers = nlayers
             S = self._jm.shape["pp"]
-            assert nlayers % S == 0, f"layers {nlayers} % pp {S} != 0"
+            sched = (st.pp_schedule or "1f1b").lower()
+            self._pp_vpp = sched == "vpp"
             params = dict(other)
-            for sub, arr in stacked.items():
-                params[_BLOCK_NS + sub] = arr.reshape(
-                    (S, nlayers // S) + arr.shape[1:])
+            if self._pp_vpp:
+                if st.pp_layer_counts:
+                    raise ValueError(
+                        "pp_layer_counts (uneven stages) is not supported "
+                        "with pp_schedule='vpp': chunks must be equal-sized")
+                V = max(int(st.pp_num_chunks), 1)
+                st.pp_num_chunks = V  # clamped once; all paths read this
+                if nlayers % (S * V) != 0:
+                    raise ValueError(
+                        f"vpp needs layers % (pp*chunks) == 0: "
+                        f"{nlayers} % ({S}*{V}) != 0")
+                Lc = nlayers // (S * V)
+                for sub, arr in stacked.items():
+                    params[_BLOCK_NS + sub] = arr.reshape(
+                        (V, S, Lc) + arr.shape[1:])
+            else:
+                counts = list(st.pp_layer_counts) if st.pp_layer_counts \
+                    else self._balanced_counts(nlayers, S)
+                if len(counts) != S or sum(counts) != nlayers \
+                        or any(c < 1 for c in counts):
+                    raise ValueError(
+                        f"pp_layer_counts {counts} must have {S} entries "
+                        f">= 1 summing to {nlayers}")
+                self._pp_counts = counts
+                Lmax = max(counts)
+                starts = np.cumsum([0] + counts[:-1])
+                for sub, arr in stacked.items():
+                    rows = []
+                    for s in range(S):
+                        piece = arr[starts[s]:starts[s] + counts[s]]
+                        if counts[s] < Lmax:
+                            pad = jnp.zeros((Lmax - counts[s],) + arr.shape[1:],
+                                            arr.dtype)
+                            piece = jnp.concatenate([piece, pad], axis=0)
+                        rows.append(piece)
+                    params[_BLOCK_NS + sub] = jnp.stack(rows, axis=0)
 
         self._params = self._place_params(params)
         self._opt_state = self._place_opt_state(
@@ -157,6 +197,12 @@ class Engine:
         self._jitted_fwd = None
 
         self._build_step()
+
+    @staticmethod
+    def _balanced_counts(nlayers, S):
+        """Front-loaded balanced segmentation (reference SegmentLayers)."""
+        base, rem = divmod(nlayers, S)
+        return [base + 1] * rem + [base] * (S - rem)
 
     @staticmethod
     def _check_pp_dropout_free(model):
@@ -206,15 +252,18 @@ class Engine:
             out = {}
             for k, v in params.items():
                 if k.startswith(_BLOCK_NS):
-                    # [S, L/S, ...]: dim0 on 'pp'; trailing dims follow the
-                    # user's shard rules (tp etc.), queried with a
+                    # gpipe/1f1b [S, Lmax, ...] (dim0 on 'pp') or vpp
+                    # [V, S, Lc, ...] (dim1 on 'pp'); trailing dims follow
+                    # the user's shard rules (tp etc.), queried with a
                     # representative per-layer name/shape
                     sub = k[len(_BLOCK_NS):]
                     rep_name = f"{self.plan.blocks_attr}.0.{sub}"
-                    user = self._user_spec(rep_name, v[0, 0])
+                    lead = 3 if self._pp_vpp else 2
+                    user = self._user_spec(rep_name, v[(0,) * lead])
                     trailing = tuple(user) if user is not None else \
-                        (None,) * (v.ndim - 2)
-                    spec = P("pp", None, *trailing)
+                        (None,) * (v.ndim - lead)
+                    spec = P(None, "pp", None, *trailing) if self._pp_vpp \
+                        else P("pp", None, *trailing)
                 else:
                     spec = self._param_spec(k, v)
                 out[k] = jax.device_put(v, NamedSharding(self._jm, spec))
@@ -393,7 +442,9 @@ class Engine:
 
     def _build_pp_vag(self):
         from ..parallel.pipeline_parallel import (pipeline_apply,
-                                                  pipeline_train_1f1b)
+                                                  pipeline_apply_interleaved,
+                                                  pipeline_train_1f1b,
+                                                  pipeline_train_vpp)
         st = self.strategy
         plan = self.plan
         mesh = self.mesh
@@ -403,26 +454,50 @@ class Engine:
         model = self.model
         template = _resolve_attr(model, plan.blocks_attr)[0]
         sched = st.pp_schedule.lower()
-        if sched not in ("gpipe", "fthenb", "1f1b"):
-            raise ValueError(f"unknown pp_schedule {st.pp_schedule!r} "
-                             "(vpp arrives with uneven stages)")
+        if sched not in ("gpipe", "fthenb", "1f1b", "vpp"):
+            raise ValueError(f"unknown pp_schedule {st.pp_schedule!r}")
+        counts = self._pp_counts
+        uneven = counts is not None and len(set(counts)) > 1
+        counts_arr = jnp.asarray(counts, jnp.int32) if uneven else None
 
         def pp_split(p):
-            """internal layout → (chunked blocks {sub: [S, L/S, ...]}, other)"""
+            """internal layout → (chunked blocks, other)"""
             blocks = {k[len(_BLOCK_NS):]: v for k, v in p.items()
                       if k.startswith(_BLOCK_NS)}
             other = {k: v for k, v in p.items() if not k.startswith(_BLOCK_NS)}
             return blocks, other
 
-        def stage_fn(sp, act):
-            def body(carry, bp):
-                with template._swapped_state(bp):
-                    out = template(Tensor(carry))
-                return _as_value(out), None
+        def apply_block(carry, bp):
+            with template._swapped_state(bp):
+                out = template(Tensor(carry))
+            return _as_value(out)
 
-            body_fn = jax.checkpoint(body) if st.remat else body
-            out, _ = jax.lax.scan(body_fn, act, sp)
-            return out
+        if not uneven:
+            def stage_fn(sp, act):
+                def body(carry, bp):
+                    return apply_block(carry, bp), None
+
+                body_fn = jax.checkpoint(body) if st.remat else body
+                out, _ = jax.lax.scan(body_fn, act, sp)
+                return out
+        else:
+            # uneven segmentation: stages scan Lmax padded slots and skip
+            # the tail via cond (padded params never run; their grads are
+            # exactly zero) — reference SegmentLayers semantics
+            def stage_fn(sp, act):
+                n = counts_arr[jax.lax.axis_index("pp")]
+
+                def body(carry, xs):
+                    slot, bp = xs
+                    y = jax.lax.cond(slot < n, apply_block,
+                                     lambda c, b: c, carry, bp)
+                    return y, None
+
+                body_fn = jax.checkpoint(body) if st.remat else body
+                Lmax = jax.tree.leaves(sp)[0].shape[0]
+                out, _ = jax.lax.scan(body_fn, act,
+                                      (jnp.arange(Lmax), sp))
+                return out
 
         def run_embed(other_vals, buffers, inputs):
             values = dict(other_vals)
@@ -446,8 +521,13 @@ class Engine:
             B = act.shape[0]
             assert B % M == 0, f"batch {B} % microbatches {M} != 0"
             mbs = act.reshape((M, B // M) + act.shape[1:])
-            outs = pipeline_apply(stage_fn, chunked, mbs, mesh, "pp",
-                                  remat=st.remat)
+            if sched == "vpp":
+                outs = pipeline_apply_interleaved(
+                    stage_fn, chunked, mbs, mesh, st.pp_num_chunks, "pp",
+                    remat=st.remat)
+            else:
+                outs = pipeline_apply(stage_fn, chunked, mbs, mesh, "pp",
+                                      remat=st.remat)
             y = outs.reshape((B,) + outs.shape[2:])
             return run_head(other, buffers, y, labels)
 
@@ -461,18 +541,20 @@ class Engine:
                         lambda p_: pp_loss(p_, buffers, inputs, labels))(p)
                     return loss, grads, dict(buffers)
 
-                # explicit 1F1B: the head/loss runs INSIDE the pp shard_map,
-                # so model buffers (closed-over tracers there) are not
-                # supported on this schedule — gpipe runs head outside
+                # explicit 1F1B / VPP: the head/loss runs INSIDE the pp
+                # shard_map, so model buffers (closed-over tracers there)
+                # are not supported on these schedules — gpipe runs the
+                # head outside
                 if self._buffers:
                     raise NotImplementedError(
-                        "pp_schedule='1f1b' with model buffers: use 'gpipe' "
-                        "(buffers would be closed over inside shard_map)")
+                        f"pp_schedule={sched!r} with model buffers: use "
+                        "'gpipe' (buffers would be closed over inside "
+                        "shard_map)")
                 if len(labels) != 1:
                     raise NotImplementedError(
-                        f"pp_schedule='1f1b' threads exactly one label array "
-                        f"through the schedule (got {len(labels)}); use "
-                        "'gpipe' for multi-label losses")
+                        f"pp_schedule={sched!r} threads exactly one label "
+                        f"array through the schedule (got {len(labels)}); "
+                        "use 'gpipe' for multi-label losses")
 
                 chunked, other = pp_split(self._cast(p))
 
@@ -489,7 +571,9 @@ class Engine:
                 def loss_fn_pp(op, y, lbl):
                     return run_head(op, buffers, y, (lbl,))
 
-                loss, g_chunked, g_other, g_mbs = pipeline_train_1f1b(
+                train = pipeline_train_vpp if sched == "vpp" \
+                    else pipeline_train_1f1b
+                loss, g_chunked, g_other, g_mbs = train(
                     stage_fn, loss_fn_pp, chunked, other, mbs, lbls, mesh,
                     "pp", remat=st.remat)
                 (d_emb,) = embed_pull(g_mbs)
@@ -598,8 +682,16 @@ class Engine:
         stacked = {}
         for k, v in self._params.items():
             if k.startswith(_BLOCK_NS):
-                stacked[k[len(_BLOCK_NS):]] = v.reshape(
-                    (self._nlayers,) + v.shape[2:])
+                if self._pp_vpp:  # [V, S, Lc, ...] in chunk==layer order
+                    flat = v.reshape((self._nlayers,) + v.shape[3:])
+                elif self._pp_counts and len(set(self._pp_counts)) > 1:
+                    # [S, Lmax, ...]: strip per-stage padding
+                    flat = jnp.concatenate(
+                        [v[s, :n] for s, n in enumerate(self._pp_counts)],
+                        axis=0)
+                else:
+                    flat = v.reshape((self._nlayers,) + v.shape[2:])
+                stacked[k[len(_BLOCK_NS):]] = flat
             else:
                 out[k] = v
         out.update(self._unstack_blocks(stacked, self._nlayers))

@@ -226,6 +226,22 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
     return shmapped(stacked_params, loss_params, microbatches, labels)
 
 
+def _vpp_fwd_coords(t, r, S, V, M):
+    """Shared interleaved-schedule tick coordinates for rank `r` at tick `t`:
+    returns (m, j, v, valid) — microbatch, global chunk (j % S == r when
+    valid), rank-local chunk slot, and validity. Used by BOTH the forward-only
+    and the training schedule so the indexing cannot diverge."""
+    SV = S * V
+    mmod = (t - r) % S
+    base = t - mmod
+    j = base % SV
+    g = base // SV
+    m = g * S + mmod
+    v = j // S
+    valid = jnp.logical_and(base >= 0, jnp.logical_and(m >= 0, m < M))
+    return m, j, v, valid
+
+
 def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
                                mesh: ProcessMesh, num_chunks: int,
                                pp_axis: str = "pp", remat: bool = True):
@@ -244,6 +260,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
     jm = mesh.jax_mesh
     S = mesh.get_dim_size(pp_axis)
     V = int(num_chunks)
+    if V < 1:
+        raise ValueError(f"num_chunks must be >= 1, got {num_chunks}")
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     M = microbatches.shape[0]
     if M % S != 0:
@@ -259,13 +277,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
 
         def body(carry, t):
             state, out_acc = carry
-            mmod = (t - r) % S
-            base = t - mmod                      # multiple of S once valid
-            j = base % SV                        # chunk index; j % S == r
-            g = base // SV                       # microbatch group
-            m = g * S + mmod
-            v = j // S
-            valid = jnp.logical_and(base >= 0, jnp.logical_and(m >= 0, m < M))
+            m, j, v, valid = _vpp_fwd_coords(t, r, S, V, M)
 
             inject = jnp.logical_and(j == 0, valid)
             mb_in = jnp.take(mbs, jnp.clip(m, 0, M - 1), axis=0)
@@ -292,6 +304,146 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
     shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
                              axis_names=frozenset({pp_axis}), check_vma=False)
     return shmapped(stacked_params, microbatches)
+
+
+def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
+                       loss_params, microbatches, labels, mesh: ProcessMesh,
+                       pp_axis: str = "pp", remat: bool = False):
+    """Explicit interleaved-VPP training: loss + grads, no autodiff-of-scan.
+
+    The schedule is the reference's PipelineParallelWithInterleaveFthenB
+    (meta_parallel/pipeline_parallel.py:2256): a forward interleaved pass
+    (chunk j = v*S + r on rank r, microbatches circling the ring V times,
+    bubble (S-1)/(M*V+S-1) per phase instead of GPipe's (S-1)/(M+S-1)),
+    then a mirrored backward pass over the REVERSED ring that rebuilds each
+    chunk's vjp from its saved input (recompute — the 1F1B ring-buffer
+    technique applied chunk-wise). Activation memory is M*V chunk inputs per
+    rank (the F-then-B VPP bound), NOT proportional to schedule ticks as
+    autodiff-of-the-scan would be.
+
+    stacked_params: pytree, leaves [V, S, ...] — chunk j = v*S + r lives on
+    rank r at local slot v; axis 1 sharded on pp_axis.
+    loss_fn(loss_params, y_mb, label_mb) -> scalar mean over the microbatch
+    (must be vmap-able over the microbatch axis).
+    microbatches: [M, mb, ...] with M % S == 0; labels [M, mb, ...].
+
+    Returns (mean_loss, grads [V, S, ...], grads_loss_params, grads_mbs).
+    """
+    jm = mesh.jax_mesh
+    S = mesh.get_dim_size(pp_axis)
+    V = int(jax.tree.leaves(stacked_params)[0].shape[0])
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    M = microbatches.shape[0]
+    if M % S != 0:
+        raise ValueError(f"num microbatches ({M}) must be a multiple of pp ({S})")
+    SV = S * V
+    T = M * V + S - 1
+
+    def local_fn(params_local, lp, mbs, lbls):
+        pv = jax.tree.map(lambda p: p[:, 0], params_local)   # [V, ...]
+        r = jax.lax.axis_index(pp_axis)
+        ring = [(i, (i + 1) % S) for i in range(S)]
+        ring_rev = [(i, (i - 1) % S) for i in range(S)]
+
+        # ---- phase 1: interleaved forward, saving each chunk's input ----
+        def fwd_body(carry, t):
+            state, inbuf, outs = carry
+            m, j, v, valid = _vpp_fwd_coords(t, r, S, V, M)
+            m_c = jnp.clip(m, 0, M - 1)
+            v_c = jnp.clip(v, 0, V - 1)
+
+            inject = jnp.logical_and(j == 0, valid)
+            mb_in = jnp.take(mbs, m_c, axis=0)
+            x_in = jnp.where(inject, mb_in, state)
+
+            # save this chunk's input for the backward recompute
+            cur = inbuf[m_c, v_c]
+            inbuf = inbuf.at[m_c, v_c].set(jnp.where(valid, x_in, cur))
+
+            p_t = jax.tree.map(lambda p: jnp.take(p, v_c, axis=0), pv)
+            y = fn(p_t, x_in)
+
+            done = jnp.logical_and(j == SV - 1, valid)   # rank S-1 only
+            cur_o = jnp.take(outs, m_c, axis=0)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(done, y, cur_o), m_c, 0)
+
+            state = jax.lax.ppermute(y, pp_axis, ring)
+            return (state, inbuf, outs), None
+
+        zeros_mb = jnp.zeros_like(mbs[0])
+        carry0 = (zeros_mb,
+                  jnp.zeros((M, V) + mbs.shape[1:], mbs.dtype),
+                  jnp.zeros_like(mbs))
+        (_, inbuf, outs), _ = jax.lax.scan(fwd_body, carry0, jnp.arange(T))
+        # final outputs to every rank (loss is computed replicated)
+        mask = (r == S - 1).astype(outs.dtype)
+        outs = jax.lax.psum(outs * mask, pp_axis)
+
+        # ---- phase 2: loss + output cotangents (replicated compute) ----
+        def loss_all(lp_, outs_):
+            per_mb = jax.vmap(loss_fn, in_axes=(None, 0, 0))(lp_, outs_, lbls)
+            return jnp.mean(per_mb)
+
+        loss, pull = jax.vjp(loss_all, lp, outs)
+        g_lp, douts = pull(jnp.ones((), loss.dtype))
+
+        # ---- phase 3: mirrored backward over the reversed ring ----
+        def bwd_body(carry, u):
+            dstate, grad_acc, dmbs = carry
+            nmod = (u - (S - 1 - r)) % S
+            base = u - nmod
+            k = base % SV                       # backward step: chunk SV-1-k
+            g = base // SV
+            m = g * S + nmod
+            j = SV - 1 - k                      # j % S == r when valid
+            v = j // S
+            valid = jnp.logical_and(base >= 0, jnp.logical_and(m >= 0, m < M))
+            m_c = jnp.clip(m, 0, M - 1)
+            v_c = jnp.clip(v, 0, V - 1)
+
+            inject = jnp.logical_and(k == 0, valid)   # chunk SV-1 on rank S-1
+            dy_in = jnp.where(inject, jnp.take(douts, m_c, axis=0), dstate)
+
+            x_saved = inbuf[m_c, v_c]
+            p_t = jax.tree.map(lambda p: jnp.take(p, v_c, axis=0), pv)
+            _, vjp_pull = jax.vjp(lambda p_, x_: fn(p_, x_), p_t, x_saved)
+            dp, dx = vjp_pull(dy_in)
+
+            grad_acc = jax.tree.map(
+                lambda a, gg: a.at[v_c].add(
+                    jnp.where(valid, gg, jnp.zeros_like(gg))),
+                grad_acc, dp)
+
+            # chunk 0 (rank 0) emits the embedding cotangent of microbatch m
+            write_dm = jnp.logical_and(
+                valid, jnp.logical_and(k == SV - 1, r == 0))
+            cur_dm = jnp.take(dmbs, m_c, axis=0)
+            dmbs = jax.lax.dynamic_update_index_in_dim(
+                dmbs, jnp.where(write_dm, dx.astype(dmbs.dtype), cur_dm),
+                m_c, 0)
+
+            dstate = jax.lax.ppermute(
+                jnp.where(valid, dx, jnp.zeros_like(dx)), pp_axis, ring_rev)
+            return (dstate, grad_acc, dmbs), None
+
+        carry0b = (zeros_mb, jax.tree.map(jnp.zeros_like, pv),
+                   jnp.zeros_like(mbs))
+        (_, grad_acc, dmbs), _ = jax.lax.scan(bwd_body, carry0b, jnp.arange(T))
+
+        dmbs = jax.lax.psum(
+            jnp.where(r == 0, dmbs, jnp.zeros_like(dmbs)), pp_axis)
+        grads_stacked = jax.tree.map(lambda g_: g_[:, None], grad_acc)
+        return loss, grads_stacked, g_lp, dmbs
+
+    in_specs = (jax.tree.map(lambda _: P(None, pp_axis), stacked_params),
+                jax.tree.map(lambda _: P(), loss_params), P(), P())
+    out_specs = (P(), jax.tree.map(lambda _: P(None, pp_axis), stacked_params),
+                 jax.tree.map(lambda _: P(), loss_params), P())
+    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs,
+                             out_specs=out_specs,
+                             axis_names=frozenset({pp_axis}), check_vma=False)
+    return shmapped(stacked_params, loss_params, microbatches, labels)
 
 
 def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp"):

@@ -325,3 +325,85 @@ class TestSPLayers:
         ref = ref + np.asarray(dist.unshard_dtensor(col.bias).numpy())
         ref = ref @ wr + np.asarray(row.bias.numpy())
         np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestPipelineVPPTrain:
+    """Explicit interleaved-VPP training schedule (reference
+    PipelineParallelWithInterleaveFthenB, pipeline_parallel.py:2256)."""
+
+    def _mesh(self, S=4):
+        import paddle_tpu.distributed as dist
+        return dist.ProcessMesh(np.arange(S), ["pp"])
+
+    def test_vpp_matches_dense_autodiff(self):
+        from paddle_tpu.parallel.pipeline_parallel import pipeline_train_vpp
+        S, V, M, B, D = 4, 2, 8, 2, 8
+        pp_mesh = self._mesh(S)
+        rng = np.random.RandomState(5)
+        chunk_params = [{"w": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+                        for _ in range(S * V)]
+        # stacked [V, S, ...]: chunk j = v*S + r
+        stacked = {"w": jnp.stack(
+            [jnp.stack([chunk_params[v * S + s]["w"] for s in range(S)])
+             for v in range(V)])}
+        lp = {"head": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+        mbs = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+        lbls = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+
+        def stage_fn(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        def loss_fn(lp_, y, lbl):
+            return jnp.mean((y @ lp_["head"] - lbl) ** 2)
+
+        loss, g_stack, g_lp, g_mbs = pipeline_train_vpp(
+            stage_fn, loss_fn, stacked, lp, mbs, lbls, pp_mesh)
+
+        def ref(plist, lp_, mbs_):
+            x = mbs_
+            for p in plist:
+                x = jnp.tanh(x @ p["w"])
+            return jnp.mean((x @ lp_["head"] - lbls) ** 2)
+
+        ref_loss, (gr_p, gr_lp, gr_mbs) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(chunk_params, lp, mbs)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for v in range(V):
+            for s in range(S):
+                np.testing.assert_allclose(
+                    np.asarray(g_stack["w"][v, s]),
+                    np.asarray(gr_p[v * S + s]["w"]), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_lp["head"]),
+                                   np.asarray(gr_lp["head"]), rtol=1e-3,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_mbs), np.asarray(gr_mbs),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_vpp_v1_matches_1f1b_loss(self):
+        # V=1 degenerates to the plain pipeline: same loss as 1F1B
+        from paddle_tpu.parallel.pipeline_parallel import (
+            pipeline_train_1f1b, pipeline_train_vpp, stack_stage_params)
+        S, M, B, D = 4, 8, 2, 8
+        pp_mesh = self._mesh(S)
+        rng = np.random.RandomState(7)
+        stage_params = [{"w": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+                        for _ in range(S)]
+        stacked1 = stack_stage_params(stage_params, pp_mesh)
+        stackedv = {"w": stacked1["w"][None]}
+        lp = {"head": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+        mbs = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+        lbls = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+
+        def stage_fn(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        def loss_fn(lp_, y, lbl):
+            return jnp.mean((y @ lp_["head"] - lbl) ** 2)
+
+        l1, g1, glp1, gm1 = pipeline_train_1f1b(
+            stage_fn, loss_fn, stacked1, lp, mbs, lbls, pp_mesh)
+        lv, gv, glpv, gmv = pipeline_train_vpp(
+            stage_fn, loss_fn, stackedv, lp, mbs, lbls, pp_mesh)
+        np.testing.assert_allclose(float(l1), float(lv), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1["w"]),
+                                   np.asarray(gv["w"][0]), rtol=1e-4, atol=1e-6)
