@@ -140,7 +140,8 @@ def main():
         l_s = f"{l:.5f}" if l is not None else "—"
         print(f"{n:<17}{str(ft):<11}{str(tt):<16}{bub:<9.3f}{mem:<42}"
               f"{ms_s:<9}{l_s:<9}")
-    assert abs(l_g - l_1) < 1e-5 and abs(l_g - l_v) < 1e-5, "schedules diverge"
+    np.testing.assert_allclose([l_1, l_v], [l_g, l_g], rtol=1e-5,
+                               err_msg="schedules diverge")
     print("\nall schedules produce identical losses ✓")
 
 

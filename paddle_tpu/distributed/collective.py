@@ -182,6 +182,12 @@ def _run_spmd(fn, t: Tensor, group: Group, out_sharded_dim=None, in_sharded_dim=
             local_np = _np.asarray(val)
             val = _from_local_shards(local_np, mesh, in_spec, local_np.shape)
         else:
+            if isinstance(val, jax.Array) and len(val.sharding.device_set) == 1:
+                # single-device -> mesh: jax's direct reshard path can trip
+                # on device-order metadata; hop through the host (tiny eager
+                # tensors only — compiled paths never take this branch)
+                import numpy as _np
+                val = _np.asarray(val)
             val = jax.device_put(val, sh)
     out = shard_map_compat(fn, jm, (in_spec,), out_spec)(val)
     res = Tensor(out, stop_gradient=t.stop_gradient)

@@ -158,8 +158,10 @@ class Engine:
                     raise ValueError(
                         "pp_layer_counts (uneven stages) is not supported "
                         "with pp_schedule='vpp': chunks must be equal-sized")
-                V = max(int(st.pp_num_chunks), 1)
-                st.pp_num_chunks = V  # clamped once; all paths read this
+                V = int(st.pp_num_chunks)
+                if V < 1:
+                    raise ValueError(
+                        f"pp_num_chunks must be >= 1 for vpp, got {V}")
                 if nlayers % (S * V) != 0:
                     raise ValueError(
                         f"vpp needs layers % (pp*chunks) == 0: "

@@ -48,7 +48,7 @@ from ..distributed.process_mesh import ProcessMesh, get_mesh
 from ..nn.layer.layers import Layer
 
 __all__ = ["pipeline_apply", "pipeline_train_1f1b", "pipeline_apply_interleaved",
-           "stack_stage_params", "PipelineParallel"]
+           "pipeline_train_vpp", "stack_stage_params", "PipelineParallel"]
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: ProcessMesh,

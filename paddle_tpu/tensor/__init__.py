@@ -8,19 +8,22 @@ from __future__ import annotations
 from ..core.tensor import Tensor, Parameter, to_tensor
 from ..core.tensor import _OPS_CACHE
 
-from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from . import (creation, einsum as _einsum_mod, linalg, logic, manipulation,
+               math, ops_ext, random, search, stat)
 
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
+from .ops_ext import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 
-_MODULES = (creation, linalg, logic, manipulation, math, random, search, stat, _einsum_mod)
+_MODULES = (creation, linalg, logic, manipulation, math, ops_ext, random,
+            search, stat, _einsum_mod)
 
 
 def _collect_ops():
@@ -34,6 +37,120 @@ def _collect_ops():
 
 
 _collect_ops()
+
+
+def _collect_extra_ops():
+    """Register the op surfaces that live outside paddle_tpu.tensor — the
+    reference exposes ALL of these as _C_ops entries (nn.functional wrappers,
+    collective c_* ops, fft kernels, fused attention), so the op table must
+    too."""
+    from ..nn import functional as F
+    for name in dir(F):
+        fn = getattr(F, name)
+        if callable(fn) and not name.startswith("_") \
+                and getattr(fn, "__module__", "").startswith("paddle_tpu"):
+            _OPS_CACHE.setdefault(name, fn)
+
+    from .. import fft as _fft
+    for name in ("fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn",
+                 "ifftn", "rfft2", "irfft2", "hfft", "ihfft"):
+        if hasattr(_fft, name):
+            _OPS_CACHE.setdefault(name, getattr(_fft, name))
+    # kernel-level fft entries (reference fft_c2c / fft_c2r / fft_r2c)
+    if hasattr(_fft, "fft"):
+        _OPS_CACHE.setdefault("fft_c2c", _fft.fft)
+        _OPS_CACHE.setdefault("fft_c2r", _fft.irfft)
+        _OPS_CACHE.setdefault("fft_r2c", _fft.rfft)
+
+    from ..ops.flash_attention import flash_attention
+    _OPS_CACHE.setdefault("flash_attn", flash_attention)
+    _OPS_CACHE.setdefault("memory_efficient_attention", flash_attention)
+
+    # collective ops (reference fluid/operators/collective c_* + phi
+    # all_gather/all_to_all/reduce_scatter kernels). The KERNEL-style ops
+    # take the INPUT tensor first and RETURN the result — the python
+    # ProcessGroup API (C.all_gather etc.) is output-parameter-first, so
+    # these are input-first shims, not direct aliases.
+    import jax
+    from ..distributed import collective as C
+    from ..distributed.collective import ReduceOp
+
+    def _k_all_gather(x, group=None, nranks=None, axis=0, **k):
+        outs = []
+        C.all_gather(outs, x, group=group)
+        return manipulation.concat(outs, axis=axis)
+
+    def _k_c_concat(x, group=None, nranks=None, **k):
+        outs = []
+        C.all_gather(outs, x, group=group)
+        return manipulation.concat(outs, axis=-1)
+
+    def _k_all_to_all(x, group=None, **k):
+        out = Tensor(jax.numpy.zeros_like(x._value))
+        C.all_to_all_single(out, x, group=group)
+        return out
+
+    def _k_reduce_scatter(x, group=None, op=None, **k):
+        return C.reduce_scatter(None, x,
+                                op=op if op is not None else ReduceOp.SUM,
+                                group=group)
+
+    def _k_c_scatter(x, src=0, group=None, nranks=None, **k):
+        parts = manipulation.split(
+            x, nranks or (group.nranks if group is not None else
+                          C._world_group().nranks), axis=0)
+        out = Tensor(jax.numpy.zeros_like(parts[0]._value))
+        C.scatter(out, parts, src=src, group=group)
+        return out
+
+    def _k_allreduce(op):
+        def fn(t, group=None, **k):
+            C.all_reduce(t, op=op, group=group)
+            return t
+        return fn
+
+    def _k_c_reduce_sum(t, ring_id=0, root_id=0, group=None, **k):
+        C.reduce(t, dst=root_id, op=ReduceOp.SUM, group=group)
+        return t
+
+    _OPS_CACHE.setdefault("all_gather", _k_all_gather)
+    _OPS_CACHE.setdefault("all_to_all", _k_all_to_all)
+    _OPS_CACHE.setdefault("reduce_scatter", _k_reduce_scatter)
+    _OPS_CACHE.setdefault("c_broadcast", C.broadcast)
+    _OPS_CACHE.setdefault("c_allgather", _k_all_gather)
+    _OPS_CACHE.setdefault("c_scatter", _k_c_scatter)
+    _OPS_CACHE.setdefault("c_identity", lambda x, *a, **k: x)
+    _OPS_CACHE.setdefault("c_concat", _k_c_concat)
+    _OPS_CACHE.setdefault("c_allreduce_sum", _k_allreduce(ReduceOp.SUM))
+    _OPS_CACHE.setdefault("c_allreduce_max", _k_allreduce(ReduceOp.MAX))
+    _OPS_CACHE.setdefault("c_allreduce_min", _k_allreduce(ReduceOp.MIN))
+    _OPS_CACHE.setdefault("c_allreduce_prod", _k_allreduce(ReduceOp.PROD))
+    _OPS_CACHE.setdefault("c_reduce_sum", _k_c_reduce_sum)
+    _OPS_CACHE.setdefault("c_sync_calc_stream", lambda x=None, *a, **k: x)
+    _OPS_CACHE.setdefault("c_sync_comm_stream", lambda x=None, *a, **k: x)
+    _OPS_CACHE.setdefault("sync_calc_stream", lambda x=None, *a, **k: x)
+
+    from .. import geometric as G
+
+    def _segment_pool(x, segment_ids, pooltype="SUM", **k):
+        fn = {"SUM": G.segment_sum, "MEAN": G.segment_mean,
+              "MAX": G.segment_max, "MIN": G.segment_min}[str(pooltype).upper()]
+        return fn(x, segment_ids)
+
+    _OPS_CACHE.setdefault("segment_pool", _segment_pool)
+    _OPS_CACHE.setdefault("send_u_recv", G.send_u_recv)
+    _OPS_CACHE.setdefault("send_ue_recv", G.send_ue_recv)
+    _OPS_CACHE.setdefault("send_uv", G.send_uv)
+    _OPS_CACHE.setdefault("reindex_graph", G.reindex_graph)
+    _OPS_CACHE.setdefault("graph_sample_neighbors", G.sample_neighbors)
+
+    from .. import signal as _sig
+    _OPS_CACHE.setdefault("stft", _sig.stft)
+    if hasattr(_sig, "istft"):
+        _OPS_CACHE.setdefault("istft", _sig.istft)
+
+
+_collect_extra_ops()
 
 
 # ---- monkey-patch Tensor methods (reference: tensor/__init__.py tensor_method_func) ----

@@ -1,0 +1,363 @@
+"""Numpy-reference tests for the op-surface extension (OpTest pattern,
+reference test/legacy_test/op_test.py:418 — op output vs numpy reference;
+grads via the engine where the op is differentiable)."""
+import numpy as np
+import pytest
+from scipy import special as sps
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import _ops
+from paddle_tpu.tensor import ops_ext as X
+
+
+def T(a):
+    return pt.to_tensor(np.asarray(a))
+
+
+RNG = np.random.RandomState(0)
+POS = RNG.rand(3, 4).astype(np.float32) + 0.1
+ANY = RNG.randn(3, 4).astype(np.float32)
+UNIT = RNG.rand(3, 4).astype(np.float32) * 0.8 + 0.1
+
+
+# (op, inputs, numpy reference) — OpTest table
+CASES = [
+    ("copysign", (ANY, -POS), lambda a, b: np.copysign(a, b)),
+    ("gammaln", (POS * 3,), lambda a: sps.gammaln(a)),
+    ("gammaincc", (POS * 2, POS), lambda a, b: sps.gammaincc(a, b)),
+    ("i0", (ANY,), lambda a: sps.i0(a)),
+    ("i0e", (ANY,), lambda a: sps.i0e(a)),
+    ("i1", (ANY,), lambda a: sps.i1(a)),
+    ("i1e", (ANY,), lambda a: sps.i1e(a)),
+    ("logit", (UNIT,), lambda a: np.log(a / (1 - a))),
+    ("logsigmoid", (ANY,), lambda a: -np.log1p(np.exp(-a)) - np.maximum(-a, 0)
+     + np.maximum(-a, 0)),
+    ("mean_all", (ANY,), lambda a: np.mean(a)),
+    ("l1_norm", (ANY,), lambda a: np.sum(np.abs(a))),
+    ("squared_l2_norm", (ANY,), lambda a: np.sum(a.astype(np.float32) ** 2).reshape(1)),
+    ("tanh_shrink", (ANY,), lambda a: a - np.tanh(a)),
+    ("bce_loss", (UNIT, (UNIT > 0.5).astype(np.float32)),
+     lambda a, y: -(y * np.log(a) + (1 - y) * np.log(1 - a))),
+    ("huber_loss", (ANY, ANY * 0.5),
+     lambda a, y: np.where(np.abs(a - y) <= 1.0, 0.5 * (a - y) ** 2,
+                           np.abs(a - y) - 0.5)),
+    ("hinge_loss", (ANY, (ANY > 0).astype(np.float32)),
+     lambda a, y: np.maximum(0, 1 - (2 * y - 1) * a)),
+    ("log_loss", (UNIT, (UNIT > 0.5).astype(np.float32)),
+     lambda a, y: -y * np.log(a + 1e-4) - (1 - y) * np.log(1 - a + 1e-4)),
+    ("sigmoid_cross_entropy_with_logits", (ANY, (ANY > 0).astype(np.float32)),
+     lambda a, y: np.maximum(a, 0) - a * y + np.log1p(np.exp(-np.abs(a)))),
+    ("reverse", (ANY, 1), lambda a, ax: np.flip(a, 1)),
+    ("mean_all", (POS,), lambda a: np.mean(a)),
+]
+
+
+@pytest.mark.parametrize("name,inputs,ref", CASES,
+                         ids=[f"{c[0]}_{i}" for i, c in enumerate(CASES)])
+def test_op_matches_numpy(name, inputs, ref):
+    fn = _ops()[name]
+    args = [T(a) if isinstance(a, np.ndarray) else a for a in inputs]
+    out = fn(*args)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               ref(*inputs).astype(np.float32),
+                               rtol=2e-5, atol=2e-6)
+
+
+class TestNorms:
+    def test_p_norm_and_frobenius(self):
+        a = ANY
+        np.testing.assert_allclose(
+            float(X.p_norm(T(a), porder=3.0, axis=1).numpy()[0]),
+            np.sum(np.abs(a) ** 3, axis=1)[0] ** (1 / 3), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(X.frobenius_norm(T(a)).numpy()),
+            np.sqrt(np.sum(a * a)), rtol=1e-5)
+
+    def test_renorm(self):
+        a = ANY
+        out = np.asarray(X.renorm(T(a), p=2.0, axis=0, max_norm=1.0).numpy())
+        for i in range(a.shape[0]):
+            assert np.linalg.norm(out[i]) <= 1.0 + 1e-5
+
+    def test_clip_by_norm(self):
+        a = ANY * 10
+        out = np.asarray(X.clip_by_norm(T(a), 1.0).numpy())
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+
+    def test_logcumsumexp(self):
+        a = ANY
+        ref = np.log(np.cumsum(np.exp(a), axis=1))
+        np.testing.assert_allclose(
+            np.asarray(X.logcumsumexp(T(a), axis=1).numpy()), ref, rtol=1e-5)
+
+
+class TestManipulationExt:
+    def test_unstack_reverse_roundtrip(self):
+        a = RNG.randn(4, 3).astype(np.float32)
+        parts = X.unstack(T(a), axis=0)
+        assert len(parts) == 4
+        np.testing.assert_allclose(np.asarray(parts[2].numpy()), a[2])
+
+    def test_as_strided(self):
+        a = np.arange(12, dtype=np.float32)
+        out = X.as_strided(T(a), [3, 4], [4, 1])
+        np.testing.assert_allclose(np.asarray(out.numpy()), a.reshape(3, 4))
+        # overlapping windows
+        out2 = X.as_strided(T(a), [5, 4], [2, 1])
+        ref = np.lib.stride_tricks.as_strided(a, (5, 4), (8, 4))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), ref)
+
+    def test_tensor_unfold(self):
+        a = np.arange(10, dtype=np.float32)
+        out = np.asarray(X.tensor_unfold(T(a), 0, 4, 2).numpy())
+        assert out.shape == (4, 4)
+        np.testing.assert_allclose(out[1], a[2:6])
+
+    def test_fold_unfold_inverse_ones(self):
+        # fold(unfold(x)) == x * counting for stride=kernel (no overlap)
+        from paddle_tpu.nn import functional as F
+        x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+        cols = F.unfold(T(x), kernel_sizes=2, strides=2)
+        back = X.fold(cols, output_sizes=(4, 4), kernel_sizes=2, strides=2)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-5)
+
+    def test_frame_overlap_add(self):
+        a = np.arange(16, dtype=np.float32)
+        fr = X.frame(T(a), frame_length=4, hop_length=4)
+        back = X.overlap_add(fr, hop_length=4)
+        np.testing.assert_allclose(np.asarray(back.numpy()), a)
+
+    def test_pixel_unshuffle_inverts_shuffle(self):
+        from paddle_tpu.nn import functional as F
+        x = RNG.randn(1, 8, 4, 4).astype(np.float32)
+        up = F.pixel_shuffle(T(x), 2)
+        back = X.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x)
+
+    def test_shuffle_channel(self):
+        x = np.arange(2 * 6 * 1 * 1, dtype=np.float32).reshape(2, 6, 1, 1)
+        out = np.asarray(X.shuffle_channel(T(x), 2).numpy())
+        ref = x.reshape(2, 2, 3, 1, 1).transpose(0, 2, 1, 3, 4).reshape(2, 6, 1, 1)
+        np.testing.assert_allclose(out, ref)
+
+    def test_sequence_mask_and_pool(self):
+        l = np.array([2, 4, 1], np.int32)
+        m = np.asarray(X.sequence_mask(T(l), maxlen=5).numpy())
+        assert m.shape == (3, 5) and m[0].sum() == 2 and m[1].sum() == 4
+        x = RNG.randn(3, 5, 2).astype(np.float32)
+        s = np.asarray(X.sequence_pool(T(x), T(l), "sum").numpy())
+        np.testing.assert_allclose(s[0], x[0, :2].sum(0), rtol=1e-5)
+
+    def test_fill_diagonal(self):
+        a = np.zeros((4, 4), np.float32)
+        out = np.asarray(X.fill_diagonal(T(a), 7.0).numpy())
+        np.testing.assert_allclose(np.diag(out), 7.0)
+
+
+class TestVisionOps:
+    def test_grid_sample_identity(self):
+        x = RNG.randn(1, 2, 5, 5).astype(np.float32)
+        ys, xs = np.linspace(-1, 1, 5), np.linspace(-1, 1, 5)
+        gx, gy = np.meshgrid(xs, ys)
+        grid = np.stack([gx, gy], -1)[None].astype(np.float32)
+        out = np.asarray(X.grid_sample(T(x), T(grid)).numpy())
+        np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+    def test_affine_grid_identity(self):
+        theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+        g = np.asarray(X.affine_grid(T(theta), (1, 1, 3, 3)).numpy())
+        np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+
+    def test_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10], [20, 20, 30, 30]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        kept = np.asarray(X.nms(T(boxes), 0.5, T(scores)).numpy())
+        assert list(kept) == [0, 2]
+
+    def test_pool2d_op(self):
+        x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+        out = np.asarray(X.pool2d(T(x), 2, pooling_type="avg").numpy())
+        ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_interp_ops(self):
+        x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+        out = np.asarray(X.nearest_interp(T(x), out_size=(8, 8)).numpy())
+        assert out.shape == (1, 2, 8, 8)
+        np.testing.assert_allclose(out[..., ::2, ::2], x)
+
+
+class TestOptimizerOps:
+    def test_sgd_(self):
+        p = T(np.ones(4, np.float32))
+        X.sgd_(p, T(np.float32(0.1)), T(np.full(4, 2.0, np.float32)))
+        np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+
+    def test_momentum_(self):
+        p = T(np.ones(4, np.float32))
+        v = T(np.zeros(4, np.float32))
+        X.momentum_(p, T(np.full(4, 1.0, np.float32)), v,
+                    T(np.float32(0.1)), mu=0.9)
+        np.testing.assert_allclose(p.numpy(), 0.9, rtol=1e-6)
+        np.testing.assert_allclose(v.numpy(), 1.0, rtol=1e-6)
+
+    def test_adam_matches_optimizer(self):
+        g = np.full(4, 0.5, np.float32)
+        p = T(np.ones(4, np.float32))
+        m = T(np.zeros(4, np.float32))
+        v = T(np.zeros(4, np.float32))
+        X.adam_(p, T(g), m, v, T(np.float32(0.01)), step=1)
+        # bias-corrected first step: update = lr * g/|g| (mhat/sqrt(vhat))
+        np.testing.assert_allclose(p.numpy(), 1 - 0.01 * 0.5 / (0.5 + 1e-8),
+                                   rtol=1e-4)
+
+    def test_adamw_decoupled_decay(self):
+        p = T(np.ones(4, np.float32))
+        m = T(np.zeros(4, np.float32))
+        v = T(np.zeros(4, np.float32))
+        X.adamw_(p, T(np.zeros(4, np.float32)), m, v, T(np.float32(0.1)),
+                 weight_decay=0.5, step=1)
+        np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 0.5, rtol=1e-5)
+
+
+class TestAmpOps:
+    def test_check_finite_and_unscale(self):
+        g = T(np.array([2.0, 4.0], np.float32))
+        outs, found = X.check_finite_and_unscale_([g], T(np.float32(2.0)))
+        np.testing.assert_allclose(g.numpy(), [1.0, 2.0])
+        assert not bool(found.numpy())
+        g2 = T(np.array([np.inf, 1.0], np.float32))
+        _, found2 = X.check_finite_and_unscale_([g2], T(np.float32(1.0)))
+        assert bool(found2.numpy())
+
+    def test_update_loss_scaling(self):
+        s = T(np.float32(8.0))
+        steps = T(np.int32(0))
+        X.update_loss_scaling_(s, T(np.bool_(True)), steps)
+        np.testing.assert_allclose(s.numpy(), 4.0)
+        X.update_loss_scaling_(s, T(np.bool_(False)), steps,
+                               incr_every_n_steps=1)
+        np.testing.assert_allclose(s.numpy(), 8.0)
+
+
+class TestQuantOps:
+    def test_fake_quant_roundtrip(self):
+        a = RNG.randn(4, 4).astype(np.float32)
+        out = X.fake_quantize_dequantize_abs_max(T(a))
+        q, s = out
+        err = np.abs(np.asarray(q.numpy()) - a).max()
+        assert err <= np.abs(a).max() / 127 + 1e-6
+
+    def test_weight_quantize_dequantize(self):
+        w = RNG.randn(8, 4).astype(np.float32)
+        q, s = X.weight_quantize(T(w))
+        back = np.asarray(X.weight_dequantize(q, s).numpy())
+        np.testing.assert_allclose(back, w, atol=np.abs(w).max() / 100)
+
+    def test_weight_only_linear(self):
+        x = RNG.randn(2, 8).astype(np.float32)
+        w = RNG.randn(8, 4).astype(np.float32)
+        q, s = X.weight_quantize(T(w))
+        out = np.asarray(X.weight_only_linear(T(x), q, weight_scale=s).numpy())
+        np.testing.assert_allclose(out, x @ w, atol=0.2)
+
+
+class TestMoeOps:
+    def test_number_count(self):
+        idx = T(np.array([0, 1, 1, 3], np.int32))
+        out = np.asarray(X.number_count(idx, 4).numpy())
+        np.testing.assert_allclose(out, [1, 2, 0, 1])
+
+    def test_prune_gate_by_capacity(self):
+        gate = T(np.array([0, 0, 0, 1], np.int32))
+        cap = T(np.array([2, 2], np.int32))
+        out = np.asarray(X.prune_gate_by_capacity(gate, cap, n_expert=2).numpy())
+        np.testing.assert_allclose(out, [0, 0, -1, 1])
+
+    def test_limit_by_capacity(self):
+        ec = T(np.array([5, 1], np.int32))
+        cap = T(np.array([3, 3], np.int32))
+        out = np.asarray(X.limit_by_capacity(ec, cap).numpy())
+        np.testing.assert_allclose(out, [3, 1])
+
+
+class TestDecodeOps:
+    def test_edit_distance(self):
+        h = T(np.array([[1, 2, 3]], np.int64))
+        r = T(np.array([[1, 3, 3]], np.int64))
+        d, n = X.edit_distance(h, r, normalized=False)
+        np.testing.assert_allclose(d.numpy(), [[1.0]])
+
+    def test_viterbi_decode_greedy_case(self):
+        # diagonal-dominant transitions: best path = argmax per step
+        emit = np.zeros((1, 3, 2), np.float32)
+        emit[0, :, 1] = 5.0
+        trans = np.zeros((4, 4), np.float32)
+        score, path = X.viterbi_decode(T(emit), T(trans))
+        np.testing.assert_allclose(np.asarray(path.numpy())[0], [1, 1, 1])
+
+    def test_top_p_sampling(self):
+        logits = np.array([[10.0, -10.0, -10.0]], np.float32)
+        scores, ids = X.top_p_sampling(T(logits), T(np.array([0.9], np.float32)))
+        assert int(np.asarray(ids.numpy())[0, 0]) == 0
+
+    def test_gather_tree(self):
+        ids = np.array([[[1, 2]], [[3, 4]]], np.int32)       # [T=2, B=1, W=2]
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int32)
+        out = np.asarray(X.gather_tree(T(ids), T(parents)).numpy())
+        # beam 0 at t=1 came from parent 1 -> its t=0 token is 2
+        assert out[0, 0, 0] == 2 and out[1, 0, 0] == 3
+
+
+class TestMetricsOps:
+    def test_accuracy(self):
+        x = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        y = np.array([[1], [1]], np.int64)
+        acc = float(np.asarray(X.accuracy(T(x), T(y)).numpy())[0])
+        assert abs(acc - 0.5) < 1e-6
+
+    def test_auc_perfect(self):
+        x = np.array([[0.1, 0.9], [0.9, 0.1], [0.2, 0.8], [0.7, 0.3]], np.float32)
+        y = np.array([1, 0, 1, 0], np.int64)
+        auc = float(np.asarray(X.auc(T(x), T(y)).numpy())[0])
+        assert auc > 0.99
+
+
+class TestGradFlow:
+    def test_huber_grad(self):
+        a = T(ANY)
+        a.stop_gradient = False
+        loss = X.huber_loss(a, T(ANY * 0.0)).sum()
+        loss.backward()
+        g = np.asarray(a.grad.numpy())
+        ref = np.clip(ANY, -1, 1)
+        np.testing.assert_allclose(g, ref, rtol=1e-5)
+
+    def test_swiglu_grad(self):
+        a = T(ANY)
+        a.stop_gradient = False
+        X.swiglu(a).sum().backward()
+        assert a.grad is not None and np.isfinite(np.asarray(a.grad.numpy())).all()
+
+    def test_fake_quant_ste_grad(self):
+        a = T(ANY)
+        a.stop_gradient = False
+        q, s = X.fake_quantize_dequantize_abs_max(a)
+        q.sum().backward()
+        np.testing.assert_allclose(np.asarray(a.grad.numpy()),
+                                   np.ones_like(ANY), rtol=1e-6)
+
+
+def test_registry_past_400():
+    ops = _ops()
+    assert len(ops) >= 400, len(ops)
+    # spot-check key families resolve through _C_ops too
+    import paddle_tpu._C_ops as C
+    for name in ("adamw_", "grid_sample", "p_norm", "sequence_mask",
+                 "c_allreduce_sum", "flash_attn", "fft_c2c", "top_p_sampling"):
+        assert callable(getattr(C, name))
