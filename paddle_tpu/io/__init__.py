@@ -266,7 +266,8 @@ def default_collate_fn(batch):
 
 
 def get_worker_info():
-    return None
+    from .worker_pool import get_worker_info as _gwi
+    return _gwi()
 
 
 class DataLoader:
@@ -282,6 +283,9 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
@@ -325,7 +329,14 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._gen_batches()
             return
-        # background prefetch thread
+        if not self._iterable_ds and self.batch_sampler is not None:
+            # REAL worker processes (reference dataloader_iter.py:368):
+            # spawned numpy-only workers run __getitem__ + collate; the
+            # parent re-orders and does the device transfer
+            yield from self._iter_multiprocess()
+            return
+        # IterableDataset: background prefetch thread (stream can't be
+        # index-partitioned across processes without sharding the source)
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
         sentinel = object()
         err: list = []
@@ -348,3 +359,41 @@ class DataLoader:
                     raise err[0]
                 return
             yield item
+
+    def _iter_multiprocess(self):
+        from .worker_pool import WorkerPool, numpy_collate, passthrough_collate
+        user_collate = None if self.collate_fn is default_collate_fn \
+            else self.collate_fn
+        # custom collate runs in the PARENT (it may build device tensors);
+        # workers then only fetch+transform raw samples
+        worker_collate = passthrough_collate if user_collate else numpy_collate
+        pool = getattr(self, "_pool", None)
+        if pool is None or not pool.alive():
+            pool = WorkerPool(
+                self.dataset, self.num_workers, collate_fn=worker_collate,
+                worker_init_fn=self.worker_init_fn,
+                base_seed=np.random.randint(0, 2 ** 31 - 1))
+            if self.persistent_workers:
+                self._pool = pool
+        try:
+            for data in pool.run_epoch(list(self.batch_sampler),
+                                       prefetch=self.prefetch_factor,
+                                       timeout=self.timeout or 0):
+                yield user_collate(data) if user_collate else _tensorize(data)
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+
+def _tensorize(tree):
+    """numpy batch tree (from workers) → Tensor tree (parent-side device
+    transfer), mirroring default_collate_fn's output types."""
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    if isinstance(tree, tuple):
+        return tuple(_tensorize(t) for t in tree)
+    if isinstance(tree, list):
+        return [_tensorize(t) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _tensorize(v) for k, v in tree.items()}
+    return tree

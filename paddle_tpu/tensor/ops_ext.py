@@ -128,7 +128,8 @@ def logcumsumexp(x, axis=None, name=None):
 @_export
 def renorm(x, p, axis, max_norm, name=None):
     def f(a):
-        dims = tuple(d for d in range(a.ndim) if d != axis)
+        ax = axis % a.ndim
+        dims = tuple(d for d in range(a.ndim) if d != ax)
         norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
         factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
         return a * factor
@@ -820,9 +821,16 @@ def pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
         ax = (2, 3) if data_format == "NCHW" else (1, 2)
         red = jnp.max if pooling_type == "max" else jnp.mean
         return apply(lambda a: red(a, axis=ax, keepdims=True), x, name="pool2d")
-    fn = F.max_pool2d if pooling_type == "max" else F.avg_pool2d
-    return fn(x, kernel_size, stride=stride, padding=padding,
-              ceil_mode=ceil_mode)
+    if adaptive:
+        # kernel_size IS the output size in adaptive mode (reference pool2d)
+        fn = F.adaptive_max_pool2d if pooling_type == "max" \
+            else F.adaptive_avg_pool2d
+        return fn(x, kernel_size)
+    if pooling_type == "max":
+        return F.max_pool2d(x, kernel_size, stride=stride, padding=padding,
+                            ceil_mode=ceil_mode)
+    return F.avg_pool2d(x, kernel_size, stride=stride, padding=padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 @_export

@@ -7,7 +7,8 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["FakeData", "MNIST", "Cifar10"]
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "Flowers", "DatasetFolder", "ImageFolder"]
 
 
 class FakeData(Dataset):
@@ -77,3 +78,116 @@ class Cifar10(_ArrayDataset):
             x = rng.rand(n, 3, 32, 32).astype(np.float32)
             y = rng.randint(0, 10, n)
         super().__init__(x, y, transform)
+
+
+class FashionMNIST(MNIST):
+    """Same layout/loader as MNIST (reference datasets/mnist.py FashionMNIST)."""
+
+
+class Cifar100(_ArrayDataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False):
+        if data_file:
+            d = np.load(data_file)
+            x = d[f"x_{mode}"].astype(np.float32)
+            y = d[f"y_{mode}"]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 1024 if mode == "train" else 256
+            x = rng.rand(n, 3, 32, 32).astype(np.float32)
+            y = rng.randint(0, 100, n)
+        super().__init__(x, y, transform)
+
+
+class Flowers(_ArrayDataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False):
+        if data_file:
+            d = np.load(data_file)
+            x = d[f"x_{mode}"].astype(np.float32)
+            y = d[f"y_{mode}"]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 512 if mode == "train" else 128
+            x = rng.rand(n, 3, 64, 64).astype(np.float32)
+            y = rng.randint(0, 102, n)
+        super().__init__(x, y, transform)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp", ".npy")
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"), np.float32)
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image dataset (reference
+    datasets/folder.py DatasetFolder): root/<class_name>/<file>."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fn)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fn.lower().endswith(exts)
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no samples with extensions {exts} "
+                                    f"under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabeled flat image folder (reference datasets/folder.py ImageFolder:
+    yields images only)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fn.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return (img,)

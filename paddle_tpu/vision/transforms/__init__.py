@@ -8,7 +8,12 @@ import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "BrightnessTransform", "Pad"]
+           "Transpose", "BrightnessTransform", "Pad",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "RandomRotation", "RandomResizedCrop", "Grayscale",
+           "RandomErasing", "adjust_brightness", "adjust_contrast",
+           "adjust_hue", "to_grayscale", "resize", "hflip", "vflip",
+           "center_crop", "crop", "normalize", "rotate", "to_tensor"]
 
 
 class Compose:
@@ -51,6 +56,27 @@ class Normalize:
         return pt.to_tensor(a.astype(np.float32)) if hasattr(img, "numpy") else a
 
 
+def _np_resize_bilinear(a, out_h, out_w):
+    """Pure-numpy bilinear resize (align_corners=False, half-pixel centers)
+    — NO jax: transforms run inside spawned DataLoader workers which must
+    never touch the device runtime."""
+    h, w = a.shape[:2]
+    fy = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    fx = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(fy).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(fx).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(fy - y0, 0, 1)[:, None, None]
+    wx = np.clip(fx - x0, 0, 1)[None, :, None]
+    a3 = a if a.ndim == 3 else a[..., None]
+    out = (a3[y0][:, x0] * (1 - wy) * (1 - wx)
+           + a3[y0][:, x1] * (1 - wy) * wx
+           + a3[y1][:, x0] * wy * (1 - wx)
+           + a3[y1][:, x1] * wy * wx)
+    return out if a.ndim == 3 else out[..., 0]
+
+
 class Resize:
     def __init__(self, size, interpolation="bilinear"):
         self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
@@ -60,10 +86,7 @@ class Resize:
         chw = a.ndim == 3 and a.shape[0] in (1, 3)
         if chw:
             a = np.transpose(a, (1, 2, 0))
-        import jax
-        import jax.numpy as jnp
-        out = np.asarray(jax.image.resize(jnp.asarray(a), self.size + a.shape[2:],
-                                          method="bilinear"))
+        out = _np_resize_bilinear(a, *self.size).astype(np.float32)
         if chw:
             out = np.transpose(out, (2, 0, 1))
         return out
@@ -157,3 +180,260 @@ class Pad:
         if a.ndim == 3 and a.shape[0] in (1, 3):
             return np.pad(a, [(0, 0), (p, p), (p, p)])
         return np.pad(a, [(p, p), (p, p)] + [(0, 0)] * (a.ndim - 2))
+
+
+# ---------------- color / photometric transforms ----------------
+def _as_hwc(a):
+    """array -> (hwc array, was_chw flag)."""
+    a = np.asarray(a, np.float32)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3)
+    return (np.transpose(a, (1, 2, 0)) if chw else a), chw
+
+
+def _restore(a, chw):
+    return np.transpose(a, (2, 0, 1)) if chw else a
+
+
+def _scale_of(a):
+    return 255.0 if a.max() > 1.5 else 1.0
+
+
+def adjust_brightness(img, factor):
+    a, chw = _as_hwc(img)
+    return _restore(np.clip(a * factor, 0, _scale_of(a)), chw)
+
+
+def adjust_contrast(img, factor):
+    a, chw = _as_hwc(img)
+    mean = a.mean()
+    return _restore(np.clip(mean + factor * (a - mean), 0, _scale_of(a)), chw)
+
+
+def adjust_saturation(img, factor):
+    a, chw = _as_hwc(img)
+    if a.ndim == 2 or a.shape[-1] == 1:
+        return _restore(a, chw)
+    gray = (a[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
+    return _restore(np.clip(gray + factor * (a - gray), 0, _scale_of(a)), chw)
+
+
+def adjust_hue(img, factor):
+    """factor in [-0.5, 0.5] — rotate hue via HSV roundtrip (numpy)."""
+    a, chw = _as_hwc(img)
+    if a.ndim == 2 or a.shape[-1] == 1:
+        return _restore(a, chw)
+    scale = _scale_of(a)
+    x = a[..., :3] / scale
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    h = (h + factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6).astype(np.int32) % 6
+    f = h * 6 - np.floor(h * 6)
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    out = np.select(
+        [(i == k)[..., None] for k in range(6)],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return _restore(np.clip(out * scale, 0, scale).astype(np.float32), chw)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a, chw = _as_hwc(img)
+    if a.ndim == 3 and a.shape[-1] == 3:
+        g = (a @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
+    else:
+        g = a if a.ndim == 3 else a[..., None]
+    g = np.repeat(g, num_output_channels, axis=-1)
+    return _restore(g, chw)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        return adjust_saturation(img, 1.0 + np.random.uniform(-self.value, self.value))
+
+
+class HueTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def __call__(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+_INTERP_ORDER = {"nearest": 0, "bilinear": 1, "bicubic": 3}
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) \
+            else tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        if center is not None:
+            raise NotImplementedError(
+                "RandomRotation(center=...) is not supported: rotation is "
+                "about the image center")
+        self.fill = fill
+
+    def __call__(self, img):
+        from scipy import ndimage
+        a, chw = _as_hwc(img)
+        angle = np.random.uniform(*self.degrees)
+        out = ndimage.rotate(a, angle, axes=(0, 1), reshape=self.expand,
+                             order=_INTERP_ORDER[self.interpolation],
+                             mode="constant", cval=self.fill)
+        return _restore(out.astype(np.float32), chw)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        a, chw = _as_hwc(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop_ = a[i:i + ch, j:j + cw]
+                break
+        else:
+            m = min(h, w)
+            i, j = (h - m) // 2, (w - m) // 2
+            crop_ = a[i:i + m, j:j + m]
+        out = _np_resize_bilinear(crop_, *self.size).astype(np.float32)
+        return _restore(out, chw)
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return np.asarray(img)
+        a, chw = _as_hwc(img)
+        a = a.copy()
+        h, w = a.shape[:2]
+        for _ in range(10):
+            target = h * w * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                a[i:i + eh, j:j + ew] = self.value
+                break
+        return _restore(a, chw)
+
+
+# ---------------- functional aliases (reference transforms.functional) ----
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    a = np.asarray(img)
+    return a[..., ::-1].copy() if a.ndim == 3 and a.shape[0] in (1, 3) \
+        else a[:, ::-1].copy()
+
+
+def vflip(img):
+    a = np.asarray(img)
+    return a[:, ::-1].copy() if a.ndim == 3 and a.shape[0] in (1, 3) \
+        else a[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    a = np.asarray(img)
+    if a.ndim == 3 and a.shape[0] in (1, 3):
+        return a[:, top:top + height, left:left + width]
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, size):
+    return CenterCrop(size)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from scipy import ndimage
+    if center is not None:
+        raise NotImplementedError("rotate(center=...) is not supported: "
+                                  "rotation is about the image center")
+    a, chw = _as_hwc(img)
+    out = ndimage.rotate(a, angle, axes=(0, 1), reshape=expand,
+                         order=_INTERP_ORDER[interpolation],
+                         mode="constant", cval=fill)
+    return _restore(out.astype(np.float32), chw)

@@ -1,0 +1,238 @@
+"""Vision/data pipeline depth (VERDICT r1 #10): process-pool DataLoader
+workers (reference io/dataloader/dataloader_iter.py:368), real transforms,
+file datasets, and end-to-end vision training through the Engine."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import (Cifar10, DatasetFolder, FakeData,
+                                        ImageFolder, MNIST)
+
+
+class SquareDataset(Dataset):
+    """Top-level (picklable for spawned workers)."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((2, 2), float(i), np.float32), np.int64(i % 4)
+
+
+def _worker_probe(worker_id):
+    from paddle_tpu.io import get_worker_info
+    info = get_worker_info()
+    assert info is not None and info.id == worker_id
+    assert info.num_workers >= 1
+
+
+class TestMultiprocessDataLoader:
+    def test_matches_single_process(self):
+        ds = SquareDataset(32)
+        single = [(np.asarray(x.numpy()), np.asarray(y.numpy()))
+                  for x, y in DataLoader(ds, batch_size=4, shuffle=False)]
+        multi = [(np.asarray(x.numpy()), np.asarray(y.numpy()))
+                 for x, y in DataLoader(ds, batch_size=4, shuffle=False,
+                                        num_workers=2)]
+        assert len(single) == len(multi) == 8
+        for (xs, ys), (xm, ym) in zip(single, multi):
+            np.testing.assert_allclose(xs, xm)
+            np.testing.assert_allclose(ys, ym)
+
+    def test_worker_info_and_init_fn(self):
+        ds = SquareDataset(8)
+        loader = DataLoader(ds, batch_size=2, num_workers=2,
+                            worker_init_fn=_worker_probe)
+        batches = list(loader)
+        assert len(batches) == 4
+        # parent has no worker info
+        from paddle_tpu.io import get_worker_info
+        assert get_worker_info() is None
+
+    def test_worker_error_propagates(self):
+        class Bad(SquareDataset):
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom at 5")
+                return super().__getitem__(i)
+
+        # spawned workers need a picklable class: define via __main__-safe
+        # top-level? Bad is local; spawn pickles by reference -> use the
+        # dataset below instead
+        loader = DataLoader(FailingDataset(), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="worker failed"):
+            list(loader)
+
+    def test_shuffle_covers_all(self):
+        ds = SquareDataset(16)
+        seen = []
+        for x, y in DataLoader(ds, batch_size=4, shuffle=True, num_workers=2):
+            seen.extend(np.asarray(x.numpy())[:, 0, 0].astype(int).tolist())
+        assert sorted(seen) == list(range(16))
+
+
+def _double_collate(samples):
+    import paddle_tpu as pt
+    xs = np.stack([s[0] for s in samples]) * 2
+    ys = np.asarray([s[1] for s in samples])
+    return pt.to_tensor(xs), pt.to_tensor(ys)
+
+
+class TensorizingDataset(SquareDataset):
+    """transform tensorizes EARLY (in the worker) — collate must still stack."""
+
+    def __getitem__(self, i):
+        x, y = super().__getitem__(i)
+        return pt.to_tensor(x), y
+
+
+class TestMultiprocessDataLoaderExtra:
+    def test_custom_collate_runs_in_parent(self):
+        loader = DataLoader(SquareDataset(8), batch_size=4, num_workers=2,
+                            collate_fn=_double_collate)
+        batches = list(loader)
+        assert len(batches) == 2
+        x0 = np.asarray(batches[0][0].numpy())
+        np.testing.assert_allclose(x0[1], 2.0)  # sample 1 doubled
+
+    def test_persistent_workers_reuse_pool(self):
+        loader = DataLoader(SquareDataset(8), batch_size=4, num_workers=2,
+                            persistent_workers=True)
+        list(loader)
+        pool1 = loader._pool
+        assert pool1.alive()
+        list(loader)
+        assert loader._pool is pool1  # same spawned interpreters
+        pool1.shutdown()
+
+    def test_tensor_samples_still_stack(self):
+        loader = DataLoader(TensorizingDataset(8), batch_size=4, num_workers=2)
+        x, y = next(iter(loader))
+        assert tuple(x.shape) == (4, 2, 2)
+
+
+class FailingDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros((2,), np.float32)
+
+
+class TestTransforms:
+    def test_color_jitter_and_grayscale(self):
+        img = np.random.RandomState(0).rand(3, 8, 8).astype(np.float32)
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+        assert out.shape == (3, 8, 8)
+        g = T.Grayscale(3)(img)
+        assert g.shape == (3, 8, 8)
+        np.testing.assert_allclose(g[0], g[1])
+
+    def test_adjust_hue_identity(self):
+        img = np.random.RandomState(1).rand(3, 4, 4).astype(np.float32)
+        out = T.adjust_hue(img, 0.0)
+        np.testing.assert_allclose(out, img, atol=1e-5)
+
+    def test_random_resized_crop_shape(self):
+        img = np.random.RandomState(2).rand(3, 32, 32).astype(np.float32)
+        out = T.RandomResizedCrop(16)(img)
+        assert out.shape == (3, 16, 16)
+
+    def test_resize_numpy_bilinear(self):
+        img = np.ones((3, 8, 8), np.float32)
+        out = T.Resize((4, 4))(img)
+        np.testing.assert_allclose(out, 1.0, rtol=1e-6)
+        # upscale of a gradient stays monotone
+        grad = np.tile(np.arange(8, dtype=np.float32), (8, 1))
+        up = T.resize(grad, (8, 16))
+        assert np.all(np.diff(up[0]) >= -1e-5)
+
+    def test_random_rotation(self):
+        img = np.random.RandomState(3).rand(3, 8, 8).astype(np.float32)
+        out = T.RandomRotation(30)(img)
+        assert out.shape == (3, 8, 8)
+        np.testing.assert_allclose(T.rotate(img, 0.0), img, atol=1e-5)
+
+    def test_random_erasing(self):
+        img = np.ones((3, 16, 16), np.float32)
+        out = T.RandomErasing(prob=1.0, value=0.0)(img)
+        assert out.min() == 0.0 and out.max() == 1.0
+
+    def test_compose_pipeline(self):
+        tf = T.Compose([T.RandomCrop(24, padding=2), T.RandomHorizontalFlip(),
+                        T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+        img = np.random.RandomState(4).rand(3, 28, 28).astype(np.float32)
+        out = tf(img)
+        assert out.shape == (3, 24, 24)
+
+
+class TestFolderDatasets:
+    def _make_tree(self, root):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = os.path.join(root, cls)
+            os.makedirs(d)
+            for i in range(3):
+                arr = np.random.RandomState(i).randint(
+                    0, 255, (8, 8, 3), np.uint8)
+                Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+
+    def test_dataset_folder(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"] and len(ds) == 6
+        img, label = ds[0]
+        assert img.shape == (8, 8, 3) and label == 0
+        _, label5 = ds[5]
+        assert label5 == 1
+
+    def test_image_folder(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        ds = ImageFolder(str(tmp_path))
+        assert len(ds) == 6
+        (img,) = ds[0]
+        assert img.shape == (8, 8, 3)
+
+    def test_dataset_folder_with_transform_in_loader(self, tmp_path):
+        self._make_tree(str(tmp_path))
+        tf = T.Compose([T.ToTensor()])
+        ds = DatasetFolder(str(tmp_path),
+                           transform=T.Compose([T.Transpose((2, 0, 1))]))
+        x, y = next(iter(DataLoader(ds, batch_size=2)))
+        assert tuple(x.shape) == (2, 3, 8, 8)
+
+
+class TestVisionEndToEnd:
+    def test_lenet_trains_through_engine_with_workers(self):
+        # the whole chain: Cifar -> transforms -> process workers -> Engine
+        from paddle_tpu.distributed.engine import Engine
+        from paddle_tpu.nn import functional as F
+        from paddle_tpu.optimizer import AdamW
+        from paddle_tpu.vision.models import LeNet
+
+        pt.seed(0)
+        tf = T.Compose([T.Resize((28, 28)), T.Grayscale(1),
+                        T.Normalize(mean=[0.5], std=[0.5])])
+        ds = Cifar10(mode="train", transform=tf)
+        loader = DataLoader(ds, batch_size=32, shuffle=True, num_workers=2)
+        model = LeNet(num_classes=10)
+        eng = Engine(model, loss=lambda logits, y: F.cross_entropy(logits, y),
+                     optimizer=AdamW(learning_rate=1e-3))
+        # pull batches through the real worker pipeline, then overfit the
+        # first one (deterministic decrease; streaming random labels aren't)
+        it = iter(loader)
+        x0, y0 = next(it)
+        stream_losses = [float(eng.step(x, y))
+                         for _, (x, y) in zip(range(3), it)]
+        assert all(np.isfinite(l) for l in stream_losses)
+        fit_losses = [float(eng.step(x0, y0)) for _ in range(8)]
+        assert fit_losses[-1] < fit_losses[0]
