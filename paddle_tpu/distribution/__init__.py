@@ -467,3 +467,84 @@ def _kl_uniform(p, q):
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
     return Tensor(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a_p, b_p, a_q, b_q = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((a_p - a_q) * digamma(a_p) - gammaln(a_p) + gammaln(a_q)
+                  + a_q * (jnp.log(b_p) - jnp.log(b_q))
+                  + a_p * (b_q - b_p) / b_p)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    def logB(a, b):
+        return gammaln(a) + gammaln(b) - gammaln(a + b)
+
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return Tensor(logB(a2, b2) - logB(a1, b1)
+                  + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                  + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1, keepdims=True)
+    return Tensor(gammaln(jnp.sum(a, -1)) - gammaln(jnp.sum(b, -1))
+                  - jnp.sum(gammaln(a) - gammaln(b), -1)
+                  + jnp.sum((a - b) * (digamma(a) - digamma(a0)), -1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    mu_p, b_p, mu_q, b_q = p.loc, p.scale, q.loc, q.scale
+    t = jnp.abs(mu_p - mu_q)
+    return Tensor(jnp.log(b_q / b_p) + t / b_q
+                  + b_p / b_q * jnp.exp(-t / b_p) - 1)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    pp, qq = p.probs_, q.probs_
+    return Tensor((jnp.log(pp) - jnp.log(qq)) +
+                  (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+# families/transforms layered on the base zoo (import at end: they subclass
+# the classes above)
+from .families import (Chi2, ContinuousBernoulli, ExponentialFamily,  # noqa: E402,F401
+                       Independent, LKJCholesky, MultivariateNormal)
+from .transforms import (AbsTransform, AffineTransform, ChainTransform,  # noqa: E402,F401
+                         ExpTransform, IndependentTransform, PowerTransform,
+                         ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                         StackTransform, StickBreakingTransform, TanhTransform,
+                         Transform, TransformedDistribution, Type)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.event_shape[0]
+    Lp, Lq = p.scale_tril, q.scale_tril
+    half_logdet_p = jnp.sum(jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), -1)
+    half_logdet_q = jnp.sum(jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), -1)
+    M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+    tr = jnp.sum(M * M, axis=(-2, -1))
+    diff = q.loc - p.loc
+    y = jax.scipy.linalg.solve_triangular(Lq, diff[..., None], lower=True)[..., 0]
+    quad = jnp.sum(y * y, -1)
+    return Tensor(half_logdet_q - half_logdet_p + 0.5 * (tr + quad - d))
+
+
+__all__ += ["Chi2", "ContinuousBernoulli", "ExponentialFamily", "Independent",
+            "LKJCholesky", "MultivariateNormal", "Transform",
+            "TransformedDistribution", "AbsTransform", "AffineTransform",
+            "ChainTransform", "ExpTransform", "IndependentTransform",
+            "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+            "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+            "TanhTransform"]

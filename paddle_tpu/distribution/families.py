@@ -1,0 +1,252 @@
+"""Additional distribution families.
+
+Reference: python/paddle/distribution/{chi2,continuous_bernoulli,
+exponential_family,independent,multivariate_normal,lkj_cholesky}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..core import random as _rng
+from ..core.tensor import Tensor
+
+__all__ = ["Chi2", "ContinuousBernoulli", "ExponentialFamily", "Independent",
+           "MultivariateNormal", "LKJCholesky"]
+
+
+from . import Distribution, Gamma, _v  # noqa: E402  (package __init__ imports us after the base zoo)
+
+
+class ExponentialFamily(Distribution):
+    """Natural-parameter base (reference exponential_family.py): subclasses
+    provide _natural_parameters and _log_normalizer; entropy falls out via
+    the Bregman identity H = A(η) - <η, ∇A(η)>  + E[log h(x)]."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """H = A(η) - <η, ∇A(η)> - E[log h(x)], elementwise over the batch
+        (the grad of sum(A) IS the elementwise ∇A since A is pointwise)."""
+        nparams = [jnp.asarray(p) for p in self._natural_parameters]
+        grads = jax.grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nparams))
+        ent = self._log_normalizer(*nparams) - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return Tensor(ent)
+
+
+class Chi2(Gamma):
+    """Chi-squared(df) = Gamma(df/2, 1/2) (reference chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _v(df)
+        super().__init__(self.df / 2.0, jnp.asarray(0.5))
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(λ) on [0,1] (reference continuous_bernoulli.py): p(x) = C(λ)
+    λ^x (1-λ)^(1-x) with C(λ) = 2 atanh(1-2λ)/(1-2λ) (λ≠0.5)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _v(probs)
+        self.lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_C(self):
+        lam = self.probs
+        safe = jnp.clip(lam, 1e-6, 1 - 1e-6)
+        near_half = jnp.logical_and(safe > self.lims[0], safe < self.lims[1])
+        lam_safe = jnp.where(near_half, 0.4, safe)
+        logC = jnp.log(2 * jnp.abs(jnp.arctanh(1 - 2 * lam_safe))) \
+            - jnp.log(jnp.abs(1 - 2 * lam_safe))
+        # Taylor around 1/2: C -> 2 + (4/3)(λ-1/2)^2 ...
+        x = safe - 0.5
+        taylor = math.log(2.0) + 4.0 / 3.0 * x * x
+        return jnp.where(near_half, taylor, logC)
+
+    def log_prob(self, value):
+        v = _v(value)
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return Tensor(self._log_C() + v * jnp.log(lam)
+                      + (1 - v) * jnp.log1p(-lam))
+
+    def sample(self, shape=(), seed=0):
+        # inverse-CDF sampling
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_rng.split_key(), shp)
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        near_half = jnp.logical_and(lam > self.lims[0], lam < self.lims[1])
+        lam_safe = jnp.where(near_half, 0.4, lam)
+        # F(x) = (r^x - 1)/(r - 1) with r = λ/(1-λ)  =>  x = log1p(u(r-1))/log r
+        r = lam_safe / (1 - lam_safe)
+        x = jnp.log1p(u * (r - 1)) / jnp.log(r)
+        return Tensor(jnp.where(near_half, u, jnp.clip(x, 0, 1)))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        near_half = jnp.logical_and(lam > self.lims[0], lam < self.lims[1])
+        lam_safe = jnp.where(near_half, 0.4, lam)
+        m = lam_safe / (2 * lam_safe - 1) \
+            + 1 / (2 * jnp.arctanh(1 - 2 * lam_safe))
+        return Tensor(jnp.where(near_half, 0.5, m))
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference
+    independent.py): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = base.batch_shape
+        super().__init__(bshape[:len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = _v(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        ent = _v(self.base.entropy())
+        return Tensor(jnp.sum(ent, axis=tuple(range(-self.rank, 0))))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class MultivariateNormal(Distribution):
+    """MVN(loc, Σ) (reference multivariate_normal.py): parameterized by
+    covariance_matrix, precision_matrix, or scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _v(loc)
+        if scale_tril is not None:
+            self.scale_tril = _v(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(_v(covariance_matrix))
+        elif precision_matrix is not None:
+            prec = _v(precision_matrix)
+            self.scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError("need covariance_matrix, precision_matrix or "
+                             "scale_tril")
+        d = self.loc.shape[-1]
+        super().__init__(jnp.broadcast_shapes(self.loc.shape[:-1],
+                                              self.scale_tril.shape[:-2]),
+                         (d,))
+
+    @property
+    def covariance_matrix(self):
+        L = self.scale_tril
+        return Tensor(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.sum(self.scale_tril ** 2, axis=-1),
+            self.batch_shape + self.event_shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        z = jax.random.normal(_rng.split_key(), shp)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self.scale_tril, z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        d = self.event_shape[0]
+        diff = v - self.loc
+        y = jax.scipy.linalg.solve_triangular(self.scale_tril, diff[..., None],
+                                              lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                                   axis2=-1)), -1)
+        return Tensor(-0.5 * jnp.sum(y * y, -1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                                   axis2=-1)), -1)
+        return Tensor(0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors (reference
+    lkj_cholesky.py): density ∝ Π_i L_ii^{d-i-1+2(η-1)}; sampled with the
+    onion method."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        self.dim = int(dim)
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def sample(self, shape=(), seed=0):
+        d = self.dim
+        eta = self.concentration
+        shp = tuple(shape) + self.batch_shape
+        # onion method: build up one row at a time
+        L = jnp.zeros(shp + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        beta_par = eta + (d - 2) / 2.0
+        for i in range(1, d):
+            # squared radius ~ Beta(i/2, beta_par)
+            b = jax.random.beta(_rng.split_key(),
+                                i / 2.0, jnp.broadcast_to(beta_par, shp))
+            beta_par = beta_par - 0.5
+            u = jax.random.normal(_rng.split_key(), shp + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(b)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - b, 1e-12)))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        L = _v(value)
+        d = self.dim
+        eta = self.concentration
+        i = jnp.arange(1, d)
+        order = d - (i + 1) + 2.0 * (eta[..., None] - 1.0)
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = jnp.sum(order * jnp.log(diag), -1)
+        # normalizer (reference lkj_cholesky.py): product of Beta functions
+        alpha = eta[..., None] + (d - (i + 1)) / 2.0
+        lognorm = jnp.sum(
+            0.5 * i * math.log(math.pi)
+            + gammaln(alpha) - gammaln(alpha + 0.5 * i), -1)
+        return Tensor(unnorm - lognorm)

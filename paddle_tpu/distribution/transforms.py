@@ -1,0 +1,330 @@
+"""Bijective transforms + TransformedDistribution.
+
+Reference: python/paddle/distribution/transform.py (Transform hierarchy:
+Affine/Exp/Power/Sigmoid/Tanh/Abs/Chain/Independent/Reshape/Softmax/Stack/
+StickBreaking) and transformed_distribution.py. TPU-native: each transform is
+a pure jnp forward/inverse/log_det triple; sampling composes on arrays.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Type", "Transform", "AbsTransform", "AffineTransform",  # noqa: E402
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform", "TransformedDistribution"]
+
+
+from . import _v  # noqa: E402  (one shared Tensor-unwrap helper)
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.BIJECTION
+
+    @property
+    def type(self):
+        return self._type
+
+    def forward(self, x):
+        return Tensor(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_v(y))))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # event dims consumed by one application (0 = elementwise)
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class IndependentTransform(Transform):
+    """Treat the trailing `reinterpreted_batch_rank` dims as event dims:
+    log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self.base._fldj(x)
+        return jnp.sum(ldj, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("softmax is not bijective: no log-det")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis` (reference StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _apply(self, x, method):
+        parts = [getattr(t, method)(p.squeeze(self.axis)) for t, p in zip(
+            self.transforms, jnp.split(x, len(self.transforms), self.axis))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._apply(x, "_forward")
+
+    def _inverse(self, y):
+        return self._apply(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._apply(x, "_fldj")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K (reference StickBreakingTransform)."""
+    _type = Type.OTHER
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zcp = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zcp[..., :1]), zcp[..., :-1]], axis=-1)
+        head = z * lead
+        last = zcp[..., -1:]
+        return jnp.concatenate([head, last], axis=-1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.concatenate(
+            [jnp.zeros_like(y[..., :1]), jnp.cumsum(y[..., :-1], -1)], -1)
+        rest = 1 - cum[..., :-1]
+        z = y[..., :-1] / jnp.clip(rest, 1e-12)
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(jnp.clip(z, 1e-12)) - jnp.log1p(-jnp.clip(z, None, 1 - 1e-12)) + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        zcp = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zcp[..., :1]), zcp[..., :-1]], axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(jnp.clip(lead, 1e-38)),
+                       axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class TransformedDistribution:
+    """base distribution pushed through a chain of transforms (reference
+    transformed_distribution.py): sample = T(base.sample), log_prob via the
+    change-of-variables formula."""
+
+    def __init__(self, base, transforms):
+        from . import Distribution  # noqa: F401 (type anchor)
+        self.base = base
+        self.transforms = list(transforms) if isinstance(transforms, (list, tuple)) \
+            else [transforms]
+
+    def sample(self, shape=()):
+        x = _v(self.base.sample(shape))
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _v(value)
+        ldj = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = ldj + t._fldj(x)
+            y = x
+        base_lp = _v(self.base.log_prob(Tensor(y)))
+        ldj = jnp.asarray(ldj)
+        # rank-align: an elementwise transform over an event-shaped base must
+        # SUM its jacobian over the event dims (and vice versa)
+        if ldj.ndim > base_lp.ndim:
+            ldj = jnp.sum(ldj, axis=tuple(range(-(ldj.ndim - base_lp.ndim), 0)))
+        elif ldj.ndim < base_lp.ndim:
+            base_lp = jnp.sum(
+                base_lp, axis=tuple(range(-(base_lp.ndim - ldj.ndim), 0)))
+        return Tensor(base_lp - ldj)
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_v(self.log_prob(value))))

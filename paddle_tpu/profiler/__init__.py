@@ -73,16 +73,30 @@ _events = threading.local()
 def _tree():
     if not hasattr(_events, "stack"):
         _events.stack = []
-        _events.totals = defaultdict(lambda: [0.0, 0])
+        _events.records = []
+        _events.first_start = None
+        _events.last_end = None
     return _events
+
+
+def reset_host_events():
+    """Drop recorded host events (called by Profiler.start so each profiling
+    window reports its own wall time and doesn't grow without bound)."""
+    tls = _tree()
+    tls.records = []
+    tls.first_start = None
+    tls.last_end = None
 
 
 class RecordEvent:
     """Host-side scoped event: feeds summary() and annotates the device trace
-    (reference phi/api/profiler/event_tracing.h RecordEvent)."""
+    (reference phi/api/profiler/event_tracing.h RecordEvent). Nesting is
+    tracked so the statistics tables can report SELF time per event."""
 
     def __init__(self, name, event_type=None):
+        from .statistics import TracerEventType
         self.name = name
+        self.event_type = event_type or TracerEventType.UserDefined
         self._ann = jax.profiler.TraceAnnotation(name)
 
     def __enter__(self):
@@ -94,16 +108,26 @@ class RecordEvent:
 
     def begin(self):
         tls = _tree()
-        tls.stack.append((self.name, time.perf_counter()))
+        now = time.perf_counter()
+        if tls.first_start is None:
+            tls.first_start = now
+        # frame: [name, type, start, child_time_accumulator]
+        tls.stack.append([self.name, self.event_type, now, 0.0])
         self._ann.__enter__()
 
     def end(self):
+        from .statistics import EventRecord
         self._ann.__exit__(None, None, None)
         tls = _tree()
-        name, t0 = tls.stack.pop()
-        tot = tls.totals[name]
-        tot[0] += time.perf_counter() - t0
-        tot[1] += 1
+        name, etype, t0, child = tls.stack.pop()
+        now = time.perf_counter()
+        dur = now - t0
+        tls.last_end = now
+        if tls.stack:
+            tls.stack[-1][3] += dur  # contribute to parent's child time
+        tls.records.append(EventRecord(name, etype, t0, dur,
+                                       depth=len(tls.stack),
+                                       self_dur=max(dur - child, 0.0)))
 
 
 class Profiler:
@@ -127,6 +151,7 @@ class Profiler:
         self._t_last = None
 
     def start(self):
+        reset_host_events()  # each profiling window reports its own events
         self._t_last = time.perf_counter()
         if not self._timer_only:
             self._maybe_transition(first=True)
@@ -192,15 +217,19 @@ class Profiler:
         self.stop()
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+                time_unit="ms", views=None):
+        """Statistics tables (reference profiler_statistic.py _build_table):
+        event-type overview + per-event calls/total/avg/max/min/self/%."""
+        from .statistics import SortedKeys, build_summary
         tls = _tree()
-        if not tls.totals:
+        if not tls.records:
             print("(no host events recorded — wrap regions in profiler.RecordEvent)")
             return
-        rows = sorted(tls.totals.items(), key=lambda kv: -kv[1][0])
-        print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}")
-        for name, (tot, calls) in rows:
-            print(f"{name:<40}{calls:>8}{tot * 1e3:>12.3f}{tot / calls * 1e3:>12.3f}")
+        wall = (tls.last_end or 0) - (tls.first_start or 0)
+        print(build_summary(tls.records, wall,
+                            sorted_by=sorted_by or SortedKeys.CPUTotal,
+                            op_detail=op_detail, time_unit=time_unit,
+                            views=views))
 
 
 def load_profiler_result(path):

@@ -1,0 +1,145 @@
+"""Profiler statistics / summary tables.
+
+Reference: python/paddle/profiler/profiler_statistic.py (SortedKeys,
+StatisticData, _build_table: overview, model-perspective and op-detail
+summaries with total/avg/max/min + percentage columns). TPU-native: events
+come from the host-side RecordEvent tree; device time lives in the XPlane
+trace (TensorBoard), so these tables report the HOST timeline the way the
+reference's CPU columns do.
+"""
+from __future__ import annotations
+
+import enum
+
+__all__ = ["SortedKeys", "EventRecord", "StatisticData", "build_summary",
+           "TracerEventType"]
+
+
+class TracerEventType(enum.Enum):
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonUserDefined = 7
+    UserDefined = 8
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4   # alias: device tables live in the XPlane trace
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class EventRecord:
+    __slots__ = ("name", "type", "start", "dur", "depth", "self_dur")
+
+    def __init__(self, name, type_, start, dur, depth, self_dur):
+        self.name = name
+        self.type = type_
+        self.start = start
+        self.dur = dur
+        self.depth = depth
+        self.self_dur = self_dur
+
+
+class _Agg:
+    __slots__ = ("calls", "total", "self_total", "mx", "mn", "type")
+
+    def __init__(self, type_):
+        self.calls = 0
+        self.total = 0.0
+        self.self_total = 0.0
+        self.mx = 0.0
+        self.mn = float("inf")
+        self.type = type_
+
+    def add(self, rec):
+        self.calls += 1
+        self.total += rec.dur
+        self.self_total += rec.self_dur
+        self.mx = max(self.mx, rec.dur)
+        self.mn = min(self.mn, rec.dur)
+
+
+_SORT_FIELD = {
+    SortedKeys.CPUTotal: lambda a: a.total,
+    SortedKeys.CPUAvg: lambda a: a.total / max(a.calls, 1),
+    SortedKeys.CPUMax: lambda a: a.mx,
+    SortedKeys.CPUMin: lambda a: a.mn,
+    SortedKeys.GPUTotal: lambda a: a.total,
+    SortedKeys.GPUAvg: lambda a: a.total / max(a.calls, 1),
+    SortedKeys.GPUMax: lambda a: a.mx,
+    SortedKeys.GPUMin: lambda a: a.mn,
+}
+
+_UNIT = {"s": 1.0, "ms": 1e3, "us": 1e6}
+
+
+class StatisticData:
+    """Aggregate a flat list of EventRecords into the summary tables
+    (reference StatisticData + ItemAverage)."""
+
+    def __init__(self, records, wall_time):
+        self.records = list(records)
+        self.wall = max(wall_time, 1e-12)
+        self.by_name: dict = {}
+        self.by_type: dict = {}
+        for r in self.records:
+            self.by_name.setdefault(r.name, _Agg(r.type)).add(r)
+            if r.depth == 0:  # model perspective counts top-level time only
+                self.by_type.setdefault(r.type, _Agg(r.type)).add(r)
+
+
+def _fmt_row(cols, widths):
+    return "".join(str(c)[:w - 2].ljust(w) for c, w in zip(cols, widths))
+
+
+def build_summary(records, wall_time, sorted_by=SortedKeys.CPUTotal,
+                  op_detail=True, time_unit="ms", views=None):
+    """Render the summary tables as one string (reference _build_table):
+    overview by event type, then the per-event table with
+    calls/total/avg/max/min/self and % of wall time."""
+    u = _UNIT.get(time_unit, 1e3)
+    data = StatisticData(records, wall_time)
+    out = []
+    w1 = [28, 10, 14, 12]
+    line = "-" * sum(w1)
+    out.append(f"Overview Summary  (wall = {wall_time * u:.3f}{time_unit})")
+    out.append(line)
+    out.append(_fmt_row(["Event Type", "Calls", f"Total({time_unit})",
+                         "Ratio (%)"], w1))
+    out.append(line)
+    for t, agg in sorted(data.by_type.items(), key=lambda kv: -kv[1].total):
+        name = t.name if isinstance(t, TracerEventType) else str(t)
+        out.append(_fmt_row([name, agg.calls, f"{agg.total * u:.3f}",
+                             f"{agg.total / data.wall * 100:.2f}"], w1))
+    out.append(line)
+
+    if op_detail and data.by_name:
+        key = _SORT_FIELD.get(sorted_by, _SORT_FIELD[SortedKeys.CPUTotal])
+        w2 = [32, 8, 12, 12, 12, 12, 12, 10]
+        line2 = "-" * sum(w2)
+        out.append("")
+        out.append(f"Event Summary  (sorted by {sorted_by.name})")
+        out.append(line2)
+        out.append(_fmt_row(
+            ["Name", "Calls", f"Total({time_unit})", f"Avg({time_unit})",
+             f"Max({time_unit})", f"Min({time_unit})", f"Self({time_unit})",
+             "Ratio (%)"], w2))
+        out.append(line2)
+        for name, agg in sorted(data.by_name.items(), key=lambda kv: -key(kv[1])):
+            out.append(_fmt_row(
+                [name, agg.calls, f"{agg.total * u:.3f}",
+                 f"{agg.total / agg.calls * u:.3f}", f"{agg.mx * u:.3f}",
+                 f"{agg.mn * u:.3f}", f"{agg.self_total * u:.3f}",
+                 f"{agg.total / data.wall * 100:.2f}"], w2))
+        out.append(line2)
+    return "\n".join(out)

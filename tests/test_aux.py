@@ -168,10 +168,10 @@ class TestLauncher:
 class TestProfiler:
     def test_record_event_and_summary(self, capsys):
         import paddle_tpu.profiler as prof
+        p = prof.Profiler(timer_only=True)
+        p.start()  # resets the host-event window
         with prof.RecordEvent("matmul_region"):
             _ = pt.matmul(pt.randn([32, 32]), pt.randn([32, 32]))
-        p = prof.Profiler(timer_only=True)
-        p.start()
         for _ in range(3):
             p.step()
         p.stop()
@@ -257,3 +257,47 @@ class TestElastic:
         with tracker.rng_state():
             import paddle_tpu as pt
             _ = pt.randn([2])
+
+
+class TestProfilerStatistics:
+    def test_summary_tables(self, capsys):
+        import time
+        import paddle_tpu.profiler as prof
+        from paddle_tpu.profiler.statistics import SortedKeys, TracerEventType
+        with prof.RecordEvent("outer", TracerEventType.Forward):
+            time.sleep(0.01)
+            with prof.RecordEvent("inner", TracerEventType.Operator):
+                time.sleep(0.02)
+        p = prof.Profiler(timer_only=True)
+        p.summary(sorted_by=SortedKeys.CPUTotal)
+        out = capsys.readouterr().out
+        assert "Overview Summary" in out and "Event Summary" in out
+        assert "Forward" in out and "outer" in out and "inner" in out
+        assert "Self(ms)" in out and "Ratio (%)" in out
+        # self time of outer excludes inner
+        for line in out.splitlines():
+            if line.startswith("outer"):
+                cols = line.split()
+                total, self_t = float(cols[2]), float(cols[6])
+                assert self_t < total and self_t < 20.0
+
+    def test_sorted_by_avg(self, capsys):
+        import paddle_tpu.profiler as prof
+        from paddle_tpu.profiler.statistics import SortedKeys
+        with prof.RecordEvent("avg_probe"):
+            pass
+        prof.Profiler(timer_only=True).summary(sorted_by=SortedKeys.CPUAvg)
+        assert "sorted by CPUAvg" in capsys.readouterr().out
+
+    def test_profiler_start_resets_window(self, capsys):
+        import paddle_tpu.profiler as prof
+        with prof.RecordEvent("stale_event"):
+            pass
+        p = prof.Profiler(timer_only=True)
+        p.start()  # window reset: stale events dropped
+        with prof.RecordEvent("fresh_event"):
+            pass
+        p.stop()
+        p.summary()
+        out = capsys.readouterr().out
+        assert "fresh_event" in out and "stale_event" not in out

@@ -1,0 +1,153 @@
+"""Distribution zoo extension: transforms, TransformedDistribution, and the
+families (Chi2/ContinuousBernoulli/Independent/MVN/LKJCholesky) — checked
+against scipy.stats / closed forms (reference python/paddle/distribution/)."""
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as pt
+import paddle_tpu.distribution as D
+
+
+def T(a):
+    return pt.to_tensor(np.asarray(a, np.float32))
+
+
+class TestTransforms:
+    @pytest.mark.parametrize("t,x", [
+        (D.ExpTransform(), 0.7), (D.SigmoidTransform(), 0.3),
+        (D.TanhTransform(), 0.4), (D.AffineTransform(1.0, 2.5), -0.6),
+        (D.PowerTransform(3.0), 1.3),
+    ])
+    def test_inverse_and_logdet(self, t, x):
+        y = t.forward(T(np.float32(x)))
+        back = float(t.inverse(y).numpy())
+        np.testing.assert_allclose(back, x, rtol=1e-5)
+        # log|J| vs numeric derivative
+        eps = 1e-3
+        num = (float(t.forward(T(np.float32(x + eps))).numpy())
+               - float(t.forward(T(np.float32(x - eps))).numpy())) / (2 * eps)
+        np.testing.assert_allclose(
+            float(t.forward_log_det_jacobian(T(np.float32(x))).numpy()),
+            np.log(abs(num)), atol=1e-3)
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = T(np.float32(0.5))
+        np.testing.assert_allclose(float(chain.forward(x).numpy()),
+                                   np.exp(1.0), rtol=1e-6)
+        np.testing.assert_allclose(float(chain.inverse(chain.forward(x)).numpy()),
+                                   0.5, rtol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        t = D.StickBreakingTransform()
+        x = T(np.array([0.2, -0.3, 0.7], np.float32))
+        y = np.asarray(t.forward(x).numpy())
+        assert y.shape == (4,) and abs(y.sum() - 1) < 1e-6 and (y > 0).all()
+        back = np.asarray(t.inverse(T(y)).numpy())
+        np.testing.assert_allclose(back, [0.2, -0.3, 0.7], atol=1e-4)
+
+    def test_transformed_matches_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.3, 1.2), [D.ExpTransform()])
+        ln = D.LogNormal(0.3, 1.2)
+        for v in (0.5, 1.7, 3.0):
+            np.testing.assert_allclose(float(td.log_prob(T(v)).numpy()),
+                                       float(ln.log_prob(T(v)).numpy()),
+                                       rtol=1e-5)
+
+
+class TestFamilies:
+    def test_chi2_logpdf(self):
+        d = D.Chi2(np.float32(5.0))
+        for v in (1.0, 4.0, 9.0):
+            np.testing.assert_allclose(float(d.log_prob(T(v)).numpy()),
+                                       stats.chi2.logpdf(v, 5.0), rtol=1e-5)
+
+    def test_mvn_logpdf_vs_scipy(self):
+        rng = np.random.RandomState(0)
+        A = rng.randn(3, 3).astype(np.float32)
+        cov = A @ A.T + 3 * np.eye(3, dtype=np.float32)
+        loc = rng.randn(3).astype(np.float32)
+        d = D.MultivariateNormal(loc, covariance_matrix=cov)
+        x = rng.randn(3).astype(np.float32)
+        np.testing.assert_allclose(
+            float(d.log_prob(T(x)).numpy()),
+            stats.multivariate_normal.logpdf(x, loc, cov), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(d.entropy().numpy()),
+            stats.multivariate_normal.entropy(loc, cov), rtol=1e-4)
+
+    def test_mvn_kl_identity(self):
+        cov = np.eye(2, dtype=np.float32)
+        p = D.MultivariateNormal(np.zeros(2, np.float32), covariance_matrix=cov)
+        np.testing.assert_allclose(float(D.kl_divergence(p, p).numpy()), 0.0,
+                                   atol=1e-6)
+
+    def test_independent_sums_event_dims(self):
+        base = D.Normal(np.zeros((4, 3), np.float32), np.ones((4, 3), np.float32))
+        ind = D.Independent(base, 1)
+        x = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        lp = np.asarray(ind.log_prob(T(x)).numpy())
+        ref = np.asarray(base.log_prob(T(x)).numpy()).sum(-1)
+        np.testing.assert_allclose(lp, ref, rtol=1e-6)
+        assert ind.event_shape == (3,) and ind.batch_shape == (4,)
+
+    def test_continuous_bernoulli(self):
+        pt.seed(0)
+        d = D.ContinuousBernoulli(np.float32(0.3))
+        s = np.asarray(d.sample((5000,)).numpy())
+        assert 0 <= s.min() and s.max() <= 1
+        np.testing.assert_allclose(s.mean(), float(d.mean.numpy()), atol=0.02)
+        # density integrates to ~1
+        xs = np.linspace(1e-3, 1 - 1e-3, 2001).astype(np.float32)
+        pdf = np.exp(np.asarray(d.log_prob(T(xs)).numpy()))
+        np.testing.assert_allclose(np.trapezoid(pdf, xs), 1.0, atol=1e-2)
+
+    def test_lkj_cholesky(self):
+        pt.seed(1)
+        d = D.LKJCholesky(4, 1.5)
+        L = np.asarray(d.sample((8,)).numpy())
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(np.diagonal(corr, axis1=-2, axis2=-1), 1.0,
+                                   atol=1e-5)
+        ev = np.linalg.eigvalsh(corr)
+        assert (ev > -1e-6).all()
+        assert np.isfinite(np.asarray(d.log_prob(T(L)).numpy())).all()
+
+
+class TestNewKLPairs:
+    def _mc_kl(self, p, q, n=200_000):
+        pt.seed(7)
+        x = p.sample((n,))
+        return float(np.mean(np.asarray(p.log_prob(x).numpy())
+                             - np.asarray(q.log_prob(x).numpy())))
+
+    @pytest.mark.parametrize("mk", [
+        lambda: (D.Gamma(np.float32(2.0), np.float32(1.5)),
+                 D.Gamma(np.float32(3.0), np.float32(1.0))),
+        lambda: (D.Beta(np.float32(2.0), np.float32(3.0)),
+                 D.Beta(np.float32(4.0), np.float32(2.0))),
+        lambda: (D.Laplace(np.float32(0.0), np.float32(1.0)),
+                 D.Laplace(np.float32(0.5), np.float32(2.0))),
+        lambda: (D.Dirichlet(np.array([1.5, 2.5, 2.0], np.float32)),
+                 D.Dirichlet(np.array([2.0, 1.0, 3.0], np.float32))),
+    ])
+    def test_closed_form_matches_monte_carlo(self, mk):
+        p, q = mk()
+        kl = float(np.asarray(D.kl_divergence(p, q).numpy()).sum())
+        mc = self._mc_kl(p, q)
+        np.testing.assert_allclose(kl, mc, rtol=0.08, atol=0.02)
+
+
+def test_transformed_event_shaped_base():
+    # elementwise transform over an event-shaped base: jacobian must SUM
+    # over the event dims
+    cov = np.eye(3, dtype=np.float32)
+    base = D.MultivariateNormal(np.zeros(3, np.float32), covariance_matrix=cov)
+    td = D.TransformedDistribution(base, [D.AffineTransform(0.0, 2.0)])
+    x = np.array([0.4, -0.2, 1.0], np.float32)
+    lp = np.asarray(td.log_prob(T(x)).numpy())
+    assert lp.shape == ()
+    ref = float(base.log_prob(T(x / 2.0)).numpy()) - 3 * np.log(2.0)
+    np.testing.assert_allclose(float(lp), ref, rtol=1e-5)
