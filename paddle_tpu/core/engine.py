@@ -446,13 +446,30 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
 
     f = functools.partial(fn, **static_kwargs) if static_kwargs else fn
 
+    # per-op SPMD rule (general custom-rule surface; the reference's
+    # InferSpmd→reshard→local-kernel contract, dist_api_gen.py:49-201)
+    posthook = None
+    if name:
+        from ..distributed import spmd_rules as _spmd
+        rule = _spmd.get_spmd_rule(name)
+        if rule is not None and any(
+                t is not None and getattr(t, "_dist", None) is not None
+                for t in tensor_inputs):
+            arrs, posthook = _spmd.apply_rule(rule, tensor_inputs, arrs)
+
+    def _finish(out_tree):
+        out_tree = _propagate_dist(out_tree, tensor_inputs)
+        if posthook is not None:
+            out_tree = posthook(out_tree)
+        return out_tree
+
     track = grad_enabled() and any_requires and not any_tracer
     if not track:
         out = f(*arrs)
         if not any_tracer:
             _check_nan_inf(name, out)
         wrapped = wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
-        return _propagate_dist(wrapped, tensor_inputs)
+        return _finish(wrapped)
 
     out, vjp_fn = jax.vjp(f, *arrs)
     _check_nan_inf(name, out)
@@ -466,7 +483,7 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         raw_args=arrs,
     )
     out_tensors = [Tensor(l, stop_gradient=False, _node=(node, i)) for i, l in enumerate(leaves)]
-    return _propagate_dist(jax.tree.unflatten(treedef, out_tensors), tensor_inputs)
+    return _finish(jax.tree.unflatten(treedef, out_tensors))
 
 
 def _reduced_if_partial(t):

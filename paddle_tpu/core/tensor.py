@@ -262,6 +262,15 @@ class Tensor:
         idx = _unwrap_index(idx)
         return engine.apply(lambda x: x[idx], self, name="getitem")
 
+    def __iter__(self):
+        # Explicit first-axis iteration. Without this, python's legacy
+        # __getitem__ iteration protocol never terminates: jnp indexing
+        # clamps out-of-range indices instead of raising IndexError.
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        for i in range(self._value.shape[0]):
+            yield self[i]
+
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)
         if isinstance(value, Tensor):

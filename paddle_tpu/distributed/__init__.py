@@ -21,6 +21,10 @@ from .collective import (  # noqa: F401
     new_group, recv, reduce, reduce_scatter, scatter, send, stream, wait,
 )
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from .spmd_rules import (  # noqa: F401
+    SpmdContext, SpmdDecision, get_spmd_rule, register_spmd_rule,
+    unregister_spmd_rule,
+)
 from .engine import Engine, PipelinePlan, Strategy as EngineStrategy  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -1,14 +1,22 @@
-"""Elastic training manager.
+"""Elastic training: membership, heartbeats, scale decisions.
 
 Reference: /root/reference/python/paddle/distributed/fleet/elastic/manager.py
 (ElasticManager :125 — etcd leases as heartbeats, np-change watch, scale
-up/down, relaunch; ElasticLevel/ElasticStatus :44,:49).
+up/down decisions via ElasticLevel/ElasticStatus :44,:49, relaunch) and
+launch/utils/kv_server.py (the in-launcher HTTP KV master used instead of
+etcd for single-node jobs).
 
-TPU-native: etcd isn't vendored; membership runs over a SHARED DIRECTORY
-(NFS/GCS-fuse on real pods): each node maintains a heartbeat file with a
-TTL; the manager watches membership, decides scale/restart, and signals the
-launcher (which owns process supervision). The decision logic mirrors the
-reference; the transport is pluggable (subclass Registry for etcd/redis).
+TPU-native: etcd isn't vendored, so membership is pluggable transport:
+
+* ``FileRegistry`` — heartbeat files with a TTL over a shared directory
+  (NFS / GCS-fuse on real pods; /tmp for same-host tests).
+* ``KVRegistry`` — the reference's HTTP-KV-master pattern: node 0 serves a
+  tiny TTL'd KV over HTTP (``KVServer``), every node heartbeats via PUT and
+  reads membership via GET. No shared filesystem needed.
+
+``ElasticManager`` owns the decision loop (HOLD / RESTART / ERROR /
+COMPLETED); the launcher (``distributed/launch/main.py``) owns process
+supervision and acts on the decisions.
 """
 from __future__ import annotations
 
@@ -17,8 +25,11 @@ import json
 import os
 import threading
 import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-__all__ = ["ElasticLevel", "ElasticStatus", "FileRegistry", "ElasticManager"]
+__all__ = ["ElasticLevel", "ElasticStatus", "FileRegistry", "KVServer",
+           "KVRegistry", "ElasticManager"]
 
 
 class ElasticLevel(enum.IntEnum):
@@ -69,11 +80,117 @@ class FileRegistry:
             pass
 
 
+class KVServer:
+    """TTL'd KV over HTTP — the master side of KVRegistry.
+
+    Reference: launch/utils/kv_server.py (the launcher master's KV store).
+    Endpoints: PUT /hb/<node> (body = info json), GET /nodes (alive list),
+    DELETE /hb/<node>.
+    """
+
+    def __init__(self, port: int = 0, ttl: float = 10.0):
+        store: dict = {}
+        lock = threading.Lock()
+        self._store, self._lock, self.ttl = store, lock, ttl
+        ttl_ref = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body=b""):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PUT(self):
+                if not self.path.startswith("/hb/"):
+                    return self._send(404)
+                node = self.path[4:]
+                n = int(self.headers.get("Content-Length", 0))
+                info = self.rfile.read(n) if n else b"{}"
+                with lock:
+                    store[node] = (time.time(), info.decode() or "{}")
+                self._send(200)
+
+            def do_DELETE(self):
+                if not self.path.startswith("/hb/"):
+                    return self._send(404)
+                with lock:
+                    store.pop(self.path[4:], None)
+                self._send(200)
+
+            def do_GET(self):
+                if self.path != "/nodes":
+                    return self._send(404)
+                now = time.time()
+                with lock:
+                    alive = sorted(k for k, (ts, _) in store.items()
+                                   if now - ts <= ttl_ref.ttl)
+                self._send(200, json.dumps(alive).encode())
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), H)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class KVRegistry:
+    """Client of a KVServer: heartbeat + membership over HTTP."""
+
+    def __init__(self, endpoint: str, ttl: float = 10.0, timeout: float = 3.0):
+        self.base = endpoint if endpoint.startswith("http") else f"http://{endpoint}"
+        self.ttl = ttl
+        self.timeout = timeout
+
+    def heartbeat(self, node_id: str, info=None):
+        req = urllib.request.Request(
+            f"{self.base}/hb/{node_id}", method="PUT",
+            data=json.dumps(info or {}).encode())
+        urllib.request.urlopen(req, timeout=self.timeout).read()
+
+    def alive_nodes(self):
+        try:
+            with urllib.request.urlopen(f"{self.base}/nodes",
+                                        timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except Exception:
+            return []
+
+    def leave(self, node_id: str):
+        try:
+            req = urllib.request.Request(
+                f"{self.base}/hb/{node_id}", method="DELETE")
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        except Exception:
+            pass
+
+
 class ElasticManager:
+    """Membership watcher + scale decisions (reference manager.py:125).
+
+    Decision table (watch()):
+      membership == np, unchanged            → HOLD
+      changed, min_np <= n, n != np          → RESTART (scale to n)
+      n < min_np for < elastic_timeout       → HOLD (wait for rejoin)
+      n < min_np for >= elastic_timeout      → ERROR (give up)
+    FAULT_TOLERANCE (min==max) never scales: a lost node is HOLD until
+    rejoin or timeout→ERROR; the restart budget is the launcher's.
+    """
+
     def __init__(self, node_id: str, np: int, min_np: int | None = None,
-                 max_np: int | None = None, registry: FileRegistry | None = None,
+                 max_np: int | None = None, registry=None,
                  root: str = "/tmp/paddle_tpu_elastic", job_id: str = "default",
-                 heartbeat_interval: float = 2.0):
+                 heartbeat_interval: float = 2.0, elastic_timeout: float = 120.0):
         self.node_id = node_id
         self.np = np
         self.min_np = min_np or np
@@ -82,17 +199,32 @@ class ElasticManager:
                       else ElasticLevel.FAULT_TOLERANCE)
         self.registry = registry or FileRegistry(root, job_id)
         self.interval = heartbeat_interval
+        self.elastic_timeout = elastic_timeout
         self._stop = threading.Event()
         self._thread = None
-        self._last_membership: tuple = ()
+        self._last_membership: tuple | None = None  # None = never observed
+        self._below_min_since: float | None = None
 
     # ---- lifecycle ----
     def start(self):
-        self.registry.heartbeat(self.node_id)
+        # the first heartbeat may race a KV master that is still coming up
+        # on node 0 — retry for up to elastic_timeout before giving up
+        deadline = time.time() + self.elastic_timeout
+        while True:
+            try:
+                self.registry.heartbeat(self.node_id)
+                break
+            except Exception:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(self.interval)
 
         def beat():
             while not self._stop.wait(self.interval):
-                self.registry.heartbeat(self.node_id)
+                try:
+                    self.registry.heartbeat(self.node_id)
+                except Exception:
+                    pass
 
         self._thread = threading.Thread(target=beat, daemon=True)
         self._thread.start()
@@ -104,19 +236,47 @@ class ElasticManager:
     # ---- decisions (reference manager.py watch loop) ----
     def watch(self) -> ElasticStatus:
         alive = tuple(self.registry.alive_nodes())
-        changed = alive != self._last_membership and self._last_membership != ()
+        if self.node_id not in alive:
+            # our own heartbeat thread keeps us registered, so a read that
+            # lacks us is an unreliable/transient registry read (KV timeout
+            # returns []) — don't let it masquerade as a membership change
+            return ElasticStatus.HOLD
+        prev = self._last_membership
         self._last_membership = alive
         n = len(alive)
-        if n >= self.np and not changed:
-            return ElasticStatus.HOLD
+
         if n < self.min_np:
-            # not enough nodes: hold (fault-tolerance waits for rejoin)
-            return ElasticStatus.HOLD if self.level == ElasticLevel.FAULT_TOLERANCE \
-                else ElasticStatus.HOLD
-        if changed and self.min_np <= n <= self.max_np:
-            self.np = n
-            return ElasticStatus.RESTART  # relaunch with new world size
+            now = time.time()
+            if self._below_min_since is None:
+                self._below_min_since = now
+            if now - self._below_min_since >= self.elastic_timeout:
+                return ElasticStatus.ERROR
+            return ElasticStatus.HOLD
+        self._below_min_since = None
+
+        if prev is None:
+            # first observation: baseline, never a restart decision
+            if self.level == ElasticLevel.ELASTIC:
+                self.np = min(n, self.max_np)
+            return ElasticStatus.HOLD
+        changed = alive != prev
+        if self.level == ElasticLevel.FAULT_TOLERANCE:
+            # fixed world: membership back at np → restart if it had changed
+            if changed and n == self.np:
+                return ElasticStatus.RESTART
+            return ElasticStatus.HOLD
+        # ELASTIC: scale to current membership when it settles inside range
+        target = min(n, self.max_np)
+        if changed and target != self.np:
+            self.np = target
+            return ElasticStatus.RESTART
         return ElasticStatus.HOLD
 
     def world_hosts(self):
         return list(self._last_membership or self.registry.alive_nodes())
+
+    def rank_of(self, node_id: str | None = None) -> int:
+        """Stable node rank = index in the sorted alive membership."""
+        hosts = self.world_hosts()
+        nid = node_id or self.node_id
+        return hosts.index(nid) if nid in hosts else -1

@@ -2,7 +2,8 @@
 
 Reference: /root/reference/python/paddle/distributed/launch/main.py:23 +
 controllers/ (pod build, env contract PADDLE_TRAINER_ID/_ENDPOINTS/_MASTER,
-watch/restart loop, master KV server or etcd).
+watch/restart loop, master KV server or etcd) and fleet/elastic/ (etcd
+membership, scale decisions).
 
 TPU-native: on TPU pods there is ONE process per host (SPMD single-controller)
 and the rendezvous is JAX's coordination service — so the launcher's job is:
@@ -10,6 +11,12 @@ set the env contract, start the local trainer process(es), supervise
 (restart-on-failure, the reference's ControllerBase.watch), and on multi-host
 point everyone at the coordinator. CPU multi-process simulation (`--nproc`)
 spawns N local ranks for the multi-node-shaped tests (SURVEY.md §4).
+
+Elastic: `--nnodes MIN:MAX` (reference syntax) turns on membership watching
+via fleet.elastic — heartbeats over a shared dir (`--elastic_root`) or the
+HTTP KV master (`--elastic_server host:port`; node 0 with `--elastic_server
+auto` serves it in-process). On membership change inside [MIN, MAX] the pod
+is relaunched with the new world size; the per-rank env is recomputed.
 """
 from __future__ import annotations
 
@@ -27,7 +34,8 @@ def _parse(argv):
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
                    help="coordinator address host:port")
-    p.add_argument("--nnodes", type=int, default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--nnodes", default=os.environ.get("PADDLE_NNODES", "1"),
+                   help="node count N, or elastic range MIN:MAX")
     p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", "-1")))
     p.add_argument("--nproc_per_node", "--nproc", type=int, default=1,
                    help="local processes (1 on TPU hosts; N for CPU simulation)")
@@ -37,19 +45,35 @@ def _parse(argv):
                    help="restart budget on non-zero exit (elastic-lite)")
     p.add_argument("--run_mode", default="collective")
     p.add_argument("--job_id", default="default")
+    p.add_argument("--elastic_root", default="/tmp/paddle_tpu_elastic",
+                   help="shared dir for heartbeat files (FileRegistry)")
+    p.add_argument("--elastic_server", default=None,
+                   help="HTTP KV master host:port, or 'auto' (node 0 serves)")
+    p.add_argument("--elastic_timeout", type=float, default=120.0)
+    p.add_argument("--heartbeat_interval", type=float, default=2.0)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+
+    nn = str(args.nnodes)
+    if ":" in nn:
+        lo, _, hi = nn.partition(":")
+        args.min_nodes, args.max_nodes = int(lo), int(hi)
+        args.nnodes = args.max_nodes
+    else:
+        args.nnodes = int(nn)
+        args.min_nodes = args.max_nodes = args.nnodes
+    return args
 
 
-def _spawn(args, local_rank: int, world: int, base_rank: int):
+def _spawn(args, local_rank: int, world: int, base_rank: int, nnodes: int):
     env = dict(os.environ)
     rank = base_rank + local_rank
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
         "PADDLE_LOCAL_RANK": str(local_rank),
-        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_NNODES": str(nnodes),
         "PADDLE_JOB_ID": args.job_id,
     })
     if args.master:
@@ -72,44 +96,132 @@ def _spawn(args, local_rank: int, world: int, base_rank: int):
     return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
 
 
+def _make_elastic(args, node_id: str):
+    from ..fleet.elastic import (ElasticManager, FileRegistry, KVRegistry,
+                                 KVServer)
+
+    server = None
+    if args.elastic_server:
+        ep = args.elastic_server
+        if ep == "auto":
+            if (args.rank if args.rank >= 0 else 0) == 0:
+                server = KVServer(ttl=5 * args.heartbeat_interval).start()
+                host = (args.master or "127.0.0.1").partition(":")[0]
+                ep = f"{host}:{server.port}"
+                print(f"[launch] elastic KV master at {ep}", file=sys.stderr)
+            else:
+                raise SystemExit(
+                    "--elastic_server auto is only valid on node 0; pass the "
+                    "master's host:port on other nodes")
+        registry = KVRegistry(ep, ttl=5 * args.heartbeat_interval)
+    else:
+        registry = FileRegistry(args.elastic_root, args.job_id,
+                                ttl=5 * args.heartbeat_interval)
+    mgr = ElasticManager(
+        node_id, np=args.nnodes, min_np=args.min_nodes, max_np=args.max_nodes,
+        registry=registry, heartbeat_interval=args.heartbeat_interval,
+        elastic_timeout=args.elastic_timeout)
+    mgr.start()
+    return mgr, server
+
+
 def launch(argv=None):
+    import socket
+
     args = _parse(argv if argv is not None else sys.argv[1:])
     node_rank = args.rank if args.rank >= 0 else 0
-    world = args.nnodes * args.nproc_per_node
-    base = node_rank * args.nproc_per_node
+    elastic_on = args.min_nodes != args.max_nodes
+    # node identity must be unique per host even when --rank is omitted
+    # (a shared default would collapse elastic membership to one node)
+    node_id = os.environ.get("PADDLE_NODE_ID") or (
+        f"node-{args.rank}" if args.rank >= 0
+        else f"{socket.gethostname()}-{os.getpid()}")
 
+    mgr = server = None
+    if elastic_on:
+        from ..fleet.elastic import ElasticStatus
+        mgr, server = _make_elastic(args, node_id)
+
+    nnodes = args.nnodes
     restarts = 0
-    while True:
-        procs = [_spawn(args, i, world, base) for i in range(args.nproc_per_node)]
-
-        def kill_all(*_):
-            for p in procs:
-                if p.poll() is None:
-                    p.terminate()
-
-        signal.signal(signal.SIGTERM, kill_all)
-        # supervision loop (reference controller.py:87 watch)
-        failed = None
+    rc = 0
+    try:
         while True:
-            alive = 0
-            for p in procs:
-                rc = p.poll()
-                if rc is None:
-                    alive += 1
-                elif rc != 0 and failed is None:
-                    failed = rc
-            if failed is not None:
-                kill_all()
-                break
-            if alive == 0:
-                return 0
-            time.sleep(0.5)
-        if restarts < args.max_restarts:
-            restarts += 1
-            print(f"[launch] rank failed (exit {failed}); restart "
-                  f"{restarts}/{args.max_restarts}", file=sys.stderr)
-            continue
-        return failed or 1
+            if mgr is not None:
+                # wait until ≥ min_nodes members are up AND our own heartbeat
+                # is visible with an in-range rank; a node beyond max_np is a
+                # spare and stays in standby until membership changes
+                deadline = time.time() + args.elastic_timeout
+                while True:
+                    mgr.watch()
+                    nnodes = max(args.min_nodes, min(mgr.np, args.max_nodes))
+                    rank = mgr.rank_of(node_id)
+                    if len(mgr.world_hosts()) >= args.min_nodes \
+                            and 0 <= rank < nnodes:
+                        break
+                    if rank >= nnodes:
+                        deadline = time.time() + args.elastic_timeout  # spare
+                    if time.time() > deadline:
+                        print("[launch] elastic: not enough nodes (or own "
+                              "heartbeat never registered)", file=sys.stderr)
+                        return 1
+                    time.sleep(args.heartbeat_interval)
+                node_rank = rank
+            world = nnodes * args.nproc_per_node
+            base = node_rank * args.nproc_per_node
+            procs = [_spawn(args, i, world, base, nnodes)
+                     for i in range(args.nproc_per_node)]
+
+            def kill_all(*_):
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+
+            signal.signal(signal.SIGTERM, kill_all)
+            # supervision loop (reference controller.py:87 watch)
+            failed = None
+            decision = None
+            while True:
+                alive = 0
+                for p in procs:
+                    prc = p.poll()
+                    if prc is None:
+                        alive += 1
+                    elif prc != 0 and failed is None:
+                        failed = prc
+                if failed is not None:
+                    kill_all()
+                    break
+                if alive == 0:
+                    return 0
+                if mgr is not None:
+                    st = mgr.watch()
+                    if st is not None and st.value == "restart":
+                        decision = st
+                        print(f"[launch] elastic: membership changed → "
+                              f"relaunch at np={mgr.np}", file=sys.stderr)
+                        kill_all()
+                        break
+                    if st is not None and st.value == "error":
+                        print("[launch] elastic: below min_np past timeout",
+                              file=sys.stderr)
+                        kill_all()
+                        return 1
+                time.sleep(0.5)
+            if decision is not None:
+                nnodes = mgr.np
+                continue
+            if restarts < args.max_restarts:
+                restarts += 1
+                print(f"[launch] rank failed (exit {failed}); restart "
+                      f"{restarts}/{args.max_restarts}", file=sys.stderr)
+                continue
+            return failed or 1
+    finally:
+        if mgr is not None:
+            mgr.stop()
+        if server is not None:
+            server.stop()
 
 
 def main():

@@ -1,0 +1,257 @@
+"""Per-op SPMD sharding rules — the general custom-rule surface.
+
+Reference: the 113 per-op rule files under
+``/root/reference/paddle/phi/infermeta/spmd_rules/`` (registered via
+``spmd_rule_macro_define.h``), consumed by the generated dist branch
+(``phi/api/generator/dist_api_gen.py:49-201``): InferSpmd decides the
+placements each input must be reshard-ed to and the placements of outputs.
+
+TPU-native reinterpretation: XLA/GSPMD already *propagates* shardings through
+every op ("computation follows sharding"), so a rule here is a **layout
+override** for ops where propagation picks a poor layout or where the
+framework knows better (embedding, cross-entropy, flash-attention, rope —
+the ops the reference hand-writes rules for). A rule:
+
+* demands input placements (inputs are reshard-ed before dispatch, the
+  InferSpmd→reshard contract), and
+* declares output placements, enforced with ``lax.with_sharding_constraint``
+  under a trace or ``jax.device_put`` in eager, and recorded on the output
+  Tensor's ``_dist``.
+
+Rules fire inside ``core.engine.apply`` for any op whose dispatch ``name``
+has a registered rule and whose inputs include a DistTensor.
+
+User surface::
+
+    @dist.register_spmd_rule("my_op")
+    def my_rule(ctx):
+        # ctx.mesh, ctx.placements (list per tensor input, None if not dist),
+        # ctx.shapes (tuple per tensor input)
+        return SpmdDecision(inputs=[...], outputs=[...])
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["register_spmd_rule", "get_spmd_rule", "unregister_spmd_rule",
+           "SpmdContext", "SpmdDecision"]
+
+_RULES: dict = {}
+
+
+@dataclass
+class SpmdContext:
+    """What a rule sees: the mesh and, per tensor input, placements/shape."""
+    mesh: object
+    placements: List[Optional[list]]
+    shapes: List[Optional[tuple]]
+
+    def axis_of(self, input_idx: int, tensor_dim: int):
+        """Mesh axis name the given input dim is sharded on, else None."""
+        pl = self.placements[input_idx]
+        if pl is None:
+            return None
+        from .placement import Shard
+        for axis_idx, p in enumerate(pl):
+            if isinstance(p, Shard) and p.get_dim() == tensor_dim:
+                return self.mesh.dim_names[axis_idx]
+        return None
+
+
+@dataclass
+class SpmdDecision:
+    """inputs: per tensor input, demanded placements (None = leave as-is).
+    outputs: placements for every output leaf, or one list applied to all
+    (None = let GSPMD decide)."""
+    inputs: List[Optional[list]] = field(default_factory=list)
+    outputs: Optional[object] = None
+
+
+def register_spmd_rule(op_name: str, rule: Callable | None = None):
+    """Register ``rule(ctx: SpmdContext) -> SpmdDecision`` for an op name
+    (the ``name=`` the op passes to ``engine.apply``). Decorator-friendly."""
+    def deco(fn):
+        _RULES[op_name] = fn
+        return fn
+    if rule is not None:
+        return deco(rule)
+    return deco
+
+
+def unregister_spmd_rule(op_name: str):
+    _RULES.pop(op_name, None)
+
+
+def get_spmd_rule(op_name: str):
+    return _RULES.get(op_name)
+
+
+# ------------------------------------------------------------------ engine glue
+
+def apply_rule(rule, tensor_inputs, arrs):
+    """Engine-side: reshard inputs per the rule; return (new_arrs, posthook).
+
+    posthook(out_tree) enforces + records output placements. Returns
+    (arrs, None) when the rule abstains."""
+    import jax
+
+    from .placement import placements_to_spec, replicate_partials
+
+    mesh = None
+    for t in tensor_inputs:
+        if t is not None and getattr(t, "_dist", None) is not None:
+            mesh = t._dist[0]
+            break
+    if mesh is None:
+        return arrs, None
+
+    placements = []
+    shapes = []
+    tensor_slots = []  # indices into arrs that are tensor inputs
+    for i, t in enumerate(tensor_inputs):
+        if t is None:
+            continue
+        tensor_slots.append(i)
+        d = getattr(t, "_dist", None)
+        placements.append(None if d is None else list(d[1]))
+        shapes.append(tuple(t._value.shape))
+
+    ctx = SpmdContext(mesh=mesh, placements=placements, shapes=shapes)
+    decision = rule(ctx)
+    if decision is None:
+        return arrs, None
+
+    from .reshard import reshard_value
+
+    new_arrs = list(arrs)
+    for k, req in enumerate(decision.inputs or []):
+        if req is None or k >= len(tensor_slots):
+            continue
+        i = tensor_slots[k]
+        cur = placements[k]
+        if cur is not None and list(cur) != list(req):
+            new_arrs[i] = reshard_value(
+                tensor_inputs[i]._value, mesh, cur, replicate_partials(req))
+        elif cur is None:
+            # undistributed input joining a dist op: place it per the rule
+            spec = placements_to_spec(mesh, replicate_partials(req),
+                                      len(shapes[k]))
+            sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
+            v = tensor_inputs[i]._value
+            if isinstance(v, jax.core.Tracer):
+                new_arrs[i] = jax.lax.with_sharding_constraint(v, sharding)
+            else:
+                new_arrs[i] = jax.device_put(v, sharding)
+
+    out_pl = decision.outputs
+    if out_pl is None:
+        return new_arrs, None
+
+    def posthook(out_tree):
+        from ..core.tensor import Tensor
+
+        leaves = jax.tree.leaves(
+            out_tree, is_leaf=lambda x: isinstance(x, Tensor))
+        # out_pl is either one placement list (applied to all leaves) or a
+        # list of placement lists (one per leaf)
+        is_per_leaf = bool(out_pl) and isinstance(out_pl[0], (list, tuple))
+
+        def placement_for(idx):
+            if is_per_leaf:
+                return out_pl[idx] if idx < len(out_pl) else None
+            return out_pl
+
+        for idx, leaf in enumerate(leaves):
+            if not isinstance(leaf, Tensor):
+                continue
+            pl = placement_for(idx)
+            if pl is None:
+                continue
+            pl = list(pl)
+            spec = placements_to_spec(mesh, replicate_partials(pl),
+                                      leaf._value.ndim)
+            sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
+            if isinstance(leaf._value, jax.core.Tracer):
+                leaf._value = jax.lax.with_sharding_constraint(
+                    leaf._value, sharding)
+            else:
+                leaf._value = jax.device_put(leaf._value, sharding)
+            leaf._dist = (mesh, pl)
+        return out_tree
+
+    return new_arrs, posthook
+
+
+# ------------------------------------------------------------------ built-ins
+
+def _install_builtin_rules():
+    """The ops the reference hand-writes rules for (embedding.cc,
+    c_softmax_with_cross_entropy.cc, flash_attention.cc, fused_rope.cc)."""
+    from .placement import Replicate, Shard
+
+    @register_spmd_rule("embedding")
+    def _embedding_rule(ctx):
+        # inputs: (ids[..., ], weight[V, H])
+        if len(ctx.shapes) < 2:
+            return None
+        ids_shape, w_shape = ctx.shapes[0], ctx.shapes[1]
+        ids_pl, w_pl = ctx.placements[0], ctx.placements[1]
+        if w_pl is None:
+            return None
+        n_axes = len(ctx.mesh.shape)
+        out_ndim = len(ids_shape) + 1
+        out = [Replicate()] * n_axes
+        # ids batch shards propagate to the same output dims
+        if ids_pl is not None:
+            for ax, p in enumerate(ids_pl):
+                if isinstance(p, Shard):
+                    out[ax] = Shard(p.get_dim())
+        # weight hidden-dim shard (Megatron col-parallel) → out last dim
+        for ax, p in enumerate(w_pl):
+            if isinstance(p, Shard) and p.get_dim() == 1:
+                out[ax] = Shard(out_ndim - 1)
+            elif isinstance(p, Shard) and p.get_dim() == 0:
+                # vocab-parallel: table rows sharded; keep the gather local by
+                # replicating ids and let XLA all-reduce the masked lookup —
+                # output is global (engine reduces partials at dispatch)
+                out[ax] = Replicate()
+        return SpmdDecision(inputs=[None, None], outputs=[out])
+
+    @register_spmd_rule("softmax_with_cross_entropy")
+    def _ce_rule(ctx):
+        # logits [..., C]: class-dim shard stays (parallel CE handles it);
+        # loss output keeps only the batch shards
+        if not ctx.shapes:
+            return None
+        lg_pl = ctx.placements[0]
+        if lg_pl is None:
+            return None
+        n_axes = len(ctx.mesh.shape)
+        logits_ndim = len(ctx.shapes[0])
+        out = [Replicate()] * n_axes
+        for ax, p in enumerate(lg_pl):
+            if isinstance(p, Shard) and p.get_dim() < logits_ndim - 1:
+                out[ax] = Shard(p.get_dim())
+        return SpmdDecision(inputs=[], outputs=[out])
+
+    @register_spmd_rule("flash_attention")
+    def _flash_rule(ctx):
+        # q/k/v [B, T, H, D] (our ops/flash_attention layout): demand q's
+        # batch/head layout on k and v; output follows q
+        if len(ctx.shapes) < 3:
+            return None
+        q_pl = ctx.placements[0]
+        if q_pl is None:
+            return None
+        return SpmdDecision(inputs=[None, list(q_pl), list(q_pl)],
+                            outputs=[list(q_pl)])
+
+    @register_spmd_rule("rope")
+    def _rope_rule(ctx):
+        if not ctx.placements or ctx.placements[0] is None:
+            return None
+        return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
+
+
+_install_builtin_rules()

@@ -4,13 +4,24 @@ Reference: /root/reference/paddle/fluid/inference/ (AnalysisPredictor
 api/analysis_predictor.h:105, AnalysisConfig, pass pipeline, TensorRT).
 
 TPU-native: the "analysis + pass pipeline + engine" collapses into XLA AOT:
-a Predictor holds a jit-compiled (optionally jax.export-serialized) forward
-with donated IO where safe. TensorRT/ONNXRT subgraphs have no TPU analog —
-XLA is the engine.
+a Predictor holds a jit-compiled (optionally jax.export-serialized) forward.
+The AnalysisConfig knobs map to real XLA-side levers:
+
+* precision mode (``PrecisionType``): bf16 low-precision IO casts float
+  inputs/params; Int8 runs weight-only quantization
+  (``quantization.weight_only_quantize``) over the param tree — int8 lives
+  in HBM, dequant fuses into the consuming matmul.
+* ``enable_memory_optim`` → input buffer donation (donate_argnums).
+* ``set_optim_cache_dir`` → jax persistent compilation cache.
+* ``enable_profile`` → per-run wall-time stats (report via
+  ``Predictor.profile_report``).
+
+TensorRT/ONNXRT subgraph knobs have no TPU analog — XLA is the engine; they
+are accepted and recorded for API compatibility.
 """
 from __future__ import annotations
 
-import os
+import time
 from typing import Any
 
 import jax
@@ -19,12 +30,20 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
+           "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
 
 
 class Config:
-    """Reference AnalysisConfig surface (device/memory/ir knobs become XLA
-    compile options or no-ops)."""
+    """Reference AnalysisConfig surface; knobs that have a TPU meaning are
+    wired (see module docstring), the rest are recorded no-ops."""
 
     def __init__(self, model_path=None, params_path=None):
         self.model_path = model_path
@@ -32,28 +51,54 @@ class Config:
         self._device = "tpu"
         self._memory_pool_mb = 0
         self._enable_profile = False
+        self._precision = PrecisionType.Float32
+        self._memory_optim = False
+        self._cache_dir = None
+        self._ir_optim = True
 
     def set_model(self, model_path, params_path=None):
         self.model_path = model_path
         self.params_path = params_path
 
+    # ---- device ----
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         self._device = "tpu"  # accelerator place
+        self._memory_pool_mb = memory_pool_init_size_mb
 
     def disable_gpu(self):
         self._device = "cpu"
 
-    def enable_profile(self):
-        self._enable_profile = True
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    # ---- precision ----
+    def set_precision_mode(self, precision):
+        self._precision = precision
+
+    def enable_low_precision_io(self, flag=True):
+        if flag and self._precision == PrecisionType.Float32:
+            self._precision = PrecisionType.Bfloat16
+
+    def precision_mode(self):
+        return self._precision
+
+    # ---- memory / compile ----
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = bool(flag)
+
+    def set_optim_cache_dir(self, d):
+        self._cache_dir = d
+        jax.config.update("jax_compilation_cache_dir", d)
 
     def switch_ir_optim(self, flag=True):
-        pass  # XLA always optimizes
-
-    def enable_memory_optim(self):
-        pass
+        self._ir_optim = bool(flag)  # XLA always optimizes; recorded only
 
     def set_cpu_math_library_num_threads(self, n):
         pass
+
+    # ---- profiling ----
+    def enable_profile(self):
+        self._enable_profile = True
 
 
 class PredictorTensor:
@@ -62,27 +107,91 @@ class PredictorTensor:
     def __init__(self, name):
         self.name = name
         self._value = None
+        self._shape = None
 
     def reshape(self, shape):
-        pass
+        self._shape = tuple(shape)
 
     def copy_from_cpu(self, arr):
-        self._value = jnp.asarray(arr)
+        a = np.asarray(arr)
+        if self._shape is not None:
+            a = a.reshape(self._shape)
+        self._value = jnp.asarray(a)
 
     def copy_to_cpu(self):
         return np.asarray(self._value)
 
+    def shape(self):
+        v = self._value
+        return list(v.shape) if v is not None else list(self._shape or [])
+
+
+def _cast_tree(tree, dtype):
+    def c(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x, dtype)
+        return x
+    return jax.tree_util.tree_map(c, tree)
+
 
 class Predictor:
-    def __init__(self, config_or_fn, example_args=None, params=None):
+    def __init__(self, config_or_fn, example_args=None, params=None,
+                 config: Config | None = None):
+        self._config = config or (config_or_fn if isinstance(config_or_fn, Config)
+                                  else Config())
+        self._params = None
+        self._run_times: list = []
+        precision = self._config.precision_mode()
+
         if isinstance(config_or_fn, Config):
             from ..static import load_inference_model
             prog, feed_names, fn = load_inference_model(config_or_fn.model_path)
-            self._fn = fn
+            raw = fn
             self._input_names = feed_names
         else:
-            self._fn = jax.jit(config_or_fn)
+            raw = config_or_fn
             self._input_names = [f"x{i}" for i in range(len(example_args or []))]
+
+        if params is not None:
+            # functional convention: raw(params, *inputs)
+            if precision == PrecisionType.Int8:
+                from ..quantization import (weight_only_dequantize,
+                                            weight_only_quantize)
+                self._params = weight_only_quantize(params)
+                inner = raw
+
+                def raw(p, *args):  # noqa: F811 — dequant fuses under jit
+                    return inner(weight_only_dequantize(p), *args)
+            elif precision in (PrecisionType.Bfloat16, PrecisionType.Half):
+                self._params = _cast_tree(params, jnp.dtype(precision))
+            else:
+                self._params = params
+
+        io_dtype = (jnp.dtype(precision)
+                    if precision in (PrecisionType.Bfloat16, PrecisionType.Half)
+                    else None)
+        base = raw
+        has_params = self._params is not None
+
+        # params are a REAL jit argument (never closure-captured: closure
+        # capture would bake the weight tree into the executable as
+        # constants — and constant-fold int8 dequant back to dense floats)
+        def wrapped(p, *args):
+            if io_dtype is not None:
+                args = tuple(_cast_tree(a, io_dtype) for a in args)
+            if has_params:
+                return base(p, *args)
+            return base(*args)
+
+        self._fn = jax.jit(wrapped)
+        # donation of inputs is only safe for run(inputs) calls that build
+        # fresh device buffers; the persistent PredictorTensor handles would
+        # be invalidated after one donated run
+        self._fn_donating = (
+            jax.jit(wrapped,
+                    donate_argnums=tuple(range(1, 1 + len(example_args or []))))
+            if self._config._memory_optim else self._fn)
         self._inputs = {n: PredictorTensor(n) for n in self._input_names}
         self._outputs: list = []
 
@@ -105,12 +214,26 @@ class Predictor:
         if inputs is not None:
             args = [jnp.asarray(a.numpy() if isinstance(a, Tensor) else a)
                     for a in inputs]
+            fn = self._fn_donating
         else:
             args = [self._inputs[n]._value for n in self._input_names]
-        out = self._fn(*args)
+            fn = self._fn
+        t0 = time.perf_counter()
+        out = fn(self._params, *args)
         outs = out if isinstance(out, (tuple, list)) else [out]
         self._outputs = [o._value if isinstance(o, Tensor) else o for o in outs]
-        return [np.asarray(o) for o in self._outputs]
+        res = [np.asarray(o) for o in self._outputs]  # blocks → honest timing
+        if self._config._enable_profile:
+            self._run_times.append(time.perf_counter() - t0)
+        return res
+
+    def profile_report(self) -> dict:
+        ts = self._run_times
+        if not ts:
+            return {"runs": 0}
+        return {"runs": len(ts), "total_s": sum(ts),
+                "avg_ms": 1e3 * sum(ts) / len(ts),
+                "min_ms": 1e3 * min(ts), "max_ms": 1e3 * max(ts)}
 
 
 def create_predictor(config: Config) -> Predictor:

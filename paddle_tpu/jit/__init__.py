@@ -38,8 +38,9 @@ def enable_to_static(flag: bool):
 
 class StaticFunction:
     def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
-                 full_graph=True, donate_args=()):
+                 full_graph=False, donate_args=()):
         from ..nn import Layer
+        from . import dy2static
 
         self._layer = None
         if isinstance(function, Layer):
@@ -50,6 +51,11 @@ class StaticFunction:
             self._layer = getattr(function, "__self__", None) \
                 if isinstance(getattr(function, "__self__", None), Layer) else None
         self._input_spec = input_spec
+        self._full_graph = bool(full_graph)
+        self._eager_fn = self._fn
+        # AST control-flow capture (dy2static): if tensor → lax.cond, etc.
+        self._fn = dy2static.convert_to_static(self._fn)
+        self._broke = False
         functools.update_wrapper(self, self._fn)
 
         layer = self._layer
@@ -67,15 +73,28 @@ class StaticFunction:
         self._jitted = jax.jit(traced)
 
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled:
-            return self._fn(*args, **kwargs)
+        from . import dy2static
+
+        if not _to_static_enabled or self._broke:
+            return self._eager_fn(*args, **kwargs)
         if self._layer is not None:
             entries = self._layer.state_dict()
             values = {k: v._value for k, v in entries.items()}
         else:
             values = {}
         key = _rng.split_key()
-        return self._jitted(values, key, args, kwargs)
+        try:
+            return self._jitted(values, key, args, kwargs)
+        except dy2static.GRAPH_BREAK_ERRORS as e:
+            if self._full_graph:
+                raise
+            # SOT-style graph break: fall back to eager for this function
+            dy2static.logger.warning(
+                "to_static: graph break in %s (%s); falling back to eager",
+                getattr(self._eager_fn, "__qualname__", self._eager_fn),
+                type(e).__name__)
+            self._broke = True
+            return self._eager_fn(*args, **kwargs)
 
     @property
     def code(self):
@@ -87,7 +106,7 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
-              full_graph=True):
+              full_graph=False):
     """paddle.jit.to_static — decorator or call."""
 
     def decorate(fn):

@@ -1,0 +1,737 @@
+"""Dynamic-to-static control-flow capture for ``to_static``.
+
+Reference surface: ``paddle.jit.dy2static`` — the AST frontend
+(``/root/reference/python/paddle/jit/dy2static/program_translator.py:1751``,
+control-flow transformers under ``jit/dy2static/transformers/``) and the SOT
+bytecode frontend's graph-break fallback
+(``/root/reference/python/paddle/jit/sot/``).
+
+TPU-native design: instead of rewriting python into a PIR program with
+``cond``/``while`` *ops*, the transformer rewrites python control flow into
+calls to runtime converters that pick, per call, between
+
+* plain python execution (condition is a concrete value — eager mode, or a
+  trace-time constant), preserving exact python semantics, and
+* ``jax.lax.cond`` / ``jax.lax.while_loop`` when the condition is a tracer
+  (data-dependent under ``jax.jit``), which XLA compiles to device-side
+  control flow.
+
+Anything the transformer cannot express functionally (``break``/``continue``
+/``return`` inside a data-dependent branch, list mutation across a traced
+loop, ...) is intentionally left as original python; if such code trips on a
+tracer at trace time, ``StaticFunction`` performs a *graph break*: it logs
+once and re-runs the call eagerly (the SOT fallback behavior). With
+``full_graph=True`` the error is raised instead (the AST-frontend contract).
+
+The transformed function is cached per code object; ``converted_call``
+recursively transforms user helper functions at call time, mirroring the
+reference's ``_jst.Call`` convention.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import linecache
+import logging
+import textwrap
+import threading
+import types
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+logger = logging.getLogger("paddle_tpu.jit.dy2static")
+
+__all__ = [
+    "UNDEF", "Undefined", "Unsupported", "convert_to_static", "converted_call",
+    "convert_ifelse", "convert_while", "convert_for", "convert_and",
+    "convert_or", "convert_not", "make_range",
+]
+
+_JST_NAME = "__pt_jst__"  # name the runtime module is injected under
+
+
+class Unsupported(Exception):
+    """A construct the static frontend cannot capture (graph-break signal)."""
+
+
+class Undefined:
+    """Sentinel for names not yet bound (reference: dy2static UndefinedVar).
+
+    Registered as an *empty* pytree node so a branch/loop variable that stays
+    undefined on every path threads through ``lax.cond``/``while_loop``
+    without contributing leaves.
+    """
+    _singleton = None
+
+    def __new__(cls):
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+        return cls._singleton
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise Unsupported(
+            "a variable assigned only inside a conditional branch/loop body "
+            "was read while still undefined")
+
+
+jax.tree_util.register_pytree_node(
+    Undefined, lambda u: ((), None), lambda aux, ch: UNDEF)
+
+UNDEF = Undefined()
+
+
+# --------------------------------------------------------------------------
+# runtime value helpers
+# --------------------------------------------------------------------------
+
+def _unwrap(x):
+    from ..core.tensor import Tensor
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _any_tracer(tree) -> bool:
+    return any(isinstance(l, jax.core.Tracer)
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def _as_pred(x):
+    """Condition value → scalar bool jax value (tracer-safe)."""
+    v = _unwrap(x)
+    v = jnp.asarray(v)
+    if v.ndim != 0:
+        if v.size == 1:
+            v = v.reshape(())
+        else:
+            raise Unsupported(
+                f"condition tensor must have exactly one element, got shape "
+                f"{v.shape}")
+    if v.dtype != jnp.bool_:
+        v = v != 0
+    return v
+
+
+def _plain_bool(x) -> bool:
+    v = _unwrap(x)
+    if isinstance(v, jax.Array):
+        v = jnp.asarray(v)
+        if v.ndim != 0 and v.size != 1:
+            raise Unsupported(
+                f"condition tensor must have exactly one element, got shape "
+                f"{v.shape}")
+        return bool(v)
+    return bool(v)
+
+
+def _name_hint(names: Sequence[str]) -> str:
+    return ", ".join(names) if names else "<no variables>"
+
+
+# --------------------------------------------------------------------------
+# runtime converters (targets of the AST rewrite)
+# --------------------------------------------------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, args, names=()):
+    """``if pred: ... else: ...`` with branch-assigned variables ``names``.
+
+    Concrete pred → exact python semantics (only one branch runs).
+    Tracer pred → ``lax.cond`` (both branches traced, device-side select).
+    """
+    if not _is_tracer(pred):
+        if _plain_bool(pred):
+            return true_fn(*args)
+        return false_fn(*args)
+    p = _as_pred(pred)
+    try:
+        return lax.cond(p,
+                        lambda ops: true_fn(*ops),
+                        lambda ops: false_fn(*ops),
+                        tuple(args))
+    except TypeError as e:
+        raise Unsupported(
+            f"traced `if` branches must produce matching values for "
+            f"[{_name_hint(names)}]; a variable is probably assigned in only "
+            f"one branch or with different shapes/dtypes ({e})") from e
+
+
+def convert_while(cond_fn, body_fn, init, names=()):
+    """``while cond: body`` over loop-carried variables ``names``."""
+    init = tuple(init)
+    c0 = cond_fn(*init)
+    if not _is_tracer(c0) and not _any_tracer(init):
+        # pure python loop (eager, or trace-time-static → unrolled)
+        vars_ = init
+        c = c0
+        while _plain_bool(c):
+            vars_ = tuple(body_fn(*vars_))
+            c = cond_fn(*vars_)
+        return vars_
+    try:
+        return lax.while_loop(
+            lambda vs: _as_pred(cond_fn(*vs)),
+            lambda vs: tuple(body_fn(*vs)),
+            init)
+    except TypeError as e:
+        raise Unsupported(
+            f"traced `while` loop variables [{_name_hint(names)}] must keep "
+            f"stable structure/shape/dtype across iterations ({e})") from e
+
+
+class _TracedRange:
+    """range() whose bounds are tracers (data-dependent trip count)."""
+
+    def __init__(self, start, stop, step):
+        self.start, self.stop, self.step = start, stop, step
+
+
+def make_range(*args):
+    """range() in a `for` iterator position; tolerates tracer bounds."""
+    vals = [_unwrap(a) for a in args]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        if len(vals) == 1:
+            start, stop, step = 0, vals[0], 1
+        elif len(vals) == 2:
+            start, stop, step = vals[0], vals[1], 1
+        else:
+            start, stop, step = vals
+        return _TracedRange(start, stop, step)
+    return range(*[int(v) for v in vals])
+
+
+def convert_for(iterable, body_fn, init, names=()):
+    """``for TARGET in iterable: body``.
+
+    ``body_fn(target_value, *vars) -> vars``. Returns ``(vars, last_target)``.
+
+    Traced paths: tensor iterables with tracer state → ``lax.while_loop``
+    over row indices; ``_TracedRange`` → counting ``while_loop``. Everything
+    else runs the exact python loop (static unroll under trace).
+    """
+    init = tuple(init)
+    from ..core.tensor import Tensor
+
+    if isinstance(iterable, _TracedRange):
+        start = jnp.asarray(iterable.start)
+        stop = jnp.asarray(iterable.stop)
+        step = jnp.asarray(iterable.step)
+
+        def cond(state):
+            i, _, _ = state
+            return jnp.where(step > 0, i < stop, i > stop)
+
+        def body(state):
+            i, _, vars_ = state
+            return (i + step, i, tuple(body_fn(i, *vars_)))
+
+        try:
+            _, last, vars_ = lax.while_loop(cond, body, (start, start, init))
+        except TypeError as e:
+            raise Unsupported(
+                f"traced `for` loop variables [{_name_hint(names)}] must keep "
+                f"stable structure/shape/dtype across iterations ({e})") from e
+        return vars_, last
+
+    arr = _unwrap(iterable)
+    if isinstance(arr, (jax.Array, jax.core.Tracer)) and hasattr(arr, "shape"):
+        if arr.ndim == 0:
+            raise Unsupported("cannot iterate over a 0-d tensor")
+        n = arr.shape[0]
+        wrap = (lambda v: Tensor(v)) if isinstance(iterable, Tensor) else (lambda v: v)
+        if isinstance(arr, jax.core.Tracer) or _any_tracer(init):
+            if n == 0:
+                return init, UNDEF
+
+            def cond(state):
+                i, _, _ = state
+                return i < n
+
+            def body(state):
+                i, _, vars_ = state
+                t = wrap(arr[i])
+                return (i + 1, arr[i], tuple(body_fn(t, *vars_)))
+
+            try:
+                _, last, vars_ = lax.while_loop(
+                    cond, body, (jnp.asarray(0), arr[0], init))
+            except TypeError as e:
+                raise Unsupported(
+                    f"traced `for` loop variables [{_name_hint(names)}] must "
+                    f"keep stable structure/shape/dtype across iterations "
+                    f"({e})") from e
+            return vars_, wrap(last)
+        # concrete tensor, concrete state: plain python iteration
+        last = UNDEF
+        vars_ = init
+        for i in range(n):
+            t = wrap(arr[i])
+            vars_ = tuple(body_fn(t, *vars_))
+            last = t
+        return vars_, last
+
+    # generic python iterable — exact python semantics (unrolls under trace)
+    last = UNDEF
+    vars_ = init
+    for t in iterable:
+        vars_ = tuple(body_fn(t, *vars_))
+        last = t
+    return vars_, last
+
+
+def convert_and(*thunks):
+    """``a and b [and c ...]`` with python value semantics off-trace."""
+    val = thunks[0]()
+    for thunk in thunks[1:]:
+        if _is_tracer(val):
+            val = jnp.logical_and(_as_pred(val), _as_pred(thunk()))
+        else:
+            if not _plain_bool(val):
+                return val
+            val = thunk()
+    return val
+
+
+def convert_or(*thunks):
+    val = thunks[0]()
+    for thunk in thunks[1:]:
+        if _is_tracer(val):
+            val = jnp.logical_or(_as_pred(val), _as_pred(thunk()))
+        else:
+            if _plain_bool(val):
+                return val
+            val = thunk()
+    return val
+
+
+def convert_not(x):
+    if _is_tracer(x):
+        return jnp.logical_not(_as_pred(x))
+    return not _plain_bool(x)
+
+
+# --------------------------------------------------------------------------
+# AST analysis helpers
+# --------------------------------------------------------------------------
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef, ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                   ast.DictComp)
+
+
+def _assigned_names(nodes) -> list:
+    """Names bound (Store/Del) anywhere in `nodes`, excluding nested scopes
+    and the transformer's own generated ``__pt_*`` helpers."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and not node.id.startswith("__pt_") and node.id not in out:
+                out.append(node.id)
+
+        def visit_FunctionDef(self, node):
+            if not node.name.startswith("__pt_") and node.name not in out:
+                out.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        visit_GeneratorExp = visit_Lambda
+        visit_ListComp = visit_Lambda
+        visit_SetComp = visit_Lambda
+        visit_DictComp = visit_Lambda
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return out
+
+
+def _contains(nodes, kinds, stop_at_loops=False) -> bool:
+    """Whether `kinds` statements appear in `nodes` (same function scope;
+    optionally not descending into nested loops, for break/continue)."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def generic_visit(self, node):
+            nonlocal found
+            if isinstance(node, kinds):
+                found = True
+                return
+            if isinstance(node, _SCOPE_BARRIERS):
+                return
+            if stop_at_loops and isinstance(node, (ast.For, ast.While)):
+                return  # break/continue inside a nested loop bind to that loop
+            super().generic_visit(node)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return found
+
+
+def _target_names(target) -> list:
+    out = []
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            if n.id not in out:
+                out.append(n.id)
+    return out
+
+
+def _tuple_src(names) -> str:
+    if not names:
+        return "()"
+    return "(" + ", ".join(names) + ",)"
+
+
+# --------------------------------------------------------------------------
+# the transformer
+# --------------------------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+        self._scope_names = []  # stack of per-function control-flow names
+
+    def _uid(self) -> int:
+        self._n += 1
+        return self._n
+
+    def _note_names(self, names):
+        if self._scope_names:
+            for n in names:
+                if n not in self._scope_names[-1][0]:
+                    self._scope_names[-1][0].append(n)
+
+    # ---- scopes ----
+    def visit_FunctionDef(self, node):
+        params = set()
+        a = node.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            params.add(arg.arg)
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+        self._scope_names.append(([], params))
+        node = self.generic_visit(node)
+        names, params = self._scope_names.pop()
+        inits = [n for n in names if n not in params]
+        if inits:
+            init_stmts = ast.parse(
+                "\n".join(f"{n} = {_JST_NAME}.UNDEF" for n in inits)).body
+            # keep a docstring (if any) first
+            idx = 0
+            if (node.body and isinstance(node.body[0], ast.Expr)
+                    and isinstance(node.body[0].value, ast.Constant)
+                    and isinstance(node.body[0].value.value, str)):
+                idx = 1
+            node.body[idx:idx] = init_stmts
+        return node
+
+    def visit_AsyncFunctionDef(self, node):  # untouched
+        return node
+
+    def visit_ClassDef(self, node):  # untouched
+        return node
+
+    # ---- expressions ----
+    def visit_BoolOp(self, node):
+        node = self.generic_visit(node)
+        fn = "convert_and" if isinstance(node.op, ast.And) else "convert_or"
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST_NAME, ctx=ast.Load()),
+                               attr=fn, ctx=ast.Load()),
+            args=[ast.Lambda(args=ast.arguments(
+                posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                kw_defaults=[], kwarg=None, defaults=[]), body=v)
+                for v in node.values],
+            keywords=[])
+        return ast.copy_location(call, node)
+
+    def visit_UnaryOp(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            call = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST_NAME, ctx=ast.Load()),
+                                   attr="convert_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+            return ast.copy_location(call, node)
+        return node
+
+    def visit_Call(self, node):
+        node = self.generic_visit(node)
+        f = node.func
+        # leave our own runtime calls and super() alone
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == _JST_NAME:
+            return node
+        if isinstance(f, ast.Name) and f.id in ("super", "locals", "globals",
+                                                "eval", "exec", "range"):
+            return node
+        wrapped = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST_NAME, ctx=ast.Load()),
+                               attr="converted_call", ctx=ast.Load()),
+            args=[f], keywords=[])
+        node.func = ast.copy_location(wrapped, f)
+        return node
+
+    # ---- statements ----
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        blk = node.body + node.orelse
+        if _contains(blk, (ast.Return, ast.Break, ast.Continue, ast.Global,
+                           ast.Nonlocal)):
+            return node  # python fallback (graph break if pred is a tracer)
+        names = _assigned_names(blk)
+        self._note_names(names)
+        uid = self._uid()
+        tf, ff, tmp = f"__pt_true_{uid}", f"__pt_false_{uid}", f"__pt_tmp_{uid}"
+        argstr = ", ".join(names)
+        tpl = (f"def {tf}({argstr}):\n    pass\n"
+               f"def {ff}({argstr}):\n    pass\n"
+               f"{tmp} = {_JST_NAME}.convert_ifelse(None, {tf}, {ff}, "
+               f"{_tuple_src(names)}, {tuple(names)!r})\n")
+        if names:
+            tpl += f"{_tuple_src(names)} = {tmp}\n"
+        stmts = ast.parse(tpl).body
+        ret = ast.parse(f"return {_tuple_src(names)}").body[0]
+        stmts[0].body = (node.body or [ast.Pass()]) + [ret]
+        stmts[1].body = (node.orelse or [ast.Pass()]) + [
+            ast.parse(f"return {_tuple_src(names)}").body[0]]
+        stmts[2].value.args[0] = node.test
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse or _contains(
+                node.body, (ast.Return, ast.Global, ast.Nonlocal)) or _contains(
+                node.body, (ast.Break, ast.Continue), stop_at_loops=True):
+            return node
+        names = _assigned_names(node.body)
+        self._note_names(names)
+        uid = self._uid()
+        cf, bf = f"__pt_cond_{uid}", f"__pt_body_{uid}"
+        argstr = ", ".join(names)
+        tpl = (f"def {cf}({argstr}):\n    return None\n"
+               f"def {bf}({argstr}):\n    pass\n"
+               f"__pt_tmp_{uid} = {_JST_NAME}.convert_while({cf}, {bf}, "
+               f"{_tuple_src(names)}, {tuple(names)!r})\n")
+        if names:
+            tpl += f"{_tuple_src(names)} = __pt_tmp_{uid}\n"
+        stmts = ast.parse(tpl).body
+        stmts[0].body[0].value = node.test
+        stmts[1].body = node.body + [
+            ast.parse(f"return {_tuple_src(names)}").body[0]]
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
+
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        if node.orelse or _contains(
+                node.body, (ast.Return, ast.Global, ast.Nonlocal)) or _contains(
+                node.body, (ast.Break, ast.Continue), stop_at_loops=True):
+            return node
+        names = [n for n in _assigned_names(node.body)
+                 if n not in _target_names(node.target)]
+        self._note_names(names)
+        self._note_names(_target_names(node.target))
+        uid = self._uid()
+        bf, it, tmp = f"__pt_body_{uid}", f"__pt_it_{uid}", f"__pt_tmp_{uid}"
+        argstr = ", ".join([it] + names)
+        iter_node = node.iter
+        if (isinstance(iter_node, ast.Call) and isinstance(iter_node.func, ast.Name)
+                and iter_node.func.id == "range"):
+            iter_node = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST_NAME, ctx=ast.Load()),
+                                   attr="make_range", ctx=ast.Load()),
+                args=iter_node.args, keywords=[])
+            ast.copy_location(iter_node, node.iter)
+        tpl = (f"def {bf}({argstr}):\n    pass\n"
+               f"{tmp} = {_JST_NAME}.convert_for(None, {bf}, "
+               f"{_tuple_src(names)}, {tuple(names)!r})\n")
+        if names:
+            tpl += f"{_tuple_src(names)} = {tmp}[0]\n"
+        stmts = ast.parse(tpl).body
+        assign_target = ast.Assign(
+            targets=[node.target],
+            value=ast.parse(f"{tmp}[1]").body[0].value)
+        target_bind = ast.Assign(
+            targets=[node.target],
+            value=ast.Name(id=it, ctx=ast.Load()))
+        stmts[0].body = [target_bind] + node.body + [
+            ast.parse(f"return {_tuple_src(names)}").body[0]]
+        stmts[1].value.args[0] = iter_node
+        stmts.append(assign_target)
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
+
+
+# --------------------------------------------------------------------------
+# function transformation + call conversion
+# --------------------------------------------------------------------------
+
+_TRANSFORM_CACHE: dict = {}
+_CACHE_LOCK = threading.Lock()
+
+# modules whose functions are never transformed when reached via converted_call
+_SKIP_ROOTS = frozenset({
+    "jax", "jaxlib", "numpy", "np", "builtins", "paddle_tpu", "flax", "optax",
+    "orbax", "chex", "einops", "torch", "functools", "itertools", "operator",
+    "math", "os", "sys", "typing", "collections", "threading", "logging",
+})
+
+
+def _transform_function(fn: types.FunctionType):
+    """AST-transform a plain python function; returns fn unchanged if the
+    source is unavailable or the construct is out of scope."""
+    code = fn.__code__
+    with _CACHE_LOCK:
+        if code in _TRANSFORM_CACHE:
+            cached = _TRANSFORM_CACHE[code]
+            return cached if cached is not None else fn
+    result = None
+    try:
+        if "__class__" in code.co_freevars:
+            raise Unsupported("zero-arg super() needs the original closure")
+        if code.co_flags & (inspect.CO_GENERATOR | inspect.CO_COROUTINE
+                            | inspect.CO_ASYNC_GENERATOR):
+            raise Unsupported("generators/coroutines are not captured")
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef,)):
+            raise Unsupported("not a plain function definition")
+        fdef.decorator_list = []
+        fdef = _ControlFlowTransformer().visit(fdef)
+        ast.fix_missing_locations(fdef)
+
+        freevars = list(code.co_freevars)
+        fname = fdef.name
+        module = ast.Module(body=[fdef], type_ignores=[])
+        if freevars:
+            outer = ast.parse(
+                f"def __pt_outer__({', '.join(freevars)}):\n"
+                f"    return None\n").body[0]
+            outer.body = [fdef, ast.parse(f"return {fname}").body[0]]
+            module = ast.Module(body=[outer], type_ignores=[])
+        ast.fix_missing_locations(module)
+
+        filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
+        compiled = compile(module, filename, "exec")
+        # make tracebacks/`inspect.getsource` work for the transformed code
+        try:
+            linecache.cache[filename] = (
+                len(ast.unparse(module)), None,
+                ast.unparse(module).splitlines(True), filename)
+        except Exception:
+            pass
+        glb = fn.__globals__
+        glb.setdefault(_JST_NAME, _runtime_module())
+        loc: dict = {}
+        exec(compiled, glb, loc)
+        if freevars:
+            cells = [c.cell_contents for c in fn.__closure__]
+            new_fn = loc["__pt_outer__"](*cells)
+        else:
+            new_fn = loc[fname]
+        new_fn.__defaults__ = fn.__defaults__
+        new_fn.__kwdefaults__ = fn.__kwdefaults__
+        new_fn.__name__ = fn.__name__
+        new_fn.__qualname__ = fn.__qualname__
+        new_fn.__module__ = fn.__module__
+        new_fn.__dict__.update(fn.__dict__)
+        result = new_fn
+    except (OSError, TypeError, SyntaxError, Unsupported) as e:
+        logger.debug("dy2static: leaving %s untransformed (%s)",
+                     getattr(fn, "__qualname__", fn), e)
+        result = None
+    with _CACHE_LOCK:
+        _TRANSFORM_CACHE[code] = result
+    return result if result is not None else fn
+
+
+_runtime = None
+
+
+def _runtime_module():
+    """The namespace injected as __pt_jst__ into user globals."""
+    global _runtime
+    if _runtime is None:
+        ns = types.SimpleNamespace(
+            UNDEF=UNDEF,
+            convert_ifelse=convert_ifelse,
+            convert_while=convert_while,
+            convert_for=convert_for,
+            convert_and=convert_and,
+            convert_or=convert_or,
+            convert_not=convert_not,
+            make_range=make_range,
+            converted_call=converted_call,
+        )
+        _runtime = ns
+    return _runtime
+
+
+def converted_call(f):
+    """Recursively capture user helper functions (reference: _jst.Call)."""
+    try:
+        target = f
+        bound_self = None
+        if isinstance(f, types.MethodType):
+            target = f.__func__
+            bound_self = f.__self__
+        if not isinstance(target, types.FunctionType):
+            return f
+        mod = (getattr(target, "__module__", "") or "").split(".")[0]
+        if mod in _SKIP_ROOTS:
+            return f
+        new = _transform_function(target)
+        if new is target:
+            return f
+        if bound_self is not None:
+            return types.MethodType(new, bound_self)
+        return new
+    except Exception:
+        return f
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Entry used by StaticFunction: transform a function or bound method."""
+    if isinstance(fn, types.MethodType):
+        new = _transform_function(fn.__func__)
+        if new is fn.__func__:
+            return fn
+        return types.MethodType(new, fn.__self__)
+    if isinstance(fn, types.FunctionType):
+        return _transform_function(fn)
+    return fn
+
+
+# errors that signal "this code needed python control flow on a tracer"
+GRAPH_BREAK_ERRORS = tuple(
+    [Unsupported] + [
+        getattr(jax.errors, n) for n in (
+            "TracerBoolConversionError", "TracerArrayConversionError",
+            "TracerIntegerConversionError", "ConcretizationTypeError")
+        if hasattr(jax.errors, n)])

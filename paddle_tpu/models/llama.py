@@ -323,6 +323,11 @@ def _decoder_layer(x, lp, config, mesh, positions):
     q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
     q = cst(q, "bthd")  # heads sharded on tp (attention region: seq gathered)
     att = _attention(q, k, v, c)
+    # named residual hook for save_only_these_names remat experiments; the
+    # default policy (dots_saveable, see remat_policy) does NOT save it —
+    # saving measured slower on v5e than recomputing the flash kernel
+    from jax.ad_checkpoint import checkpoint_name
+    att = checkpoint_name(att, "flash_out")
     x = x + (att.reshape(B, T, -1) @ lp["wo"])
     x = cst(x, "btd_seq")
 
@@ -337,9 +342,21 @@ def _decoder_layer(x, lp, config, mesh, positions):
     return x, jnp.zeros((), jnp.float32)
 
 
+def remat_policy():
+    """Selective rematerialisation policy for the decoder scan: save matmul
+    outputs, recompute the cheap elementwise rest. Measured on v5e (850M,
+    seq 2048, bf16): 491ms/step vs 533ms full remat (~8%); also saving the
+    named 'flash_out' residual measured *slower* (527ms — the extra VMEM/HBM
+    pressure outweighs skipping the flash recompute), so it is not saved."""
+    return jax.checkpoint_policies.dots_saveable
+
+
 def llama_trunk(x, stacked_layer_params, config, mesh=None, positions=None,
                 remat=True):
-    """Scan the decoder stack over layer-stacked params."""
+    """Scan the decoder stack over layer-stacked params.
+
+    remat: False | True (selective policy) | "full" (save nothing — the
+    lowest-memory schedule, the pre-tuning behavior)."""
     if positions is None:
         positions = jnp.arange(x.shape[1])[None, :].astype(jnp.int32)
         positions = jnp.broadcast_to(positions, (x.shape[0], x.shape[1]))
@@ -348,7 +365,12 @@ def llama_trunk(x, stacked_layer_params, config, mesh=None, positions=None,
         y, aux = _decoder_layer(carry, lp, config, mesh, positions)
         return y, aux
 
-    fn = jax.checkpoint(body) if remat else body
+    if not remat:
+        fn = body
+    elif remat == "full":
+        fn = jax.checkpoint(body)
+    else:
+        fn = jax.checkpoint(body, policy=remat_policy())
     x, auxes = jax.lax.scan(fn, x, stacked_layer_params)
     return x, jnp.sum(auxes)
 
@@ -372,7 +394,11 @@ def llama_forward(params, tokens, config: LlamaConfig, mesh=None, remat=True):
     head = other.get("lm_head")
     if head is None:
         head = other["embed_tokens"].T
-    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    # bf16 operands + f32 accumulation: runs at bf16 MXU rate (an f32 lm-head
+    # GEMM is 2-4x slower on TPU) while keeping f32 logits for the softmax
+    logits = jax.lax.dot_general(
+        x, head.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     return logits, aux
 
 

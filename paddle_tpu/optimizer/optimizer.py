@@ -122,12 +122,16 @@ class Optimizer:
                 g32 = g32 + self._weight_decay * work
             new_work, new_st = self._update_one(work, g32, st, lr, step)
             if self._weight_decay and self._decoupled_decay():
-                new_work = new_work - lr * self._weight_decay * work
+                # keep the work dtype: `lr` is a traced f32 scalar and would
+                # silently promote bf16 params to f32 (breaking the bf16
+                # activation carry on the NEXT step's retrace)
+                new_work = (new_work -
+                            (lr * self._weight_decay * work).astype(work.dtype))
             if master is not None:
                 new_st = dict(new_st)
                 new_st["master"] = new_work
                 return new_work.astype(p.dtype), new_st
-            return new_work, new_st
+            return new_work.astype(p.dtype), new_st
 
         flat_p, treedef = jax.tree.flatten(params)
         flat_g = treedef.flatten_up_to(grads)

@@ -12,7 +12,8 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "quanter", "FakeQuanterWithAbsMax",
-           "AbsmaxObserver", "fake_quant"]
+           "AbsmaxObserver", "fake_quant", "QuantizedWeight",
+           "weight_only_quantize", "weight_only_dequantize"]
 
 
 def fake_quant(x, scale, bits=8):
@@ -25,6 +26,61 @@ def fake_quant(x, scale, bits=8):
         return a + jax.lax.stop_gradient(q - a)
 
     return apply(f, x, scale, name="fake_quant")
+
+
+class QuantizedWeight:
+    """int8 weight + per-output-channel scale (weight-only quantization).
+
+    Registered as a pytree so quantized param trees flow through jit; the
+    int8 buffer is what lives in HBM — dequantize fuses into the consumer
+    matmul on TPU (reference deployment analog: the int8 path of
+    fluid/inference + quantization passes)."""
+
+    def __init__(self, int8, scale, orig_dtype="float32"):
+        self.int8 = int8
+        self.scale = scale
+        self.orig_dtype = orig_dtype
+
+    def dequantize(self):
+        return self.int8.astype(jnp.dtype(self.orig_dtype)) * self.scale
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight,
+    lambda q: ((q.int8, q.scale), q.orig_dtype),
+    lambda aux, ch: QuantizedWeight(ch[0], ch[1], aux))
+
+
+def weight_only_quantize(params, bits: int = 8, min_elems: int = 1024):
+    """Quantize every float matrix (ndim>=2, >= min_elems) in a param pytree
+    to int8 + per-output-channel scales; other leaves pass through."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def q(leaf):
+        v = leaf._value if isinstance(leaf, Tensor) else leaf
+        if not isinstance(v, jax.Array) and not hasattr(v, "dtype"):
+            return leaf
+        v = jnp.asarray(v)
+        if v.ndim < 2 or v.size < min_elems or not jnp.issubdtype(
+                v.dtype, jnp.floating):
+            return leaf
+        # per-output-channel (last dim) absmax scale
+        absmax = jnp.max(jnp.abs(v), axis=tuple(range(v.ndim - 1)),
+                         keepdims=True)
+        scale = jnp.maximum(absmax, 1e-8) / qmax
+        int8 = jnp.clip(jnp.round(v / scale), -qmax, qmax).astype(jnp.int8)
+        return QuantizedWeight(int8, scale.astype(v.dtype), str(v.dtype))
+
+    return jax.tree_util.tree_map(
+        q, params, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def weight_only_dequantize(params):
+    """Inverse: QuantizedWeight leaves → dense float arrays (inside jit the
+    dequant fuses into consumers; int8 stays the stored representation)."""
+    return jax.tree_util.tree_map(
+        lambda l: l.dequantize() if isinstance(l, QuantizedWeight) else l,
+        params, is_leaf=lambda x: isinstance(x, (QuantizedWeight, Tensor)))
 
 
 class AbsmaxObserver:

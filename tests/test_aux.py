@@ -232,6 +232,80 @@ class TestSaveLoad:
 
 
 class TestElastic:
+    def test_kv_server_registry(self):
+        from paddle_tpu.distributed.fleet.elastic import KVRegistry, KVServer
+        srv = KVServer(ttl=5.0).start()
+        try:
+            reg = KVRegistry(f"127.0.0.1:{srv.port}", ttl=5.0)
+            reg.heartbeat("nodeA", {"slots": 4})
+            reg.heartbeat("nodeB")
+            assert reg.alive_nodes() == ["nodeA", "nodeB"]
+            reg.leave("nodeA")
+            assert reg.alive_nodes() == ["nodeB"]
+        finally:
+            srv.stop()
+
+    def test_kv_server_ttl_expiry(self):
+        from paddle_tpu.distributed.fleet.elastic import KVRegistry, KVServer
+        srv = KVServer(ttl=0.2).start()
+        try:
+            reg = KVRegistry(f"127.0.0.1:{srv.port}", ttl=0.2)
+            reg.heartbeat("ghost")
+            assert reg.alive_nodes() == ["ghost"]
+            import time
+            time.sleep(0.4)
+            assert reg.alive_nodes() == []
+        finally:
+            srv.stop()
+
+    def test_scale_up_down_decisions(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus,
+                                                          FileRegistry)
+        reg = FileRegistry(str(tmp_path), "scalejob", ttl=30.0)
+        reg.heartbeat("node0")
+        reg.heartbeat("node1")
+        m = ElasticManager("node0", np=2, min_np=1, max_np=4, registry=reg,
+                           heartbeat_interval=0.1)
+        assert m.watch() is ElasticStatus.HOLD  # baseline at np=2
+        assert m.np == 2
+        # scale up: two more nodes join
+        reg.heartbeat("node2")
+        reg.heartbeat("node3")
+        assert m.watch() is ElasticStatus.RESTART
+        assert m.np == 4
+        assert m.rank_of("node2") == 2
+        # scale down: two leave
+        reg.leave("node2")
+        reg.leave("node3")
+        assert m.watch() is ElasticStatus.RESTART
+        assert m.np == 2
+        # cap at max_np: a 5th node beyond max joins others
+        for nid in ("node2", "node3", "node4"):
+            reg.heartbeat(nid)
+        m.watch()
+        assert m.np == 4
+
+    def test_below_min_times_out_to_error(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus,
+                                                          FileRegistry)
+        reg = FileRegistry(str(tmp_path), "minjob", ttl=30.0)
+        reg.heartbeat("node0")
+        m = ElasticManager("node0", np=2, min_np=2, max_np=4, registry=reg,
+                           heartbeat_interval=0.1, elastic_timeout=0.2)
+        assert m.watch() is ElasticStatus.HOLD  # below min: wait for rejoin
+        import time
+        time.sleep(0.3)
+        assert m.watch() is ElasticStatus.ERROR
+
+    def test_launcher_elastic_range_parsing(self):
+        from paddle_tpu.distributed.launch.main import _parse
+        a = _parse(["--nnodes", "2:4", "dummy.py"])
+        assert (a.min_nodes, a.max_nodes, a.nnodes) == (2, 4, 4)
+        b = _parse(["--nnodes", "3", "dummy.py"])
+        assert (b.min_nodes, b.max_nodes, b.nnodes) == (3, 3, 3)
+
     def test_membership_and_scale(self, tmp_path):
         from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
                                                           ElasticStatus,

@@ -1,0 +1,234 @@
+"""dy2static control-flow capture tests.
+
+Reference test model: test/dygraph_to_static/ (ifelse/while/for suites) —
+python control flow on tensors must survive to_static, with graph-break
+fallback where capture is impossible (SOT behavior).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import dy2static, to_static
+
+
+def t(x, dtype="float32"):
+    return pt.to_tensor(np.asarray(x, dtype=dtype))
+
+
+# ---------------------------------------------------------------- if / else
+
+def branchy(x):
+    if x.sum() > 0:
+        y = x * 2.0
+    else:
+        y = x - 1.0
+    return y
+
+
+def test_if_on_tensor_traced():
+    f = to_static(branchy, full_graph=True)
+    for v in ([1.0, 2.0], [-5.0, 1.0]):
+        got = f(t(v))
+        want = branchy(t(v))
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6)
+
+
+def test_if_eager_semantics_preserved():
+    g = dy2static.convert_to_static(branchy)
+    np.testing.assert_allclose(
+        g(t([3.0])).numpy(), branchy(t([3.0])).numpy())
+    np.testing.assert_allclose(
+        g(t([-3.0])).numpy(), branchy(t([-3.0])).numpy())
+
+
+def test_if_single_branch_var_errors_full_graph():
+    def bad(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        return y  # noqa: F821 — defined on one path only
+
+    f = to_static(bad, full_graph=True)
+    with pytest.raises(Exception):
+        f(t([1.0, 2.0]))
+
+
+def test_elif_chain():
+    def f(x):
+        if x.sum() > 10.0:
+            y = x * 3.0
+        elif x.sum() > 0.0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y
+
+    sf = to_static(f, full_graph=True)
+    for v in ([20.0], [1.0], [-1.0]):
+        np.testing.assert_allclose(sf(t(v)).numpy(), f(t(v)).numpy())
+
+
+def test_bool_ops_in_condition():
+    def f(x):
+        if (x.sum() > 0.0) and (x.sum() < 100.0):
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    sf = to_static(f, full_graph=True)
+    for v in ([1.0], [200.0], [-1.0]):
+        np.testing.assert_allclose(sf(t(v)).numpy(), f(t(v)).numpy())
+
+
+# ---------------------------------------------------------------- while
+
+def doubling(x):
+    s = x
+    while s.sum() < 100.0:
+        s = s * 2.0
+    return s
+
+
+def test_while_on_tensor_traced():
+    f = to_static(doubling, full_graph=True)
+    got = f(t([1.0, 2.0]))
+    want = doubling(t([1.0, 2.0]))
+    np.testing.assert_allclose(got.numpy(), want.numpy())
+
+
+def test_while_python_counter_unrolls():
+    def f(x):
+        i = 0
+        while i < 3:
+            x = x + 1.0
+            i += 1
+        return x
+
+    sf = to_static(f, full_graph=True)
+    np.testing.assert_allclose(sf(t([0.0])).numpy(), [3.0])
+
+
+# ---------------------------------------------------------------- for
+
+def test_for_range_static():
+    def f(x):
+        acc = x * 0.0
+        for i in range(4):
+            acc = acc + x * float(i)
+        return acc
+
+    sf = to_static(f, full_graph=True)
+    np.testing.assert_allclose(sf(t([1.0, 2.0])).numpy(), [6.0, 12.0])
+
+
+def test_for_over_tensor_rows():
+    def f(xs):
+        s = xs[0] * 0.0
+        for row in xs:
+            s = s + row
+        return s
+
+    xs = t(np.arange(12).reshape(4, 3), "float32")
+    sf = to_static(f, full_graph=True)
+    np.testing.assert_allclose(sf(xs).numpy(), f(xs).numpy())
+
+
+def test_for_traced_range_bound():
+    def f(n, x):
+        s = x
+        for _ in range(n):
+            s = s + 1.0
+        return s
+
+    sf = to_static(f, full_graph=True)
+    got = sf(t(5, "int32"), t([0.0]))
+    np.testing.assert_allclose(got.numpy(), [5.0])
+
+
+def test_nested_if_in_for():
+    def f(x):
+        acc = x * 0.0
+        for i in range(4):
+            if x.sum() > 0.0:
+                acc = acc + x
+            else:
+                acc = acc - x
+        return acc
+
+    sf = to_static(f, full_graph=True)
+    np.testing.assert_allclose(sf(t([1.0])).numpy(), f(t([1.0])).numpy())
+    np.testing.assert_allclose(sf(t([-1.0])).numpy(), f(t([-1.0])).numpy())
+
+
+# ---------------------------------------------------------------- helpers
+
+def _helper(x):
+    if x.sum() > 0.0:
+        y = x * 2.0
+    else:
+        y = -x
+    return y
+
+
+def test_converted_call_transforms_helpers():
+    def f(x):
+        return _helper(x) + 1.0
+
+    sf = to_static(f, full_graph=True)
+    for v in ([2.0], [-2.0]):
+        np.testing.assert_allclose(sf(t(v)).numpy(), f(t(v)).numpy())
+
+
+# ---------------------------------------------------------------- fallback
+
+def test_graph_break_falls_back_to_eager():
+    def f(x):
+        while x.sum() < 10.0:
+            x = x * 2.0
+            if x.sum() > 5.0:
+                break  # break → loop left as python → graph break on tracer
+        return x
+
+    sf = to_static(f)  # full_graph=False → fallback allowed
+    got = sf(t([1.0]))
+    want = f(t([1.0]))
+    np.testing.assert_allclose(got.numpy(), want.numpy())
+    assert sf._broke
+
+
+def test_graph_break_raises_under_full_graph():
+    def f(x):
+        while x.sum() < 10.0:
+            x = x * 2.0
+            if x.sum() > 5.0:
+                break
+        return x
+
+    sf = to_static(f, full_graph=True)
+    with pytest.raises(Exception):
+        sf(t([1.0]))
+
+
+# ---------------------------------------------------------------- layers
+
+class GatedBlock(pt.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = pt.nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.sum() > 0.0:
+            out = h * 2.0
+        else:
+            out = h * 0.5
+        return out
+
+
+def test_layer_forward_control_flow():
+    layer = GatedBlock()
+    sf = to_static(layer, full_graph=True)
+    x = t(np.random.randn(2, 4).astype("float32"))
+    got = sf(x)
+    want = layer(x)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5, atol=1e-6)

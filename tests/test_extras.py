@@ -63,6 +63,59 @@ class TestStaticInference:
         (out,) = p.run([np.ones(3, np.float32)])
         np.testing.assert_allclose(out, [3, 3, 3])
 
+    def test_predictor_bf16_io(self):
+        from paddle_tpu.inference import Config, Predictor, PrecisionType
+
+        cfg = Config()
+        cfg.set_precision_mode(PrecisionType.Bfloat16)
+        cfg.enable_profile()
+
+        def fwd(p, x):
+            return x @ p["w"]
+
+        params = {"w": np.random.rand(4, 4).astype(np.float32)}
+        pr = Predictor(fwd, example_args=[np.zeros((2, 4), np.float32)],
+                       params=params, config=cfg)
+        x = np.random.rand(2, 4).astype(np.float32)
+        (out,) = pr.run([x])
+        assert out.dtype == np.dtype("bfloat16") or out.dtype == np.float32
+        np.testing.assert_allclose(
+            out.astype(np.float32), x @ params["w"], rtol=5e-2)
+        rep = pr.profile_report()
+        assert rep["runs"] == 1 and rep["avg_ms"] > 0
+
+    def test_predictor_int8_weight_only(self):
+        from paddle_tpu.inference import Config, Predictor, PrecisionType
+        from paddle_tpu.quantization import QuantizedWeight
+
+        cfg = Config()
+        cfg.set_precision_mode(PrecisionType.Int8)
+
+        def fwd(p, x):
+            return x @ p["w"]
+
+        w = np.random.randn(64, 64).astype(np.float32)
+        pr = Predictor(fwd, example_args=[np.zeros((2, 64), np.float32)],
+                       params={"w": w}, config=cfg)
+        # the stored representation is int8
+        assert isinstance(pr._params["w"], QuantizedWeight)
+        assert pr._params["w"].int8.dtype == np.int8
+        x = np.random.randn(2, 64).astype(np.float32)
+        (out,) = pr.run([x])
+        # weight-only int8: ~1% relative error on a 64-dim contraction
+        np.testing.assert_allclose(out, x @ w, rtol=0.1, atol=0.1)
+
+    def test_weight_only_quantize_roundtrip(self):
+        from paddle_tpu.quantization import (weight_only_dequantize,
+                                             weight_only_quantize)
+        params = {"w": np.random.randn(128, 32).astype(np.float32),
+                  "b": np.zeros(32, np.float32)}  # small/1-d: passes through
+        q = weight_only_quantize(params)
+        deq = weight_only_dequantize(q)
+        err = np.abs(np.asarray(deq["w"]) - params["w"]).max()
+        assert err < np.abs(params["w"]).max() / 100  # 8-bit ⇒ <1% of range
+        np.testing.assert_array_equal(np.asarray(deq["b"]), params["b"])
+
 
 class TestIncubate:
     def test_fused_rope_matches_manual(self):

@@ -1,0 +1,114 @@
+"""Per-op SPMD custom-rule surface tests.
+
+Reference pattern: phi/infermeta/spmd_rules/ (113 per-op rules) consumed by
+the generated dist branch; tests mirror test/auto_parallel per-op semi-auto
+tests (placements asserted after dispatch)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import Replicate, Shard
+from paddle_tpu.distributed.spmd_rules import SpmdDecision
+
+
+@pytest.fixture
+def mesh1d():
+    return dist.ProcessMesh(np.arange(8), ["x"])
+
+
+def _global(t):
+    return np.asarray(dist.unshard_dtensor(t).numpy())
+
+
+class TestCustomRule:
+    def test_register_and_fire(self, mesh1d):
+        fired = {}
+
+        @dist.register_spmd_rule("my_scale_op")
+        def rule(ctx):
+            fired["placements"] = ctx.placements
+            # demand a replicated input; declare a replicated output
+            return SpmdDecision(inputs=[[Replicate()]],
+                                outputs=[[Replicate()]])
+
+        try:
+            from paddle_tpu.core import engine
+            a = np.random.rand(8, 4).astype(np.float32)
+            d = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+            out = engine.apply(lambda x: x * 2.0, d, name="my_scale_op")
+            assert fired["placements"][0][0].is_shard(0)
+            assert out.placements is not None
+            assert out.placements[0].is_replicate()
+            np.testing.assert_allclose(_global(out), a * 2.0, rtol=1e-6)
+        finally:
+            dist.unregister_spmd_rule("my_scale_op")
+
+    def test_rule_abstains_none(self, mesh1d):
+        @dist.register_spmd_rule("my_noop_op")
+        def rule(ctx):
+            return None
+
+        try:
+            from paddle_tpu.core import engine
+            a = np.random.rand(8, 4).astype(np.float32)
+            d = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+            out = engine.apply(lambda x: x + 1.0, d, name="my_noop_op")
+            np.testing.assert_allclose(_global(out), a + 1.0, rtol=1e-6)
+        finally:
+            dist.unregister_spmd_rule("my_noop_op")
+
+    def test_axis_of_helper(self, mesh1d):
+        seen = {}
+
+        @dist.register_spmd_rule("probe_op")
+        def rule(ctx):
+            seen["axis"] = ctx.axis_of(0, 0)
+            return None
+
+        try:
+            from paddle_tpu.core import engine
+            d = dist.shard_tensor(pt.ones([8, 4]), mesh1d, [Shard(0)])
+            engine.apply(lambda x: x, d, name="probe_op")
+            assert seen["axis"] == "x"
+        finally:
+            dist.unregister_spmd_rule("probe_op")
+
+
+class TestBuiltinRules:
+    def test_embedding_col_parallel_out_shard(self, mesh1d):
+        # Megatron col-parallel: weight Shard(1) on hidden → out Shard(last)
+        V, H = 16, 8
+        w = np.random.rand(V, H).astype(np.float32)
+        ids = np.random.randint(0, V, (4, 6))
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Shard(1)])
+        dids = dist.shard_tensor(pt.to_tensor(ids, dtype="int32"), mesh1d,
+                                 [Replicate()])
+        out = F.embedding(dids, dw)
+        assert out.placements is not None
+        assert out.placements[0].is_shard(2)
+        np.testing.assert_allclose(_global(out), w[ids], rtol=1e-6)
+
+    def test_embedding_vocab_parallel_out_replicated(self, mesh1d):
+        V, H = 16, 8
+        w = np.random.rand(V, H).astype(np.float32)
+        ids = np.random.randint(0, V, (4, 6))
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Shard(0)])
+        dids = dist.shard_tensor(pt.to_tensor(ids, dtype="int32"), mesh1d,
+                                 [Replicate()])
+        out = F.embedding(dids, dw)
+        assert out.placements is not None
+        assert out.placements[0].is_replicate()
+        np.testing.assert_allclose(_global(out), w[ids], rtol=1e-6)
+
+    def test_cross_entropy_keeps_batch_shard(self, mesh1d):
+        B, C = 8, 16
+        logits = np.random.randn(B, C).astype(np.float32)
+        labels = np.random.randint(0, C, (B, 1))
+        dl = dist.shard_tensor(pt.to_tensor(logits), mesh1d, [Shard(0)])
+        dt = dist.shard_tensor(pt.to_tensor(labels, dtype="int64"), mesh1d,
+                               [Shard(0)])
+        loss = F.softmax_with_cross_entropy(dl, dt)
+        assert loss.placements is not None
+        assert loss.placements[0].is_shard(0)
