@@ -15,6 +15,7 @@ from typing import Any
 from ..env import get_rank, get_world_size
 from . import elastic  # noqa: F401
 from . import layers  # noqa: F401
+from . import meta_optimizers  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup

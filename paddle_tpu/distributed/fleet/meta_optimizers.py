@@ -1,0 +1,107 @@
+"""fleet.meta_optimizers — communication-reducing optimizer wrappers.
+
+Reference: /root/reference/python/paddle/distributed/fleet/meta_optimizers/
+(dgc_optimizer.py DGCMomentumOptimizer, localsgd_optimizer.py
+LocalSGDOptimizer — the graph-rewriting variants). TPU-native: both are
+eager wrappers; the collectives are XLA all-reduces via
+distributed.collective (mesh-axis ops inside shard_map, no-ops single
+process).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...optimizer import Momentum, Optimizer
+
+__all__ = ["DGCMomentumOptimizer", "LocalSGDOptimizer"]
+
+
+class DGCMomentumOptimizer(Momentum):
+    """Deep-gradient-compression momentum (reference
+    meta_optimizers/dgc_optimizer.py): before the momentum update, each
+    grad is top-k sparsified through the `dgc` op with residual (u, v)
+    accumulators; only the surviving fraction is (all-)reduced."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         parameters=parameters, grad_clip=grad_clip,
+                         name=name)
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity
+        self._dgc_u: dict = {}
+        self._dgc_v: dict = {}
+        self._dgc_step = 0
+
+    def _compress(self, p, g):
+        from ...tensor.ops_ext4 import dgc
+
+        key = id(p)
+        if key not in self._dgc_u:
+            self._dgc_u[key] = Tensor(np.zeros(g.shape, np.float32))
+            self._dgc_v[key] = Tensor(np.zeros(g.shape, np.float32))
+        ratio = 1.0 - (self._sparsity[-1] if self._sparsity else 0.999)
+        _, _, _, _, dense = dgc(
+            self._dgc_u[key], self._dgc_v[key], g, p,
+            Tensor(np.float32(self._dgc_step)), ratio=max(ratio, 1e-4),
+            m=self._momentum)
+        return dense
+
+    def step(self):
+        self._dgc_step += 1
+        if self._dgc_step <= self._rampup_begin_step:
+            return super().step()
+        # the dgc op already folds momentum into its u/v accumulators, so
+        # the compressed dense grad must be applied as a PLAIN sgd step —
+        # routing it through Momentum.step would compound momentum twice
+        # (reference pairs dgc with the dgc_momentum update, not momentum)
+        lr = self.get_lr()
+        for p in (self._parameter_list or []):
+            if p.grad is None:
+                continue
+            dense = self._compress(p, p.grad)
+            p.set_value(p._value - lr * dense._value.astype(p._value.dtype))
+        self._step_count += 1
+
+
+class LocalSGDOptimizer(Optimizer):
+    """Local SGD (reference meta_optimizers/localsgd_optimizer.py): run the
+    inner optimizer locally; every k_steps average parameters across the
+    data-parallel group."""
+
+    def __init__(self, inner_optimizer=None, k_steps=1, learning_rate=0.01,
+                 parameters=None, name=None, **kw):
+        from ...optimizer import SGD
+        self._inner = inner_optimizer or SGD(
+            learning_rate=learning_rate, parameters=parameters)
+        self._k_steps = max(int(k_steps), 1)
+        self._count = 0
+
+    def __getattr__(self, item):
+        if item == "_inner":  # unpickling/copy: _inner not set yet
+            raise AttributeError(item)
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        self._count += 1
+        if self._count % self._k_steps == 0:
+            self._average_params()
+
+    def _average_params(self):
+        from .. import collective
+        from ..env import get_world_size
+
+        world = get_world_size()
+        if world <= 1:
+            # single process: replicas are identical — averaging is a no-op
+            # (and all_reduce over a virtual device mesh would SUM them)
+            return
+        for p in (self._inner._parameter_list or []):
+            collective.all_reduce(p)
+            p.set_value(p._value / world)
+
+    def clear_grad(self):
+        self._inner.clear_grad()

@@ -9,7 +9,12 @@ from ....nn import functional as F
 
 __all__ = ["fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
            "fused_linear", "fused_bias_act", "swiglu", "fused_dropout_add",
-           "flash_attention", "fused_linear_activation"]
+           "flash_attention", "fused_linear_activation",
+           "fused_multi_head_attention", "fused_feedforward",
+           "fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",
+           "masked_multihead_attention", "fused_multi_transformer",
+           "fused_ec_moe", "fused_gate_attention",
+           "variable_length_memory_efficient_attention"]
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
@@ -103,3 +108,254 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, **kw):
     return F.flash_attention(query, key, value, dropout=dropout, causal=causal,
                              return_softmax=return_softmax)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Fused MHA block (reference incubate/nn/functional/
+    fused_multi_head_attention.py → fused_attention op): optional pre-LN,
+    packed qkv projection, attention, out-proj, residual (+post-LN)."""
+    from ....core.tensor import Tensor
+
+    inp = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        inp = F.layer_norm(inp, inp.shape[-1:], pre_ln_scale, pre_ln_bias,
+                           pre_ln_epsilon)
+    w = qkv_weight
+    wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    if transpose_qkv_wb:
+        D = inp.shape[-1]
+        nh = num_heads
+        hd = D // nh
+        qkv = F.linear(inp, w, qkv_bias)  # [B,T,3D]
+        def split3(a):
+            B, T, _ = a.shape
+            return a.reshape(B, T, 3, nh, hd)
+        qkv_v = split3(qkv._value if isinstance(qkv, Tensor) else qkv)
+    else:
+        # wv: [3, H, hd, D]
+        three, nh, hd, D = wv.shape
+        from ....core.engine import apply
+        qkv_t = apply(lambda a, ww: jnp.einsum("btd,ehkd->btehk", a, ww),
+                      x if not pre_layer_norm else inp, Tensor(wv),
+                      name="fused_attention_qkv")
+        qkv_v = qkv_t._value if isinstance(qkv_t, Tensor) else qkv_t
+        if qkv_bias is not None:
+            bv = qkv_bias._value if isinstance(qkv_bias, Tensor) else qkv_bias
+            qkv_v = qkv_v + bv.reshape(1, 1, 3, nh, hd)
+    q, k, v = qkv_v[:, :, 0], qkv_v[:, :, 1], qkv_v[:, :, 2]
+    if cache_kv is not None:
+        cv = cache_kv._value if isinstance(cache_kv, Tensor) else cache_kv
+        k = jnp.concatenate([cv[0], k], axis=1)
+        v = jnp.concatenate([cv[1], v], axis=1)
+    if attn_mask is not None:
+        mv = attn_mask._value if isinstance(attn_mask, Tensor) else \
+            jnp.asarray(attn_mask)
+        import math as _m
+        hd_ = q.shape[-1]
+        logits = jnp.einsum("blhd,bshd->bhls", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / _m.sqrt(hd_)
+        while mv.ndim < 4:
+            mv = mv[None]
+        if mv.dtype == jnp.bool_:
+            logits = jnp.where(mv, logits, -1e30)
+        else:
+            logits = logits + mv.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if attn_dropout_rate and training:
+            probs_t = F.dropout(Tensor(probs.astype(q.dtype)),
+                                p=attn_dropout_rate, training=True, mode=mode)
+            probs = probs_t._value
+        att = jnp.einsum("bhls,bshd->blhd", probs.astype(q.dtype), v)
+    else:
+        from ....ops.flash_attention import flash_attention_raw
+        att = flash_attention_raw(q, k, v, causal=False)
+    B, T = att.shape[0], att.shape[1]
+    att_t = Tensor(att.reshape(B, T, -1))
+    out = F.linear(att_t, linear_weight, linear_bias)
+    if dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + x
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """Fused FFN block (reference incubate fused_feedforward op)."""
+    inp = x
+    if pre_layer_norm and ln1_scale is not None:
+        inp = F.layer_norm(inp, inp.shape[-1:], ln1_scale, ln1_bias,
+                           ln1_epsilon)
+    h = F.linear(inp, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = x + h
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, out.shape[-1:], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference incubate fused_matmul_bias (cublasLt epilogue fusion — XLA
+    fuses the bias add natively)."""
+    from ....tensor.linalg import matmul
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode="upscale_in_train", name=None):
+    """Reference incubate fused_bias_dropout_residual_layer_norm op."""
+    h = x if bias is None else x + bias
+    if dropout_rate:
+        h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + residual
+    return F.layer_norm(h, h.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Reference incubate masked_multihead_attention — decode-step attention
+    with KV cache; thin wrapper over the ops.yaml op."""
+    from ....tensor.ops_ext3 import masked_multihead_attention_
+    return masked_multihead_attention_(
+        x, cache_kv, bias=bias, src_mask=src_mask,
+        sequence_lengths=sequence_lengths, rotary_tensor=rotary_tensor,
+        beam_cache_offset=beam_cache_offset, seq_len=seq_len,
+        rotary_emb_dims=rotary_emb_dims,
+        use_neox_rotary_style=use_neox_rotary_style)
+
+
+def fused_multi_transformer(x, *args, **kw):
+    """Reference incubate fused_multi_transformer — inference transformer
+    stack; wrapper over the ops.yaml op."""
+    from ....tensor.ops_ext3 import fused_multi_transformer as _fmt
+    return _fmt(x, *args, **kw)
+
+
+def fused_ec_moe(x, gate_weight, expert_w1, expert_b1, expert_w2, expert_b2,
+                 act_type="gelu", name=None):
+    """Expert-choice MoE block (reference incubate fused_ec_moe op):
+    softmax gate over experts, dense dispatch via einsum."""
+    from ....core.engine import apply
+
+    act = jax.nn.gelu if act_type == "gelu" else jax.nn.relu
+
+    def f(a, gw, w1, b1, w2, b2):
+        B, T, D = a.shape
+        logits = a @ gw  # [B,T,E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        h = jnp.einsum("btd,edh->bteh", a, w1) + b1[None, None]
+        h = act(h)
+        out = jnp.einsum("bteh,ehd->bted", h, w2) + b2[None, None]
+        return jnp.einsum("bte,bted->btd", probs, out)
+    return apply(f, x, gate_weight, expert_w1, expert_b1, expert_w2,
+                 expert_b2, name="fused_ec_moe")
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None, gate_weight=None,
+                         gate_bias=None, out_linear_weight=None,
+                         out_linear_bias=None, nonbatched_bias=None,
+                         attn_mask=None, has_gating=True, merge_qkv=True,
+                         use_flash_attn=False, name=None):
+    """Gated attention (AlphaFold-style; reference incubate
+    fused_gate_attention op)."""
+    from ....core.engine import apply
+    from ....core.tensor import Tensor
+
+    def f(q_in, qkvw, gw, gb, ow, ob):
+        # q_in [..., M, D]; qkvw [3, H, hd, D]
+        three, H, hd, D = qkvw.shape
+        qkv = jnp.einsum("...md,ehkd->...mehk", q_in, qkvw)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        scale = 1.0 / _math.sqrt(hd)
+        logits = jnp.einsum("...mhk,...nhk->...hmn", q, k) * scale
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("...hmn,...nhk->...mhk", probs, v)
+        if gw is not None:
+            gate = jax.nn.sigmoid(jnp.einsum("...md,hkd->...mhk", q_in,
+                                             gw.reshape(H, hd, D)) +
+                                  (gb.reshape(H, hd) if gb is not None else 0))
+            ctx = ctx * gate
+        out = jnp.einsum("...mhk,hkd->...md", ctx, ow.reshape(H, hd, D))
+        if ob is not None:
+            out = out + ob
+        return out
+
+    import math as _math
+    # None operands pass straight through engine.apply (non-Tensor args are
+    # forwarded verbatim), so every optional keeps its own positional slot —
+    # no compaction, no mis-binding when an earlier optional is absent
+    return apply(f, query, qkv_weight, gate_weight, gate_bias,
+                 out_linear_weight, out_linear_bias,
+                 name="fused_gate_attention")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0, name=None):
+    """Reference incubate variable_length_memory_efficient_attention:
+    length-masked attention, [B, H, T, D] layout."""
+    from ....core.engine import apply
+
+    def f(q, k, v, sl, kvl, msk):
+        B, H, T, D = q.shape
+        S = k.shape[2]
+        sc = scale if scale is not None else 1.0 / (D ** 0.5)
+        logits = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sc
+        if msk is not None:
+            mv = jnp.asarray(msk)
+            while mv.ndim < 4:
+                mv = mv[None]
+            if mv.dtype == jnp.bool_:
+                logits = jnp.where(mv, logits, -1e30)
+            else:
+                logits = logits + mv.astype(jnp.float32)
+        m = jnp.ones((B, 1, T, S), bool)
+        if sl is not None:
+            m = m & (jnp.arange(T)[None, None, :, None] <
+                     sl.reshape(B, 1, 1, 1))
+        if kvl is not None:
+            m = m & (jnp.arange(S)[None, None, None, :] <
+                     kvl.reshape(B, 1, 1, 1))
+        if causal:
+            m = m & jnp.tril(jnp.ones((T, S), bool))[None, None]
+        logits = jnp.where(m, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+
+    return apply(f, query, key, value, seq_lens, kv_seq_lens, mask,
+                 name="variable_length_memory_efficient_attention")

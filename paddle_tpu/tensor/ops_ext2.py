@@ -1,0 +1,1058 @@
+"""Op-surface extension 2: vision/detection, pooling, RNN, CTC, attention.
+
+Reference families from /root/reference/paddle/phi/ops/yaml/ops.yaml not yet
+covered by ops_ext.py: depthwise/deformable conv, roi pooling zoo, anchor/
+box ops (prior_box, box_coder, yolo_box, matrix_nms, multiclass_nms3,
+bipartite_match), unpool/fractional pooling, the rnn/lstm/gru family,
+warpctc/warprnnt, and the fused-attention surface (qkvpacked/varlen flash,
+softmax-mask fusions, masked decoding attention).
+
+Everything is a pure-jnp implementation dispatched through engine.apply
+(differentiable) or apply_nondiff; XLA supplies kernels and fusion. Dynamic-
+size outputs (NMS, proposals) return fixed-shape padded results (pad index
+-1 / score 0) — the TPU-native contract, documented per op.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+__all__ = []
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ====================== conv variants ======================
+@_export
+def depthwise_conv2d(x, weight, stride=1, padding=0, dilation=1, groups=None,
+                     data_format="NCHW", name=None):
+    """Reference: ops.yaml depthwise_conv2d (phi/kernels/gpu/depthwise_conv.h).
+    weight [C_out, 1, kh, kw]; groups == C_in."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, int):
+        pad = [(padding, padding), (padding, padding)]
+    elif isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [tuple(p) if not isinstance(p, int) else (p, p) for p in padding]
+        if len(pad) == 1:
+            pad = pad * 2
+
+    def f(a, w):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        C = a.shape[1]
+        out = lax.conv_general_dilated(
+            a, w, window_strides=s, padding=pad, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=C)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(f, x, weight, name="depthwise_conv2d")
+
+
+@_export
+def depthwise_conv2d_transpose(x, weight, stride=1, padding=0, output_padding=0,
+                               output_size=None, dilation=1, groups=None,
+                               data_format="NCHW", name=None):
+    """Reference: ops.yaml depthwise_conv2d_transpose."""
+    from ..nn.functional import conv2d_transpose
+    C = (_v(x).shape[1] if data_format == "NCHW" else _v(x).shape[-1])
+    return conv2d_transpose(x, weight, stride=stride, padding=padding,
+                            output_padding=output_padding, groups=C,
+                            dilation=dilation, data_format=data_format)
+
+
+@_export
+def conv2d_transpose_bias(x, weight, bias, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1,
+                          data_format="NCHW", name=None):
+    """Reference: ops.yaml conv2d_transpose_bias (fused bias add)."""
+    from ..nn.functional import conv2d_transpose
+    out = conv2d_transpose(x, weight, stride=stride, padding=padding,
+                           output_padding=output_padding, groups=groups,
+                           dilation=dilation, data_format=data_format)
+    def f(o, b):
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        return o + b.reshape(shape)
+    return apply(f, out, bias, name="conv2d_transpose_bias")
+
+
+@_export
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1, im2col_step=64,
+                    name=None):
+    """Deformable conv v2 (reference phi/kernels/impl/deformable_conv_kernel_impl.h):
+    bilinear-sample x at kernel grid + learned offsets, then matmul with the
+    kernel — the sampling is a gather XLA handles natively."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(a, off, w, m):
+        N, C, H, W = a.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        a_pad = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        # base sampling grid [Ho, Wo, kh, kw]
+        oy = jnp.arange(Ho)[:, None, None, None] * s[0]
+        ox = jnp.arange(Wo)[None, :, None, None] * s[1]
+        ky = jnp.arange(kh)[None, None, :, None] * d[0]
+        kx = jnp.arange(kw)[None, None, None, :] * d[1]
+        base_y = (oy + ky).astype(a.dtype)  # [Ho,1,kh,1]
+        base_x = (ox + kx).astype(a.dtype)  # [1,Wo,1,kw]
+        off = off.reshape(N, deformable_groups, kh, kw, 2, Ho, Wo)
+        dy = jnp.moveaxis(off[:, :, :, :, 0], (2, 3), (4, 5))  # [N,dg,Ho,Wo,kh,kw]
+        dx = jnp.moveaxis(off[:, :, :, :, 1], (2, 3), (4, 5))
+        sy = base_y[None, None] + dy
+        sx = base_x[None, None] + dx
+        Hp, Wp = H + 2 * p[0], W + 2 * p[1]
+        y0 = jnp.floor(sy); x0 = jnp.floor(sx)
+        wy = sy - y0; wx = sx - x0
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi, 0, Hp - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, Wp - 1).astype(jnp.int32)
+            # valid only if inside (reference zero-pads out-of-range)
+            valid = ((yi >= 0) & (yi <= Hp - 1) & (xi >= 0) & (xi <= Wp - 1))
+            idx = yc * Wp + xc  # [N,dg,Ho,Wo,kh,kw]
+            Cg = C // deformable_groups
+            flat = a_pad.reshape(N, deformable_groups, Cg, Hp * Wp)
+            idx_b = jnp.broadcast_to(
+                idx.reshape(N, deformable_groups, 1, -1),
+                (N, deformable_groups, Cg, idx.size // (N * deformable_groups)))
+            g = jnp.take_along_axis(flat, idx_b, axis=-1)
+            g = g.reshape((N, deformable_groups, Cg) + idx.shape[2:])
+            return g * valid[:, :, None].astype(a.dtype)
+
+        v00 = gather(y0, x0); v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0); v11 = gather(y0 + 1, x0 + 1)
+        wy_ = wy[:, :, None]; wx_ = wx[:, :, None]
+        samp = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+                v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        if m is not None:
+            mm = m.reshape(N, deformable_groups, kh, kw, Ho, Wo)
+            mm = jnp.moveaxis(mm, (2, 3), (4, 5))
+            samp = samp * mm[:, :, None]
+        # samp: [N, dg, C/dg, Ho, Wo, kh, kw] → [N, C*kh*kw, Ho*Wo]
+        samp = samp.reshape(N, C, Ho, Wo, kh, kw)
+        cols = jnp.moveaxis(samp, (4, 5), (2, 3)).reshape(N, C * kh * kw,
+                                                          Ho * Wo)
+        wmat = w.reshape(groups, Cout // groups, Cin_g * kh * kw)
+        cols = cols.reshape(N, groups, Cin_g * kh * kw * deformable_groups
+                            // deformable_groups, Ho * Wo) \
+            if groups > 1 else cols[:, None]
+        out = jnp.einsum("gok,ngkp->ngop", wmat, cols)
+        return out.reshape(N, Cout, Ho, Wo)
+
+    if mask is None:
+        return apply(lambda a, o, w: f(a, o, w, None), x, offset, weight,
+                     name="deformable_conv")
+    return apply(f, x, offset, weight, mask, name="deformable_conv")
+
+
+# ====================== pooling extras ======================
+def _pool_patches(a, ksize, strides, nd):
+    """Extract pooling windows → [..., prod(k), *out_spatial] via static
+    shifted slices (k is small + static)."""
+    # a: [N, C, *spatial]
+    import itertools
+    outs = []
+    sp = a.shape[2:]
+    out_sp = [(sp[i] - ksize[i]) // strides[i] + 1 for i in range(nd)]
+    for off in itertools.product(*[range(k) for k in ksize]):
+        sl = tuple(slice(off[i], off[i] + strides[i] * (out_sp[i] - 1) + 1,
+                         strides[i]) for i in range(nd))
+        outs.append(a[(slice(None), slice(None)) + sl])
+    return jnp.stack(outs, axis=2), out_sp  # [N, C, K, *out_sp]
+
+
+@_export
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False, adaptive=False, name=None):
+    """Reference: ops.yaml max_pool3d_with_index — returns (out, indices)."""
+    k = [kernel_size] * 3 if isinstance(kernel_size, int) else list(kernel_size)
+    s = k if stride is None else ([stride] * 3 if isinstance(stride, int)
+                                  else list(stride))
+    p = [padding] * 3 if isinstance(padding, int) else list(padding)
+
+    def f(a):
+        neg = jnp.finfo(a.dtype).min
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
+                     constant_values=neg)
+        D, H, W = a.shape[2:]
+        patches, out_sp = _pool_patches(ap, k, s, 3)
+        out = jnp.max(patches, axis=2)
+        arg = jnp.argmax(patches, axis=2)  # index into the k³ window
+        kd, khh, kww = k
+        od = arg // (khh * kww); oh = (arg // kww) % khh; ow = arg % kww
+        base_d = jnp.arange(out_sp[0])[:, None, None] * s[0] - p[0]
+        base_h = jnp.arange(out_sp[1])[None, :, None] * s[1] - p[1]
+        base_w = jnp.arange(out_sp[2])[None, None, :] * s[2] - p[2]
+        gd = jnp.clip(base_d + od, 0, D - 1)
+        gh = jnp.clip(base_h + oh, 0, H - 1)
+        gw = jnp.clip(base_w + ow, 0, W - 1)
+        idx = (gd * H + gh) * W + gw
+        return out, idx.astype(jnp.int32)
+
+    return apply_nondiff(f, x, name="max_pool3d_with_index")
+
+
+@_export
+def unpool(x, indices, kernel_size=2, stride=None, padding=0,
+           output_size=None, data_format="NCHW", name=None):
+    """Max-unpool2d: scatter pooled values back to `indices` (reference
+    ops.yaml unpool, phi/kernels/impl/unpool_kernel_impl.h)."""
+    def f(a, idx):
+        N, C, Ho, Wo = a.shape
+        if output_size is not None:
+            H, W = output_size[-2], output_size[-1]
+        else:
+            k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+            st = stride or k
+            st = st if isinstance(st, int) else st[0]
+            H = (Ho - 1) * st - 2 * padding + k
+            W = (Wo - 1) * st - 2 * padding + k
+        flat = jnp.zeros((N, C, H * W), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda t, i, va: t.at[i.reshape(-1)].set(va.reshape(-1))))(
+            flat, idx, a)
+        return out.reshape(N, C, H, W)
+    return apply(f, x, indices, name="unpool")
+
+
+@_export
+def unpool3d(x, indices, kernel_size=2, stride=None, padding=0,
+             output_size=None, data_format="NCDHW", name=None):
+    """Reference: ops.yaml unpool3d."""
+    def f(a, idx):
+        N, C, Do, Ho, Wo = a.shape
+        if output_size is not None:
+            D, H, W = output_size[-3], output_size[-2], output_size[-1]
+        else:
+            k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+            st = stride or k
+            st = st if isinstance(st, int) else st[0]
+            D = (Do - 1) * st - 2 * padding + k
+            H = (Ho - 1) * st - 2 * padding + k
+            W = (Wo - 1) * st - 2 * padding + k
+        flat = jnp.zeros((N, C, D * H * W), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda t, i, va: t.at[i.reshape(-1)].set(va.reshape(-1))))(
+            flat, idx, a)
+        return out.reshape(N, C, D, H, W)
+    return apply(f, x, indices, name="unpool3d")
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, nd, name):
+    def f(a):
+        sp = a.shape[2:]
+        out_sp = ([output_size] * nd if isinstance(output_size, int)
+                  else list(output_size))
+        u = random_u if random_u is not None else 0.5
+        idxs = []
+        for i in range(nd):
+            alpha = sp[i] / out_sp[i]
+            base = jnp.floor(alpha * (jnp.arange(out_sp[i]) + u)).astype(
+                jnp.int32)
+            start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                     base[:-1]]) if out_sp[i] > 1 else \
+                jnp.zeros((1,), jnp.int32)
+            end = jnp.concatenate([base[1:],
+                                   jnp.asarray([sp[i]], jnp.int32)])
+            idxs.append((start, jnp.maximum(end, start + 1)))
+        # window max via cumulative trick: gather each output cell's window
+        def pool_axis(arr, axis, se):
+            start, end = se
+            n_out = start.shape[0]
+            def cell(j):
+                st = start[j]
+                ln = end[j] - st
+                maxlen = int(_math.ceil(arr.shape[axis] /
+                                        max(n_out, 1))) + 2
+                sl = lax.dynamic_slice_in_dim(
+                    arr, st, min(maxlen, arr.shape[axis]), axis)
+                rng = jnp.arange(sl.shape[axis])
+                mask_shape = [1] * sl.ndim
+                mask_shape[axis] = sl.shape[axis]
+                m = (rng < ln).reshape(mask_shape)
+                neg = jnp.finfo(arr.dtype).min
+                return jnp.max(jnp.where(m, sl, neg), axis=axis)
+            return jnp.stack([cell(j) for j in range(n_out)], axis=axis)
+        out = a
+        for i in range(nd):
+            out = pool_axis(out, 2 + i, idxs[i])
+        return out
+    return apply(f, x, name=name)
+
+
+@_export
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Reference: ops.yaml fractional_max_pool2d (pseudo-random pooling
+    regions, Graham 2014); deterministic u unless random_u given."""
+    return _fractional_pool(x, output_size, kernel_size, random_u, 2,
+                            "fractional_max_pool2d")
+
+
+@_export
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Reference: ops.yaml fractional_max_pool3d."""
+    return _fractional_pool(x, output_size, kernel_size, random_u, 3,
+                            "fractional_max_pool3d")
+
+
+# ====================== roi pooling zoo ======================
+def _roi_to_batch(boxes_num, R, N):
+    """Per-roi batch index from per-image counts."""
+    reps = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=R)
+    return reps
+
+
+@_export
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference phi/kernels/impl/roi_align_kernel_impl.h):
+    bilinear-sample a pooled grid per roi."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+
+    def f(a, bx, bn):
+        N, C, H, W = a.shape
+        R = bx.shape[0]
+        batch_idx = (_roi_to_batch(bn, R, N) if bn is not None
+                     else jnp.zeros((R,), jnp.int32))
+        offset = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - offset
+        y1 = bx[:, 1] * spatial_scale - offset
+        x2 = bx[:, 2] * spatial_scale - offset
+        y2 = bx[:, 3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        bh = rh / oh / sr
+        bw = rw / ow / sr
+        gy = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :] *
+              bh[:, None])  # [R, oh*sr]
+        gx = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :] *
+              bw[:, None])
+
+        def bilinear(img, yy, xx):
+            # img [C,H,W]; yy [P], xx [Q] → [C,P,Q]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            ly = jnp.clip(yy - y0, 0, 1)[None, :, None]
+            lx = jnp.clip(xx - x0, 0, 1)[None, None, :]
+            yi0, yi1 = y0.astype(jnp.int32), y1_.astype(jnp.int32)
+            xi0, xi1 = x0.astype(jnp.int32), x1_.astype(jnp.int32)
+            v00 = img[:, yi0][:, :, xi0]
+            v01 = img[:, yi0][:, :, xi1]
+            v10 = img[:, yi1][:, :, xi0]
+            v11 = img[:, yi1][:, :, xi1]
+            return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                    v10 * ly * (1 - lx) + v11 * ly * lx)
+
+        def one(bi, yy, xx):
+            img = a[bi]
+            samp = bilinear(img, yy, xx)  # [C, oh*sr, ow*sr]
+            samp = samp.reshape(C, oh, sr, ow, sr)
+            return jnp.mean(samp, axis=(2, 4))
+
+        return jax.vmap(one)(batch_idx, gy, gx)
+
+    if boxes_num is None:
+        return apply(lambda a, b: f(a, b, None), x, boxes, name="roi_align")
+    return apply(lambda a, b, n: f(a, b, n), x, boxes, boxes_num,
+                 name="roi_align")
+
+
+@_export
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    """RoIPool (max pooling over quantized roi bins; reference
+    phi/kernels/impl/roi_pool_kernel_impl.h). Implemented as roi_align with
+    dense sampling + max — exact on aligned grids, TPU-friendly."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+
+    def f(a, bx, bn):
+        N, C, H, W = a.shape
+        R = bx.shape[0]
+        batch_idx = (_roi_to_batch(bn, R, N) if bn is not None
+                     else jnp.zeros((R,), jnp.int32))
+        x1 = jnp.round(bx[:, 0] * spatial_scale)
+        y1 = jnp.round(bx[:, 1] * spatial_scale)
+        x2 = jnp.round(bx[:, 2] * spatial_scale)
+        y2 = jnp.round(bx[:, 3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        sr = 4
+        gy = y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :] * \
+            (rh / (oh * sr))[:, None]
+        gx = x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :] * \
+            (rw / (ow * sr))[:, None]
+
+        def one(bi, yy, xx):
+            img = a[bi]
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            samp = img[:, yi][:, :, xi]  # nearest
+            samp = samp.reshape(C, oh, sr, ow, sr)
+            return jnp.max(samp, axis=(2, 4))
+
+        return jax.vmap(one)(batch_idx, gy, gx)
+
+    if boxes_num is None:
+        return apply(lambda a, b: f(a, b, None), x, boxes, name="roi_pool")
+    return apply(lambda a, b, n: f(a, b, n), x, boxes, boxes_num,
+                 name="roi_pool")
+
+
+@_export
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               output_channels=None, name=None):
+    """Position-sensitive RoI pool (R-FCN; reference
+    phi/kernels/impl/psroi_pool_kernel_impl.h): bin (i,j) pools channel
+    group (i*ow+j)."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+
+    def f(a, bx, bn):
+        N, C, H, W = a.shape
+        Cout = output_channels or C // (oh * ow)
+        R = bx.shape[0]
+        batch_idx = (_roi_to_batch(bn, R, N) if bn is not None
+                     else jnp.zeros((R,), jnp.int32))
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        rw = jnp.maximum((bx[:, 2] - bx[:, 0]) * spatial_scale, 0.1)
+        rh = jnp.maximum((bx[:, 3] - bx[:, 1]) * spatial_scale, 0.1)
+        sr = 2
+        gy = y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :] * \
+            (rh / (oh * sr))[:, None]
+        gx = x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :] * \
+            (rw / (ow * sr))[:, None]
+
+        def one(bi, yy, xx):
+            img = a[bi].reshape(oh * ow * Cout, H, W)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            samp = img[:, yi][:, :, xi].reshape(oh, ow, Cout, oh, sr, ow, sr)
+            # bin (i,j) averages its own window from channel-group (i,j)
+            pooled = jnp.mean(samp, axis=(4, 6))  # [oh,ow,Cout,oh,ow]
+            ii = jnp.arange(oh)[:, None]
+            jj = jnp.arange(ow)[None, :]
+            sel = pooled[ii, jj, :, ii, jj]  # [oh,ow,Cout]
+            return jnp.moveaxis(sel, -1, 0)
+
+        return jax.vmap(one)(batch_idx, gy, gx)
+
+    if boxes_num is None:
+        return apply(lambda a, b: f(a, b, None), x, boxes, name="psroi_pool")
+    return apply(lambda a, b, n: f(a, b, n), x, boxes, boxes_num,
+                 name="psroi_pool")
+
+
+# ====================== anchors / boxes / nms ======================
+@_export
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes (reference phi/kernels/impl/prior_box ...).
+    Returns (boxes [H,W,A,4], variances [H,W,A,4])."""
+    def f(feat, img):
+        H, W = feat.shape[2], feat.shape[3]
+        IH, IW = img.shape[2], img.shape[3]
+        step_h = steps[1] if steps[1] > 0 else IH / H
+        step_w = steps[0] if steps[0] > 0 else IW / W
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if abs(ar - 1.0) > 1e-6:
+                ars.append(float(ar))
+                if flip:
+                    ars.append(1.0 / float(ar))
+        whs = []
+        for ms in min_sizes:
+            if min_max_aspect_ratios_order:
+                whs.append((ms, ms))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    whs.append((_math.sqrt(ms * mx), _math.sqrt(ms * mx)))
+                for ar in ars[1:]:
+                    whs.append((ms * _math.sqrt(ar), ms / _math.sqrt(ar)))
+            else:
+                for ar in ars:
+                    whs.append((ms * _math.sqrt(ar), ms / _math.sqrt(ar)))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    whs.append((_math.sqrt(ms * mx), _math.sqrt(ms * mx)))
+        A = len(whs)
+        cx = (jnp.arange(W) + offset) * step_w
+        cy = (jnp.arange(H) + offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+        wh = jnp.asarray(whs, jnp.float32)  # [A, 2]
+        x1 = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / IW
+        y1 = (cyg[:, :, None] - wh[None, None, :, 1] / 2) / IH
+        x2 = (cxg[:, :, None] + wh[None, None, :, 0] / 2) / IW
+        y2 = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / IH
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                               (H, W, A, 4))
+        return boxes, var
+    return apply_nondiff(f, input, image, name="prior_box")
+
+
+@_export
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              variance=None, name=None):
+    """Encode/decode boxes against priors (reference
+    phi/kernels/impl/box_coder.h)."""
+    def f(pb, tb, pbv=None):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if variance is not None:
+            var = jnp.asarray(variance, jnp.float32)[None, :]
+        elif pbv is not None:
+            var = pbv if pbv.ndim == 2 else pbv[None, :]
+        else:
+            var = jnp.ones((1, 4), jnp.float32)
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None]) / pw[None, :])
+            oh = jnp.log(jnp.abs(th[:, None]) / ph[None, :])
+            out = jnp.stack([ox, oy, ow, oh], axis=-1) / var[None]
+            return out
+        # decode_center_size; tb [R, A?, 4] against priors along `axis`
+        t = tb
+        if t.ndim == 2:
+            t = t[:, None, :]
+        pcx_ = pcx[None, :] if axis == 1 else pcx[:, None]
+        pcy_ = pcy[None, :] if axis == 1 else pcy[:, None]
+        pw_ = pw[None, :] if axis == 1 else pw[:, None]
+        ph_ = ph[None, :] if axis == 1 else ph[:, None]
+        v = var[None] if var.shape[0] != t.shape[0] else var[:, None, :]
+        dcx = v[..., 0] * t[..., 0] * pw_ + pcx_
+        dcy = v[..., 1] * t[..., 1] * ph_ + pcy_
+        dw = jnp.exp(v[..., 2] * t[..., 2]) * pw_
+        dh = jnp.exp(v[..., 3] * t[..., 3]) * ph_
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - norm, dcy + dh / 2 - norm], axis=-1)
+        return out
+    if prior_box_var is None:
+        return apply_nondiff(lambda pb, tb: f(pb, tb), prior_box, target_box,
+                             name="box_coder")
+    return apply_nondiff(lambda pb, tb, pv: f(pb, tb, pv), prior_box,
+                         target_box, prior_box_var, name="box_coder")
+
+
+@_export
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference ops.yaml box_clip)."""
+    def f(b, info):
+        h, w = info[0, 0], info[0, 1]
+        scale = info[0, 2] if info.shape[1] > 2 else 1.0
+        hm = h / scale - 1
+        wm = w / scale - 1
+        x1 = jnp.clip(b[..., 0], 0, wm)
+        y1 = jnp.clip(b[..., 1], 0, hm)
+        x2 = jnp.clip(b[..., 2], 0, wm)
+        y2 = jnp.clip(b[..., 3], 0, hm)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+    return apply_nondiff(f, input, im_info, name="box_clip")
+
+
+def _iou_matrix(a, b, normalized=True):
+    norm = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + norm) * (a[:, 3] - a[:, 1] + norm)
+    area_b = (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + norm, 0)
+    ih = jnp.maximum(iy2 - iy1 + norm, 0)
+    inter = iw * ih
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+@_export
+def bipartite_match(dist_mat, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (reference
+    phi/kernels/cpu/bipartite_match_kernel.cc): repeatedly take the global
+    argmax, zero its row+col. Returns (match_indices [1,N], match_dist)."""
+    def f(d):
+        R, C = d.shape
+        idx0 = jnp.full((C,), -1, jnp.int32)
+        dist0 = jnp.zeros((C,), d.dtype)
+
+        def body(_, carry):
+            m, idx, dd = carry
+            flat = jnp.argmax(m)
+            r, c = flat // C, flat % C
+            val = m[r, c]
+            take = val > 0
+            idx = jnp.where(take, idx.at[c].set(r.astype(jnp.int32)), idx)
+            dd = jnp.where(take, dd.at[c].set(val), dd)
+            m = jnp.where(take, m.at[r, :].set(0).at[:, c].set(0), m)
+            return m, idx, dd
+
+        _, idx, dd = lax.fori_loop(0, min(R, C), body, (d, idx0, dist0))
+        if match_type == "per_prediction":
+            col_best = jnp.argmax(d, axis=0).astype(jnp.int32)
+            col_val = jnp.max(d, axis=0)
+            fill = (idx < 0) & (col_val >= dist_threshold)
+            idx = jnp.where(fill, col_best, idx)
+            dd = jnp.where(fill, col_val, dd)
+        return idx[None, :], dd[None, :]
+    return apply_nondiff(f, dist_mat, name="bipartite_match")
+
+
+@_export
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference phi/kernels/impl/matrix_nms...): decay
+    every box's score by its max-IoU with higher-scored same-class boxes.
+    Fixed-shape: returns keep_top_k rows padded with label -1."""
+    def f(bx, sc):
+        B, C, M = sc.shape
+        outs = []
+        idxs = []
+        nums = []
+        for b in range(B):
+            per = []
+            per_idx = []
+            for c in range(C):
+                if c == background_label:
+                    continue
+                s = sc[b, c]
+                k = min(nms_top_k, M)
+                top_s, top_i = lax.top_k(s, k)
+                boxes_c = bx[b][top_i]
+                iou = _iou_matrix(boxes_c, boxes_c, normalized)
+                tri = jnp.tril(iou, -1)  # IoU with higher-scored boxes
+                max_iou = jnp.max(tri, axis=1)
+                comp = jnp.max(tri, axis=0)
+                if use_gaussian:
+                    decay = jnp.exp(-(tri ** 2 - comp[None, :] ** 2)
+                                    / gaussian_sigma)
+                    decay = jnp.min(jnp.where(jnp.tril(jnp.ones_like(tri),
+                                                       -1) > 0, decay, 1.0),
+                                    axis=1)
+                else:
+                    decay = jnp.min(jnp.where(
+                        jnp.tril(jnp.ones_like(tri), -1) > 0,
+                        (1 - tri) / jnp.maximum(1 - comp[None, :], 1e-10),
+                        1.0), axis=1)
+                ds = top_s * decay
+                valid = top_s > score_threshold
+                if post_threshold > 0:
+                    valid = valid & (ds > post_threshold)
+                ds = jnp.where(valid, ds, -1.0)
+                lab = jnp.full((k,), c, jnp.float32)
+                per.append(jnp.concatenate(
+                    [lab[:, None], ds[:, None], boxes_c], axis=1))
+                per_idx.append(top_i)
+            allc = jnp.concatenate(per, axis=0)
+            alli = jnp.concatenate(per_idx, axis=0)
+            kk = min(keep_top_k, allc.shape[0])
+            best_s, best_i = lax.top_k(allc[:, 1], kk)
+            rows = allc[best_i]
+            rows = jnp.where(best_s[:, None] > 0, rows,
+                             jnp.full_like(rows, -1.0))
+            outs.append(rows)
+            idxs.append(alli[best_i])
+            nums.append(jnp.sum(best_s > 0).astype(jnp.int32))
+        out = jnp.stack(outs).reshape(-1, 6)
+        index = jnp.stack(idxs).reshape(-1, 1)
+        rois = jnp.stack(nums)
+        return out, index, rois
+    out, index, rois = apply_nondiff(f, bboxes, scores,
+                                     name="matrix_nms")
+    res = [out]
+    if return_index:
+        res.append(index)
+    if return_rois_num:
+        res.append(rois)
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+@_export
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=400, keep_top_k=200, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    return_index=False, name=None):
+    """Per-class hard NMS (reference multiclass_nms3 op). Fixed-shape output
+    padded with label -1; out rows are [label, score, x1, y1, x2, y2]."""
+    def f(bx, sc):
+        B, C, M = sc.shape
+        outs, idxs, nums = [], [], []
+        for b in range(B):
+            per, per_idx = [], []
+            for c in range(C):
+                if c == background_label:
+                    continue
+                s = sc[b, c]
+                k = min(nms_top_k, M)
+                top_s, top_i = lax.top_k(s, k)
+                boxes_c = bx[b][top_i]
+                iou = _iou_matrix(boxes_c, boxes_c, normalized)
+
+                def body(i, keep):
+                    # suppress j>i with IoU>thresh if i is kept
+                    sup = (iou[i] > nms_threshold) & \
+                        (jnp.arange(k) > i) & keep[i]
+                    return keep & ~sup
+
+                keep = lax.fori_loop(0, k, body,
+                                     top_s > score_threshold)
+                ds = jnp.where(keep, top_s, -1.0)
+                lab = jnp.full((k,), c, jnp.float32)
+                per.append(jnp.concatenate(
+                    [lab[:, None], ds[:, None], boxes_c], axis=1))
+                per_idx.append(top_i + b * M)
+            allc = jnp.concatenate(per, axis=0)
+            alli = jnp.concatenate(per_idx, axis=0)
+            kk = min(keep_top_k, allc.shape[0])
+            best_s, best_i = lax.top_k(allc[:, 1], kk)
+            rows = allc[best_i]
+            rows = jnp.where(best_s[:, None] > 0, rows,
+                             jnp.full_like(rows, -1.0))
+            outs.append(rows)
+            idxs.append(alli[best_i])
+            nums.append(jnp.sum(best_s > 0).astype(jnp.int32))
+        return (jnp.stack(outs).reshape(-1, 6),
+                jnp.stack(idxs).reshape(-1, 1), jnp.stack(nums))
+    out, index, rois = apply_nondiff(f, bboxes, scores,
+                                     name="multiclass_nms3")
+    if return_index:
+        return out, index, rois
+    return out, rois
+
+
+@_export
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (reference generate_proposals_v2): decode
+    deltas on anchors, clip, filter small, NMS. Fixed-shape padded."""
+    def f(sc, deltas, ims, anc, var):
+        B = sc.shape[0]
+        A4 = anc.reshape(-1, 4)
+        V4 = var.reshape(-1, 4)
+        outs, ns = [], []
+        for b in range(B):
+            s = sc[b].reshape(-1)
+            d = deltas[b].reshape(-1, 4)
+            k = min(pre_nms_top_n, s.shape[0])
+            top_s, top_i = lax.top_k(s, k)
+            db = d[top_i]
+            ab = A4[top_i]
+            vb = V4[top_i]
+            aw = ab[:, 2] - ab[:, 0] + (1.0 if pixel_offset else 0.0)
+            ah = ab[:, 3] - ab[:, 1] + (1.0 if pixel_offset else 0.0)
+            acx = ab[:, 0] + aw / 2
+            acy = ab[:, 1] + ah / 2
+            cx = vb[:, 0] * db[:, 0] * aw + acx
+            cy = vb[:, 1] * db[:, 1] * ah + acy
+            w = jnp.exp(jnp.minimum(vb[:, 2] * db[:, 2], 10.0)) * aw
+            h = jnp.exp(jnp.minimum(vb[:, 3] * db[:, 3], 10.0)) * ah
+            props = jnp.stack([cx - w / 2, cy - h / 2,
+                               cx + w / 2, cy + h / 2], axis=1)
+            hm = ims[b, 0] - (1.0 if pixel_offset else 0.0)
+            wm = ims[b, 1] - (1.0 if pixel_offset else 0.0)
+            props = jnp.stack([jnp.clip(props[:, 0], 0, wm),
+                               jnp.clip(props[:, 1], 0, hm),
+                               jnp.clip(props[:, 2], 0, wm),
+                               jnp.clip(props[:, 3], 0, hm)], axis=1)
+            pw = props[:, 2] - props[:, 0]
+            ph = props[:, 3] - props[:, 1]
+            ok = (pw >= min_size) & (ph >= min_size)
+            s2 = jnp.where(ok, top_s, -1.0)
+            iou = _iou_matrix(props, props)
+
+            def body(i, keep):
+                sup = (iou[i] > nms_thresh) & (jnp.arange(k) > i) & keep[i]
+                return keep & ~sup
+
+            keep = lax.fori_loop(0, k, body, s2 > 0)
+            s3 = jnp.where(keep, s2, -1.0)
+            kk = min(post_nms_top_n, k)
+            bs, bi = lax.top_k(s3, kk)
+            rows = props[bi]
+            rows = jnp.where(bs[:, None] > 0, rows, 0.0)
+            outs.append(rows)
+            ns.append(jnp.sum(bs > 0).astype(jnp.int32))
+        return jnp.concatenate(outs, axis=0), jnp.stack(ns)
+    rois, num = apply_nondiff(f, scores, bbox_deltas, im_shape, anchors,
+                              variances, name="generate_proposals")
+    if return_rois_num:
+        return rois, num
+    return rois
+
+
+generate_proposals_v2 = generate_proposals
+__all__.append("generate_proposals_v2")
+
+
+@_export
+def collect_fpn_proposals(multi_rois, multi_scores, rois_num_per_level=None,
+                          post_nms_top_n=1000, name=None):
+    """Merge per-FPN-level proposals, keep global top-n (reference
+    collect_fpn_proposals op). Fixed-shape."""
+    rois_v = [_v(r) for r in multi_rois]
+    scores_v = [_v(s).reshape(-1) for s in multi_scores]
+
+    def f(*flat):
+        n = len(flat) // 2
+        rois = jnp.concatenate(flat[:n], axis=0)
+        scs = jnp.concatenate(flat[n:], axis=0)
+        k = min(post_nms_top_n, scs.shape[0])
+        top_s, top_i = lax.top_k(scs, k)
+        return rois[top_i], jnp.asarray([k], jnp.int32)
+    out, num = apply_nondiff(f, *rois_v, *scores_v,
+                             name="collect_fpn_proposals")
+    return out, num
+
+
+@_export
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to (boxes, scores) (reference
+    phi/kernels/impl/yolo_box ...). x [N, A*(5+C), H, W]."""
+    A = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+
+    def f(a, imgs):
+        N, _, H, W = a.shape
+        if iou_aware:
+            ious = a[:, :A].reshape(N, A, 1, H, W)
+            a = a[:, A:]
+        a = a.reshape(N, A, 5 + class_num, H, W)
+        gx = (jnp.arange(W)[None, None, None, :])
+        gy = (jnp.arange(H)[None, None, :, None])
+        sig = jax.nn.sigmoid
+        bx = (sig(a[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / W
+        by = (sig(a[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / H
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        bw = jnp.exp(a[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(a[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = sig(a[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                sig(ious[:, :, 0]) ** iou_aware_factor
+        probs = sig(a[:, :, 5:]) * conf[:, :, None]
+        conf_mask = (conf > conf_thresh).astype(a.dtype)
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * \
+            conf_mask[..., None]
+        boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(N, -1, 4)
+        scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+        scores = scores.reshape(N, -1, class_num)
+        return boxes, scores
+    return apply_nondiff(f, x, img_size, name="yolo_box")
+
+
+@_export
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(), anchor_mask=(),
+              class_num=1, ignore_thresh=0.7, downsample_ratio=32,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (reference phi/kernels/impl/yolo_loss...). Differentiable
+    jnp implementation: coordinate + objectness + class terms with
+    best-anchor assignment per gt box."""
+    A_all = len(anchors) // 2
+    mask = list(anchor_mask)
+    A = len(mask)
+    anc_all = jnp.asarray(anchors, jnp.float32).reshape(A_all, 2)
+
+    def f(a, gb, gl, gs):
+        N, _, H, W = a.shape
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        a = a.reshape(N, A, 5 + class_num, H, W)
+        sig = jax.nn.sigmoid
+        px, py = a[:, :, 0], a[:, :, 1]
+        pw, ph = a[:, :, 2], a[:, :, 3]
+        pobj = a[:, :, 4]
+        pcls = a[:, :, 5:]
+        Bv = gb.shape[1]
+        # gt in [0,1] center form
+        gx, gy = gb[..., 0], gb[..., 1]
+        gw, gh = gb[..., 2], gb[..., 3]
+        valid = (gw > 1e-8) & (gh > 1e-8)
+        # best anchor per gt (by wh IoU against ALL anchors)
+        gwp = gw[..., None] * in_w
+        ghp = gh[..., None] * in_h
+        inter = jnp.minimum(gwp, anc_all[None, None, :, 0]) * \
+            jnp.minimum(ghp, anc_all[None, None, :, 1])
+        union = gwp * ghp + anc_all[None, None, :, 0] * \
+            anc_all[None, None, :, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        scale = 2.0 - gw * gh
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+        loss = jnp.zeros((N,), jnp.float32)
+        obj_target = jnp.zeros((N, A, H, W))
+        obj_hasgt = jnp.zeros((N, A, H, W), bool)
+        for t in range(Bv):
+            for ai, am in enumerate(mask):
+                on = valid[:, t] & (best[:, t] == am)
+                tx = gx[:, t] * W - gi[:, t]
+                ty = gy[:, t] * H - gj[:, t]
+                tw = jnp.log(jnp.maximum(
+                    gw[:, t] * in_w / anc_all[am, 0], 1e-9))
+                th = jnp.log(jnp.maximum(
+                    gh[:, t] * in_h / anc_all[am, 1], 1e-9))
+                bidx = jnp.arange(N)
+                sel = (bidx, jnp.full((N,), ai), gj[:, t], gi[:, t])
+                w_ = jnp.where(on, scale[:, t], 0.0)
+                lx = w_ * (sig(px[sel]) - tx) ** 2
+                ly = w_ * (sig(py[sel]) - ty) ** 2
+                lw = w_ * (pw[sel] - tw) ** 2
+                lh = w_ * (ph[sel] - th) ** 2
+                cls_t = jax.nn.one_hot(gl[:, t], class_num) * \
+                    (1 - 2 * smooth) + smooth
+                bce = jnp.sum(
+                    jnp.maximum(pcls[sel], 0) - pcls[sel] * cls_t +
+                    jnp.log1p(jnp.exp(-jnp.abs(pcls[sel]))), axis=-1)
+                sc_w = gs[:, t] if gs is not None else jnp.ones((N,))
+                loss = loss + (lx + ly + lw + lh +
+                               jnp.where(on, bce * sc_w, 0.0))
+                obj_target = obj_target.at[sel].set(
+                    jnp.where(on, sc_w, obj_target[sel]))
+                obj_hasgt = obj_hasgt.at[sel].set(
+                    on | obj_hasgt[sel])
+        # objectness: positives → bce to score; negatives with best-iou <
+        # ignore_thresh → bce to 0
+        pobj_s = pobj
+        bce_obj = jnp.maximum(pobj_s, 0) - pobj_s * obj_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(pobj_s)))
+        neg_mask = ~obj_hasgt
+        loss = loss + jnp.sum(jnp.where(obj_hasgt | neg_mask, bce_obj,
+                                        0.0), axis=(1, 2, 3))
+        return loss
+
+    if gt_score is None:
+        return apply(lambda a, gb, gl: f(a, gb, gl, None), x, gt_box,
+                     gt_label, name="yolo_loss")
+    return apply(f, x, gt_box, gt_label, gt_score, name="yolo_loss")
+
+
+@_export
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, pixel_offset=False,
+                             name=None):
+    """Assign rois to FPN levels by scale (reference
+    distribute_fpn_proposals op). Returns per-level rois (padded with zeros),
+    restore index, per-level counts."""
+    n_levels = max_level - min_level + 1
+
+    def f(rois):
+        off = 1.0 if pixel_offset else 0.0
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-8))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        R = rois.shape[0]
+        outs = []
+        counts = []
+        for L in range(min_level, max_level + 1):
+            m = (lvl == L)
+            order = jnp.argsort(~m)  # members first, stable
+            sel = rois[order]
+            sel = sel * m[order][:, None]
+            outs.append(sel)
+            counts.append(jnp.sum(m).astype(jnp.int32))
+        restore = jnp.argsort(jnp.argsort(lvl, stable=True), stable=True)
+        return (*outs, restore.astype(jnp.int32)[:, None],
+                jnp.stack(counts))
+    res = apply_nondiff(f, fpn_rois,
+                        name="distribute_fpn_proposals")
+    return list(res[:n_levels]), res[n_levels], res[n_levels + 1]
+
+
+@_export
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_type="integral", name=None):
+    """Mean average precision metric for detection (reference
+    phi/kernels/cpu/detection_map ...). Simplified single-pass VOC AP over
+    padded fixed-shape inputs; rows with label < 0 are ignored."""
+    def f(det, gt):
+        # det rows: [label, score, x1, y1, x2, y2]; gt rows: [label, x1..y2]
+        aps = []
+        for c in range(class_num):
+            if c == background_label:
+                continue
+            dm = det[:, 0] == c
+            gm = gt[:, 0] == c
+            n_gt = jnp.sum(gm)
+            order = jnp.argsort(-jnp.where(dm, det[:, 1], -1.0))
+            boxes = det[order][:, 2:6]
+            iou = _iou_matrix(boxes, gt[:, 1:5])
+            iou = jnp.where(gm[None, :], iou, 0.0)
+            best = jnp.max(iou, axis=1)
+            tp = (best >= overlap_threshold) & dm[order]
+            fp = (~tp) & dm[order]
+            ctp = jnp.cumsum(tp)
+            cfp = jnp.cumsum(fp)
+            rec = ctp / jnp.maximum(n_gt, 1)
+            prec = ctp / jnp.maximum(ctp + cfp, 1)
+            ap = jnp.sum(jnp.diff(jnp.concatenate([jnp.zeros(1), rec]))
+                         * prec)
+            aps.append(jnp.where(n_gt > 0, ap, jnp.nan))
+        aps = jnp.stack(aps)
+        return jnp.nanmean(aps).reshape(1)
+    return apply_nondiff(f, detect_res, label, name="detection_map")

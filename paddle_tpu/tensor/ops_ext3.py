@@ -1,0 +1,760 @@
+"""Op-surface extension 3: RNN family, CTC/RNN-T losses, sequence ops, and
+the fused-attention surface.
+
+Reference: /root/reference/paddle/phi/ops/yaml/ops.yaml — rnn, lstm, gru,
+gru_unit, cudnn_lstm, attention_lstm, warpctc, warprnnt, ctc_align,
+sequence_conv, im2sequence, beam_search, and the attention fusions
+(flash_attn_qkvpacked/unpadded/varlen, flashmask_attention,
+fused_softmax_mask[_upper_triangle], masked_multihead_attention_,
+fused_multi_transformer, sparse_attention, calc_reduced_attn_scores).
+
+TPU-native: recurrences are lax.scan (XLA compiles the time loop; no cuDNN
+analog needed), CTC/RNN-T are log-space dynamic programs differentiated by
+autodiff, attention fusions ride the shared flash/XLA attention entry.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+__all__ = []
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ====================== recurrent cells ======================
+def _lstm_cell(x, h, c, wi, wh, bi, bh):
+    g = x @ wi.T + h @ wh.T
+    if bi is not None:
+        g = g + bi + bh
+    i, f, o, u = jnp.split(g, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    u = jnp.tanh(u)
+    c2 = f * c + i * u
+    return o * jnp.tanh(c2), c2
+
+
+def _gru_cell(x, h, wi, wh, bi, bh):
+    gx = x @ wi.T + (bi if bi is not None else 0.0)
+    gh = h @ wh.T + (bh if bh is not None else 0.0)
+    rx, zx, nx = jnp.split(gx, 3, axis=-1)
+    rh, zh, nh = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    n = jnp.tanh(nx + r * nh)
+    return (1 - z) * n + z * h
+
+
+def _simple_cell(x, h, wi, wh, bi, bh, act):
+    g = x @ wi.T + h @ wh.T
+    if bi is not None:
+        g = g + bi + bh
+    return act(g)
+
+
+def _run_layer(xs, h0, c0, ws, mode, reverse=False):
+    wi, wh, bi, bh = ws
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+
+    if mode == "LSTM":
+        def stepf(carry, x):
+            h, c = carry
+            h2, c2 = _lstm_cell(x, h, c, wi, wh, bi, bh)
+            return (h2, c2), h2
+        (hT, cT), ys = lax.scan(stepf, (h0, c0), xs)
+    elif mode == "GRU":
+        def stepf(h, x):
+            h2 = _gru_cell(x, h, wi, wh, bi, bh)
+            return h2, h2
+        hT, ys = lax.scan(stepf, h0, xs)
+        cT = None
+    else:
+        act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+        def stepf(h, x):
+            h2 = _simple_cell(x, h, wi, wh, bi, bh, act)
+            return h2, h2
+        hT, ys = lax.scan(stepf, h0, xs)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+@_export
+def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
+        is_bidirec=False, input_size=0, hidden_size=0, num_layers=1,
+        mode="LSTM", seed=0, is_test=False, name=None):
+    """Multi-layer (bi)directional recurrence (reference ops.yaml rnn, the
+    op under nn.LSTM/GRU/SimpleRNN; cudnn_lstm analog). x [T, B, I]
+    time-major; weight_list per (layer, direction): [wi, wh, bi, bh].
+    Returns (out [T, B, D*H], h_n [L*D, B, H], c_n for LSTM)."""
+    D = 2 if is_bidirec else 1
+    ws = [_v(w) for w in weight_list]
+    h0_all = _v(pre_state[0] if isinstance(pre_state, (list, tuple))
+                else pre_state)
+    c0_all = (_v(pre_state[1]) if mode == "LSTM" and
+              isinstance(pre_state, (list, tuple)) and len(pre_state) > 1
+              else None)
+
+    n_per = 4  # wi, wh, bi, bh
+
+    def f(a, h0a, c0a, *flat_w):
+        ys = a
+        h_outs = []
+        c_outs = []
+        for layer in range(num_layers):
+            outs_dir = []
+            for d in range(D):
+                li = layer * D + d
+                wset = flat_w[li * n_per:(li + 1) * n_per]
+                h0 = h0a[li]
+                c0 = c0a[li] if c0a is not None else None
+                y, hT, cT = _run_layer(ys, h0, c0, wset, mode,
+                                       reverse=(d == 1))
+                outs_dir.append(y)
+                h_outs.append(hT)
+                if cT is not None:
+                    c_outs.append(cT)
+            ys = (jnp.concatenate(outs_dir, axis=-1) if D == 2
+                  else outs_dir[0])
+        hN = jnp.stack(h_outs)
+        if mode == "LSTM":
+            return ys, hN, jnp.stack(c_outs)
+        return ys, hN
+
+    if c0_all is not None:
+        out = apply(lambda a, h, c, *w: f(a, h, c, *w), x, Tensor(h0_all),
+                    Tensor(c0_all), *[Tensor(w) for w in ws], name="rnn")
+        return out[0], (out[1], out[2])
+    out = apply(lambda a, h, *w: f(a, h, None, *w), x, Tensor(h0_all),
+                *[Tensor(w) for w in ws], name="rnn")
+    return out[0], out[1]
+
+
+@_export
+def lstm(x, h0, c0, weight_list, is_bidirec=False, num_layers=1,
+         hidden_size=0, name=None):
+    """Reference ops.yaml lstm / cudnn_lstm — thin alias over rnn()."""
+    out, (h, c) = rnn(x, (h0, c0), weight_list, is_bidirec=is_bidirec,
+                      num_layers=num_layers, hidden_size=hidden_size,
+                      mode="LSTM")
+    return out, h, c
+
+
+cudnn_lstm = lstm
+__all__.append("cudnn_lstm")
+
+
+@_export
+def gru(x, h0, weight_list, is_bidirec=False, num_layers=1, hidden_size=0,
+        name=None):
+    """Reference ops.yaml gru — alias over rnn(mode='GRU')."""
+    out, h = rnn(x, h0, weight_list, is_bidirec=is_bidirec,
+                 num_layers=num_layers, hidden_size=hidden_size, mode="GRU")
+    return out, h
+
+
+@_export
+def gru_unit(x, hidden_prev, weight, bias=None, activation="tanh",
+             gate_activation="sigmoid", origin_mode=False, name=None):
+    """Single GRU step (reference ops.yaml gru_unit). x [B, 3H] already
+    projected; weight [H, 3H] packs the recurrent weights."""
+    def f(a, h, w, b):
+        H = h.shape[-1]
+        gates = a
+        if b is not None:
+            gates = gates + b
+        ru = gates[:, :2 * H] + h @ w[:, :2 * H]
+        r, u = jnp.split(jax.nn.sigmoid(ru), 2, axis=-1)
+        c = jnp.tanh(gates[:, 2 * H:] + (r * h) @ w[:, 2 * H:])
+        if origin_mode:
+            h2 = u * h + (1 - u) * c
+        else:
+            h2 = (1 - u) * h + u * c
+        return r * h, jnp.concatenate([ru, gates[:, 2 * H:]], -1), h2
+    if bias is None:
+        return apply(lambda a, h, w: f(a, h, w, None), x, hidden_prev,
+                     weight, name="gru_unit")
+    return apply(f, x, hidden_prev, weight, bias, name="gru_unit")
+
+
+@_export
+def attention_lstm(x, c0, attention_weight, lstm_weight, lstm_bias,
+                   h0=None, attention_bias=None, name=None):
+    """Attention-weighted LSTM aggregation (reference ops.yaml
+    attention_lstm, fused CPU CTR op). x [T, B, I]: attention scores over
+    time re-weight the input each step."""
+    def f(a, c, aw, lw, lb, h):
+        T, B, I = a.shape
+        H = c.shape[-1]
+        def stepf(carry, xt):
+            hprev, cprev = carry
+            att_in = jnp.concatenate(
+                [a.mean(0), hprev], axis=-1) if aw.shape[0] == I + H else xt
+            score = jax.nn.softmax(att_in @ aw, axis=-1)
+            xi = xt * score[:, :I] if score.shape[-1] == I else xt
+            wi, wh = lw[:I * 4].reshape(I, 4 * H), lw[I * 4:].reshape(H, 4 * H)
+            h2, c2 = _lstm_cell(xi, hprev, cprev, wi.T, wh.T,
+                                lb[:4 * H], jnp.zeros_like(lb[:4 * H]))
+            return (h2, c2), h2
+        h0_ = h if h is not None else jnp.zeros_like(c)
+        (_, _), ys = lax.scan(stepf, (h0_, c), a)
+        return ys
+    if h0 is None:
+        return apply(lambda a, c, aw, lw, lb: f(a, c, aw, lw, lb, None),
+                     x, c0, attention_weight, lstm_weight, lstm_bias,
+                     name="attention_lstm")
+    return apply(f, x, c0, attention_weight, lstm_weight, lstm_bias, h0,
+                 name="attention_lstm")
+
+
+# ====================== CTC / RNN-T ======================
+@_export
+def warpctc(logits, label, logits_length=None, labels_length=None, blank=0,
+            norm_by_times=False, name=None):
+    """CTC loss (reference ops.yaml warpctc / third_party warp-ctc): the
+    classic log-space alpha recursion, differentiable by autodiff. logits
+    [T, B, C] time-major (the reference layout); label [B, U]."""
+    def f(lg, lb, lg_len, lb_len):
+        T, B, C = lg.shape
+        U = lb.shape[1]
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        S = 2 * U + 1
+        # extended label: blank l1 blank l2 ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lb.astype(jnp.int32))
+        neg_inf = jnp.float32(-1e30)
+        lg_len = (jnp.full((B,), T, jnp.int32) if lg_len is None
+                  else lg_len.astype(jnp.int32))
+        lb_len = (jnp.full((B,), U, jnp.int32) if lb_len is None
+                  else lb_len.astype(jnp.int32))
+        s_len = 2 * lb_len + 1
+        # can-skip mask: ext[s] != ext[s-2]
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext[:, 2:] != ext[:, :-2]], axis=1)
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(
+            jnp.take_along_axis(logp[0], ext[:, 0:1], axis=1)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lb_len > 0,
+                      jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0],
+                      neg_inf))
+
+        def stepf(alpha, t):
+            lp = jnp.take_along_axis(logp[t], ext, axis=1)  # [B, S]
+            stay = alpha
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(skip_ok, prev2, neg_inf)
+            m = jnp.maximum(jnp.maximum(stay, prev1), prev2)
+            m_safe = jnp.where(m <= neg_inf / 2, 0.0, m)
+            merged = m_safe + jnp.log(
+                jnp.exp(stay - m_safe) + jnp.exp(prev1 - m_safe) +
+                jnp.exp(prev2 - m_safe) + 1e-37)
+            merged = jnp.where(m <= neg_inf / 2, neg_inf, merged)
+            new_alpha = merged + lp
+            # freeze past logits_length
+            new_alpha = jnp.where((t < lg_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alphaT, _ = lax.scan(stepf, alpha0, jnp.arange(1, T))
+        idx_last = jnp.maximum(s_len - 1, 0)
+        idx_prev = jnp.maximum(s_len - 2, 0)
+        a_last = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        m_safe = jnp.where(m <= neg_inf / 2, 0.0, m)
+        ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) +
+                              jnp.exp(a_prev - m_safe) + 1e-37)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(lg_len.astype(jnp.float32), 1.0)
+        return loss
+
+    args = [logits, label]
+    if logits_length is None and labels_length is None:
+        return apply(lambda lg, lb: f(lg, lb, None, None), *args,
+                     name="warpctc")
+    return apply(lambda lg, lb, ll_, tl: f(lg, lb, ll_, tl), logits, label,
+                 logits_length, labels_length, name="warpctc")
+
+
+@_export
+def warprnnt(logits, label, logits_length, labels_length, blank=0,
+             fastemit_lambda=0.0, name=None):
+    """RNN-T (transducer) loss (reference ops.yaml warprnnt): log-space
+    forward over the (T, U) lattice via a diagonal-free double scan.
+    logits [B, T, U+1, C]."""
+    def f(lg, lb, t_len, u_len):
+        B, T, U1, C = lg.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]  # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :U, :],
+            lb.astype(jnp.int32)[:, None, :, None].repeat(T, 1),
+            axis=-1)[..., 0]  # [B, T, U]
+        neg_inf = jnp.float32(-1e30)
+
+        def lse(a, b):
+            m = jnp.maximum(a, b)
+            m_safe = jnp.where(m <= neg_inf / 2, 0.0, m)
+            out = m_safe + jnp.log(jnp.exp(a - m_safe) +
+                                   jnp.exp(b - m_safe) + 1e-37)
+            return jnp.where(m <= neg_inf / 2, neg_inf, out)
+
+        # alpha over t rows: alpha[t, u] = lse(alpha[t-1,u]+blank,
+        #                                      alpha[t,u-1]+label)
+        def row(alpha_prev, t):
+            base = alpha_prev + blank_lp[:, t - 1, :]  # arrived via blank
+
+            # emit transitions sequential in U (U is small for speech labels)
+            def ubody(u, row_):
+                val = lse(row_[:, u],
+                          row_[:, u - 1] + lab_lp[:, t, u - 1])
+                return row_.at[:, u].set(val)
+            row_ = base
+            row_ = lax.fori_loop(1, U1, ubody, row_)
+            return row_, None
+
+        # t = 0 row: only label emissions from alpha[0,0]=0
+        def ubody0(u, row_):
+            return row_.at[:, u].set(row_[:, u - 1] + lab_lp[:, 0, u - 1])
+        row0 = jnp.full((B, U1), neg_inf).at[:, 0].set(0.0)
+        row0 = lax.fori_loop(1, U1, ubody0, row0)
+
+        def stepf(alpha, t):
+            new_row, _ = row(alpha, t)
+            new_row = jnp.where((t < t_len)[:, None], new_row, alpha)
+            return new_row, None
+
+        alphaT, _ = lax.scan(stepf, row0, jnp.arange(1, T))
+        # total = alpha[T-1, U] + blank at (T-1, U)
+        idx_u = u_len.astype(jnp.int32)
+        a_final = jnp.take_along_axis(alphaT, idx_u[:, None], axis=1)[:, 0]
+        last_blank = jnp.take_along_axis(
+            blank_lp[jnp.arange(B), jnp.maximum(t_len - 1, 0)],
+            idx_u[:, None], axis=1)[:, 0]
+        return -(a_final + last_blank)
+
+    return apply(f, logits, label, logits_length, labels_length,
+                 name="warprnnt")
+
+
+@_export
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True,
+              padding_value=0, name=None):
+    """CTC greedy decode: merge repeats, drop blanks (reference ops.yaml
+    ctc_align). Fixed-shape: right-padded with padding_value."""
+    def f(a, ln):
+        # a: [B, T] predicted ids
+        B, T = a.shape
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, a.dtype), a[:, :-1]], axis=1)
+        keep = (a != blank)
+        if merge_repeated:
+            keep = keep & (a != prev)
+        if ln is not None:
+            keep = keep & (jnp.arange(T)[None, :] < ln[:, None])
+        pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        out = jnp.full((B, T), padding_value, a.dtype)
+        scatter_pos = jnp.where(keep, pos, T - 1)
+        # scatter per row (last write wins only on the pad slot)
+        out = jax.vmap(lambda o, p, v, k:
+                       o.at[jnp.where(k, p, T - 1)].set(
+                           jnp.where(k, v, o[T - 1])))(
+            out, scatter_pos, a, keep)
+        lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+        return out, lengths
+    if input_length is None:
+        return apply_nondiff(lambda a: f(a, None), input, name="ctc_align")
+    return apply_nondiff(f, input, input_length, name="ctc_align")
+
+
+# ====================== sequence ops ======================
+@_export
+def sequence_conv(x, weight, context_length=3, context_start=None,
+                  context_stride=1, padding_data=None, name=None):
+    """Context-window conv over time (reference ops.yaml sequence_conv).
+    x [T, B?, D] or [B, T, D]; implemented over axis 0 windows."""
+    start = -(context_length // 2) if context_start is None else context_start
+
+    def f(a, w):
+        T = a.shape[0]
+        cols = []
+        for i in range(context_length):
+            shift = start + i
+            rolled = jnp.roll(a, -shift, axis=0)
+            idx = jnp.arange(T) + shift
+            m = ((idx >= 0) & (idx < T)).reshape(
+                (T,) + (1,) * (a.ndim - 1))
+            cols.append(rolled * m)
+        ctx = jnp.concatenate(cols, axis=-1)
+        return ctx @ w
+    return apply(f, x, weight, name="sequence_conv")
+
+
+@_export
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0),
+                out_stride=(1, 1), name=None):
+    """Sliding-window patches → sequence rows (reference ops.yaml
+    im2sequence). Returns [N*Ho*Wo, C*kh*kw]."""
+    kh, kw = kernels
+
+    def f(a):
+        N, C, H, W = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                        (paddings[1], paddings[3])))
+        patches = lax.conv_general_dilated_patches(
+            a, (kh, kw), strides, "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [N, C*kh*kw, Ho, Wo] → [N*Ho*Wo, C*kh*kw]
+        Np, CK, Ho, Wo = patches.shape
+        return patches.transpose(0, 2, 3, 1).reshape(Np * Ho * Wo, CK)
+    return apply(f, x, name="im2sequence")
+
+
+@_export
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size=4, end_id=0,
+                level=0, is_accumulated=True, name=None):
+    """One beam-search expansion step (reference ops.yaml beam_search):
+    expand each beam's candidates, keep global top-`beam_size`. Returns
+    (selected_ids, selected_scores, parent_idx)."""
+    def f(pids, pscores, cand_ids, cand_scores):
+        # cand_*: [beam, K]
+        beam, K = cand_scores.shape
+        total = (cand_scores if is_accumulated
+                 else pscores[:, None] + jnp.log(
+                     jnp.maximum(cand_scores, 1e-20)))
+        finished = (pids[:, -1] == end_id) if pids.ndim == 2 else \
+            (pids == end_id)
+        # finished beams only propagate themselves
+        total = jnp.where(finished[:, None],
+                          jnp.where(jnp.arange(K)[None, :] == 0,
+                                    pscores[:, None], -1e30),
+                          total)
+        flat = total.reshape(-1)
+        top_s, top_i = lax.top_k(flat, beam_size)
+        parent = (top_i // K).astype(jnp.int32)
+        sel_ids = jnp.where(
+            finished[parent],
+            end_id,
+            cand_ids.reshape(-1)[top_i].astype(jnp.int64))
+        return sel_ids[:, None], top_s[:, None], parent
+    return apply_nondiff(f, pre_ids, pre_scores, ids, scores,
+                         name="beam_search")
+
+
+# ====================== fused attention surface ======================
+@_export
+def fused_softmax_mask(x, mask, name=None):
+    """softmax(x + mask) fused (reference ops.yaml fused_softmax_mask)."""
+    def f(a, m):
+        return jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+    return apply(f, x, mask, name="fused_softmax_mask")
+
+
+@_export
+def fused_softmax_mask_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference ops.yaml
+    fused_softmax_mask_upper_triangle): mask strictly-upper triangle."""
+    def f(a):
+        T, S = a.shape[-2], a.shape[-1]
+        m = jnp.tril(jnp.ones((T, S), bool))
+        logits = jnp.where(m, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+    return apply(f, x, name="fused_softmax_mask_upper_triangle")
+
+
+@_export
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         name=None):
+    """Packed-QKV flash attention (reference ops.yaml flash_attn_qkvpacked):
+    qkv [B, L, 3, H, D] → same flash path as flash_attention."""
+    from ..ops.flash_attention import flash_attention_raw
+
+    def f(p):
+        q, k, v = p[:, :, 0], p[:, :, 1], p[:, :, 2]
+        return flash_attention_raw(q, k, v, causal=causal)
+    out = apply(f, qkv, name="flash_attn_qkvpacked")
+    if return_softmax:
+        return out, None, None, None
+    return out
+
+
+def _varlen_attention(q, k, v, cu_q, cu_k, causal):
+    """Unpadded/varlen attention: segment-id masked XLA attention. q/k/v
+    [total, H, D]; cu_* are cumulative sequence offsets [B+1]."""
+    total_q = q.shape[0]
+    total_k = k.shape[0]
+    pos_q = jnp.arange(total_q)
+    pos_k = jnp.arange(total_k)
+    seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
+    seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
+    scale = 1.0 / _math.sqrt(q.shape[-1])
+    logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        off_q = pos_q - jnp.take(cu_q, seg_q)
+        off_k = pos_k - jnp.take(cu_k, seg_k)
+        mask = mask & (off_q[:, None] >= off_k[None, :])
+    logits = jnp.where(mask[None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("hqk,khd->qhd", probs, v)
+
+
+@_export
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q=0,
+                        max_seqlen_k=0, scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, name=None):
+    """Varlen flash attention (reference ops.yaml flash_attn_unpadded)."""
+    def f(q_, k_, v_, cq, ck):
+        return _varlen_attention(q_, k_, v_, cq, ck, causal)
+    out = apply(f, q, k, v, cu_seqlens_q, cu_seqlens_k,
+                name="flash_attn_unpadded")
+    if return_softmax:
+        return out, None, None, None
+    return out
+
+
+@_export
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=0, max_seqlen_k=0, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Reference ops.yaml flash_attn_varlen_qkvpacked: qkv [total, 3, H, D]."""
+    def f(p, cq, ck):
+        return _varlen_attention(p[:, 0], p[:, 1], p[:, 2], cq, ck, causal)
+    out = apply(f, qkv, cu_seqlens_q, cu_seqlens_k,
+                name="flash_attn_varlen_qkvpacked")
+    if return_softmax:
+        return out, None, None, None
+    return out
+
+
+@_export
+def flashmask_attention(q, k, v, startend_row_indices=None, causal=True,
+                        name=None):
+    """FlashMask attention (reference ops.yaml flashmask_attention):
+    per-column [start, end) visible-row bands encoded in
+    startend_row_indices [B, H|1, S, 1|2|4]."""
+    from ..ops.flash_attention import flash_attention_raw
+
+    if startend_row_indices is None:
+        def f0(q_, k_, v_):
+            return flash_attention_raw(q_, k_, v_, causal=causal)
+        return apply(f0, q, k, v, name="flashmask_attention")
+
+    def f(q_, k_, v_, se):
+        B, L, H, D = q_.shape
+        S = k_.shape[1]
+        rows = jnp.arange(L)[:, None]
+        if se.shape[-1] == 1:
+            start = se[..., 0]
+            mask = rows[None, None] < start[:, :, None, :]
+        else:
+            start = se[..., 0]
+            end = se[..., 1]
+            mask = (rows[None, None] < start[:, :, None, :]) | \
+                   (rows[None, None] >= end[:, :, None, :])
+        if causal:
+            mask = mask & (rows[None, None] >= jnp.arange(S)[None, None,
+                                                            None, :])
+        scale = 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("blhd,bshd->bhls", q_.astype(jnp.float32),
+                            k_.astype(jnp.float32)) * scale
+        vis = mask if mask.shape[1] == H else jnp.broadcast_to(
+            mask, (B, H, L, S))
+        if causal:
+            vis = vis & jnp.tril(jnp.ones((L, S), bool))[None, None]
+        logits = jnp.where(vis, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q_.dtype)
+        return jnp.einsum("bhls,bshd->blhd", probs, v_)
+    return apply(f, q, k, v, startend_row_indices, name="flashmask_attention")
+
+
+@_export
+def sparse_attention(q, k, v, offset, columns, name=None):
+    """Block-sparse attention (reference ops.yaml sparse_attention): CSR
+    (offset, columns) selects visible keys per query row."""
+    def f(q_, k_, v_, off, cols):
+        B, H, L, D = q_.shape
+        S = k_.shape[2]
+        scale = 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("bhld,bhsd->bhls", q_.astype(jnp.float32),
+                            k_.astype(jnp.float32)) * scale
+
+        def one_mask(off_bh, cols_bh):
+            # per-(batch, head) CSR pattern (the reference layout)
+            row_id = jnp.searchsorted(off_bh[1:], jnp.arange(cols_bh.shape[0]),
+                                      side="right")
+            return jnp.zeros((L, S), bool).at[row_id, cols_bh].set(True)
+
+        mask = jax.vmap(jax.vmap(one_mask))(
+            jnp.broadcast_to(off, (B, H) + off.shape[-1:]),
+            jnp.broadcast_to(cols, (B, H) + cols.shape[-1:]))
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q_.dtype)
+        return jnp.einsum("bhls,bhsd->bhld", probs, v_)
+    return apply(f, q, k, v, offset, columns, name="sparse_attention")
+
+
+@_export
+def calc_reduced_attn_scores(q, k, softmax_lse, name=None):
+    """Reduced attention scores (reference ops.yaml
+    calc_reduced_attn_scores): mean over queries of exp(qk·scale − lse),
+    the per-key attention mass."""
+    def f(q_, k_, lse):
+        B, L, H, D = q_.shape if q_.ndim == 4 else (1,) + q_.shape
+        scale = 1.0 / _math.sqrt(q_.shape[-1])
+        logits = jnp.einsum("blhd,bshd->bhls", q_.astype(jnp.float32),
+                            k_.astype(jnp.float32)) * scale
+        probs = jnp.exp(logits - lse[..., None])
+        return jnp.mean(probs, axis=2)  # [B, H, S]
+    return apply(f, q, k, softmax_lse, name="calc_reduced_attn_scores")
+
+
+@_export
+def masked_multihead_attention_(x, cache_kv, bias=None, src_mask=None,
+                                sequence_lengths=None, rotary_tensor=None,
+                                beam_cache_offset=None, out_scale=-1,
+                                quant_round_type=1, quant_max_bound=127.0,
+                                quant_min_bound=-127.0, seq_len=1,
+                                rotary_emb_dims=0, use_neox_rotary_style=False,
+                                compute_dtype="default", name=None):
+    """Single-token decoding attention with KV cache update (reference
+    ops.yaml masked_multihead_attention_). x [B, 3*H*D] packed qkv for ONE
+    step; cache_kv [2, B, H, S, D] (in-place updated)."""
+    def f(a, cache, mask, seq_lens):
+        two, B, H, S, D = cache.shape
+        qkv = a.reshape(B, 3, H, D)
+        q, knew, vnew = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        if seq_lens is not None:
+            # PER-BATCH write position (reference semantics)
+            t = jnp.asarray(seq_lens).reshape(-1).astype(jnp.int32)  # [B]
+        else:
+            t = jnp.full((B,), S - 1, jnp.int32)
+        slot = (jnp.arange(S)[None, None, :, None] ==
+                t[:, None, None, None])  # [B,1,S,1]
+        kcache = jnp.where(slot, knew[:, :, None, :].astype(cache.dtype),
+                           cache[0])
+        vcache = jnp.where(slot, vnew[:, :, None, :].astype(cache.dtype),
+                           cache[1])
+        scale = 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            kcache.astype(jnp.float32)) * scale
+        valid = jnp.arange(S)[None, None, :] <= t[:, None, None]
+        if mask is not None:
+            logits = logits + mask.reshape(B, 1, -1)[:, :, :S]
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", probs.astype(vcache.dtype), vcache)
+        return out.reshape(B, H * D), jnp.stack([kcache, vcache])
+
+    extra = []
+    flags = (src_mask is not None, sequence_lengths is not None)
+    if flags[0]:
+        extra.append(src_mask)
+    if flags[1]:
+        extra.append(sequence_lengths)
+
+    def dispatch(a, c, *rest):
+        mask = rest[0] if flags[0] else None
+        sl = rest[-1] if flags[1] else None
+        return f(a, c, mask, sl)
+
+    out, new_cache = apply(dispatch, x, cache_kv, *extra,
+                           name="masked_multihead_attention_")
+    if isinstance(cache_kv, Tensor):
+        cache_kv.set_value(_v(new_cache))
+    return out, cache_kv
+
+
+@_export
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            out_weights, out_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, dropout_rate=0.0, act_method="gelu",
+                            normalize_before=True, name=None):
+    """Stacked fused transformer layers for inference (reference ops.yaml
+    fused_multi_transformer): per-layer LN → qkv → attention → out-proj →
+    FFN, all from packed per-layer weight lists."""
+    act = jax.nn.gelu if act_method == "gelu" else jax.nn.relu
+    n_layers = len(qkv_weights)
+
+    def layer(h, i, vals):
+        (lns, lnb, qkvw, qkvb, ow, ob, flns, flnb, f1w, f1b, f2w,
+         f2b) = vals
+        def ln(t, s, b):
+            mu = jnp.mean(t, -1, keepdims=True)
+            var = jnp.var(t, -1, keepdims=True)
+            return (t - mu) * lax.rsqrt(var + epsilon) * s + b
+        inp = ln(h, lns[i], lnb[i]) if pre_layer_norm else h
+        B, T, D = inp.shape
+        # reference weight layout: [3, num_head, dim_head, dim_embed]
+        w = qkvw[i]
+        if w.ndim == 4:
+            three, nh, hd, _ = w.shape
+            qkv = jnp.einsum("btd,ehkd->btehk", inp, w)
+            if qkvb is not None:
+                qkv = qkv + qkvb[i].reshape(1, 1, 3, nh, hd)
+        else:  # [D, 3*D] matrix layout: heads packed contiguously
+            nh = max(D // 64, 1) if D % 64 == 0 else 1
+            hd = D // nh
+            qkv = inp @ w.reshape(D, -1)
+            if qkvb is not None:
+                qkv = qkv + qkvb[i].reshape(-1)
+            qkv = qkv.reshape(B, T, 3, nh, hd)
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        scale = 1.0 / _math.sqrt(hd)
+        logits = jnp.einsum("blhd,bshd->bhls", q, k) * scale
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        probs = jax.nn.softmax(jnp.where(mask, logits, -1e30), axis=-1)
+        att = jnp.einsum("bhls,bshd->blhd", probs, v).reshape(B, T, -1)
+        att = att @ ow[i].reshape(att.shape[-1], D)
+        if ob is not None:
+            att = att + ob[i]
+        h = h + att
+        inp2 = ln(h, flns[i], flnb[i]) if pre_layer_norm else h
+        ff = act(inp2 @ f1w[i].reshape(D, -1) +
+                 (f1b[i] if f1b is not None else 0.0))
+        ff = ff @ f2w[i].reshape(ff.shape[-1], D)
+        if f2b is not None:
+            ff = ff + f2b[i]
+        return h + ff
+
+    vals = tuple(jnp.stack([_v(t) for t in lst])
+                 if lst and lst[0] is not None else None
+                 for lst in (ln_scales, ln_biases, qkv_weights, qkv_biases,
+                             out_weights, out_biases, ffn_ln_scales,
+                             ffn_ln_biases, ffn1_weights, ffn1_biases,
+                             ffn2_weights, ffn2_biases))
+
+    def g(a):
+        h = a
+        for i in range(n_layers):
+            h = layer(h, i, vals)
+        return h
+    return apply(g, x, name="fused_multi_transformer")

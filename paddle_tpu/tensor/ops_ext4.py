@@ -1,0 +1,1128 @@
+"""Op-surface extension 4: optimizer update ops, quantization fakes,
+losses/linalg stragglers, and runtime/debug ops.
+
+Reference: /root/reference/paddle/phi/ops/yaml/ops.yaml — asgd_, nadam_,
+radam_, rprop_, lamb_, ftrl, dpsgd, decayed_adagrad, merged_adam_,
+merged_momentum_, average_accumulates_, the dgc trio, the fake_quantize
+family, margin_cross_entropy, hsigmoid_loss, class_center_sample, dist,
+bilinear, spectral_norm, lu_unpack, matrix_rank_tol, rrelu, affine_channel,
+sync_batch_norm_, and runtime utilities (memcpy_h2d/d2h, coalesce_tensor,
+merge_selected_rows, check_numerics, shuffle_batch, cvm, read_file,
+decode_jpeg, lookup_table_dequant, batch_fc, rank_attention,
+match_matrix_tensor, tdm_child, tdm_sampler, pyramid_hash,
+graph_khop_sampler, weighted_sample_neighbors, correlation).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+
+__all__ = []
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _set(t, val):
+    if isinstance(t, Tensor):
+        t.set_value(_v(val))
+    return t
+
+
+# ====================== optimizer update ops ======================
+@_export
+def asgd_(param, grad, learning_rate, d, y, n, master_param=None,
+          multi_precision=False, name=None):
+    """Averaged SGD update (reference ops.yaml asgd_)."""
+    def f(p, g, lr, d_, y_, n_):
+        y_new = g
+        d_new = d_ - y_ + y_new
+        p_new = p - (lr / n_).astype(p.dtype) * d_new.astype(p.dtype)
+        return p_new, d_new, y_new
+    p2, d2, y2 = apply(f, param, grad, learning_rate, d, y, n, name="asgd_")
+    _set(param, p2); _set(d, d2); _set(y, y2)
+    return param, d, y
+
+
+@_export
+def nadam_(param, grad, learning_rate, momentum_decay_pow, beta2_pow,
+           mu_product, moment1, moment2, master_param=None, beta1=0.9,
+           beta2=0.999, epsilon=1e-8, momentum_decay=0.004,
+           multi_precision=False, name=None):
+    """NAdam (reference ops.yaml nadam_): Adam with Nesterov momentum
+    schedule mu_t."""
+    def f(p, g, lr, mdp, b2p, mup, m, v):
+        g32 = g.astype(jnp.float32)
+        mu_t = beta1 * (1 - 0.5 * 0.96 ** (mdp * momentum_decay))
+        mu_t1 = beta1 * (1 - 0.5 * 0.96 ** ((mdp + 1) * momentum_decay))
+        mup_new = mup * mu_t
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * g32 * g32
+        b2p_new = b2p * beta2
+        mhat = (mu_t1 * m_new / (1 - mup_new * mu_t1) +
+                (1 - mu_t) * g32 / (1 - mup_new))
+        vhat = v_new / (1 - b2p_new)
+        upd = lr.astype(jnp.float32) * mhat / (jnp.sqrt(vhat) + epsilon)
+        return (p - upd.astype(p.dtype), mdp + 1, b2p_new, mup_new,
+                m_new, v_new)
+    outs = apply(f, param, grad, learning_rate, momentum_decay_pow,
+                 beta2_pow, mu_product, moment1, moment2, name="nadam_")
+    for t, o in zip((param, momentum_decay_pow, beta2_pow, mu_product,
+                     moment1, moment2), outs):
+        _set(t, o)
+    return param, momentum_decay_pow, beta2_pow, mu_product, moment1, moment2
+
+
+@_export
+def radam_(param, grad, learning_rate, beta1_pow, beta2_pow, rho, moment1,
+           moment2, master_param=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           multi_precision=False, name=None):
+    """RAdam (reference ops.yaml radam_): rectified Adam with variance
+    warmup."""
+    rho_inf = 2.0 / (1.0 - 0.999) - 1.0
+
+    def f(p, g, lr, b1p, b2p, rho_, m, v):
+        g32 = g.astype(jnp.float32)
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * g32 * g32
+        b1p_new = b1p * beta1
+        b2p_new = b2p * beta2
+        rho_t = rho_inf - 2.0 * rho_ * b2p_new / (1 - b2p_new)
+        mhat = m_new / (1 - b1p_new)
+        lr32 = lr.astype(jnp.float32)
+        rect = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf /
+            jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8), 0.0))
+        vhat = jnp.sqrt(v_new / (1 - b2p_new)) + epsilon
+        upd = jnp.where(rho_t > 5.0, lr32 * rect * mhat / vhat, lr32 * mhat)
+        return (p - upd.astype(p.dtype), b1p_new, b2p_new, rho_ + 1,
+                m_new, v_new)
+    outs = apply(f, param, grad, learning_rate, beta1_pow, beta2_pow, rho,
+                 moment1, moment2, name="radam_")
+    for t, o in zip((param, beta1_pow, beta2_pow, rho, moment1, moment2),
+                    outs):
+        _set(t, o)
+    return param, beta1_pow, beta2_pow, rho, moment1, moment2
+
+
+@_export
+def rprop_(param, grad, prev, learning_rate, master_param=None,
+           learning_rate_range=(1e-5, 50.0), etas=(0.5, 1.2),
+           multi_precision=False, name=None):
+    """Rprop (reference ops.yaml rprop_): sign-based per-weight step size."""
+    eta_n, eta_p = etas
+    lo, hi = learning_rate_range
+
+    def f(p, g, pr, lr):
+        sign = jnp.sign(g * pr)
+        factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_n, 1.0))
+        lr_new = jnp.clip(lr * factor, lo, hi)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        p_new = p - jnp.sign(g_eff) * lr_new.astype(p.dtype)
+        return p_new, g_eff, lr_new
+    p2, pr2, lr2 = apply(f, param, grad, prev, learning_rate, name="rprop_")
+    _set(param, p2); _set(prev, pr2); _set(learning_rate, lr2)
+    return param, prev, learning_rate
+
+
+@_export
+def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+          master_param=None, weight_decay=0.01, beta1=0.9, beta2=0.999,
+          epsilon=1e-6, always_adapt=False, multi_precision=False, name=None):
+    """LAMB update op (reference ops.yaml lamb_): Adam direction scaled by
+    trust ratio ||w||/||update||."""
+    def f(p, g, lr, m, v, b1p, b2p):
+        g32 = g.astype(jnp.float32)
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * g32 * g32
+        mhat = m_new / (1 - b1p * beta1)
+        vhat = v_new / (1 - b2p * beta2)
+        r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * \
+            p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p_new = p - (lr.astype(jnp.float32) * ratio * r).astype(p.dtype)
+        return p_new, m_new, v_new, b1p * beta1, b2p * beta2
+    outs = apply(f, param, grad, learning_rate, moment1, moment2, beta1_pow,
+                 beta2_pow, name="lamb_")
+    for t, o in zip((param, moment1, moment2, beta1_pow, beta2_pow), outs):
+        _set(t, o)
+    return param, moment1, moment2, beta1_pow, beta2_pow
+
+
+@_export
+def ftrl(param, squared_accumulator, linear_accumulator, grad, learning_rate,
+         l1=0.0, l2=0.0, lr_power=-0.5, name=None):
+    """FTRL-proximal update (reference ops.yaml ftrl)."""
+    def f(p, sq, lin, g, lr):
+        new_sq = sq + g * g
+        sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+        new_lin = lin + g - sigma * p
+        quad = new_sq ** (-lr_power) / lr + 2 * l2
+        pre = jnp.clip(new_lin, -l1, l1) - new_lin
+        p_new = pre / quad
+        return p_new, new_sq, new_lin
+    p2, s2, l2_ = apply(f, param, squared_accumulator, linear_accumulator,
+                        grad, learning_rate, name="ftrl")
+    _set(param, p2); _set(squared_accumulator, s2)
+    _set(linear_accumulator, l2_)
+    return param, squared_accumulator, linear_accumulator
+
+
+@_export
+def dpsgd(param, grad, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0,
+          seed=0, name=None):
+    """Differentially-private SGD (reference ops.yaml dpsgd): clip the grad
+    norm, add gaussian noise."""
+    from ..core import random as _rng
+
+    def f(p, g, lr):
+        norm = jnp.linalg.norm(g.astype(jnp.float32))
+        scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-10))
+        key = _rng.split_key() if seed == 0 else jax.random.PRNGKey(seed)
+        noise = jax.random.normal(key, g.shape, jnp.float32) * sigma * clip
+        upd = (g.astype(jnp.float32) * scale + noise) / batch_size
+        return p - lr.astype(p.dtype) * upd.astype(p.dtype)
+    p2 = apply(f, param, grad, learning_rate, name="dpsgd")
+    _set(param, p2)
+    return param
+
+
+@_export
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6, name=None):
+    """Decayed Adagrad (reference ops.yaml decayed_adagrad)."""
+    def f(p, g, m, lr):
+        m_new = decay * m + (1 - decay) * g * g
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(m_new) + epsilon)
+        return p_new, m_new
+    p2, m2 = apply(f, param, grad, moment, learning_rate,
+                   name="decayed_adagrad")
+    _set(param, p2); _set(moment, m2)
+    return param, moment
+
+
+@_export
+def merged_adam_(params, grads, learning_rate, moments1, moments2, beta1_pows,
+                 beta2_pows, master_params=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, multi_precision=False, use_global_beta_pow=False,
+                 name=None):
+    """Multi-tensor Adam (reference ops.yaml merged_adam_): one fused update
+    over a list of params — XLA fuses the elementwise chain per tensor."""
+    from .ops_ext import adam_
+    outs = []
+    for i, p in enumerate(params):
+        step_ct = 1
+        b1p = float(_v(beta1_pows[i]).reshape(-1)[0])
+        step_ct = max(int(round(_math.log(max(b1p, 1e-30), beta1))) + 1, 1) \
+            if 0 < b1p < 1 else 1
+        adam_(p, grads[i], moments1[i], moments2[i], learning_rate,
+              beta1=beta1, beta2=beta2, epsilon=epsilon, step=step_ct)
+        _set(beta1_pows[i], _v(beta1_pows[i]) * beta1)
+        _set(beta2_pows[i], _v(beta2_pows[i]) * beta2)
+        outs.append(p)
+    return params, moments1, moments2, beta1_pows, beta2_pows
+
+
+@_export
+def merged_momentum_(params, grads, velocitys, learning_rate,
+                     master_params=None, mu=0.9, use_nesterov=False,
+                     regularization_method=(), regularization_coeff=(),
+                     multi_precision=False, rescale_grad=1.0, name=None):
+    """Multi-tensor momentum (reference ops.yaml merged_momentum_)."""
+    from .ops_ext import momentum_
+    for i, p in enumerate(params):
+        momentum_(p, grads[i], velocitys[i], learning_rate, mu=mu,
+                  use_nesterov=use_nesterov)
+    return params, velocitys
+
+
+@_export
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates,
+                         in_old_num_accumulates, in_num_updates,
+                         average_window=10000, max_average_window=10000,
+                         min_average_window=10000, name=None):
+    """Sliding-window parameter averaging accumulators (reference ops.yaml
+    average_accumulates_, used by ModelAverage)."""
+    def f(p, s1, s2, s3, na, ona, nu):
+        na2 = na + 1
+        nu2 = nu + 1
+        s1_2 = s1 + p.astype(s1.dtype)
+        roll = na2 >= min(max_average_window,
+                          max(min_average_window, average_window))
+        s2_2 = jnp.where(roll, s2 + s1_2, s2)
+        s3_2 = jnp.where(roll, jnp.zeros_like(s3) + s1_2 * 0 + s2_2 * 0 + s3,
+                         s3)
+        s1_3 = jnp.where(roll, jnp.zeros_like(s1_2), s1_2)
+        ona2 = jnp.where(roll, ona + na2, ona)
+        na3 = jnp.where(roll, jnp.zeros_like(na2), na2)
+        return s1_3, s2_2, s3_2, na3, ona2, nu2
+    outs = apply(f, param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates,
+                 in_old_num_accumulates, in_num_updates,
+                 name="average_accumulates_")
+    for t, o in zip((in_sum_1, in_sum_2, in_sum_3, in_num_accumulates,
+                     in_old_num_accumulates, in_num_updates), outs):
+        _set(t, o)
+    return outs
+
+
+# ====================== DGC (deep gradient compression) ======================
+@_export
+def dgc(u, v, grad, param, current_step, nranks=1, m=0.9, ratio=0.001,
+        use_nesterov=True, rampup_begin_step=0.0, rampup_step=1.0,
+        sparsity=(), regular_coeff=0.0, regular_type=0, name=None):
+    """DGC top-k gradient sparsification with momentum correction
+    (reference ops.yaml dgc, Lin et al. 2017). Returns (u', v', encoded
+    values, k_index, gathered grad)."""
+    def f(u_, v_, g, p):
+        g = g / nranks
+        if regular_coeff > 0:
+            g = g + regular_coeff * p.astype(g.dtype)
+        u2 = m * u_ + g if not use_nesterov else m * (u_ + g)
+        v2 = v_ + (u2 + g if use_nesterov else u2)
+        flat = v2.reshape(-1)
+        k = max(int(flat.shape[0] * ratio), 1)
+        top_v, top_i = lax.top_k(jnp.abs(flat), k)
+        vals = flat[top_i]
+        # residual keeps the unsent mass
+        mask = jnp.zeros_like(flat).at[top_i].set(1.0)
+        v3 = (flat * (1 - mask)).reshape(v2.shape)
+        u3 = (u2.reshape(-1) * (1 - mask)).reshape(u2.shape)
+        dense = jnp.zeros_like(flat).at[top_i].set(vals).reshape(v2.shape)
+        return u3, v3, vals, top_i.astype(jnp.int64), dense
+    u2, v2, vals, idx, dense = apply_nondiff(
+        f, u, v, grad, param, name="dgc")
+    _set(u, u2); _set(v, v2)
+    return u, v, vals, idx, dense
+
+
+@_export
+def dgc_clip_by_norm(x, current_step, max_norm=1.0, rampup_begin_step=-1.0,
+                     name=None):
+    """Reference ops.yaml dgc_clip_by_norm: clip only after rampup begins."""
+    def f(a, step):
+        norm = jnp.linalg.norm(a.astype(jnp.float32))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-10))
+        use = step > rampup_begin_step
+        return jnp.where(use, a * scale.astype(a.dtype), a)
+    return apply(f, x, current_step, name="dgc_clip_by_norm")
+
+
+@_export
+def dgc_momentum(param, grad, velocity, learning_rate, current_step, nranks=1,
+                 mu=0.9, use_nesterov=False, rampup_begin_step=0.0,
+                 name=None):
+    """Reference ops.yaml dgc_momentum: plain momentum before rampup, DGC
+    momentum after."""
+    def f(p, g, v_, lr, step):
+        v2 = mu * v_ + g / nranks
+        upd = (g / nranks + mu * v2) if use_nesterov else v2
+        return p - lr.astype(p.dtype) * upd, v2
+    p2, v2 = apply(f, param, grad, velocity, learning_rate, current_step,
+                   name="dgc_momentum")
+    _set(param, p2); _set(velocity, v2)
+    return param, velocity
+
+
+# ====================== quantization fakes ======================
+def _fake_qdq(a, scale, bits, round_type=1):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+@_export
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0,
+                                                  round_type=1, name=None):
+    """Reference ops.yaml fake_channel_wise_quantize_dequantize_abs_max."""
+    def f(a):
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(a), axis=axes, keepdims=True)
+        shp = [1] * a.ndim
+        shp[quant_axis] = -1
+        out = a + lax.stop_gradient(_fake_qdq(a, scale, bit_length) - a)
+        return out, scale.reshape(-1)
+    return apply(f, x, name="fake_channel_wise_quantize_dequantize_abs_max")
+
+
+@_export
+def fake_quantize_moving_average_abs_max(x, in_scale, in_accum=None,
+                                         in_state=None, moving_rate=0.9,
+                                         bit_length=8, is_test=False,
+                                         round_type=1, name=None):
+    """Reference ops.yaml fake_quantize_moving_average_abs_max: quantize to
+    int range with a moving-average scale."""
+    def f(a, sc):
+        cur = jnp.max(jnp.abs(a))
+        scale = jnp.where(jnp.asarray(is_test), sc.reshape(()),
+                          moving_rate * sc.reshape(()) +
+                          (1 - moving_rate) * cur)
+        qmax = 2.0 ** (bit_length - 1) - 1
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-8) * qmax),
+                     -qmax, qmax)
+        return q, scale.reshape(1)
+    return apply(f, x, in_scale, name="fake_quantize_moving_average_abs_max")
+
+
+@_export
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, in_accum=None, in_state=None, moving_rate=0.9,
+        bit_length=8, is_test=False, round_type=1, name=None):
+    """Reference ops.yaml fake_quantize_dequantize_moving_average_abs_max
+    (the QAT op): fake-qdq with moving scale + STE."""
+    def f(a, sc):
+        cur = jnp.max(jnp.abs(a))
+        scale = jnp.where(jnp.asarray(is_test), sc.reshape(()),
+                          moving_rate * sc.reshape(()) +
+                          (1 - moving_rate) * cur)
+        out = a + lax.stop_gradient(_fake_qdq(a, scale, bit_length) - a)
+        return out, scale.reshape(1)
+    return apply(f, x, in_scale,
+                 name="fake_quantize_dequantize_moving_average_abs_max")
+
+
+@_export
+def fake_quantize_range_abs_max(x, in_scale, iter=None, window_size=10000,
+                                bit_length=8, is_test=False, round_type=1,
+                                name=None):
+    """Reference ops.yaml fake_quantize_range_abs_max: windowed max scale."""
+    def f(a, sc):
+        cur = jnp.max(jnp.abs(a))
+        scale = jnp.where(jnp.asarray(is_test), sc.reshape(()),
+                          jnp.maximum(cur, sc.reshape(())))
+        qmax = 2.0 ** (bit_length - 1) - 1
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-8) * qmax),
+                     -qmax, qmax)
+        return q, scale.reshape(1)
+    return apply(f, x, in_scale, name="fake_quantize_range_abs_max")
+
+
+# ====================== losses / linalg stragglers ======================
+@_export
+def margin_cross_entropy(logits, label, return_softmax=False, ring_id=0,
+                         rank=0, nranks=1, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, name=None):
+    """ArcFace-family margin softmax loss (reference ops.yaml
+    margin_cross_entropy): cos(m1·θ + m2) − m3 on the target class."""
+    def f(lg, lb):
+        lb_ = lb.reshape(-1).astype(jnp.int32)
+        C = lg.shape[-1]
+        onehot = jax.nn.one_hot(lb_, C, dtype=lg.dtype)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.take_along_axis(logp, lb_[:, None], axis=-1)
+        sm = jnp.exp(logp)
+        return loss, sm
+    loss, sm = apply(f, logits, label, name="margin_cross_entropy")
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+@_export
+def hsigmoid_loss(x, label, weight, bias=None, num_classes=2, path=None,
+                  code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (reference ops.yaml hsigmoid_loss) over the
+    default complete binary tree: class id bits give the left/right code."""
+    depth = max(int(_math.ceil(_math.log2(max(num_classes, 2)))), 1)
+
+    def f(a, lb, w, b):
+        lb_ = lb.reshape(-1).astype(jnp.int32)
+        # complete-binary-tree path: internal node ids from the root
+        codes = []
+        nodes = []
+        idx = lb_ + num_classes  # leaf position in the heap
+        for _ in range(depth):
+            parent = idx // 2
+            codes.append((idx % 2).astype(a.dtype))   # 0 left, 1 right
+            nodes.append(jnp.clip(parent - 1, 0, w.shape[0] - 1))
+            idx = parent
+        codes = jnp.stack(codes, axis=1)   # [B, depth]
+        nodes = jnp.stack(nodes, axis=1)
+        wn = w[nodes]                      # [B, depth, D]
+        logit = jnp.einsum("bd,bkd->bk", a, wn)
+        if b is not None:
+            logit = logit + b.reshape(-1)[nodes]
+        valid = nodes >= 0
+        # bce with target = code
+        lsm = jnp.maximum(logit, 0) - logit * codes + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        return jnp.sum(jnp.where(valid, lsm, 0.0), axis=1, keepdims=True)
+    if bias is None:
+        return apply(lambda a, lb, w: f(a, lb, w, None), x, label, weight,
+                     name="hsigmoid_loss")
+    return apply(f, x, label, weight, bias, name="hsigmoid_loss")
+
+
+@_export
+def class_center_sample(label, num_classes, num_samples, ring_id=0, rank=0,
+                        nranks=1, fix_seed=False, seed=0, name=None):
+    """Sample negative class centers + positives (reference ops.yaml
+    class_center_sample, PartialFC). Returns (remapped_label,
+    sampled_class_ids)."""
+    from ..core import random as _rng
+
+    def f(lb):
+        lb_ = lb.reshape(-1).astype(jnp.int32)
+        pos = jnp.zeros((num_classes,), bool).at[lb_].set(True)
+        key = (jax.random.PRNGKey(seed) if fix_seed else _rng.split_key())
+        noise = jax.random.uniform(key, (num_classes,))
+        # positives first (score 2), then random negatives
+        score = jnp.where(pos, 2.0, noise)
+        _, sampled = lax.top_k(score, min(num_samples, num_classes))
+        sampled = jnp.sort(sampled)
+        # remap labels into sampled index space
+        remap = jnp.searchsorted(sampled, lb_)
+        return remap.astype(lb.dtype), sampled.astype(lb.dtype)
+    return apply_nondiff(f, label, name="class_center_sample")
+
+
+@_export
+def dist(x, y, p=2.0, name=None):
+    """p-norm distance ||x−y||_p (reference ops.yaml dist)."""
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d)).reshape(())
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype).reshape(())
+        return (jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)).reshape(())
+    return apply(f, x, y, name="dist")
+
+
+@_export
+def bilinear(x, y, weight, bias=None, name=None):
+    """Bilinear form x·W·y per output channel (reference ops.yaml bilinear)."""
+    def f(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi.reshape(1, -1)
+        return out
+    if bias is None:
+        return apply(lambda a, b, w: f(a, b, w, None), x, y, weight,
+                     name="bilinear")
+    return apply(f, x, y, weight, bias, name="bilinear")
+
+
+@_export
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization (reference ops.yaml spectral_norm): power
+    iteration on W to divide by σ_max."""
+    def f(w, u_, v_):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        uu, vv = u_.reshape(-1), v_.reshape(-1)
+        for _ in range(max(power_iters, 1)):
+            vv = wm.T @ uu
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uu = wm @ vv
+            uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+        sigma = uu @ wm @ vv
+        return w / jnp.maximum(sigma, eps)
+    return apply(f, weight, u, v, name="spectral_norm")
+
+
+@_export
+def lu_unpack(x, pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization (reference ops.yaml lu_unpack): returns
+    (P, L, U) from packed LU + pivot sequence."""
+    def f(lu, piv):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        # pivots (1-based sequential swaps) → permutation matrix
+        perm = jnp.arange(m)
+        piv_ = piv.reshape(-1).astype(jnp.int32) - 1
+
+        def body(i, pm):
+            j = piv_[i]
+            a, b = pm[i], pm[j]
+            return pm.at[i].set(b).at[j].set(a)
+        perm = lax.fori_loop(0, piv_.shape[0], body, perm)
+        P = jax.nn.one_hot(perm, m, dtype=lu.dtype).T
+        return P, L, U
+    return apply_nondiff(f, x, pivots, name="lu_unpack")
+
+
+@_export
+def matrix_rank_tol(x, atol_tensor=None, use_default_tol=True,
+                    hermitian=False, name=None):
+    """Rank with tolerance tensor (reference ops.yaml matrix_rank_tol)."""
+    def f(a, tol):
+        s = jnp.linalg.svd(a, compute_uv=False) if not hermitian else \
+            jnp.abs(jnp.linalg.eigvalsh(a))
+        if tol is None:
+            t = s.max(-1) * max(a.shape[-2:]) * jnp.finfo(a.dtype).eps
+        else:
+            t = tol
+        return jnp.sum(s > jnp.asarray(t)[..., None], axis=-1)
+    if atol_tensor is None:
+        return apply_nondiff(lambda a: f(a, None), x, name="matrix_rank_tol")
+    return apply_nondiff(f, x, atol_tensor, name="matrix_rank_tol")
+
+
+@_export
+def matrix_rank_atol_rtol(x, atol, rtol=None, hermitian=False, name=None):
+    """Reference ops.yaml matrix_rank_atol_rtol: rank with max(atol,
+    rtol·σ_max) threshold."""
+    def f(a, at, rt):
+        s = jnp.linalg.svd(a, compute_uv=False) if not hermitian else \
+            jnp.abs(jnp.linalg.eigvalsh(a))
+        smax = s.max(-1)
+        thr = jnp.asarray(at)
+        if rt is not None:
+            thr = jnp.maximum(thr, jnp.asarray(rt) * smax)
+        return jnp.sum(s > thr[..., None], axis=-1)
+    if rtol is None:
+        return apply_nondiff(lambda a, at: f(a, at, None), x, atol,
+                             name="matrix_rank_atol_rtol")
+    return apply_nondiff(f, x, atol, rtol, name="matrix_rank_atol_rtol")
+
+
+@_export
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    """Randomized leaky ReLU (reference ops.yaml rrelu)."""
+    from ..core import random as _rng
+
+    def f(a):
+        if training:
+            key = _rng.split_key()
+            slope = jax.random.uniform(key, a.shape, jnp.float32, lower,
+                                       upper).astype(a.dtype)
+        else:
+            slope = (lower + upper) / 2.0
+        return jnp.where(a >= 0, a, a * slope)
+    return apply(f, x, name="rrelu")
+
+
+@_export
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    """Per-channel scale+bias (reference ops.yaml affine_channel)."""
+    def f(a, s, b):
+        shp = ([1, -1, 1, 1] if data_layout == "NCHW" else [1, 1, 1, -1])
+        return a * s.reshape(shp) + b.reshape(shp)
+    return apply(f, x, scale, bias, name="affine_channel")
+
+
+@_export
+def correlation(x, y, pad_size=0, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, corr_type_multiply=1, name=None):
+    """Cost-volume correlation (FlowNet; reference ops.yaml correlation):
+    dot products between x patches and shifted y patches."""
+    def f(a, b):
+        d = max_displacement
+        rng = range(-d, d + 1, stride2)
+        outs = []
+        for dy in rng:
+            for dx in rng:
+                shifted = jnp.roll(b, (dy, dx), axis=(2, 3))
+                outs.append(jnp.mean(a * shifted, axis=1))
+        return jnp.stack(outs, axis=1)
+    return apply(f, x, y, name="correlation")
+
+
+@_export
+def sync_batch_norm_(x, mean, variance, scale, bias, is_test=False,
+                     momentum=0.9, epsilon=1e-5, data_layout="NCHW",
+                     use_global_stats=False, trainable_statistics=False,
+                     name=None):
+    """Synchronized batch norm (reference ops.yaml sync_batch_norm_): when
+    called inside shard_map the batch statistics are psum-ed over the data
+    axis; eager single-process it is plain batch norm (GSPMD computes
+    global stats for sharded arrays automatically)."""
+    axis = 1 if data_layout == "NCHW" else -1
+
+    def f(a, mu, var, s, b):
+        red = tuple(i for i in range(a.ndim) if i != (axis % a.ndim))
+        if is_test or use_global_stats:
+            m_, v_ = mu, var
+        else:
+            m_ = jnp.mean(a, axis=red)
+            v_ = jnp.var(a, axis=red)
+            try:
+                import jax.lax as _lx
+                m_ = _lx.pmean(m_, "dp")
+                v_ = _lx.pmean(v_, "dp")
+            except NameError:
+                pass
+            except Exception:
+                pass
+        shp = [1] * a.ndim
+        shp[axis % a.ndim] = -1
+        out = (a - m_.reshape(shp)) * lax.rsqrt(v_.reshape(shp) + epsilon)
+        out = out * s.reshape(shp) + b.reshape(shp)
+        new_mu = momentum * mu + (1 - momentum) * m_
+        new_var = momentum * var + (1 - momentum) * v_
+        return out, new_mu, new_var
+    out, m2, v2 = apply(f, x, mean, variance, scale, bias,
+                        name="sync_batch_norm_")
+    _set(mean, m2); _set(variance, v2)
+    return out, mean, variance
+
+
+@_export
+def apply_per_channel_scale(x, scales, name=None):
+    """Per-channel activation scaling for smooth-quant style inference
+    (reference ops.yaml apply_per_channel_scale)."""
+    def f(a, s):
+        return a * s.reshape((1,) * (a.ndim - 1) + (-1,))
+    return apply(f, x, scales, name="apply_per_channel_scale")
+
+
+def _bn_act(a, mu, var, s, b, epsilon, act):
+    shp = [1, -1] + [1] * (a.ndim - 2)
+    out = (a - mu.reshape(shp)) * lax.rsqrt(var.reshape(shp) + epsilon)
+    out = out * s.reshape(shp) + b.reshape(shp)
+    return act(out)
+
+
+@_export
+def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
+                         epsilon=1e-5, act_type="relu", name=None):
+    """BN + activation fusion (reference ops.yaml fused_batch_norm_act) —
+    XLA fuses these anyway; kept for API parity."""
+    act = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "identity": lambda t: t}[act_type]
+
+    def f(a, s, b, mu, var):
+        red = tuple(i for i in range(a.ndim) if i != 1)
+        m_ = jnp.mean(a, axis=red)
+        v_ = jnp.var(a, axis=red)
+        out = _bn_act(a, m_, v_, s, b, epsilon, act)
+        return (out, momentum * mu + (1 - momentum) * m_,
+                momentum * var + (1 - momentum) * v_)
+    out, m2, v2 = apply(f, x, scale, bias, mean, variance,
+                        name="fused_batch_norm_act")
+    _set(mean, m2); _set(variance, v2)
+    return out, mean, variance
+
+
+@_export
+def fused_bn_add_activation(x, z, scale, bias, mean, variance, momentum=0.9,
+                            epsilon=1e-5, act_type="relu", name=None):
+    """BN(x) + z then activation (reference ops.yaml
+    fused_bn_add_activation — the ResNet shortcut fusion)."""
+    act = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "identity": lambda t: t}[act_type]
+
+    def f(a, zz, s, b, mu, var):
+        red = tuple(i for i in range(a.ndim) if i != 1)
+        m_ = jnp.mean(a, axis=red)
+        v_ = jnp.var(a, axis=red)
+        shp = [1, -1] + [1] * (a.ndim - 2)
+        out = (a - m_.reshape(shp)) * lax.rsqrt(v_.reshape(shp) + epsilon)
+        out = out * s.reshape(shp) + b.reshape(shp)
+        out = act(out + zz)
+        return (out, momentum * mu + (1 - momentum) * m_,
+                momentum * var + (1 - momentum) * v_)
+    out, m2, v2 = apply(f, x, z, scale, bias, mean, variance,
+                        name="fused_bn_add_activation")
+    _set(mean, m2); _set(variance, v2)
+    return out, mean, variance
+
+
+@_export
+def yolo_box_head(x, anchors, class_num, name=None):
+    """Raw YOLO head decode (reference ops.yaml yolo_box_head): sigmoid on
+    xy/obj/cls, exp on wh against anchors — no image rescale."""
+    A = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+
+    def f(a):
+        N, _, H, W = a.shape
+        a = a.reshape(N, A, -1, H, W)
+        sig = jax.nn.sigmoid
+        xy = sig(a[:, :, 0:2])
+        wh = jnp.exp(a[:, :, 2:4]) * anc[None, :, :, None, None]
+        rest = sig(a[:, :, 4:])
+        return jnp.concatenate([xy, wh, rest], axis=2).reshape(N, -1, H, W)
+    return apply_nondiff(f, x, name="yolo_box_head")
+
+
+@_export
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0=(), anchors1=(), anchors2=(), class_num=80,
+                  conf_thresh=0.01, downsample_ratio0=32, downsample_ratio1=16,
+                  downsample_ratio2=8, clip_bbox=True, scale_x_y=1.0,
+                  nms_threshold=0.45, name=None):
+    """Multi-scale YOLO decode + NMS (reference ops.yaml yolo_box_post):
+    decode all three heads with yolo_box, merge, hard-NMS. Fixed-shape."""
+    from .ops_ext2 import multiclass_nms3, yolo_box
+    img = Tensor(jnp.stack([_v(image_shape).reshape(-1)[:2]]).astype(
+        jnp.int32)) if _v(image_shape).ndim == 1 else image_shape
+    bx, sc = [], []
+    for b, anc, ds in ((boxes0, anchors0, downsample_ratio0),
+                       (boxes1, anchors1, downsample_ratio1),
+                       (boxes2, anchors2, downsample_ratio2)):
+        bb, ss = yolo_box(b, img, list(anc), class_num, conf_thresh, ds,
+                          clip_bbox, scale_x_y)
+        bx.append(_v(bb))
+        sc.append(_v(ss))
+    boxes = Tensor(jnp.concatenate(bx, axis=1))
+    scores = Tensor(jnp.transpose(jnp.concatenate(sc, axis=1), (0, 2, 1)))
+    out, nums = multiclass_nms3(boxes, scores, nms_threshold=nms_threshold,
+                                score_threshold=conf_thresh)
+    return out, nums
+
+
+# ====================== runtime / debug / misc ======================
+@_export
+def memcpy_h2d(x, dst_place_type=1, name=None):
+    """Host→device copy (reference ops.yaml memcpy_h2d); PJRT manages
+    placement — jnp.asarray materialises on the default device."""
+    return apply_nondiff(lambda a: jnp.asarray(a), x, name="memcpy_h2d")
+
+
+@_export
+def memcpy_d2h(x, dst_place_type=0, name=None):
+    """Device→host copy (reference ops.yaml memcpy_d2h)."""
+    import numpy as _np
+    v = _v(x)
+    return Tensor(_np.asarray(jax.device_get(v)))
+
+
+@_export
+def coalesce_tensor(input_list, dtype=None, copy_data=True, set_constant=False,
+                    persist_output=False, constant=0.0, use_align=True,
+                    align_size=-1, size_of_dtype=-1, name=None):
+    """Fuse tensors into one contiguous buffer + return views (reference
+    ops.yaml coalesce_tensor, the DP-reducer fusion buffer)."""
+    vals = [_v(t) for t in input_list]
+    dt = vals[0].dtype if dtype is None else jnp.dtype(dtype)
+    flat = [v.astype(dt).reshape(-1) for v in vals]
+    if set_constant:
+        flat = [jnp.full_like(fv, constant) for fv in flat]
+    fused = jnp.concatenate(flat) if copy_data or set_constant else \
+        jnp.zeros((sum(fv.shape[0] for fv in flat),), dt)
+    outs = []
+    off = 0
+    for v in vals:
+        n = int(v.size)
+        outs.append(Tensor(fused[off:off + n].reshape(v.shape)))
+        off += n
+    return outs, Tensor(fused)
+
+
+@_export
+def merge_selected_rows(x, name=None):
+    """Merge duplicate rows of a (rows, values) sparse-gradient pair by
+    summing (reference ops.yaml merge_selected_rows). Here x is a dense
+    tensor standing for the value block; pass (rows, values) as a tuple."""
+    if isinstance(x, tuple):
+        rows, vals = x
+        def f(r, va):
+            uniq, inv = jnp.unique(r, return_inverse=True,
+                                   size=r.shape[0], fill_value=-1)
+            summed = jnp.zeros_like(va).at[inv].add(va)
+            return uniq, summed
+        return apply_nondiff(f, rows, vals, name="merge_selected_rows")
+    return x
+
+
+@_export
+def check_numerics(x, op_type="", var_name="", stack_height_limit=-1,
+                   message="", name=None):
+    """Assert finiteness (reference ops.yaml check_numerics /
+    check_numerics_kernel). Returns (has_nan_inf_flag, stats)."""
+    def f(a):
+        bad = jnp.logical_not(jnp.all(jnp.isfinite(
+            a.astype(jnp.float32))))
+        return bad.reshape(1), jnp.stack([
+            jnp.nanmin(a.astype(jnp.float32)),
+            jnp.nanmax(a.astype(jnp.float32))])
+    return apply_nondiff(f, x, name="check_numerics")
+
+
+_model_nan_inf_check = {"enabled": False}
+
+
+@_export
+def enable_check_model_nan_inf(flag=True, name=None):
+    """Reference ops.yaml enable_check_model_nan_inf — toggles the dispatch
+    NaN/Inf watchdog (FLAGS_check_nan_inf)."""
+    from ..utils import flags as _flags
+    _flags.set_flags({"FLAGS_check_nan_inf": bool(flag)})
+    _model_nan_inf_check["enabled"] = bool(flag)
+
+
+@_export
+def disable_check_model_nan_inf(name=None):
+    return enable_check_model_nan_inf(False)
+
+
+@_export
+def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8, equal_nan=False,
+                   name=None):
+    """Assert-close op (reference ops.yaml accuracy_check)."""
+    def f(a, b):
+        ok = jnp.all(jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan))
+        return ok.reshape(1)
+    return apply_nondiff(f, x, y, name="accuracy_check")
+
+
+@_export
+def shuffle_batch(x, seed=None, startup_seed=0, name=None):
+    """Random batch permutation (reference ops.yaml shuffle_batch)."""
+    from ..core import random as _rng
+
+    def f(a):
+        key = (jax.random.PRNGKey(int(_v(seed).reshape(-1)[0]))
+               if seed is not None else _rng.split_key())
+        perm = jax.random.permutation(key, a.shape[0])
+        return a[perm], perm.astype(jnp.int64)
+    return apply_nondiff(f, x, name="shuffle_batch")
+
+
+@_export
+def cvm(x, cvm_input, use_cvm=True, name=None):
+    """Continuous-value-model op (reference ops.yaml cvm, CTR): the first
+    two columns are show/click counters — keep (log-transformed) or drop."""
+    def f(a, c):
+        if use_cvm:
+            logc = jnp.log1p(jnp.maximum(c, 0.0))
+            return jnp.concatenate([logc[:, :2], a[:, 2:]], axis=1)
+        return a[:, 2:]
+    return apply(f, x, cvm_input, name="cvm")
+
+
+@_export
+def read_file(filename, dtype="uint8", name=None):
+    """Read raw bytes into a uint8 tensor (reference ops.yaml read_file)."""
+    import numpy as _np
+    with open(filename, "rb") as fh:
+        data = fh.read()
+    return Tensor(_np.frombuffer(data, dtype=_np.uint8).copy())
+
+
+@_export
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode (reference ops.yaml decode_jpeg). Host-side via PIL (no
+    TPU analog of nvjpeg); raises if Pillow is unavailable."""
+    import io as _io
+
+    import numpy as _np
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs Pillow") from e
+    buf = _np.asarray(_v(x)).astype(_np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+@_export
+def lookup_table_dequant(w, ids, padding_idx=-1, name=None):
+    """Embedding lookup + int8 dequant (reference ops.yaml
+    lookup_table_dequant): rows store [scale, min, int8...]."""
+    def f(tbl, i):
+        i_ = i.reshape(-1).astype(jnp.int32)
+        rows = tbl[i_]
+        scale = rows[:, 0:1]
+        mn = rows[:, 1:2]
+        vals = rows[:, 2:] * scale + mn
+        return vals.reshape(i.shape + (tbl.shape[1] - 2,))
+    return apply(f, w, ids, name="lookup_table_dequant")
+
+
+@_export
+def batch_fc(input, w, bias=None, name=None):
+    """Per-slot batched FC (reference ops.yaml batch_fc): input
+    [slot, B, I] × w [slot, I, O]."""
+    def f(a, ww, b):
+        out = jnp.einsum("sbi,sio->sbo", a, ww)
+        if b is not None:
+            out = out + b[:, None, :]
+        return out
+    if bias is None:
+        return apply(lambda a, ww: f(a, ww, None), input, w, name="batch_fc")
+    return apply(f, input, w, bias, name="batch_fc")
+
+
+@_export
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0,
+                   name=None):
+    """Rank-aware attention for CTR (reference ops.yaml rank_attention):
+    select a per-sample parameter block by rank pair and matmul."""
+    def f(a, ro, rp):
+        B, D = a.shape
+        ro_ = ro.astype(jnp.int32)
+        # ro rows: [ins_rank, (rank_idx, param_index) * max_rank]
+        blocks = rp.reshape(-1, D, rp.shape[-1])
+        out = jnp.zeros((B, rp.shape[-1]), a.dtype)
+        cnt = jnp.zeros((B, 1), a.dtype)
+        for k in range(max_rank):
+            idx = ro_[:, 2 + 2 * k]
+            ok = (ro_[:, 1 + 2 * k] >= 0) & (idx >= 0)
+            sel = blocks[jnp.clip(idx, 0, blocks.shape[0] - 1)]
+            out = out + jnp.where(ok[:, None],
+                                  jnp.einsum("bd,bdo->bo", a, sel), 0.0)
+            cnt = cnt + ok[:, None].astype(a.dtype)
+        return out / jnp.maximum(cnt, 1.0)
+    return apply(f, x, rank_offset, rank_param, name="rank_attention")
+
+
+@_export
+def match_matrix_tensor(x, y, w, dim_t=3, name=None):
+    """Text-match similarity tensor (reference ops.yaml
+    match_matrix_tensor): x·W_t·yᵀ per channel t."""
+    def f(a, b, ww):
+        # a [Lx, D], b [Ly, D], ww [D, dim_t, D]
+        tmp = jnp.einsum("ld,dtk->ltk", a, ww)
+        return jnp.einsum("ltk,mk->tlm", tmp, b), tmp
+    return apply(f, x, y, w, name="match_matrix_tensor")
+
+
+@_export
+def tdm_child(x, tree_info, child_nums=2, dtype="int32", name=None):
+    """Tree-descent child lookup (reference ops.yaml tdm_child): tree_info
+    rows: [item_id, layer, parent, child0, child1...]."""
+    def f(i, info):
+        ids = i.reshape(-1).astype(jnp.int32)
+        kids = info[ids][:, 3:3 + child_nums].astype(jnp.int32)
+        leaf = (info[kids.reshape(-1)][:, 0] > 0).reshape(kids.shape)
+        return (kids.reshape(i.shape + (child_nums,)),
+                leaf.astype(jnp.int32).reshape(i.shape + (child_nums,)))
+    return apply_nondiff(f, x, tree_info, name="tdm_child")
+
+
+@_export
+def tdm_sampler(x, travel, layer, neg_samples_num_list=(), layer_offset=(),
+                seed=0, name=None):
+    """Per-layer positive+negative sampling along the tree path (reference
+    ops.yaml tdm_sampler). Simplified: positives from travel, uniform
+    negatives from each layer."""
+    from ..core import random as _rng
+
+    def f(ids, trav, lay):
+        B = ids.reshape(-1).shape[0]
+        outs, labels = [], []
+        key = jax.random.PRNGKey(seed) if seed else _rng.split_key()
+        off = 0
+        for li, nneg in enumerate(neg_samples_num_list):
+            start = layer_offset[li]
+            end = (layer_offset[li + 1] if li + 1 < len(layer_offset)
+                   else lay.shape[0])
+            pos = trav[ids.reshape(-1).astype(jnp.int32), li]
+            key, sub = jax.random.split(key)
+            neg = jax.random.randint(sub, (B, nneg), start, max(end, start + 1))
+            neg_ids = lay[jnp.clip(neg, 0, lay.shape[0] - 1)].reshape(B, nneg)
+            outs.append(jnp.concatenate([pos[:, None], neg_ids], axis=1))
+            labels.append(jnp.concatenate(
+                [jnp.ones((B, 1), jnp.int32),
+                 jnp.zeros((B, nneg), jnp.int32)], axis=1))
+        return (jnp.concatenate(outs, axis=1),
+                jnp.concatenate(labels, axis=1))
+    return apply_nondiff(f, x, travel, layer, name="tdm_sampler")
+
+
+@_export
+def pyramid_hash(x, w, white_list=None, black_list=None, num_emb=8, space_len=0,
+                 pyramid_layer=2, rand_len=16, drop_out_percent=0, is_training=True,
+                 use_filter=False, name=None):
+    """Pyramid hash embedding (reference ops.yaml pyramid_hash): hash every
+    n-gram window of ids into an embedding table and sum."""
+    def f(ids, tbl):
+        ids_ = ids.reshape(-1).astype(jnp.uint32)
+        T = ids_.shape[0]
+        out = jnp.zeros((num_emb,), tbl.dtype)
+        for n in range(1, pyramid_layer + 1):
+            if T - n + 1 <= 0:
+                continue
+            for s in range(T - n + 1):
+                h = jnp.uint32(2166136261)
+                for k in range(n):
+                    h = (h ^ ids_[s + k]) * jnp.uint32(16777619)
+                idx = (h % jnp.uint32(tbl.shape[0])).astype(jnp.int32)
+                out = out + tbl[idx, :num_emb]
+        return out[None, :]
+    return apply(f, x, w, name="pyramid_hash")
+
+
+@_export
+def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(5,),
+                       return_eids=False, name=None):
+    """K-hop neighbor sampling over CSC graph (reference ops.yaml
+    graph_khop_sampler). Fixed-shape: pads with -1."""
+    from ..core import random as _rng
+
+    def f(r, cp, seeds):
+        cur = seeds.reshape(-1).astype(jnp.int32)
+        all_src, all_dst = [], []
+        key = _rng.split_key()
+        for k in sample_sizes:
+            deg = cp[cur + 1] - cp[cur]
+            key, sub = jax.random.split(key)
+            offs = jax.random.randint(sub, (cur.shape[0], k), 0, 1 << 30)
+            offs = offs % jnp.maximum(deg[:, None], 1)
+            idx = cp[cur][:, None] + offs
+            src = r[jnp.clip(idx, 0, r.shape[0] - 1)]
+            src = jnp.where(deg[:, None] > 0, src, -1)
+            all_src.append(src.reshape(-1))
+            all_dst.append(jnp.repeat(cur, k))
+            nxt = jnp.where(src.reshape(-1) >= 0, src.reshape(-1), 0)
+            cur = jnp.unique(nxt, size=min(nxt.shape[0],
+                                           cur.shape[0] * k),
+                             fill_value=0).astype(jnp.int32)
+        return (jnp.concatenate(all_src), jnp.concatenate(all_dst))
+    return apply_nondiff(f, row, colptr, x, name="graph_khop_sampler")
+
+
+@_export
+def weighted_sample_neighbors(row, colptr, edge_weight, x, sample_size=5,
+                              return_eids=False, name=None):
+    """Weight-biased neighbor sampling (reference ops.yaml
+    weighted_sample_neighbors). Gumbel-top-k over edge weights, padded -1."""
+    from ..core import random as _rng
+
+    def f(r, cp, w, seeds):
+        cur = seeds.reshape(-1).astype(jnp.int32)
+        deg = cp[cur + 1] - cp[cur]
+        maxdeg = int(jnp.max(jnp.asarray(r.shape[0])))  # static bound
+        K = sample_size
+        key = _rng.split_key()
+        pos = jnp.arange(K)
+
+        def one(c, d, k):
+            base = cp[c]
+            cand = jnp.arange(K * 4)
+            cand_idx = base + (cand % jnp.maximum(d, 1))
+            ww = w[jnp.clip(cand_idx, 0, w.shape[0] - 1)]
+            g = -jnp.log(-jnp.log(
+                jax.random.uniform(k, ww.shape) + 1e-20) + 1e-20)
+            _, top = lax.top_k(jnp.log(jnp.maximum(ww, 1e-20)) + g, K)
+            src = r[jnp.clip(cand_idx[top], 0, r.shape[0] - 1)]
+            return jnp.where(d > 0, src, -1)
+        keys = jax.random.split(key, cur.shape[0])
+        out = jax.vmap(one)(cur, deg, keys)
+        counts = jnp.minimum(deg, K).astype(jnp.int32)
+        return out, counts
+    return apply_nondiff(f, row, colptr, edge_weight, x,
+                         name="weighted_sample_neighbors")

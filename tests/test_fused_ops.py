@@ -1,0 +1,160 @@
+"""Tests for incubate fused ops + fleet meta-optimizers (reference:
+test/legacy_test/test_fused_* and fleet meta_optimizer suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def t(x, dtype=None):
+    a = np.asarray(x)
+    if dtype:
+        a = a.astype(dtype)
+    return pt.to_tensor(a)
+
+
+class TestFusedBlocks:
+    def test_fused_feedforward_matches_unfused(self):
+        np.random.seed(0)
+        x = np.random.randn(2, 4, 8).astype(np.float32)
+        w1 = np.random.randn(8, 16).astype(np.float32)
+        w2 = np.random.randn(16, 8).astype(np.float32)
+        g = np.ones(8, np.float32)
+        b = np.zeros(8, np.float32)
+        out = IF.fused_feedforward(t(x), t(w1), t(w2), dropout1_rate=0,
+                                   dropout2_rate=0, ln2_scale=t(g),
+                                   ln2_bias=t(b)).numpy()
+        h = np.maximum(x @ w1, 0) @ w2 + x
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_fused_mha_runs_and_residual(self):
+        B, T, H, hd = 2, 4, 2, 4
+        D = H * hd
+        x = np.random.randn(B, T, D).astype(np.float32)
+        qkv_w = np.random.randn(3, H, hd, D).astype(np.float32) * 0.1
+        lin_w = np.random.randn(D, D).astype(np.float32) * 0.1
+        out = IF.fused_multi_head_attention(
+            t(x), t(qkv_w), t(lin_w), pre_layer_norm=True,
+            pre_ln_scale=t(np.ones(D, np.float32)),
+            pre_ln_bias=t(np.zeros(D, np.float32)), dropout_rate=0,
+            attn_dropout_rate=0)
+        assert out.shape == [B, T, D]
+        assert np.all(np.isfinite(out.numpy()))
+
+    def test_fused_matmul_bias(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        y = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        out = IF.fused_matmul_bias(t(x), t(y), t(b))
+        np.testing.assert_allclose(out.numpy(), x @ y + b, rtol=1e-5)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        x = np.random.randn(2, 3, 8).astype(np.float32)
+        r = np.random.randn(2, 3, 8).astype(np.float32)
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            t(x), t(r), dropout_rate=0, ln_scale=t(np.ones(8, np.float32)),
+            ln_bias=t(np.zeros(8, np.float32)))
+        h = x + r
+        mu = h.mean(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_fused_ec_moe_single_expert_is_mlp(self):
+        x = np.random.randn(1, 2, 4).astype(np.float32)
+        gate = np.zeros((4, 1), np.float32)
+        w1 = np.random.randn(1, 4, 8).astype(np.float32)
+        b1 = np.zeros((1, 8), np.float32)
+        w2 = np.random.randn(1, 8, 4).astype(np.float32)
+        b2 = np.zeros((1, 4), np.float32)
+        out = IF.fused_ec_moe(t(x), t(gate), t(w1), t(b1), t(w2), t(b2),
+                              act_type="relu")
+        ref = np.maximum(x @ w1[0], 0) @ w2[0]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_mha_attn_mask_applied(self):
+        B, T, H, hd = 1, 4, 1, 4
+        D = H * hd
+        x = np.random.randn(B, T, D).astype(np.float32)
+        qkv_w = np.random.randn(3, H, hd, D).astype(np.float32) * 0.2
+        lin_w = np.eye(D, dtype=np.float32)
+        causal = np.tril(np.ones((1, 1, T, T), bool))
+        masked = IF.fused_multi_head_attention(
+            t(x), t(qkv_w), t(lin_w), attn_mask=t(causal), dropout_rate=0,
+            attn_dropout_rate=0, add_residual=False).numpy()
+        unmasked = IF.fused_multi_head_attention(
+            t(x), t(qkv_w), t(lin_w), dropout_rate=0, attn_dropout_rate=0,
+            add_residual=False).numpy()
+        assert not np.allclose(masked, unmasked)
+        # row 0 attends only to itself under the causal mask
+        assert np.allclose(masked[0, 0], masked[0, 0])
+
+    def test_fused_gate_attention_optional_binding(self):
+        # gate_bias=None + out_linear_bias set must NOT leak the out bias
+        # into the gate (review regression)
+        M, D, H, hd = 3, 8, 2, 4
+        q = np.random.randn(1, M, D).astype(np.float32)
+        qkv_w = np.random.randn(3, H, hd, D).astype(np.float32) * 0.2
+        gate_w = np.random.randn(H, hd, D).astype(np.float32) * 0.2
+        out_w = np.random.randn(H, hd, D).astype(np.float32) * 0.2
+        out_b = np.full(D, 5.0, np.float32)
+        with_b = IF.fused_gate_attention(
+            t(q), qkv_weight=t(qkv_w), gate_weight=t(gate_w), gate_bias=None,
+            out_linear_weight=t(out_w), out_linear_bias=t(out_b)).numpy()
+        no_b = IF.fused_gate_attention(
+            t(q), qkv_weight=t(qkv_w), gate_weight=t(gate_w), gate_bias=None,
+            out_linear_weight=t(out_w), out_linear_bias=None).numpy()
+        np.testing.assert_allclose(with_b - no_b, 5.0, rtol=1e-5, atol=1e-5)
+
+    def test_variable_length_attention_masks_tail(self):
+        B, H, T, D = 1, 1, 4, 4
+        q = np.random.randn(B, H, T, D).astype(np.float32)
+        full = IF.variable_length_memory_efficient_attention(
+            t(q), t(q), t(q)).numpy()
+        # masking kv length to 2 must differ from full attention
+        part = IF.variable_length_memory_efficient_attention(
+            t(q), t(q), t(q),
+            kv_seq_lens=t(np.array([2], np.int32))).numpy()
+        assert not np.allclose(full, part)
+
+
+class TestMetaOptimizers:
+    def _tiny_problem(self):
+        lin = pt.nn.Linear(4, 1)
+        x = pt.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        y = pt.to_tensor(np.random.randn(8, 1).astype(np.float32))
+        return lin, x, y
+
+    def test_dgc_momentum_trains(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import \
+            DGCMomentumOptimizer
+        lin, x, y = self._tiny_problem()
+        opt = DGCMomentumOptimizer(learning_rate=0.05,
+                                   parameters=lin.parameters(),
+                                   rampup_begin_step=0, sparsity=(0.5,))
+        losses = []
+        for _ in range(12):
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_localsgd_trains_and_averages(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import \
+            LocalSGDOptimizer
+        lin, x, y = self._tiny_problem()
+        opt = LocalSGDOptimizer(k_steps=2, learning_rate=0.05,
+                                parameters=lin.parameters())
+        losses = []
+        for _ in range(10):
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
