@@ -22,7 +22,8 @@ def get_available_device():
 
 
 def get_available_custom_device():
-    return []
+    from .custom import available_custom_devices
+    return available_custom_devices()
 
 
 def device_count():

@@ -1,0 +1,190 @@
+"""Custom-device plugin runtime (the device_ext.h C-ABI loader).
+
+Reference: /root/reference/paddle/phi/backends/device_ext.h:95
+(C_DeviceInterface), DeviceManager registration
+(phi/backends/device_manager.h), and the fake-CPU test plugin
+(phi/backends/custom/fake_cpu_device.h +
+test/custom_runtime/test_custom_cpu_plugin.py).
+
+TPU-native: PJRT owns the real accelerators, so a "custom device" here is a
+host-side plugin runtime — its memory lives in plugin-managed buffers, its
+kernels run through the plugin's `run_kernel`, and it interoperates with
+jax/TPU tensors through explicit h2d/d2h copies (and `jax.pure_callback`
+when a plugin kernel is used inside a traced program). This keeps the
+reference's plugin *capability* (bring-your-own-device ABI, tested with a
+fake device) without pretending a C plugin can join an XLA mesh.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["load_custom_device", "get_custom_device", "CustomDevice",
+           "CustomDeviceTensor", "available_custom_devices"]
+
+_REGISTRY: dict = {}
+
+
+class _CInterface(ctypes.Structure):
+    _fields_ = [
+        ("struct_size", ctypes.c_size_t),
+        ("abi_version", ctypes.c_int),
+        ("device_type", ctypes.c_char_p),
+        ("init", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("finalize", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("get_device_count", ctypes.CFUNCTYPE(ctypes.c_int,
+                                              ctypes.POINTER(ctypes.c_int))),
+        ("memory_allocate", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p))),
+        ("memory_deallocate", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t)),
+        ("memory_copy_h2d", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_size_t)),
+        ("memory_copy_d2h", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_size_t)),
+        ("run_kernel", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_size_t)),
+    ]
+
+
+class CustomDeviceTensor:
+    """A buffer living in plugin-managed memory (float32)."""
+
+    def __init__(self, device, ptr, shape, device_id=0):
+        self.device = device
+        self.ptr = ptr
+        self.shape = tuple(shape)
+        self.device_id = device_id
+        self.nbytes = int(np.prod(shape)) * 4 if shape else 4
+
+    def numpy(self) -> np.ndarray:
+        return self.device.copy_to_host(self)
+
+    def __del__(self):
+        try:
+            self.device._free(self.ptr, self.nbytes, self.device_id)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return (f"CustomDeviceTensor(type={self.device.device_type}, "
+                f"shape={self.shape})")
+
+
+class CustomDevice:
+    """One loaded plugin runtime (the DeviceManager entry)."""
+
+    def __init__(self, lib_path: str):
+        self._lib = ctypes.CDLL(os.path.abspath(lib_path))
+        entry = self._lib.PT_InitPlugin
+        entry.restype = ctypes.POINTER(_CInterface)
+        self._if = entry().contents
+        if self._if.abi_version != 1:
+            raise RuntimeError(
+                f"plugin ABI {self._if.abi_version} unsupported")
+        self.device_type = self._if.device_type.decode()
+        rc = self._if.init()
+        if rc != 0:
+            raise RuntimeError(f"plugin init failed rc={rc}")
+
+    # ---- capability surface ----
+    def device_count(self) -> int:
+        n = ctypes.c_int(0)
+        self._if.get_device_count(ctypes.byref(n))
+        return n.value
+
+    def _alloc(self, nbytes: int, device_id: int = 0):
+        p = ctypes.c_void_p()
+        rc = self._if.memory_allocate(device_id, nbytes, ctypes.byref(p))
+        if rc != 0 or not p.value:
+            raise MemoryError(f"plugin alloc({nbytes}) rc={rc}")
+        return p
+
+    def _free(self, ptr, nbytes: int, device_id: int = 0):
+        self._if.memory_deallocate(device_id, ptr, nbytes)
+
+    def copy_from_host(self, array, device_id: int = 0) -> CustomDeviceTensor:
+        a = np.ascontiguousarray(
+            array.numpy() if isinstance(array, Tensor) else array,
+            dtype=np.float32)
+        buf = self._alloc(a.nbytes, device_id)
+        rc = self._if.memory_copy_h2d(
+            device_id, buf, a.ctypes.data_as(ctypes.c_void_p), a.nbytes)
+        if rc != 0:
+            raise RuntimeError(f"h2d rc={rc}")
+        return CustomDeviceTensor(self, buf, a.shape, device_id)
+
+    def copy_to_host(self, t: CustomDeviceTensor) -> np.ndarray:
+        out = np.empty(t.shape, np.float32)
+        rc = self._if.memory_copy_d2h(
+            t.device_id, out.ctypes.data_as(ctypes.c_void_p), t.ptr, t.nbytes)
+        if rc != 0:
+            raise RuntimeError(f"d2h rc={rc}")
+        return out
+
+    def run_kernel(self, name: str, inputs: Sequence[CustomDeviceTensor],
+                   out_shape=None, device_id: int | None = None
+                   ) -> CustomDeviceTensor:
+        """Invoke a plugin kernel on plugin buffers (on the buffers' device
+        unless overridden)."""
+        if device_id is None:
+            device_id = inputs[0].device_id if inputs else 0
+        out_shape = tuple(out_shape if out_shape is not None
+                          else inputs[0].shape)
+        numel = int(np.prod(out_shape)) if out_shape else 1
+        out = CustomDeviceTensor(self, self._alloc(numel * 4, device_id),
+                                 out_shape, device_id)
+        arr = (ctypes.c_void_p * len(inputs))(
+            *[i.ptr for i in inputs])
+        rc = self._if.run_kernel(device_id, name.encode(), arr, len(inputs),
+                                 out.ptr, numel)
+        if rc != 0:
+            raise RuntimeError(f"plugin kernel {name!r} rc={rc}")
+        return out
+
+    def as_jax_op(self, name: str):
+        """Wrap a plugin kernel as a host-callback op usable inside jit
+        (pure_callback per shard — the phi C-ABI kernel path analog)."""
+        import jax
+
+        def op(*tensors):
+            vals = [t._value if isinstance(t, Tensor) else t for t in tensors]
+
+            def host(*arrays):
+                ins = [self.copy_from_host(np.asarray(a)) for a in arrays]
+                return self.run_kernel(name, ins).numpy()
+
+            out = jax.pure_callback(
+                host, jax.ShapeDtypeStruct(vals[0].shape, np.float32), *vals)
+            return Tensor(out)
+
+        return op
+
+    def finalize(self):
+        self._if.finalize()
+
+
+def load_custom_device(lib_path: str) -> CustomDevice:
+    """dlopen a plugin and register its device type (reference:
+    DeviceManager::Register via LoadCustomRuntimeLib)."""
+    dev = CustomDevice(lib_path)
+    _REGISTRY[dev.device_type] = dev
+    return dev
+
+
+def get_custom_device(device_type: str) -> CustomDevice:
+    return _REGISTRY[device_type]
+
+
+def available_custom_devices():
+    return sorted(_REGISTRY)

@@ -1,0 +1,62 @@
+/* paddle_tpu custom-device plugin C ABI.
+ *
+ * Reference: /root/reference/paddle/phi/backends/device_ext.h:95
+ * (C_DeviceInterface — the custom-device plugin contract) and
+ * phi/backends/custom/fake_cpu_device.h (the CPU-masquerading test plugin).
+ *
+ * A plugin shared library implements this struct and exports
+ *     const PT_DeviceInterface* PT_InitPlugin(void);
+ * The framework loads it with dlopen/ctypes and registers `device_type` as
+ * a custom place: tensors can be copied onto plugin-managed memory and
+ * plugin kernels can be invoked by name on plugin buffers.
+ *
+ * All functions return 0 on success, nonzero on failure.
+ */
+#ifndef PADDLE_TPU_DEVICE_EXT_H
+#define PADDLE_TPU_DEVICE_EXT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PT_DEVICE_ABI_VERSION 1
+
+typedef struct PT_DeviceInterface {
+  /* struct size for forward-compatible extension (reference: the `size`
+   * field convention of C_DeviceInterface) */
+  size_t struct_size;
+  int abi_version;
+  const char* device_type; /* e.g. "fake_npu" */
+
+  /* lifecycle */
+  int (*init)(void);
+  int (*finalize)(void);
+  int (*get_device_count)(int* count);
+
+  /* memory (device_id, ...) */
+  int (*memory_allocate)(int device_id, size_t size, void** ptr);
+  int (*memory_deallocate)(int device_id, void* ptr, size_t size);
+  int (*memory_copy_h2d)(int device_id, void* dst, const void* src,
+                         size_t size);
+  int (*memory_copy_d2h)(int device_id, void* dst, const void* src,
+                         size_t size);
+
+  /* kernel dispatch: n_inputs buffers in, one buffer out, all f32 of
+   * `numel` elements (the minimal contract the fake-device test and the
+   * pure_callback bridge need; richer dtypes ride the same entry with a
+   * name suffix, e.g. "add.i32") */
+  int (*run_kernel)(int device_id, const char* name, void** inputs,
+                    int n_inputs, void* output, size_t numel);
+} PT_DeviceInterface;
+
+/* plugin entry point */
+typedef const PT_DeviceInterface* (*PT_InitPluginFn)(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_DEVICE_EXT_H */

@@ -122,6 +122,14 @@ class KVServer:
                 self._send(200)
 
             def do_GET(self):
+                if self.path.startswith("/info/"):
+                    node = self.path[6:]
+                    with lock:
+                        rec = store.get(node)
+                    # same TTL contract as /nodes: stale entries are gone
+                    if rec is None or time.time() - rec[0] > ttl_ref.ttl:
+                        return self._send(404)
+                    return self._send(200, rec[1].encode())
                 if self.path != "/nodes":
                     return self._send(404)
                 now = time.time()

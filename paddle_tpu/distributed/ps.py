@@ -1,0 +1,177 @@
+"""paddle_tpu.distributed.ps — a parameter-server runtime.
+
+Reference: /root/reference/paddle/fluid/distributed/ps/ (brpc services,
+sparse/dense tables: table/memory_sparse_table.cc) and
+python/paddle/distributed/ps/the_one_ps.py (TheOnePSRuntime — server/worker
+roles, pull/push of dense + sparse tables).
+
+TPU-native reinterpretation: dense training belongs on the chips (SPMD); the
+PS pattern survives for what it is uniquely good at — HOST-memory embedding
+tables far larger than HBM. Servers hold sharded numpy tables keyed by
+feature id; workers pull rows before a step and push gradient updates after.
+Transport is distributed/rpc.py (the brpc analog). Sharding: row id modulo
+the number of servers (the reference's default hash placement).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import rpc as _rpc
+
+__all__ = ["SparseTable", "PsServer", "PsWorker", "TheOnePSRuntime"]
+
+_SERVER: dict = {}  # table name -> SparseTable (in server processes)
+_SERVER_LOCK = threading.Lock()
+
+
+class SparseTable:
+    """Host-memory sparse embedding table with lazy row init + SGD update
+    (reference table/memory_sparse_table.cc semantics, simplified: optimizer
+    = sgd, initializer = uniform)."""
+
+    def __init__(self, name, dim, init_range=0.01, lr=0.05, seed=0):
+        self.name = name
+        self.dim = dim
+        self.lr = lr
+        self.init_range = init_range
+        self._rows: dict = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def _row(self, rid):
+        r = self._rows.get(int(rid))
+        if r is None:
+            r = self._rng.uniform(-self.init_range, self.init_range,
+                                  self.dim).astype(np.float32)
+            self._rows[int(rid)] = r
+        return r
+
+    def pull(self, ids):
+        with self._lock:
+            return np.stack([self._row(i) for i in ids])
+
+    def push(self, ids, grads):
+        with self._lock:
+            for i, g in zip(ids, grads):
+                self._rows[int(i)] = self._row(i) - self.lr * g
+        return len(ids)
+
+    def size(self):
+        return len(self._rows)
+
+
+# ---- functions executed server-side via rpc ----
+def _srv_create(name, dim, init_range, lr, seed):
+    # idempotent AND race-free: concurrent create_table calls from several
+    # workers must never replace a live table (it would drop pushed rows)
+    with _SERVER_LOCK:
+        if name not in _SERVER:
+            _SERVER[name] = SparseTable(name, dim, init_range, lr, seed)
+    return True
+
+
+def _srv_dim(name):
+    return _SERVER[name].dim
+
+
+def _srv_pull(name, ids):
+    return _SERVER[name].pull(np.asarray(ids))
+
+
+def _srv_push(name, ids, grads):
+    return _SERVER[name].push(np.asarray(ids), np.asarray(grads))
+
+
+def _srv_size(name):
+    return _SERVER[name].size()
+
+
+class PsServer:
+    """A server role: hosts its shard of every table; just keeps the rpc
+    agent alive (tables are created remotely by workers)."""
+
+    def __init__(self, agent):
+        self.agent = agent
+
+
+class PsWorker:
+    """A worker role: pulls/pushes sharded rows from all servers."""
+
+    def __init__(self, agent, server_names):
+        self.agent = agent
+        self.servers = list(server_names)
+
+    def create_table(self, name, dim, init_range=0.01, lr=0.05):
+        for si, s in enumerate(self.servers):
+            _rpc.rpc_sync(s, _srv_create, (name, dim, init_range, lr, si))
+
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        n = len(self.servers)
+        parts = [np.where(ids % n == k)[0] for k in range(n)]
+        return ids, parts
+
+    def pull(self, name, ids):
+        """Gather rows for `ids` (any shape); returns [*, dim] float32."""
+        flat, parts = self._shard(ids)
+        if flat.size == 0:
+            dim = _rpc.rpc_sync(self.servers[0], _srv_dim, (name,))
+            return np.zeros(tuple(np.asarray(ids).shape) + (dim,),
+                            np.float32)
+        futures = []
+        for k, idx in enumerate(parts):
+            if idx.size == 0:
+                continue
+            futures.append((idx, _rpc.rpc_async(
+                self.servers[k], _srv_pull, (name, flat[idx]))))
+        rows = None
+        for idx, fut in futures:
+            vals = fut.result()
+            if rows is None:
+                rows = np.zeros((flat.shape[0], vals.shape[1]), np.float32)
+            rows[idx] = vals
+        return rows.reshape(tuple(np.asarray(ids).shape) + (-1,))
+
+    def push(self, name, ids, grads):
+        flat, parts = self._shard(ids)
+        g = np.asarray(grads, np.float32).reshape(flat.shape[0], -1)
+        futs = [
+            _rpc.rpc_async(self.servers[k], _srv_push,
+                           (name, flat[idx], g[idx]))
+            for k, idx in enumerate(parts) if idx.size
+        ]
+        return sum(f.result() for f in futs)
+
+    def table_size(self, name):
+        return sum(_rpc.rpc_sync(s, _srv_size, (name,))
+                   for s in self.servers)
+
+
+class TheOnePSRuntime:
+    """Role dispatcher (reference the_one_ps.py:1024): processes whose name
+    starts with 'server' become PsServer, the rest PsWorker."""
+
+    def __init__(self, role=None, name=None, rank=None, world_size=None,
+                 master_endpoint=None):
+        import os
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) \
+            if rank is None else rank
+        self.name = name or f"{role or 'worker'}{rank}"
+        self.role = role or ("server" if self.name.startswith("server")
+                             else "worker")
+        self.agent = _rpc.init_rpc(self.name, rank=rank,
+                                   world_size=world_size,
+                                   master_endpoint=master_endpoint)
+        servers = sorted(n for n in self.agent.workers
+                         if n.startswith("server"))
+        if self.role == "server":
+            self.server = PsServer(self.agent)
+            self.worker = None
+        else:
+            self.server = None
+            self.worker = PsWorker(self.agent, servers)
+
+    def stop(self):
+        _rpc.shutdown()

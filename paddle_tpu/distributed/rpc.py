@@ -1,0 +1,381 @@
+"""paddle_tpu.distributed.rpc — remote procedure calls between workers.
+
+Reference: /root/reference/paddle/fluid/distributed/rpc/ (brpc RpcAgent,
+rpc_agent.h) + python/paddle/distributed/rpc (init_rpc :, rpc_sync,
+rpc_async, shutdown, get_worker_info).
+
+TPU-native: no brpc — a small TCP mesh. Each worker runs a threaded
+length-prefixed-pickle server; `init_rpc` rendezvouses worker endpoints
+through the elastic HTTP KV master (fleet.elastic.KVServer, started by rank
+0) so no shared filesystem is needed. Functions are sent by module-qualified
+name plus pickled args (same trust model as the reference: RPC peers are
+within one training job).
+
+Used by the parameter-server stack (distributed/ps.py) for pull/push.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: dict = {"agent": None}
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def _resolve(fn):
+    """Callable → wire form; wire form → callable."""
+    if callable(fn):
+        return fn
+    mod, _, qual = fn.rpartition(":")
+    import importlib
+    obj = importlib.import_module(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _serialize_fn(fn) -> bytes:
+    """By-value function transport for lambdas/closures/locals (plain pickle
+    refuses them): marshal the code object + pickle the closure cells.
+    Remote globals come from the function's module when importable there —
+    enough for the ad-hoc helpers RPC is used for."""
+    import marshal
+    cells = tuple(c.cell_contents for c in (fn.__closure__ or ()))
+    return pickle.dumps({
+        "code": marshal.dumps(fn.__code__),
+        "name": fn.__name__,
+        "defaults": fn.__defaults__,
+        "cells": cells,
+        "module": getattr(fn, "__module__", "builtins") or "builtins",
+    })
+
+
+def _deserialize_fn(blob: bytes):
+    import builtins
+    import importlib
+    import marshal
+    import types
+    d = pickle.loads(blob)
+    code = marshal.loads(d["code"])
+    try:
+        g = importlib.import_module(d["module"]).__dict__
+    except Exception:
+        g = {"__builtins__": builtins}
+    closure = tuple(types.CellType(v) for v in d["cells"])
+    return types.FunctionType(code, g, d["name"], d["defaults"],
+                              closure if code.co_freevars else None)
+
+
+class _Agent:
+    def __init__(self, name, rank, world_size, server):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self._server = server
+        self.workers: dict = {}  # name -> WorkerInfo
+        self._pool = _futures.ThreadPoolExecutor(max_workers=16)
+        # persistent per-peer connections, one per calling thread (sockets
+        # are not safe for concurrent use; the reference keeps brpc channels)
+        self._conns = threading.local()
+
+    def info_by(self, to):
+        if isinstance(to, WorkerInfo):
+            return to
+        if isinstance(to, int):
+            for w in self.workers.values():
+                if w.rank == to:
+                    return w
+            raise KeyError(f"no rpc worker with rank {to}")
+        return self.workers[to]
+
+    def _connection(self, w, timeout):
+        cache = getattr(self._conns, "cache", None)
+        if cache is None:
+            cache = self._conns.cache = {}
+        key = (w.ip, w.port)
+        s = cache.get(key)
+        if s is None:
+            s = socket.create_connection((w.ip, w.port), timeout=timeout or 30)
+            cache[key] = s
+        if timeout:
+            s.settimeout(timeout)
+        return s
+
+    def _drop_connection(self, w):
+        cache = getattr(self._conns, "cache", {})
+        s = cache.pop((w.ip, w.port), None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _wire_fn(fn):
+        """Module-qualified name when importable remotely, else pickled.
+        Lambdas/closures/locals have '<' in their qualname and can never be
+        resolved by name — they MUST go by value."""
+        if isinstance(fn, str):
+            return ("call", fn)
+        qual = getattr(fn, "__qualname__", "")
+        if "<" in qual or getattr(fn, "__closure__", None):
+            return ("call_pickled", _serialize_fn(fn))
+        return ("call", f"{fn.__module__}:{qual}")
+
+    def call(self, to, fn, args=(), kwargs=None, timeout=None):
+        w = self.info_by(to)
+        kind, wire = self._wire_fn(fn)
+        for attempt in (0, 1):
+            cache = getattr(self._conns, "cache", {})
+            was_cached = (w.ip, w.port) in cache
+            s = self._connection(w, timeout)
+            sent = False
+            try:
+                _send_msg(s, (kind, wire, args, kwargs or {}))
+                sent = True
+                status, payload = _recv_msg(s)
+                break
+            except socket.timeout:
+                # the server may still be EXECUTING — retrying could run a
+                # non-idempotent call twice; surface the timeout instead
+                self._drop_connection(w)
+                raise
+            except (ConnectionError, OSError):
+                self._drop_connection(w)
+                # retry only a stale cached connection that died before the
+                # request was delivered; anything after send may have
+                # executed remotely
+                if attempt or not was_cached or sent:
+                    raise
+        if status == "ok":
+            return payload
+        raise RuntimeError(f"rpc to {w.name} failed: {payload}")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        # persistent connection: serve messages until the peer closes
+        while True:
+            try:
+                msg = _recv_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            kind = msg[0]
+            try:
+                if kind == "call":
+                    _, wire_fn, args, kwargs = msg
+                    fn = _resolve(wire_fn)
+                    out = fn(*args, **kwargs)
+                elif kind == "call_pickled":
+                    _, blob, args, kwargs = msg
+                    out = _deserialize_fn(blob)(*args, **kwargs)
+                elif kind == "ping":
+                    out = "pong"
+                else:
+                    raise ValueError(f"unknown rpc message {kind!r}")
+                _send_msg(self.request, ("ok", out))
+            except (ConnectionError, OSError):
+                return
+            except Exception as e:  # deliver the error to the caller
+                try:
+                    _send_msg(self.request, ("err",
+                                             f"{type(e).__name__}: {e}"))
+                except Exception:
+                    return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and rendezvous with the others.
+
+    master_endpoint: host:port of the KV master. Rank 0 starts it in-process
+    when the port is free (the reference's master is started by the
+    launcher). Registry keys are namespaced by PADDLE_JOB_ID so entries
+    from an orphaned previous job (same master port drawn twice) can never
+    satisfy this job's rendezvous."""
+    from .fleet.elastic import KVRegistry, KVServer
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:8813")
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+
+    def scoped(n):
+        return f"{job}::{n}"
+
+    server = _Server(("0.0.0.0", 0), _Handler)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    agent = _Agent(name, rank, world_size, server)
+    _state["agent"] = agent
+
+    kv_server = None
+    host, _, mport = master_endpoint.partition(":")
+    if rank == 0:
+        try:
+            kv_server = KVServer(port=int(mport), ttl=30.0).start()
+        except OSError:
+            kv_server = None  # launcher (or another agent) already serves it
+    _state["kv_server"] = kv_server
+
+    # heartbeat-scale ttl: stale entries from dead workers must expire fast
+    # enough that an elastic relaunch cannot rendezvous against them
+    reg = KVRegistry(master_endpoint, ttl=30.0)
+    _state["registry"] = reg
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+    _state["scoped_name"] = scoped(name)
+    deadline = time.time() + 60
+    while True:
+        try:
+            reg.heartbeat(scoped(name),
+                          {"rank": rank, "ip": my_ip, "port": port})
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+    # Wait for the full world. Workers are ACCUMULATED as they appear — a
+    # peer that registers, finishes fast, and deregisters (or whose entry
+    # expires) still counts once its endpoint was fetched; requiring one
+    # simultaneous full-membership snapshot deadlocks under start skew.
+    import json
+    import urllib.request
+    debug = os.environ.get("PADDLE_RPC_DEBUG") == "1"
+    deadline = time.time() + 120
+    last_beat = 0.0
+    t_start = time.time()
+    while len(agent.workers) < world_size:
+        now = time.time()
+        if now - last_beat > 5:  # keep our own entry fresh past the ttl
+            try:
+                reg.heartbeat(scoped(name),
+                              {"rank": rank, "ip": my_ip, "port": port})
+                last_beat = now
+            except Exception:
+                pass
+        if debug:
+            print(f"[rpc {name}] t={time.time()-t_start:.1f} "
+                  f"alive={reg.alive_nodes()} have={sorted(agent.workers)}",
+                  flush=True)
+        for sn in reg.alive_nodes():
+            if not sn.startswith(job + "::"):
+                continue  # another job's orphan on a recycled master port
+            n = sn[len(job) + 2:]
+            if n in agent.workers:
+                continue
+            try:
+                with urllib.request.urlopen(f"{reg.base}/info/{sn}",
+                                            timeout=5) as r:
+                    info = json.loads(r.read())
+                agent.workers[n] = WorkerInfo(
+                    n, int(info["rank"]), info["ip"], int(info["port"]))
+            except Exception:
+                pass
+        if len(agent.workers) >= world_size:
+            break
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"rpc rendezvous: {len(agent.workers)}/{world_size} workers")
+        time.sleep(0.2)
+    return agent
+
+
+def shutdown():
+    agent = _state.get("agent")
+    reg = _state.get("registry")
+    if agent is not None and reg is not None:
+        # deregister so relaunches can't see us
+        reg.leave(_state.get("scoped_name") or agent.name)
+    if agent is not None:
+        agent._server.shutdown()
+        agent._server.server_close()
+        agent._pool.shutdown(wait=False)
+    kv = _state.get("kv_server")
+    if kv is not None:
+        kv.stop()
+    _state["agent"] = None
+    _state["kv_server"] = None
+    _state["registry"] = None
+
+
+def _agent():
+    a = _state.get("agent")
+    if a is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return a
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=None):
+    """Blocking remote call; returns the result (reference rpc_sync)."""
+    return _agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=None):
+    """Non-blocking remote call; returns a Future (reference rpc_async)."""
+    a = _agent()
+    return a._pool.submit(a.call, to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name=None):
+    a = _agent()
+    if name is None:
+        # the rendezvoused record carries the externally-reachable address
+        own = a.workers.get(a.name)
+        if own is not None:
+            return own
+        return WorkerInfo(a.name, a.rank, "127.0.0.1",
+                          a._server.server_address[1])
+    return a.workers[name]
+
+
+def get_all_worker_infos():
+    return sorted(_agent().workers.values(), key=lambda w: w.rank)

@@ -121,63 +121,69 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     """Fused MHA block (reference incubate/nn/functional/
     fused_multi_head_attention.py → fused_attention op): optional pre-LN,
     packed qkv projection, attention, out-proj, residual (+post-LN)."""
+    import math as _m
+
+    from ....core import random as _prng
+    from ....core.engine import apply
     from ....core.tensor import Tensor
 
     inp = x
     if pre_layer_norm and pre_ln_scale is not None:
         inp = F.layer_norm(inp, inp.shape[-1:], pre_ln_scale, pre_ln_bias,
                            pre_ln_epsilon)
-    w = qkv_weight
-    wv = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    wv = qkv_weight._value if isinstance(qkv_weight, Tensor) else \
+        jnp.asarray(qkv_weight)
     if transpose_qkv_wb:
-        D = inp.shape[-1]
         nh = num_heads
-        hd = D // nh
-        qkv = F.linear(inp, w, qkv_bias)  # [B,T,3D]
-        def split3(a):
-            B, T, _ = a.shape
-            return a.reshape(B, T, 3, nh, hd)
-        qkv_v = split3(qkv._value if isinstance(qkv, Tensor) else qkv)
+        hd = x.shape[-1] // nh
     else:
-        # wv: [3, H, hd, D]
-        three, nh, hd, D = wv.shape
-        from ....core.engine import apply
-        qkv_t = apply(lambda a, ww: jnp.einsum("btd,ehkd->btehk", a, ww),
-                      x if not pre_layer_norm else inp, Tensor(wv),
-                      name="fused_attention_qkv")
-        qkv_v = qkv_t._value if isinstance(qkv_t, Tensor) else qkv_t
-        if qkv_bias is not None:
-            bv = qkv_bias._value if isinstance(qkv_bias, Tensor) else qkv_bias
-            qkv_v = qkv_v + bv.reshape(1, 1, 3, nh, hd)
-    q, k, v = qkv_v[:, :, 0], qkv_v[:, :, 1], qkv_v[:, :, 2]
-    if cache_kv is not None:
-        cv = cache_kv._value if isinstance(cache_kv, Tensor) else cache_kv
-        k = jnp.concatenate([cv[0], k], axis=1)
-        v = jnp.concatenate([cv[1], v], axis=1)
-    if attn_mask is not None:
-        mv = attn_mask._value if isinstance(attn_mask, Tensor) else \
-            jnp.asarray(attn_mask)
-        import math as _m
-        hd_ = q.shape[-1]
-        logits = jnp.einsum("blhd,bshd->bhls", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) / _m.sqrt(hd_)
-        while mv.ndim < 4:
-            mv = mv[None]
-        if mv.dtype == jnp.bool_:
-            logits = jnp.where(mv, logits, -1e30)
+        _, nh, hd, _ = wv.shape  # reference layout [3, H, hd, D]
+    drop_key = (_prng.split_key()
+                if attn_dropout_rate and training else None)
+
+    # one differentiable op for projection+attention: everything runs inside
+    # engine.apply so the eager tape records it (grads flow to x, weights,
+    # bias — re-wrapping raw jnp values in fresh Tensors would sever it)
+    def attn_f(a, ww, bb, cv, mv, key):
+        B, T, D = a.shape
+        if transpose_qkv_wb:
+            qkv = a @ ww  # [B,T,3D]
+            if bb is not None:
+                qkv = qkv + bb
+            qkv = qkv.reshape(B, T, 3, nh, hd)
         else:
-            logits = logits + mv.astype(jnp.float32)
-        probs = jax.nn.softmax(logits, axis=-1)
-        if attn_dropout_rate and training:
-            probs_t = F.dropout(Tensor(probs.astype(q.dtype)),
-                                p=attn_dropout_rate, training=True, mode=mode)
-            probs = probs_t._value
-        att = jnp.einsum("bhls,bshd->blhd", probs.astype(q.dtype), v)
-    else:
-        from ....ops.flash_attention import flash_attention_raw
-        att = flash_attention_raw(q, k, v, causal=False)
-    B, T = att.shape[0], att.shape[1]
-    att_t = Tensor(att.reshape(B, T, -1))
+            qkv = jnp.einsum("btd,ehkd->btehk", a, ww)
+            if bb is not None:
+                qkv = qkv + bb.reshape(1, 1, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cv is not None:
+            k = jnp.concatenate([cv[0], k], axis=1)
+            v = jnp.concatenate([cv[1], v], axis=1)
+        if mv is None and key is None:
+            from ....ops.flash_attention import flash_attention_raw
+            att = flash_attention_raw(q, k, v, causal=False)
+        else:
+            logits = jnp.einsum("blhd,bshd->bhls", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) / _m.sqrt(hd)
+            if mv is not None:
+                m_ = jnp.asarray(mv)
+                while m_.ndim < 4:
+                    m_ = m_[None]
+                if m_.dtype == jnp.bool_:
+                    logits = jnp.where(m_, logits, -1e30)
+                else:
+                    logits = logits + m_.astype(jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1)
+            if key is not None:
+                keep = jax.random.bernoulli(key, 1.0 - attn_dropout_rate,
+                                            probs.shape)
+                probs = probs * keep / (1.0 - attn_dropout_rate)
+            att = jnp.einsum("bhls,bshd->blhd",
+                             probs.astype(q.dtype), v)
+        return att.reshape(B, T, nh * hd)
+
+    att_t = apply(attn_f, inp, qkv_weight, qkv_bias, cache_kv, attn_mask,
+                  drop_key, name="fused_attention")
     out = F.linear(att_t, linear_weight, linear_bias)
     if dropout_rate:
         out = F.dropout(out, p=dropout_rate, training=training, mode=mode)

@@ -25,12 +25,12 @@ from ..core.tensor import Tensor
 
 __all__ = []
 
-
-def _v(x):
-    return x._value if isinstance(x, Tensor) else x
+from .ops_ext import _v  # shared Tensor-unwrap helper  # noqa: E402
 
 
 def _export(fn):
+    # per-module __all__ registration (each module owns its export list;
+    # the unwrap logic is shared with ops_ext)
     __all__.append(fn.__name__)
     return fn
 
@@ -266,6 +266,9 @@ def _fractional_pool(x, output_size, kernel_size, random_u, nd, name):
         out_sp = ([output_size] * nd if isinstance(output_size, int)
                   else list(output_size))
         u = random_u if random_u is not None else 0.5
+        ks = (None if kernel_size is None else
+              ([kernel_size] * nd if isinstance(kernel_size, int)
+               else list(kernel_size)))
         idxs = []
         for i in range(nd):
             alpha = sp[i] / out_sp[i]
@@ -274,8 +277,13 @@ def _fractional_pool(x, output_size, kernel_size, random_u, nd, name):
             start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                      base[:-1]]) if out_sp[i] > 1 else \
                 jnp.zeros((1,), jnp.int32)
-            end = jnp.concatenate([base[1:],
-                                   jnp.asarray([sp[i]], jnp.int32)])
+            if ks is not None:
+                # explicit kernel: fixed-size windows at fractional offsets
+                start = jnp.minimum(start, sp[i] - ks[i])
+                end = start + ks[i]
+            else:
+                end = jnp.concatenate([base[1:],
+                                       jnp.asarray([sp[i]], jnp.int32)])
             idxs.append((start, jnp.maximum(end, start + 1)))
         # window max via cumulative trick: gather each output cell's window
         def pool_axis(arr, axis, se):
@@ -306,7 +314,13 @@ def _fractional_pool(x, output_size, kernel_size, random_u, nd, name):
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """Reference: ops.yaml fractional_max_pool2d (pseudo-random pooling
-    regions, Graham 2014); deterministic u unless random_u given."""
+    regions, Graham 2014); deterministic u unless random_u given.
+    return_mask is not supported (an honest error beats silently returning
+    one tensor into a two-target unpacking)."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True): indices are not "
+            "implemented on the TPU build")
     return _fractional_pool(x, output_size, kernel_size, random_u, 2,
                             "fractional_max_pool2d")
 
@@ -314,7 +328,11 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
 @_export
 def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
-    """Reference: ops.yaml fractional_max_pool3d."""
+    """Reference: ops.yaml fractional_max_pool3d (see 2d note)."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True): indices are not "
+            "implemented on the TPU build")
     return _fractional_pool(x, output_size, kernel_size, random_u, 3,
                             "fractional_max_pool3d")
 
@@ -667,19 +685,20 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
                 top_s, top_i = lax.top_k(s, k)
                 boxes_c = bx[b][top_i]
                 iou = _iou_matrix(boxes_c, boxes_c, normalized)
-                tri = jnp.tril(iou, -1)  # IoU with higher-scored boxes
+                tri = jnp.tril(iou, -1)  # tri[i, j<i]: IoU with higher box j
+                # each HIGHER box j's own compensation = its max IoU with
+                # boxes above it (reference matrix_nms: decay_ij uses the
+                # suppressor's compensation, indexed by j)
                 max_iou = jnp.max(tri, axis=1)
-                comp = jnp.max(tri, axis=0)
+                lower = jnp.tril(jnp.ones_like(tri), -1) > 0
                 if use_gaussian:
-                    decay = jnp.exp(-(tri ** 2 - comp[None, :] ** 2)
+                    decay = jnp.exp(-(tri ** 2 - max_iou[None, :] ** 2)
                                     / gaussian_sigma)
-                    decay = jnp.min(jnp.where(jnp.tril(jnp.ones_like(tri),
-                                                       -1) > 0, decay, 1.0),
-                                    axis=1)
+                    decay = jnp.min(jnp.where(lower, decay, 1.0), axis=1)
                 else:
                     decay = jnp.min(jnp.where(
-                        jnp.tril(jnp.ones_like(tri), -1) > 0,
-                        (1 - tri) / jnp.maximum(1 - comp[None, :], 1e-10),
+                        lower,
+                        (1 - tri) / jnp.maximum(1 - max_iou[None, :], 1e-10),
                         1.0), axis=1)
                 ds = top_s * decay
                 valid = top_s > score_threshold
@@ -973,14 +992,42 @@ def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(), anchor_mask=(),
                     jnp.where(on, sc_w, obj_target[sel]))
                 obj_hasgt = obj_hasgt.at[sel].set(
                     on | obj_hasgt[sel])
-        # objectness: positives → bce to score; negatives with best-iou <
-        # ignore_thresh → bce to 0
-        pobj_s = pobj
-        bce_obj = jnp.maximum(pobj_s, 0) - pobj_s * obj_target + \
-            jnp.log1p(jnp.exp(-jnp.abs(pobj_s)))
-        neg_mask = ~obj_hasgt
-        loss = loss + jnp.sum(jnp.where(obj_hasgt | neg_mask, bce_obj,
-                                        0.0), axis=(1, 2, 3))
+        # objectness: positives → bce to score; negatives participate ONLY
+        # when their predicted box's best IoU with any gt < ignore_thresh
+        # (reference: anchors overlapping a gt well are neither positive nor
+        # negative)
+        gxc = (jnp.arange(W)[None, None, None, :])
+        gyc = (jnp.arange(H)[None, None, :, None])
+        anc_m = anc_all[jnp.asarray(mask)]
+        pbx = (sig(px) + gxc) / W
+        pby = (sig(py) + gyc) / H
+        pbw = jnp.exp(jnp.clip(pw, -10, 10)) * \
+            anc_m[None, :, 0, None, None] / in_w
+        pbh = jnp.exp(jnp.clip(ph, -10, 10)) * \
+            anc_m[None, :, 1, None, None] / in_h
+        # IoU of every predicted box with every gt (center form)
+        px1 = (pbx - pbw / 2)[..., None]
+        py1 = (pby - pbh / 2)[..., None]
+        px2 = (pbx + pbw / 2)[..., None]
+        py2 = (pby + pbh / 2)[..., None]
+        gx1 = (gx - gw / 2)[:, None, None, None, :]
+        gy1 = (gy - gh / 2)[:, None, None, None, :]
+        gx2 = (gx + gw / 2)[:, None, None, None, :]
+        gy2 = (gy + gh / 2)[:, None, None, None, :]
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter_p = iw * ih
+        union_p = (px2 - px1) * (py2 - py1) + \
+            ((gx2 - gx1) * (gy2 - gy1)) - inter_p
+        iou_p = inter_p / jnp.maximum(union_p, 1e-10)
+        iou_p = jnp.where(valid[:, None, None, None, :], iou_p, 0.0)
+        best_iou = jnp.max(iou_p, axis=-1) if Bv > 0 else \
+            jnp.zeros_like(pbx)
+        bce_obj = jnp.maximum(pobj, 0) - pobj * obj_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(pobj)))
+        contributes = obj_hasgt | (best_iou < ignore_thresh)
+        loss = loss + jnp.sum(jnp.where(contributes, bce_obj, 0.0),
+                              axis=(1, 2, 3))
         return loss
 
     if gt_score is None:

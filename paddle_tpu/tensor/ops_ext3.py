@@ -25,12 +25,12 @@ from ..core.tensor import Tensor
 
 __all__ = []
 
-
-def _v(x):
-    return x._value if isinstance(x, Tensor) else x
+from .ops_ext import _v  # shared Tensor-unwrap helper  # noqa: E402
 
 
 def _export(fn):
+    # per-module __all__ registration (each module owns its export list;
+    # the unwrap logic is shared with ops_ext)
     __all__.append(fn.__name__)
     return fn
 
@@ -695,7 +695,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             ffn_ln_biases, ffn1_weights, ffn1_biases,
                             ffn2_weights, ffn2_biases, pre_layer_norm=True,
                             epsilon=1e-5, dropout_rate=0.0, act_method="gelu",
-                            normalize_before=True, name=None):
+                            normalize_before=True, num_heads=None, name=None):
     """Stacked fused transformer layers for inference (reference ops.yaml
     fused_multi_transformer): per-layer LN → qkv → attention → out-proj →
     FFN, all from packed per-layer weight lists."""
@@ -719,7 +719,12 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             if qkvb is not None:
                 qkv = qkv + qkvb[i].reshape(1, 1, 3, nh, hd)
         else:  # [D, 3*D] matrix layout: heads packed contiguously
-            nh = max(D // 64, 1) if D % 64 == 0 else 1
+            if num_heads is None:
+                raise ValueError(
+                    "fused_multi_transformer: num_heads is required with 2-D "
+                    "qkv weights (the 4-D [3, H, hd, D] layout is "
+                    "self-describing)")
+            nh = num_heads
             hd = D // nh
             qkv = inp @ w.reshape(D, -1)
             if qkvb is not None:

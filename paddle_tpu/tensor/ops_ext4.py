@@ -25,12 +25,12 @@ from ..core.tensor import Tensor
 
 __all__ = []
 
-
-def _v(x):
-    return x._value if isinstance(x, Tensor) else x
+from .ops_ext import _v  # shared Tensor-unwrap helper  # noqa: E402
 
 
 def _export(fn):
+    # per-module __all__ registration (each module owns its export list;
+    # the unwrap logic is shared with ops_ext)
     __all__.append(fn.__name__)
     return fn
 
@@ -261,13 +261,19 @@ def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates
         na2 = na + 1
         nu2 = nu + 1
         s1_2 = s1 + p.astype(s1.dtype)
-        roll = na2 >= min(max_average_window,
-                          max(min_average_window, average_window))
-        s2_2 = jnp.where(roll, s2 + s1_2, s2)
-        s3_2 = jnp.where(roll, jnp.zeros_like(s3) + s1_2 * 0 + s2_2 * 0 + s3,
-                         s3)
+        window = min(max_average_window,
+                     max(min_average_window, average_window))
+        roll = na2 >= window
+        # on roll: flush s1 into s2; when the long accumulator would exceed
+        # max_average_window, retire s2 into s3 and restart (reference
+        # average_accumulates semantics: s3 holds the retired full windows)
+        retire = roll & ((ona + na2) >= max_average_window)
+        s2_after_roll = jnp.where(roll, s2 + s1_2, s2)
+        s3_2 = jnp.where(retire, s2_after_roll, s3)
+        s2_2 = jnp.where(retire, jnp.zeros_like(s2), s2_after_roll)
         s1_3 = jnp.where(roll, jnp.zeros_like(s1_2), s1_2)
-        ona2 = jnp.where(roll, ona + na2, ona)
+        ona2 = jnp.where(retire, jnp.zeros_like(ona),
+                         jnp.where(roll, ona + na2, ona))
         na3 = jnp.where(roll, jnp.zeros_like(na2), na2)
         return s1_3, s2_2, s3_2, na3, ona2, nu2
     outs = apply(f, param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates,

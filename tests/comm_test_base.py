@@ -29,7 +29,9 @@ class CommunicationTestDistBase:
 
     def run_test_case(self, script: str, nproc: int = 2, timeout: int = 180,
                       extra_env: dict | None = None, expect_fail: bool = False):
+        import uuid
         port = free_port()
+        job_id = f"{script}-{uuid.uuid4().hex[:8]}"
         procs = []
         for r in range(nproc):
             env = {k: v for k, v in os.environ.items()
@@ -40,6 +42,7 @@ class CommunicationTestDistBase:
                 "PADDLE_TRAINERS_NUM": str(nproc),
                 "PADDLE_MASTER": f"127.0.0.1:{port}",
                 "PADDLE_NNODES": str(nproc),
+                "PADDLE_JOB_ID": job_id,
                 "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
             })
             env.update(extra_env or {})
@@ -61,7 +64,9 @@ class CommunicationTestDistBase:
                 out = (out or "") + "\n<TIMEOUT: harness killed the rank>"
             outs.append(out)
             codes.append(p.returncode)
-        if not expect_fail:
-            for r, (c, o) in enumerate(zip(codes, outs)):
-                assert c == 0, f"rank {r} exited {c}:\n{o[-3000:]}"
+        if not expect_fail and any(c != 0 for c in codes):
+            report = "\n".join(
+                f"==== rank {r} exited {c} ====\n{o[-1500:]}"
+                for r, (c, o) in enumerate(zip(codes, outs)))
+            raise AssertionError(f"ranks failed:\n{report}")
         return codes, outs

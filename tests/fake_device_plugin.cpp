@@ -1,0 +1,75 @@
+// Fake custom-device plugin: a CPU masquerading as "fake_npu".
+// Reference: /root/reference/paddle/phi/backends/custom/fake_cpu_device.h +
+// test/custom_runtime/test_custom_cpu_plugin.py — the hardware-free way to
+// exercise the whole plugin/device-manager path.
+//
+// Built by tests/test_custom_device.py with g++ -shared -fPIC.
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+#include "../paddle_tpu/device/custom/device_ext.h"
+
+namespace {
+
+int fake_init() { return 0; }
+int fake_finalize() { return 0; }
+int fake_count(int* n) { *n = 2; return 0; }
+
+int fake_alloc(int, size_t size, void** ptr) {
+  *ptr = std::malloc(size);
+  return *ptr ? 0 : 1;
+}
+int fake_free(int, void* ptr, size_t) { std::free(ptr); return 0; }
+int fake_h2d(int, void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return 0;
+}
+int fake_d2h(int, void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return 0;
+}
+
+int fake_kernel(int, const char* name, void** ins, int n_ins, void* out,
+                size_t numel) {
+  float* o = static_cast<float*>(out);
+  if (std::strcmp(name, "add") == 0 && n_ins == 2) {
+    const float* a = static_cast<const float*>(ins[0]);
+    const float* b = static_cast<const float*>(ins[1]);
+    for (size_t i = 0; i < numel; ++i) o[i] = a[i] + b[i];
+    return 0;
+  }
+  if (std::strcmp(name, "scale2") == 0 && n_ins == 1) {
+    const float* a = static_cast<const float*>(ins[0]);
+    for (size_t i = 0; i < numel; ++i) o[i] = 2.0f * a[i];
+    return 0;
+  }
+  if (std::strcmp(name, "softmax_row") == 0 && n_ins == 1) {
+    const float* a = static_cast<const float*>(ins[0]);
+    float mx = a[0];
+    for (size_t i = 1; i < numel; ++i) mx = a[i] > mx ? a[i] : mx;
+    float s = 0.f;
+    for (size_t i = 0; i < numel; ++i) { o[i] = std::exp(a[i] - mx); s += o[i]; }
+    for (size_t i = 0; i < numel; ++i) o[i] /= s;
+    return 0;
+  }
+  return 2;  // unknown kernel
+}
+
+const PT_DeviceInterface kIface = {
+    sizeof(PT_DeviceInterface),
+    PT_DEVICE_ABI_VERSION,
+    "fake_npu",
+    fake_init,
+    fake_finalize,
+    fake_count,
+    fake_alloc,
+    fake_free,
+    fake_h2d,
+    fake_d2h,
+    fake_kernel,
+};
+
+}  // namespace
+
+extern "C" const PT_DeviceInterface* PT_InitPlugin() { return &kIface; }

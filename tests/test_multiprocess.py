@@ -38,6 +38,16 @@ class TestMultiProcessCheckpoint(CommunicationTestDistBase):
         assert all("CKPT_OK" in o for o in outs)
 
 
+class TestRpcAndParameterServer(CommunicationTestDistBase):
+    def test_rpc_ps_2proc(self):
+        codes, outs = self.run_test_case("rpc_ps.py", nproc=2)
+        assert all("RPC_PS_OK" in o for o in outs), outs
+
+    def test_rpc_ps_3proc(self):
+        codes, outs = self.run_test_case("rpc_ps.py", nproc=3)
+        assert all("RPC_PS_OK" in o for o in outs), outs
+
+
 class TestCommWatchdog(CommunicationTestDistBase):
     def test_hung_barrier_dies_with_named_error(self):
         codes, outs = self.run_test_case("watchdog_hang.py", nproc=2,
