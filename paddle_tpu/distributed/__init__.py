@@ -25,6 +25,10 @@ from .spmd_rules import (  # noqa: F401
     SpmdContext, SpmdDecision, get_spmd_rule, register_spmd_rule,
     unregister_spmd_rule,
 )
+from .align_mode import (  # noqa: F401
+    align_mode_guard, assert_allclose_state, compare_state_dicts,
+    enable_auto_parallel_align_mode, in_auto_parallel_align_mode,
+)
 from .engine import Engine, PipelinePlan, Strategy as EngineStrategy  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401

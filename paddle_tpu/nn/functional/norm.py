@@ -1,0 +1,186 @@
+"""Normalization (layer/rms/batch/group/instance/lrn)
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/norm.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+# ======================= norms =======================
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mu = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native RMSNorm (reference fused_rms_norm op in incubate)."""
+
+    def f(a, *w):
+        a32 = a.astype(jnp.float32)
+        var = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = a32 * jax.lax.rsqrt(var + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = (x,) if weight is None else (x, weight)
+    return apply(f, *args, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    use_batch_stats = training and not use_global_stats
+    ch_axis_last = True  # we normalize with stats reshaped for channel axis
+
+    def f(a, *args_in):
+        idx = 0
+        w = b = None
+        if weight is not None:
+            w = args_in[idx]; idx += 1
+        if bias is not None:
+            b = args_in[idx]; idx += 1
+        ch_axis = a.ndim - 1 if channels_last else 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        a32 = a.astype(jnp.float32)
+        if use_batch_stats:
+            axes = tuple(d for d in range(a.ndim) if d != ch_axis)
+            mu = jnp.mean(a32, axis=axes)
+            var = jnp.var(a32, axis=axes)
+        else:
+            mu = running_mean._value.astype(jnp.float32)
+            var = running_var._value.astype(jnp.float32)
+        out = (a32 - mu.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32).reshape(shape)
+        if b is not None:
+            out = out + b.astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    # running-stat update: eager side effect (matches the reference kernel),
+    # or — under a functional train step's buffer_capture — a tracer write
+    # that the step reads back as new buffer state before the swap restores
+    from ...core import engine as _engine
+    if use_batch_stats and (not isinstance(x._value, jax.core.Tracer)
+                            or _engine.buffer_capture_enabled()):
+        ch_axis = x.ndim - 1 if channels_last else 1
+        axes = tuple(d for d in range(x.ndim) if d != ch_axis)
+        a32 = x._value.astype(jnp.float32)
+        mu = jnp.mean(a32, axis=axes)
+        var = jnp.var(a32, axis=axes)
+        n = x.size // x.shape[ch_axis]
+        unbiased = var * n / max(n - 1, 1)
+        running_mean.set_value(momentum * running_mean._value + (1 - momentum) * mu)
+        running_var.set_value(momentum * running_var._value + (1 - momentum) * unbiased)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, name="layer_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def f(a, *wb):
+        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
+        n, c = a_cf.shape[:2]
+        g = num_groups
+        grouped = a_cf.reshape(n, g, c // g, *a_cf.shape[2:]).astype(jnp.float32)
+        axes = tuple(range(2, grouped.ndim))
+        mu = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mu) * jax.lax.rsqrt(var + epsilon)).reshape(a_cf.shape)
+        shape = [1, c] + [1] * (a_cf.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        a32 = a.astype(jnp.float32)
+        mu = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = (a32 - mu) * jax.lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, name="layer_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        sq = a.astype(jnp.float32) ** 2
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = sum(jax.lax.slice_in_dim(padded, i, i + c, axis=1) for i in range(size))
+        return (a / ((k + alpha * acc / size) ** beta)).astype(a.dtype)
+
+    return apply(f, x, name="lrn")
+
+

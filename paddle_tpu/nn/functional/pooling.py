@@ -1,0 +1,165 @@
+"""Pooling (max/avg/adaptive) + unfold
+
+Split from the former nn/functional monolith (reference layout:
+python/paddle/nn/functional/pooling.py); the flat `nn.functional.*` API is
+re-exported unchanged by __init__.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core import random as _rng
+from ...core.engine import apply, apply_nondiff, grad_enabled
+from ...core.tensor import Tensor
+
+from .conv import _pair  # shared tuple-normalizer
+
+def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False,
+             exclusive=True, count_include_pad=False):
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride if stride is not None else kernel, nd)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding, nd)
+        pad = [(pi, pi) for pi in p]
+
+    def f(a):
+        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            padding_cfg = [(0, 0), (0, 0)] + list(pad)
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            out = jax.lax.reduce_window(a_cf, init, jax.lax.max, window, strides, padding_cfg)
+        else:
+            s = jax.lax.reduce_window(a_cf, 0.0, jax.lax.add, window, strides, padding_cfg)
+            if isinstance(padding_cfg, str) or (exclusive and not count_include_pad):
+                ones = jnp.ones_like(a_cf)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding_cfg)
+                out = s / cnt
+            else:
+                out = s / float(np.prod(kernel))
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return apply(f, x, name=f"{op}_pool{nd}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", data_format, ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode, exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
+
+
+def _adaptive_pool(x, output_size, nd, op, data_format):
+    out_sz = _pair(output_size, nd)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def f(a):
+        a_cf = jnp.moveaxis(a, -1, 1) if channels_last else a
+        spatial = a_cf.shape[2:]
+        out = a_cf
+        # exact adaptive pooling when divisible; else mean over variable slices
+        if all(s % o == 0 for s, o in zip(spatial, out_sz)):
+            k = tuple(s // o for s, o in zip(spatial, out_sz))
+            window = (1, 1) + k
+            if op == "avg":
+                out = jax.lax.reduce_window(a_cf, 0.0, jax.lax.add, window, window, "VALID") \
+                    / float(np.prod(k))
+            else:
+                out = jax.lax.reduce_window(a_cf, -jnp.inf, jax.lax.max, window, window, "VALID")
+        else:
+            for d, o in enumerate(out_sz):
+                s = out.shape[2 + d]
+                starts = [int(math.floor(i * s / o)) for i in range(o)]
+                ends = [int(math.ceil((i + 1) * s / o)) for i in range(o)]
+                slices = []
+                for st, en in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(out, st, en, axis=2 + d)
+                    red = jnp.mean(sl, axis=2 + d, keepdims=True) if op == "avg" \
+                        else jnp.max(sl, axis=2 + d, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=2 + d)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+
+    return apply(f, x, name=f"adaptive_{op}_pool")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+    d = _pair(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * k[0] * k[1], -1)
+
+    return apply(f, x, name="unfold")
+
+

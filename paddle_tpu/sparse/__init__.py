@@ -87,3 +87,74 @@ def relu(x, name=None):
                          shape=x._bcoo.shape)
         return SparseTensor(b)
     return Tensor(jax.nn.relu(x._value))
+
+
+def to_sparse_coo(x, sparse_dim=None, name=None):
+    """Dense → COO (reference sparse_ops.yaml to_sparse_coo)."""
+    if isinstance(x, SparseTensor):
+        return x
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseTensor(jsparse.BCOO.fromdense(v))
+
+
+def to_sparse_csr(x, name=None):
+    """Dense/COO → CSR-semantics tensor (reference to_sparse_csr). Stored as
+    BCOO (XLA's TPU-lowerable format); crows()/cols() views derive from it."""
+    t = to_sparse_coo(x)
+    t._is_csr = True
+    return t
+
+
+def values(x, name=None):
+    """Reference sparse_ops.yaml `values` op (function form of .values())."""
+    return x.values() if isinstance(x, SparseTensor) else Tensor(x)
+
+
+def divide_scalar(x, scalar, name=None):
+    """Reference sparse_ops.yaml divide_scalar: elementwise on stored values."""
+    if isinstance(x, SparseTensor):
+        b = jsparse.BCOO((x._bcoo.data / scalar, x._bcoo.indices),
+                         shape=x._bcoo.shape)
+        return SparseTensor(b)
+    return Tensor((x._value if isinstance(x, Tensor) else jnp.asarray(x))
+                  / scalar)
+
+
+def batch_norm_(x, mean, variance, scale, bias, is_test=False, momentum=0.9,
+                epsilon=1e-5, data_format="NDHWC", use_global_stats=False,
+                trainable_statistics=False, name=None):
+    """Sparse batch norm (reference sparse_ops.yaml batch_norm_): normalize
+    the stored values channel-wise, dense statistics. data_format picks the
+    channel dim: *C-last layouts (NDHWC/NHWC) vs channel-first (NCDHW)."""
+    from ..tensor.ops_ext4 import sync_batch_norm_
+    layout = "NHWC" if data_format.endswith("C") else "NCHW"
+    dense = to_dense(x)
+    out, m, v = sync_batch_norm_(dense, mean, variance, scale, bias,
+                                 is_test=is_test, momentum=momentum,
+                                 epsilon=epsilon, data_layout=layout)
+    if isinstance(x, SparseTensor):
+        return to_sparse_coo(out), m, v
+    return out, m, v
+
+
+def conv3d_implicit_gemm(x, kernel, bias=None, stride=1, padding=0,
+                         dilation=1, groups=1, subm=False, key=None,
+                         name=None):
+    """Sparse/submanifold conv3d (reference sparse_ops.yaml
+    conv3d_implicit_gemm): densify → lax conv (XLA's implicit-GEMM path on
+    the MXU) → re-sparsify. NDHWC layout."""
+    from ..nn.functional import conv3d
+    dense = to_dense(x)
+    v = dense._value
+    # NDHWC → NCDHW for the shared conv entry
+    out = conv3d(Tensor(jnp.moveaxis(v, -1, 1)), kernel, bias=bias,
+                 stride=stride, padding=padding, dilation=dilation,
+                 groups=groups)
+    out = Tensor(jnp.moveaxis(out._value, 1, -1))
+    if isinstance(x, SparseTensor):
+        return to_sparse_coo(out)
+    return out
+
+
+__all__ += ["to_sparse_coo", "to_sparse_csr", "values", "divide_scalar",
+            "batch_norm_", "conv3d_implicit_gemm"]

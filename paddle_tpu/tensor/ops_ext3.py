@@ -103,10 +103,12 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
     time-major; weight_list per (layer, direction): [wi, wh, bi, bh].
     Returns (out [T, B, D*H], h_n [L*D, B, H], c_n for LSTM)."""
     D = 2 if is_bidirec else 1
-    ws = [_v(w) for w in weight_list]
-    h0_all = _v(pre_state[0] if isinstance(pre_state, (list, tuple))
-                else pre_state)
-    c0_all = (_v(pre_state[1]) if mode == "LSTM" and
+    # keep caller Tensors intact — re-wrapping (Tensor(_v(w))) would sever
+    # the eager tape and the RNN weights would never receive gradients
+    ws = list(weight_list)
+    h0_all = (pre_state[0] if isinstance(pre_state, (list, tuple))
+              else pre_state)
+    c0_all = (pre_state[1] if mode == "LSTM" and
               isinstance(pre_state, (list, tuple)) and len(pre_state) > 1
               else None)
 
@@ -137,11 +139,11 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
         return ys, hN
 
     if c0_all is not None:
-        out = apply(lambda a, h, c, *w: f(a, h, c, *w), x, Tensor(h0_all),
-                    Tensor(c0_all), *[Tensor(w) for w in ws], name="rnn")
+        out = apply(lambda a, h, c, *w: f(a, h, c, *w), x, h0_all,
+                    c0_all, *ws, name="rnn")
         return out[0], (out[1], out[2])
-    out = apply(lambda a, h, *w: f(a, h, None, *w), x, Tensor(h0_all),
-                *[Tensor(w) for w in ws], name="rnn")
+    out = apply(lambda a, h, *w: f(a, h, None, *w), x, h0_all,
+                *ws, name="rnn")
     return out[0], out[1]
 
 

@@ -158,3 +158,108 @@ class TestMetaOptimizers:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
+
+
+class TestFusedOpsYamlSurface:
+    def test_fc_and_gemm_epilogue(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        w = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        out = pt.fc(t(x), t(w), t(b), activation_type="relu")
+        np.testing.assert_allclose(out.numpy(), np.maximum(x @ w + b, 0),
+                                   rtol=1e-5)
+        out2 = pt.gemm_epilogue(t(x), t(w), t(b), activation="gelu")
+        assert out2.shape == [3, 5]
+
+    def test_skip_layernorm(self):
+        x = np.random.randn(2, 3, 8).astype(np.float32)
+        y = np.random.randn(2, 3, 8).astype(np.float32)
+        out = pt.skip_layernorm(t(x), t(y), t(np.ones(8, np.float32)),
+                                t(np.zeros(8, np.float32)))
+        h = x + y
+        ref = (h - h.mean(-1, keepdims=True)) / \
+            np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_multihead_matmul(self):
+        B, T, H, hd = 1, 4, 2, 3
+        D = H * hd
+        x = np.random.randn(B, T, D).astype(np.float32)
+        w = np.random.randn(D, 3, H, hd).astype(np.float32) * 0.2
+        out = pt.multihead_matmul(t(x), t(w.reshape(D, 3 * H * hd)),
+                                  head_number=H, alpha=1.0 / np.sqrt(hd))
+        assert out.shape == [B, T, D]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_resnet_basic_block_identity_shortcut(self):
+        x = np.random.randn(1, 4, 8, 8).astype(np.float32)
+        w1 = np.random.randn(4, 4, 3, 3).astype(np.float32) * 0.1
+        w2 = np.random.randn(4, 4, 3, 3).astype(np.float32) * 0.1
+        ones = np.ones(4, np.float32)
+        zeros = np.zeros(4, np.float32)
+        out = pt.resnet_basic_block(
+            t(x), t(w1), t(ones), t(zeros), t(zeros), t(ones),
+            t(w2), t(ones), t(zeros), t(zeros), t(ones))
+        assert out.shape == [1, 4, 8, 8]
+        assert (out.numpy() >= 0).all()  # relu output
+
+    def test_fused_embedding_eltwise_layernorm(self):
+        V, D = 10, 6
+        ids = np.random.randint(0, V, (2, 3, 1))
+        emb = np.random.randn(V, D).astype(np.float32)
+        out = pt.fused_embedding_eltwise_layernorm(
+            [t(ids, "int32")], [t(emb)], t(np.zeros(D, np.float32)),
+            t(np.ones(D, np.float32)))
+        looked = emb[ids[..., 0]]
+        ref = (looked - looked.mean(-1, keepdims=True)) / \
+            np.sqrt(looked.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_fused_token_prune(self):
+        B, H, T, D = 1, 1, 6, 4
+        x = np.random.randn(B, T, D).astype(np.float32)
+        attn = np.random.rand(B, H, T, T).astype(np.float32)
+        mask = np.ones((B, H, T, T), np.float32)
+        new_mask = np.ones((B, H, 3, 3), np.float32)
+        out, idx = pt.fused_token_prune(t(attn), t(x), t(mask), t(new_mask))
+        assert out.shape == [B, 3, D]
+        assert 0 in idx.numpy()  # first token kept
+
+    def test_fused_linear_param_grad_add(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        g = np.random.randn(4, 5).astype(np.float32)
+        dw0 = np.ones((3, 5), np.float32)
+        dw, db = pt.fused_linear_param_grad_add(t(x), t(g), t(dw0), None)
+        np.testing.assert_allclose(dw.numpy(), dw0 + x.T @ g, rtol=1e-4)
+        np.testing.assert_allclose(db.numpy(), g.sum(0), rtol=1e-4)
+
+    def test_squeeze_excitation_block(self):
+        x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+        wsq = np.random.randn(4, 2).astype(np.float32)
+        wex = np.random.randn(2, 4).astype(np.float32)
+        out = pt.squeeze_excitation_block(t(x), t(wsq), t(wex))
+        assert out.shape == [2, 4, 5, 5]
+
+    def test_sparse_surface(self):
+        import paddle_tpu.sparse as sp
+        dense = np.array([[0, 1.0], [2.0, 0]], np.float32)
+        s = sp.to_sparse_coo(t(dense))
+        assert s.nnz == 2
+        np.testing.assert_allclose(
+            sp.divide_scalar(s, 2.0).to_dense().numpy(), dense / 2)
+        np.testing.assert_allclose(sp.values(s).numpy(), [1.0, 2.0])
+
+    def test_fusion_gru_lstm_run_and_grads(self):
+        T_, B, I, H = 4, 2, 3, 5
+        x = pt.randn([T_, B, I])
+        wx = pt.to_tensor(np.random.randn(I, 3 * H).astype(np.float32) * 0.2)
+        wh = pt.to_tensor(np.random.randn(H, 3 * H).astype(np.float32) * 0.2)
+        wx.stop_gradient = False
+        out, hT = pt.fusion_gru(x, None, wx, wh)
+        assert out.shape == [T_, B, H]
+        out.sum().backward()
+        assert wx.grad is not None  # tape preserved through the fusion
+        wx4 = pt.to_tensor(np.random.randn(I, 4 * H).astype(np.float32) * 0.2)
+        wh4 = pt.to_tensor(np.random.randn(H, 4 * H).astype(np.float32) * 0.2)
+        out2, h2, c2 = pt.fusion_lstm(x, None, None, wx4, wh4)
+        assert out2.shape == [T_, B, H]
