@@ -108,3 +108,14 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    """Fallback attribute resolution through the op table — ops that are
+    deliberately not star-exported (e.g. `hash`, which would shadow the
+    python builtin in `from paddle_tpu import *`) stay reachable as
+    `paddle_tpu.<op>`, exactly like `_C_ops.<op>`."""
+    from .core.tensor import _OPS_CACHE
+    if name in _OPS_CACHE:
+        return _OPS_CACHE[name]
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

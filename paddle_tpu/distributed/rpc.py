@@ -290,7 +290,9 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     import json
     import urllib.request
     debug = os.environ.get("PADDLE_RPC_DEBUG") == "1"
-    deadline = time.time() + 120
+    # generous default: under heavy CI load a peer's interpreter start can
+    # stall minutes before it registers (PADDLE_RPC_TIMEOUT overrides)
+    deadline = time.time() + float(os.environ.get("PADDLE_RPC_TIMEOUT", 300))
     last_beat = 0.0
     t_start = time.time()
     while len(agent.workers) < world_size:

@@ -8,9 +8,9 @@ from __future__ import annotations
 from ..core.tensor import Tensor, Parameter, to_tensor
 from ..core.tensor import _OPS_CACHE
 
-from . import (creation, einsum as _einsum_mod, fused_ops, linalg, logic,
-               manipulation, math, ops_ext, ops_ext2, ops_ext3, ops_ext4,
-               random, search, stat)
+from . import (creation, einsum as _einsum_mod, fused_ops, legacy_ops, linalg,
+               logic, manipulation, math, ops_ext, ops_ext2, ops_ext3,
+               ops_ext4, random, search, stat)
 
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
@@ -18,6 +18,10 @@ from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .fused_ops import *  # noqa: F401,F403
+from .legacy_ops import *  # noqa: F401,F403
+# NOTE: legacy_ops.hash is deliberately NOT imported into this namespace —
+# it stays reachable via the op table (paddle_tpu.__getattr__/_C_ops.hash)
+# so star-imports never shadow the python builtin.
 from .ops_ext import *  # noqa: F401,F403
 from .ops_ext2 import *  # noqa: F401,F403
 from .ops_ext3 import *  # noqa: F401,F403
@@ -27,8 +31,9 @@ from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 
-_MODULES = (creation, fused_ops, linalg, logic, manipulation, math, ops_ext,
-            ops_ext2, ops_ext3, ops_ext4, random, search, stat, _einsum_mod)
+_MODULES = (creation, fused_ops, legacy_ops, linalg, logic, manipulation,
+            math, ops_ext, ops_ext2, ops_ext3, ops_ext4, random, search,
+            stat, _einsum_mod)
 
 
 def _collect_ops():

@@ -1,0 +1,338 @@
+"""The legacy static-graph op surface
+(reference /root/reference/paddle/phi/ops/yaml/legacy/static_ops.yaml, 90
+ops): renamed/older-ABI variants of ops the modern surface already has.
+Each entry routes to the modern implementation — exactly how the reference
+maps legacy program ops onto phi kernels via op_compat.yaml.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply, apply_nondiff
+from ..core.tensor import Tensor
+from .ops_ext import _v
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_export
+def assign_value(shape, dtype, values, name=None):
+    """legacy assign_value: materialize a constant tensor of `dtype`."""
+    import numpy as np
+
+    from ..core import dtypes as _dt
+    dt = _dt.convert_dtype(dtype) if dtype is not None else None
+    arr = np.asarray(values).reshape(shape)
+    return Tensor(jnp.asarray(arr, dtype=dt))
+
+
+@_export
+def beam_search_decode(ids_list, parent_idx_list, scores_list=None,
+                       beam_size=4, end_id=0, name=None):
+    """legacy beam_search_decode: backtrack the per-step parent pointers
+    from beam_search into full sequences (padded with end_id). Takes the
+    parent-index outputs of `beam_search`; per-step scores (optional) are
+    backtracked the same way."""
+    import numpy as np
+    ids = [np.asarray(_v(t)).reshape(-1) for t in ids_list]
+    parents = [np.asarray(_v(t)).reshape(-1).astype(np.int64)
+               for t in parent_idx_list] if parent_idx_list else None
+    scs = ([np.asarray(_v(t)).reshape(-1) for t in scores_list]
+           if scores_list else None)
+    T = len(ids)
+    beams = len(ids[0]) if T else 0
+    seqs = np.full((beams, T), end_id, np.int64)
+    scores = np.zeros((beams, T), np.float32)
+    for b in range(beams):
+        cur = b
+        for t in range(T - 1, -1, -1):
+            seqs[b, t] = ids[t][cur]
+            if scs is not None:
+                scores[b, t] = scs[t][cur]
+            if parents is not None:
+                cur = int(parents[t][cur])
+    return Tensor(seqs), Tensor(scores)
+
+
+@_export
+def cross_entropy2(x, label, ignore_index=-100, name=None):
+    from ..nn.functional import cross_entropy
+    return cross_entropy(x, label, ignore_index=ignore_index,
+                         reduction="none")
+
+
+@_export
+def elementwise_pow(x, y, axis=-1, name=None):
+    def f(a, b):
+        return jnp.power(a, b)
+    return apply(f, x, y, name="elementwise_pow")
+
+
+@_export
+def flatten2(x, axis=1, name=None):
+    """legacy flatten2: flatten to 2-D at `axis`; returns (out, xshape) —
+    the legacy two-output ABI (xshape records the input shape for the
+    backward translation)."""
+    import numpy as np
+
+    def f(a):
+        lead = 1
+        for s in a.shape[:axis]:
+            lead *= s
+        return a.reshape(lead, -1)
+    out = apply(f, x, name="flatten2")
+    return out, Tensor(jnp.asarray(np.asarray(_v(x).shape), jnp.int64))
+
+
+def hash(x, num_hash=1, mod_by=100000000, name=None):  # noqa: A001
+    """legacy hash op: per-row integer hashing into num_hash buckets.
+    Deliberately NOT in __all__: star-importing a symbol named `hash` would
+    shadow the python builtin for users; it is reachable as an attribute
+    (paddle_tpu.hash / tensor.hash) like the reference op."""
+    def f(a):
+        ids = a.astype(jnp.uint32).reshape(a.shape[0], -1)
+        outs = []
+        for h in range(num_hash):
+            acc = jnp.full((ids.shape[0],), 2166136261 + h, jnp.uint32)
+            for c in range(ids.shape[1]):
+                acc = (acc ^ ids[:, c]) * jnp.uint32(16777619)
+            outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+        return jnp.stack(outs, axis=1)
+    return apply_nondiff(f, x, name="hash")
+
+
+@_export
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW", name=None):
+    from ..nn.functional import local_response_norm
+    return local_response_norm(x, n, alpha=alpha, beta=beta, k=k,
+                               data_format=data_format)
+
+
+@_export
+def matmul_with_flatten(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """legacy mul op: flatten then matmul."""
+    import math as _m
+
+    def f(a, b):
+        a2 = a.reshape(_m.prod(a.shape[:x_num_col_dims]) or 1, -1)
+        b2 = b.reshape(_m.prod(b.shape[:y_num_col_dims]) or 1, -1)
+        return a2 @ b2
+    return apply(f, x, y, name="matmul_with_flatten")
+
+
+@_export
+def quant_linear(x, w, bias=None, scale_in=1.0, scale_weights=(1.0,),
+                 quant_round_type=1, quant_max_bound=127.0,
+                 quant_min_bound=-127.0, name=None):
+    """legacy quant_linear: int8-simulated linear (scale → round → matmul →
+    dequant)."""
+    def f(a, ww, b):
+        q_a = jnp.clip(jnp.round(a * scale_in), quant_min_bound,
+                       quant_max_bound)
+        sw = jnp.asarray(scale_weights).reshape(1, -1)
+        q_w = jnp.clip(jnp.round(ww * sw), quant_min_bound, quant_max_bound)
+        out = (q_a @ q_w) / (scale_in * sw)
+        if b is not None:
+            out = out + b
+        return out
+    return apply(f, x, w, bias, name="quant_linear")
+
+
+@_export
+def row_conv(x, filter, name=None):
+    """legacy row_conv (lookahead conv for streaming ASR): y[t] = sum_k
+    w[k] * x[t+k]."""
+    def f(a, w):
+        T = a.shape[0]
+        ctx = w.shape[0]
+        out = jnp.zeros_like(a)
+        for kk in range(ctx):
+            rolled = jnp.roll(a, -kk, axis=0)
+            mask = (jnp.arange(T) + kk < T).reshape((T,) + (1,) * (a.ndim - 1))
+            out = out + rolled * mask * w[kk]
+        return out
+    return apply(f, x, filter, name="row_conv")
+
+
+@_export
+def sequence_expand(x, y, ref_level=0, name=None):
+    """legacy sequence_expand: repeat rows of x to cover y's length exactly
+    (ragged lengths distribute the remainder over the leading rows — the
+    dense stand-in for the reference's LoD-driven expansion)."""
+    def f(a, b):
+        n = max(a.shape[0], 1)
+        base, rem = divmod(b.shape[0], n)
+        reps = jnp.asarray([base + (1 if i < rem else 0)
+                            for i in range(n)])
+        return jnp.repeat(a, reps, axis=0,
+                          total_repeat_length=b.shape[0])
+    return apply(f, x, y, name="sequence_expand")
+
+
+@_export
+def sequence_softmax(x, name=None):
+    def f(a):
+        return jax.nn.softmax(a, axis=-1)
+    return apply(f, x, name="sequence_softmax")
+
+
+@_export
+def sparse_momentum(param, grad, index, velocity, learning_rate, mu=0.9,
+                    use_nesterov=False, axis=0, name=None):
+    """legacy sparse_momentum: momentum update on the rows in `index`."""
+    def f(p, g, idx, v, lr):
+        i = idx.astype(jnp.int32).reshape(-1)
+        v_rows = v[i]
+        v_new_rows = mu * v_rows + g
+        upd = (g + mu * v_new_rows) if use_nesterov else v_new_rows
+        p2 = p.at[i].add(-lr.astype(p.dtype) * upd)
+        v2 = v.at[i].set(v_new_rows)
+        return p2, v2
+    p2, v2 = apply_nondiff(f, param, grad, index, velocity, learning_rate,
+                           name="sparse_momentum")
+    if isinstance(param, Tensor):
+        param.set_value(_v(p2))
+    if isinstance(velocity, Tensor):
+        velocity.set_value(_v(v2))
+    return param, velocity
+
+
+@_export
+def topk_v1(x, k=1, name=None):
+    from .search import topk
+    return topk(x, k)
+
+
+@_export
+def tril_triu(x, diagonal=0, lower=True, name=None):
+    def f(a):
+        return jnp.tril(a, diagonal) if lower else jnp.triu(a, diagonal)
+    return apply(f, x, name="tril_triu")
+
+
+@_export
+def transfer_layout(x, src_layout=0, dst_layout=0, name=None):
+    """legacy transfer_layout (NCHW↔NHWC): XLA manages layouts; an explicit
+    transpose when the logical layouts differ."""
+    if src_layout == dst_layout:
+        return x
+    perm = [0, 2, 3, 1] if dst_layout else [0, 3, 1, 2]
+    from .manipulation import transpose
+    return transpose(x, perm)
+
+
+@_export
+def share_buffer(x, name=None):
+    """legacy share_buffer: alias the storage (jax arrays are immutable —
+    sharing is the default; returns the same Tensor + a share flag)."""
+    return x, Tensor(jnp.ones((), jnp.bool_))
+
+
+@_export
+def shadow_output(x, name=None):
+    """legacy shadow_output (fetch bridge): identity."""
+    return x
+
+
+@_export
+def fetch_barrier(x_list=None, name=None):
+    """legacy fetch_barrier: synchronize pending work (PS-era); PJRT analog
+    is blocking on the arrays."""
+    if x_list:
+        for t in x_list:
+            jax.block_until_ready(_v(t))
+    return x_list
+
+
+@_export
+def comm_init_all(devices=None, ring_id=0, name=None):
+    """legacy comm_init_all: collective rings are implicit in XLA meshes."""
+    return None
+
+
+@_export
+def dist_concat(x, ring_id=0, nranks=1, name=None):
+    """legacy dist_concat: all_gather the shards and concat along dim 0."""
+    from ..distributed import collective
+    gathered: list = []
+    collective.all_gather(gathered, x)
+    if not gathered:
+        return x
+    from .manipulation import concat
+    return concat(gathered, axis=0)
+
+
+# p2p legacy ops route to the modern send/recv surface
+@_export
+def p_send(x, peer=0, ring_id=0, dynamic_shape=False, name=None):
+    from ..distributed import collective
+    return collective.send(x, dst=peer)
+
+
+@_export
+def p_recv(dtype=None, peer=0, ring_id=0, out_shape=None, name=None):
+    from ..distributed import collective
+    out = Tensor(jnp.zeros(out_shape or (1,),
+                           jnp.dtype(dtype) if dtype else jnp.float32))
+    collective.recv(out, src=peer)
+    return out
+
+
+@_export
+def p_send_array(x_list, peer=0, ring_id=0, name=None):
+    for t in x_list:
+        p_send(t, peer, ring_id)
+
+
+@_export
+def p_recv_array(shapes, dtypes, peer=0, ring_id=0, name=None):
+    return [p_recv(dt, peer, ring_id, sh) for sh, dt in zip(shapes, dtypes)]
+
+
+# legacy_* interp/crop/expand/proposals: older-ABI aliases of modern ops
+@_export
+def legacy_bilinear_interp(x, out_size=None, scale=0.0, name=None, **kw):
+    from ..nn.functional import interpolate
+    return interpolate(x, size=out_size,
+                       scale_factor=scale if scale else None,
+                       mode="bilinear")
+
+
+@_export
+def legacy_nearest_interp(x, out_size=None, scale=0.0, name=None, **kw):
+    from ..nn.functional import interpolate
+    return interpolate(x, size=out_size,
+                       scale_factor=scale if scale else None, mode="nearest")
+
+
+@_export
+def legacy_crop(x, shape=None, offsets=None, name=None):
+    def f(a):
+        offs = offsets or [0] * a.ndim
+        sl = tuple(slice(o, o + s) for o, s in zip(offs, shape))
+        return a[sl]
+    return apply(f, x, name="legacy_crop")
+
+
+@_export
+def legacy_expand(x, expand_times=None, name=None):
+    def f(a):
+        return jnp.tile(a, expand_times)
+    return apply(f, x, name="legacy_expand")
+
+
+@_export
+def legacy_generate_proposals(scores, bbox_deltas, im_info, anchors,
+                              variances, pre_nms_top_n=6000,
+                              post_nms_top_n=1000, nms_thresh=0.5,
+                              min_size=0.1, eta=1.0, name=None):
+    from .ops_ext2 import generate_proposals
+    return generate_proposals(scores, bbox_deltas, im_info, anchors,
+                              variances, pre_nms_top_n, post_nms_top_n,
+                              nms_thresh, min_size, eta, pixel_offset=True)

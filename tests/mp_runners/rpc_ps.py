@@ -79,14 +79,14 @@ def main():
 
         # finish barrier: report done, wait until every worker is done
         rpc.rpc_sync("server0", mark_done, (name,))
-        deadline = time.time() + 120
+        deadline = time.time() + 300
         while rpc.rpc_sync("server0", done_count, ()) < n_workers:
             if time.time() > deadline:
                 raise TimeoutError("finish barrier")
             time.sleep(0.3)
     else:
         # server: hold until every worker reported done
-        deadline = time.time() + 150
+        deadline = time.time() + 330
         while len(_DONE) < n_workers:
             if time.time() > deadline:
                 raise TimeoutError(f"server finish barrier: {_DONE}")

@@ -1,0 +1,97 @@
+"""Legacy static-op surface tests (reference legacy/static_ops.yaml — the
+older-ABI variants routed onto the modern surface)."""
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def t(x, dtype=None):
+    a = np.asarray(x)
+    if dtype:
+        a = a.astype(dtype)
+    return pt.to_tensor(a)
+
+
+class TestLegacyOps:
+    def test_matmul_with_flatten(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        y = np.random.randn(12, 5).astype(np.float32)
+        out = pt.matmul_with_flatten(t(x), t(y))
+        np.testing.assert_allclose(out.numpy(), x.reshape(2, 12) @ y,
+                                   rtol=1e-5)
+
+    def test_flatten2_and_tril_triu(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+        out, xshape = pt.flatten2(t(x), axis=2)
+        assert out.shape == [6, 4]
+        np.testing.assert_array_equal(xshape.numpy(), [2, 3, 4])
+        m = np.random.randn(4, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.tril_triu(t(m), lower=True).numpy(),
+                                   np.tril(m))
+        np.testing.assert_allclose(pt.tril_triu(t(m), lower=False).numpy(),
+                                   np.triu(m))
+
+    def test_elementwise_pow_and_lrn(self):
+        x = np.abs(np.random.randn(4)).astype(np.float32) + 0.1
+        y = np.full(4, 2.0, np.float32)
+        np.testing.assert_allclose(pt.elementwise_pow(t(x), t(y)).numpy(),
+                                   x ** 2, rtol=1e-5)
+        img = np.random.randn(1, 4, 6, 6).astype(np.float32)
+        assert pt.lrn(t(img)).shape == [1, 4, 6, 6]
+
+    def test_hash_deterministic(self):
+        ids = np.array([[1, 2], [1, 2], [3, 4]], np.int64)
+        h = pt.hash(t(ids), num_hash=2, mod_by=1000).numpy()
+        assert h.shape == (3, 2)
+        np.testing.assert_array_equal(h[0], h[1])
+        assert (h < 1000).all()
+
+    def test_row_conv_lookahead(self):
+        x = np.random.randn(5, 3).astype(np.float32)
+        w = np.array([1.0, 0.5, 0.25], np.float32)
+        out = pt.row_conv(t(x), t(w)).numpy()
+        ref0 = x[0] * 1.0 + x[1] * 0.5 + x[2] * 0.25
+        np.testing.assert_allclose(out[0], ref0, rtol=1e-5)
+        np.testing.assert_allclose(out[4], x[4] * 1.0, rtol=1e-5)
+
+    def test_quant_linear_close_to_dense(self):
+        x = np.random.randn(3, 8).astype(np.float32)
+        w = np.random.randn(8, 4).astype(np.float32) * 0.1
+        out = pt.quant_linear(t(x), t(w), scale_in=32.0,
+                              scale_weights=(127.0,))
+        np.testing.assert_allclose(out.numpy(), x @ w, rtol=0.2, atol=0.05)
+
+    def test_sparse_momentum_updates_rows(self):
+        p = t(np.ones((4, 2), np.float32))
+        g = t(np.ones((2, 2), np.float32))
+        idx = t(np.array([0, 2], np.int64))
+        vel = t(np.zeros((4, 2), np.float32))
+        lr = t(np.float32(0.1))
+        pt.sparse_momentum(p, g, idx, vel, lr)
+        assert (p.numpy()[0] < 1.0).all() and (p.numpy()[2] < 1.0).all()
+        np.testing.assert_allclose(p.numpy()[1], 1.0)
+
+    def test_assign_value_and_legacy_expand(self):
+        v = pt.assign_value([2, 2], "float32", [1.0, 2.0, 3.0, 4.0])
+        np.testing.assert_allclose(v.numpy(), [[1, 2], [3, 4]])
+        e = pt.legacy_expand(t(np.ones((1, 2), np.float32)),
+                             expand_times=[2, 1])
+        assert e.shape == [2, 2]
+
+    def test_sequence_ops_and_layout(self):
+        x = np.random.randn(2, 3).astype(np.float32)
+        y = np.zeros((6, 1), np.float32)
+        assert pt.sequence_expand(t(x), t(y)).shape == [6, 3]
+        sm = pt.sequence_softmax(t(x)).numpy()
+        np.testing.assert_allclose(sm.sum(-1), 1.0, rtol=1e-5)
+        img = np.random.randn(1, 3, 4, 4).astype(np.float32)
+        nhwc = pt.transfer_layout(t(img), 0, 1)
+        assert nhwc.shape == [1, 4, 4, 3]
+
+    def test_beam_search_decode(self):
+        ids = [t(np.array([5, 6], np.int64)), t(np.array([7, 8], np.int64))]
+        parents = [t(np.array([0, 1], np.int64)),
+                   t(np.array([0, 0], np.int64))]
+        seqs, scores = pt.beam_search_decode(ids, parents, beam_size=2)
+        assert seqs.shape == [2, 2]
+        np.testing.assert_array_equal(seqs.numpy()[0], [5, 7])

@@ -40,11 +40,11 @@ class TestMultiProcessCheckpoint(CommunicationTestDistBase):
 
 class TestRpcAndParameterServer(CommunicationTestDistBase):
     def test_rpc_ps_2proc(self):
-        codes, outs = self.run_test_case("rpc_ps.py", nproc=2)
+        codes, outs = self.run_test_case("rpc_ps.py", nproc=2, timeout=700)
         assert all("RPC_PS_OK" in o for o in outs), outs
 
     def test_rpc_ps_3proc(self):
-        codes, outs = self.run_test_case("rpc_ps.py", nproc=3)
+        codes, outs = self.run_test_case("rpc_ps.py", nproc=3, timeout=700)
         assert all("RPC_PS_OK" in o for o in outs), outs
 
 
