@@ -69,7 +69,11 @@ def cross_entropy2(x, label, ignore_index=-100, name=None):
 
 @_export
 def elementwise_pow(x, y, axis=-1, name=None):
+    """legacy elementwise op ABI: `axis` aligns y's dims to x starting at
+    `axis` (mid-dim broadcast), unlike numpy's trailing-dim rule."""
     def f(a, b):
+        if axis >= 0 and b.ndim < a.ndim:
+            b = b.reshape(b.shape + (1,) * (a.ndim - axis - b.ndim))
         return jnp.power(a, b)
     return apply(f, x, y, name="elementwise_pow")
 
@@ -277,11 +281,15 @@ def p_send(x, peer=0, ring_id=0, dynamic_shape=False, name=None):
 
 @_export
 def p_recv(dtype=None, peer=0, ring_id=0, out_shape=None, name=None):
-    from ..distributed import collective
-    out = Tensor(jnp.zeros(out_shape or (1,),
-                           jnp.dtype(dtype) if dtype else jnp.float32))
-    collective.recv(out, src=peer)
-    return out
+    """legacy p_recv cannot allocate a TRACED receive buffer itself (a
+    fresh jnp.zeros is a constant, which the p2p layer rejects) — an honest
+    error beats the opaque crash; the modern path is
+    `distributed.collective.recv(buffer, src=...)` inside a shard_map with
+    a buffer that participates in the traced computation."""
+    raise NotImplementedError(
+        "p_recv: use distributed.collective.recv with a traced buffer "
+        "inside shard_map (the legacy ABI's self-allocated buffer cannot "
+        "join an SPMD trace)")
 
 
 @_export
@@ -292,7 +300,9 @@ def p_send_array(x_list, peer=0, ring_id=0, name=None):
 
 @_export
 def p_recv_array(shapes, dtypes, peer=0, ring_id=0, name=None):
-    return [p_recv(dt, peer, ring_id, sh) for sh, dt in zip(shapes, dtypes)]
+    raise NotImplementedError(
+        "p_recv_array: see p_recv — receive buffers must be traced "
+        "shard_map operands (distributed.collective.recv)")
 
 
 # legacy_* interp/crop/expand/proposals: older-ABI aliases of modern ops
@@ -313,6 +323,11 @@ def legacy_nearest_interp(x, out_size=None, scale=0.0, name=None, **kw):
 
 @_export
 def legacy_crop(x, shape=None, offsets=None, name=None):
+    if shape is None:
+        raise ValueError(
+            "legacy_crop: `shape` is required (the legacy Y-input/attr "
+            "inference is not supported — pass the crop shape explicitly)")
+
     def f(a):
         offs = offsets or [0] * a.ndim
         sl = tuple(slice(o, o + s) for o, s in zip(offs, shape))
@@ -336,3 +351,18 @@ def legacy_generate_proposals(scores, bbox_deltas, im_info, anchors,
     return generate_proposals(scores, bbox_deltas, im_info, anchors,
                               variances, pre_nms_top_n, post_nms_top_n,
                               nms_thresh, min_size, eta, pixel_offset=True)
+
+
+@_export
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1, name=None):
+    """legacy multiclass_nms → the modern multiclass_nms3 (fixed-shape
+    padded contract)."""
+    from .ops_ext2 import multiclass_nms3
+    out, nums = multiclass_nms3(
+        bboxes, scores, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=normalized,
+        nms_eta=nms_eta, background_label=background_label)
+    return out

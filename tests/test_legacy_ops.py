@@ -95,3 +95,25 @@ class TestLegacyOps:
         seqs, scores = pt.beam_search_decode(ids, parents, beam_size=2)
         assert seqs.shape == [2, 2]
         np.testing.assert_array_equal(seqs.numpy()[0], [5, 7])
+
+    def test_elementwise_pow_axis_broadcast(self):
+        x = np.abs(np.random.randn(2, 3, 4)).astype(np.float32) + 0.1
+        y = np.full(3, 2.0, np.float32)
+        out = pt.elementwise_pow(t(x), t(y), axis=1)
+        np.testing.assert_allclose(out.numpy(), x ** 2, rtol=1e-5)
+
+    def test_p_recv_and_crop_errors_are_clear(self):
+        import pytest
+        with pytest.raises(NotImplementedError, match="traced buffer"):
+            pt.p_recv("float32", peer=0, out_shape=(2,))
+        with pytest.raises(ValueError, match="shape.*required"):
+            pt.legacy_crop(t(np.ones((4, 4), np.float32)), offsets=[1, 1])
+
+    def test_multiclass_nms_legacy_alias(self):
+        boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.8]
+        out = pt.multiclass_nms(t(boxes), t(scores), score_threshold=0.1,
+                                background_label=0)
+        kept = out.numpy()[out.numpy()[:, 0] >= 0]
+        assert kept.shape[0] == 2
