@@ -1,4 +1,5 @@
 """paddle_tpu.audio (reference: /root/reference/python/paddle/audio/ —
-spectral features + functional windows). jnp.fft-backed, MXU/VPU-friendly."""
+spectral features + functional windows + datasets). jnp.fft-backed."""
+from . import datasets  # noqa: F401
 from . import features  # noqa: F401
 from . import functional  # noqa: F401

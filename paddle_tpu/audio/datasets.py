@@ -1,0 +1,132 @@
+"""paddle.audio datasets (reference /root/reference/python/paddle/audio/
+datasets/: AudioClassificationDataset, ESC50, TESS).
+
+Zero-egress build: datasets read an already-downloaded corpus directory in
+the reference's on-disk layout; wav decoding uses the stdlib `wave` module
+(16-bit PCM, the format both corpora ship)."""
+from __future__ import annotations
+
+import os
+import wave
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+def _load_wav(path):
+    with wave.open(path, "rb") as w:
+        n = w.getnframes()
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        if width == 2:
+            data = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+        elif width == 4:
+            data = np.frombuffer(raw, np.int32).astype(np.float32) / 2**31
+        elif width == 1:
+            data = np.frombuffer(raw, np.uint8).astype(np.float32) / 128 - 1
+        else:
+            raise ValueError(
+                f"{path}: unsupported wav sample width {width} bytes "
+                f"(24-bit PCM is not supported — convert to 16-bit)")
+        if w.getnchannels() > 1:
+            data = data.reshape(-1, w.getnchannels()).mean(-1)
+        return data, w.getframerate()
+
+
+class AudioClassificationDataset(Dataset):
+    """(files, labels) → (waveform-or-feature, label) (reference
+    audio/datasets/dataset.py). feat_type 'raw' or one of the
+    paddle_tpu.audio.features transforms by name."""
+
+    _FEATS = {"spectrogram": "Spectrogram", "melspectrogram":
+              "MelSpectrogram", "logmelspectrogram": "LogMelSpectrogram",
+              "mfcc": "MFCC"}
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_kwargs):
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_kwargs = feat_kwargs
+        self._feat_cache: dict = {}  # sr -> extractor
+
+    def _extractor(self, sr):
+        # reference builds the extractor with the file's ACTUAL sample rate
+        # (sr=self.sample_rate) — a fixed 22050 default would mis-place the
+        # mel filterbank for 44.1k corpora like the real ESC-50
+        if sr not in self._feat_cache:
+            from . import features as feats
+            cls = getattr(feats, self._FEATS[self.feat_type])
+            kw = dict(self.feat_kwargs)
+            if self.feat_type != "spectrogram":
+                kw.setdefault("sr", sr)
+            self._feat_cache[sr] = cls(**kw)
+        return self._feat_cache[sr]
+
+    def __getitem__(self, idx):
+        data, sr = _load_wav(self.files[idx])
+        if self.feat_type != "raw":
+            from ..core.tensor import Tensor
+            feat = self._extractor(self.sample_rate or sr)
+            data = feat(Tensor(data[None])).numpy()[0]
+        return data, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference esc50.py): corpus dir with
+    audio/*.wav named {fold}-{src}-{take}-{target}.wav; 5-fold split."""
+
+    def __init__(self, data_dir=None, mode="train", split=1, feat_type="raw",
+                 **kw):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                "ESC50: pass data_dir= pointing at the extracted corpus "
+                "(zero-egress build)")
+        audio_dir = os.path.join(data_dir, "audio") \
+            if os.path.isdir(os.path.join(data_dir, "audio")) else data_dir
+        files, labels = [], []
+        for fn in sorted(os.listdir(audio_dir)):
+            if not fn.endswith(".wav"):
+                continue
+            fold, _, _, target = fn[:-4].split("-")
+            in_split = int(fold) == split
+            if (mode == "dev") == in_split:
+                files.append(os.path.join(audio_dir, fn))
+                labels.append(int(target))
+        super().__init__(files, labels, feat_type, **kw)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference tess.py): dirs per speaker_emotion,
+    files named *_{word}_{emotion}.wav; 7 emotion classes."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, data_dir=None, mode="train", n_folds=5, split=1,
+                 feat_type="raw", **kw):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                "TESS: pass data_dir= pointing at the extracted corpus "
+                "(zero-egress build)")
+        wavs = []
+        for root, _, fns in os.walk(data_dir):
+            for fn in sorted(fns):
+                if fn.endswith(".wav"):
+                    wavs.append(os.path.join(root, fn))
+        files, labels = [], []
+        for i, path in enumerate(sorted(wavs)):
+            emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
+            if emotion not in self.EMOTIONS:
+                continue
+            in_split = (i % n_folds) + 1 == split
+            if (mode == "dev") == in_split:
+                files.append(path)
+                labels.append(self.EMOTIONS.index(emotion))
+        super().__init__(files, labels, feat_type, **kw)

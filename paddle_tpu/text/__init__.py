@@ -8,7 +8,12 @@ import jax.numpy as jnp
 from ..core.engine import apply, apply_nondiff
 from ..core.tensor import Tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Conll05st", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
