@@ -2,21 +2,5 @@
 experimental APIs: fused ops, MoE, asp sparsity, prim autograd)."""
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
-
-
-class _MoENamespace:
-    """incubate.distributed.models.moe compatibility path."""
-
-    @property
-    def MoELayer(self):
-        from ..parallel.moe import MoELayer
-        return MoELayer
-
-
-class _Models:
-    moe = _MoENamespace()
-
-
-class distributed:
-    models = _Models()

@@ -1,0 +1,4 @@
+"""incubate.distributed (reference:
+/root/reference/python/paddle/incubate/distributed/ — MoE models +
+fleet utilities). Routes to the main distributed/parallel packages."""
+from . import models  # noqa: F401

@@ -1,0 +1,15 @@
+"""incubate.distributed.models.moe (reference moe_layer.py:263 MoELayer,
+gate/*.py) — the incubate namespace for the MoE layer zoo; implementation
+lives in paddle_tpu.parallel.moe (GShard-style gates + capacity dispatch)."""
+from paddle_tpu.parallel.moe import (  # noqa: F401
+    GShardGate, MoELayer, NaiveGate, SwitchGate,
+)
+from paddle_tpu.parallel.moe import _GateBase as BaseGate  # noqa: F401
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm as \
+    ClipGradForMOEByGlobalNorm  # noqa: F401 — MoE-grad clip (reference
+# clips expert grads with the global-norm rule; our clip already spans the
+# sharded pytree)
+from . import gate  # noqa: F401
+
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "BaseGate",
+           "ClipGradForMOEByGlobalNorm"]

@@ -1,0 +1,7 @@
+"""gate submodule (reference incubate/distributed/models/moe/gate/)."""
+from paddle_tpu.parallel.moe import (  # noqa: F401
+    GShardGate, NaiveGate, SwitchGate,
+)
+from paddle_tpu.parallel.moe import _GateBase as BaseGate  # noqa: F401
+
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "BaseGate"]

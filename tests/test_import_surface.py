@@ -1,0 +1,46 @@
+"""Import-completeness smoke: every module in the package imports, and the
+flagship namespaces expose their reference surfaces."""
+import importlib
+import os
+import pkgutil
+
+import paddle_tpu
+
+
+def test_every_module_imports():
+    root = os.path.dirname(paddle_tpu.__file__)
+    failures = []
+    walker = pkgutil.walk_packages([root], prefix="paddle_tpu.",
+                               onerror=lambda name: failures.append(
+                                   (name, "walk error")))
+    for mod in walker:
+        if mod.name.endswith("__main__"):
+            continue  # CLI entry points execute on import by design
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001
+            failures.append((mod.name, f"{type(e).__name__}: {e}"))
+    assert not failures, failures
+
+
+def test_reference_namespace_spotchecks():
+    import paddle_tpu as pt
+
+    # the namespaces a migrating reference user reaches for
+    assert callable(pt.nn.Linear)
+    assert callable(pt.optimizer.AdamW)
+    assert callable(pt.distributed.shard_tensor)
+    assert callable(pt.distributed.rpc.rpc_sync)
+    assert callable(pt.distributed.ps.TheOnePSRuntime)
+    assert callable(pt.jit.to_static)
+    assert callable(pt.amp.auto_cast)
+    assert callable(pt.inference.Predictor)
+    assert callable(pt.audio.datasets.ESC50)
+    assert callable(pt.text.Imdb)
+    assert callable(pt.vision.models.resnet18)
+    assert callable(pt.sparse.sparse_coo_tensor)
+    assert callable(pt.incubate.nn.functional.fused_multi_head_attention)
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    assert callable(MoELayer)
+    from paddle_tpu.device.custom import load_custom_device
+    assert callable(load_custom_device)
