@@ -1,0 +1,62 @@
+"""KV-cache decode throughput on the TPU chip (VERDICT r2 next #2).
+
+    python benchmarks/decode_bench.py [B] [PROMPT] [NEW]
+
+Times the compiled prefill+scan generate (models/llama_decode.py) on the
+850M flagship config and prints one JSON line with decode tokens/s.
+The whole generate is ONE executable; sync via np.asarray of the result
+(tunnel: block_until_ready lies — ROUND2_PERF.md).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    prompt = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    new = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig, llama_init_params
+    from paddle_tpu.models.llama_decode import llama_generate
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=14, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=2048, dtype=jnp.bfloat16)
+    params = llama_init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (B, prompt)).astype(np.int32))
+
+    t0 = time.time()
+    out = llama_generate(params, toks, cfg, new)
+    np.asarray(out)
+    compile_s = time.time() - t0
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = llama_generate(params, toks, cfg, new)
+        np.asarray(out)
+        times.append(time.perf_counter() - t0)
+
+    dt = float(np.median(times))
+    print(json.dumps({
+        "metric": "llama_decode_tokens_per_sec",
+        "config": {"B": B, "prompt": prompt, "new_tokens": new,
+                   "params_m": 850},
+        "total_ms_median": round(dt * 1e3, 1),
+        "decode_tokens_per_sec": round(B * new / dt, 1),
+        "ms_per_token": round(dt * 1e3 / new, 2),
+        "compile_s": round(compile_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

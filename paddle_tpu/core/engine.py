@@ -318,6 +318,16 @@ def backward(tensors: Sequence, grad_tensors: Sequence | None = None,
                 raw_cots = [c._value if isinstance(c, Tensor) else c
                             for c in cots]
                 in_grads = node.vjp_fn(raw_cots)
+                # reverse SPMD rule (reference registers a reverse rule per
+                # op; here keyed "grad_<op>"): constrain input-grad layouts
+                if node.name:
+                    from ..distributed import spmd_rules as _spmd
+                    rrule = _spmd.get_spmd_rule("grad_" + node.name)
+                    if rrule is not None and any(
+                            t is not None and getattr(t, "_dist", None)
+                            is not None for t in node.inputs):
+                        in_grads = _spmd.apply_reverse_rule(
+                            rrule, node.inputs, raw_cots, in_grads)
                 for inp, g in zip(node.inputs, in_grads):
                     if inp is None or g is None:
                         continue
@@ -455,7 +465,8 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         if rule is not None and any(
                 t is not None and getattr(t, "_dist", None) is not None
                 for t in tensor_inputs):
-            arrs, posthook = _spmd.apply_rule(rule, tensor_inputs, arrs)
+            arrs, posthook = _spmd.apply_rule(rule, tensor_inputs, arrs,
+                                              static_kwargs)
 
     def _finish(out_tree):
         out_tree = _propagate_dist(out_tree, tensor_inputs)

@@ -32,6 +32,16 @@ __all__ = ["ElasticLevel", "ElasticStatus", "FileRegistry", "KVServer",
            "KVRegistry", "ElasticManager"]
 
 
+def _kv_token() -> str:
+    """Job token required on mutating KV endpoints: a peer outside the job
+    (who does not know PADDLE_JOB_ID / PADDLE_RPC_SECRET) cannot forge or
+    delete heartbeats to force elastic restarts."""
+    import hashlib
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    secret = os.environ.get("PADDLE_RPC_SECRET", "")
+    return hashlib.sha256(f"paddle-tpu-kv:{secret}:{job}".encode()).hexdigest()
+
+
 class ElasticLevel(enum.IntEnum):
     FAULT_TOLERANCE = 1  # fixed np, restart on failure
     ELASTIC = 2          # np range, scale up/down
@@ -104,9 +114,16 @@ class KVServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authed(self):
+                import hmac as _hmac
+                tok = self.headers.get("X-Paddle-Job-Token", "")
+                return _hmac.compare_digest(tok, _kv_token())
+
             def do_PUT(self):
                 if not self.path.startswith("/hb/"):
                     return self._send(404)
+                if not self._authed():
+                    return self._send(403)
                 node = self.path[4:]
                 n = int(self.headers.get("Content-Length", 0))
                 info = self.rfile.read(n) if n else b"{}"
@@ -117,6 +134,8 @@ class KVServer:
             def do_DELETE(self):
                 if not self.path.startswith("/hb/"):
                     return self._send(404)
+                if not self._authed():
+                    return self._send(403)
                 with lock:
                     store.pop(self.path[4:], None)
                 self._send(200)
@@ -163,7 +182,8 @@ class KVRegistry:
     def heartbeat(self, node_id: str, info=None):
         req = urllib.request.Request(
             f"{self.base}/hb/{node_id}", method="PUT",
-            data=json.dumps(info or {}).encode())
+            data=json.dumps(info or {}).encode(),
+            headers={"X-Paddle-Job-Token": _kv_token()})
         urllib.request.urlopen(req, timeout=self.timeout).read()
 
     def alive_nodes(self):
@@ -177,7 +197,8 @@ class KVRegistry:
     def leave(self, node_id: str):
         try:
             req = urllib.request.Request(
-                f"{self.base}/hb/{node_id}", method="DELETE")
+                f"{self.base}/hb/{node_id}", method="DELETE",
+                headers={"X-Paddle-Job-Token": _kv_token()})
             urllib.request.urlopen(req, timeout=self.timeout).read()
         except Exception:
             pass

@@ -40,6 +40,64 @@ class WorkerInfo:
 _state: dict = {"agent": None}
 
 
+def _job_token() -> bytes:
+    """Shared secret for the RPC handshake, derived from the job identity.
+
+    Every worker of one launch shares PADDLE_JOB_ID (set by the launcher).
+    NOTE the honest threat model: without PADDLE_RPC_SECRET the token is a
+    deterministic function of the job id, so it only stops peers that don't
+    know the job id (accidental cross-job traffic, scanners). For a real
+    boundary set PADDLE_RPC_SECRET — init_rpc warns when binding a
+    non-loopback interface without it."""
+    import hashlib
+    import hmac as _hmac
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    secret = os.environ.get("PADDLE_RPC_SECRET", "")
+    return _hmac.new(("paddle-tpu-rpc:" + secret).encode(),
+                     job.encode(), hashlib.sha256).digest()
+
+
+def _bind_host(master_host: str) -> str:
+    """Interface to bind the RPC server to: the address we advertise —
+    loopback for single-host jobs, the host's job interface otherwise
+    (never 0.0.0.0; PADDLE_RPC_BIND_HOST overrides)."""
+    explicit = os.environ.get("PADDLE_RPC_BIND_HOST")
+    if explicit:
+        return explicit
+    if master_host in ("127.0.0.1", "localhost", ""):
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "0.0.0.0"
+
+
+def _send_raw(sock, payload: bytes):
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_raw(sock, max_len=1 << 16) -> bytes:
+    """Length-prefixed RAW frame — no pickle. Used for the auth preamble,
+    which must be parsed WITHOUT unpickling (pickle.loads of attacker bytes
+    is itself code execution)."""
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    if n > max_len:
+        raise ConnectionError("oversized auth frame")
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj)
     sock.sendall(struct.pack("!Q", len(payload)) + payload)
@@ -136,6 +194,10 @@ class _Agent:
         s = cache.get(key)
         if s is None:
             s = socket.create_connection((w.ip, w.port), timeout=timeout or 30)
+            _send_raw(s, _job_token())
+            if _recv_raw(s) != b"OK":
+                s.close()
+                raise ConnectionError(f"rpc auth rejected by {w.name}")
             cache[key] = s
         if timeout:
             s.settimeout(timeout)
@@ -194,6 +256,18 @@ class _Agent:
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        import hmac as _hmac
+        # connections must authenticate before anything is dispatched. The
+        # auth preamble is a RAW length-prefixed token frame — never pickle:
+        # unpickling attacker-controlled bytes is itself code execution, so
+        # nothing from the socket may reach pickle.loads before this check.
+        try:
+            token = _recv_raw(self.request)
+            if not _hmac.compare_digest(token, _job_token()):
+                return  # silent close — reveal nothing to a probe
+            _send_raw(self.request, b"OK")
+        except Exception:
+            return
         # persistent connection: serve messages until the peer closes
         while True:
             try:
@@ -249,7 +323,18 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     def scoped(n):
         return f"{job}::{n}"
 
-    server = _Server(("0.0.0.0", 0), _Handler)
+    host, _, mport = master_endpoint.partition(":")
+    bind = _bind_host(host)
+    if bind not in ("127.0.0.1", "localhost") \
+            and not os.environ.get("PADDLE_RPC_SECRET"):
+        import warnings
+        warnings.warn(
+            "paddle_tpu.distributed.rpc: binding a non-loopback interface "
+            f"({bind}) without PADDLE_RPC_SECRET — the job-id-derived auth "
+            "token only stops accidental cross-job traffic, not an attacker "
+            "who knows PADDLE_JOB_ID; set PADDLE_RPC_SECRET for a real "
+            "boundary", stacklevel=2)
+    server = _Server((bind, 0), _Handler)
     port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True).start()
 
@@ -257,7 +342,6 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _state["agent"] = agent
 
     kv_server = None
-    host, _, mport = master_endpoint.partition(":")
     if rank == 0:
         try:
             kv_server = KVServer(port=int(mport), ttl=30.0).start()

@@ -42,10 +42,13 @@ _RULES: dict = {}
 
 @dataclass
 class SpmdContext:
-    """What a rule sees: the mesh and, per tensor input, placements/shape."""
+    """What a rule sees: the mesh, per tensor input placements/shape, and
+    the op's static kwargs (axis/perm/shape attrs — the reference rules read
+    the same attrs from the op desc, e.g. transpose.cc reads `perm`)."""
     mesh: object
     placements: List[Optional[list]]
     shapes: List[Optional[tuple]]
+    kwargs: dict = field(default_factory=dict)
 
     def axis_of(self, input_idx: int, tensor_dim: int):
         """Mesh axis name the given input dim is sharded on, else None."""
@@ -89,7 +92,7 @@ def get_spmd_rule(op_name: str):
 
 # ------------------------------------------------------------------ engine glue
 
-def apply_rule(rule, tensor_inputs, arrs):
+def apply_rule(rule, tensor_inputs, arrs, static_kwargs=None):
     """Engine-side: reshard inputs per the rule; return (new_arrs, posthook).
 
     posthook(out_tree) enforces + records output placements. Returns
@@ -117,7 +120,8 @@ def apply_rule(rule, tensor_inputs, arrs):
         placements.append(None if d is None else list(d[1]))
         shapes.append(tuple(t._value.shape))
 
-    ctx = SpmdContext(mesh=mesh, placements=placements, shapes=shapes)
+    ctx = SpmdContext(mesh=mesh, placements=placements, shapes=shapes,
+                      kwargs=dict(static_kwargs or {}))
     decision = rule(ctx)
     if decision is None:
         return arrs, None
@@ -154,8 +158,15 @@ def apply_rule(rule, tensor_inputs, arrs):
         leaves = jax.tree.leaves(
             out_tree, is_leaf=lambda x: isinstance(x, Tensor))
         # out_pl is either one placement list (applied to all leaves) or a
-        # list of placement lists (one per leaf)
-        is_per_leaf = bool(out_pl) and isinstance(out_pl[0], (list, tuple))
+        # list of placement-lists/None (one per leaf)
+        is_per_leaf = bool(out_pl) and all(
+            e is None or isinstance(e, (list, tuple)) for e in out_pl)
+        if is_per_leaf and len(out_pl) != len(leaves):
+            # per-leaf declaration that doesn't match the actual output
+            # count (e.g. a reverse rule declared grads for every primal
+            # but only a subset requires grad) — abstain rather than
+            # mis-assign layouts
+            return out_tree
 
         def placement_for(idx):
             if is_per_leaf:
@@ -183,45 +194,220 @@ def apply_rule(rule, tensor_inputs, arrs):
     return new_arrs, posthook
 
 
+def apply_reverse_rule(rule, inputs, cots, in_grads):
+    """Backward-side glue (core.engine backward loop): run a reverse rule
+    (registered as ``grad_<op>``) and constrain the produced input grads.
+
+    inputs: node.inputs (Tensor or None per primal); cots: raw cotangent
+    arrays; in_grads: raw grads aligned with inputs. decision.outputs is
+    indexed by TENSOR-INPUT ordinal (k-th tensor input's grad), so partial
+    requires-grad sets can't misalign. Returns the (possibly constrained)
+    grads."""
+    import jax
+
+    from .placement import placements_to_spec, replicate_partials
+
+    mesh = None
+    for t in inputs:
+        if t is not None and getattr(t, "_dist", None) is not None:
+            mesh = t._dist[0]
+            break
+    if mesh is None:
+        return in_grads
+
+    placements, shapes, slots = [], [], []
+    for i, t in enumerate(inputs):
+        if t is None:
+            continue
+        slots.append(i)
+        d = getattr(t, "_dist", None)
+        placements.append(None if d is None else list(d[1]))
+        shapes.append(tuple(t._value.shape))
+    for c in cots:
+        placements.append(None)
+        shapes.append(tuple(getattr(c, "shape", ())))
+
+    decision = rule(SpmdContext(mesh=mesh, placements=placements,
+                                shapes=shapes))
+    if decision is None or decision.outputs is None:
+        return in_grads
+    out_pl = decision.outputs
+    # per-slot form: a list whose entries are each a placement list or None;
+    # single form: a flat list of Placement objects (applied to every slot)
+    per_slot = bool(out_pl) and all(
+        e is None or isinstance(e, (list, tuple)) for e in out_pl)
+    if not per_slot:
+        out_pl = [out_pl] * len(slots)
+
+    out = list(in_grads)
+    for k, i in enumerate(slots):
+        if k >= len(out_pl) or out_pl[k] is None:
+            continue
+        g = out[i]
+        if g is None or not hasattr(g, "ndim"):
+            continue
+        if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+            continue
+        spec = placements_to_spec(mesh, replicate_partials(list(out_pl[k])),
+                                  g.ndim)
+        sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
+        if isinstance(g, jax.core.Tracer):
+            out[i] = jax.lax.with_sharding_constraint(g, sharding)
+        else:
+            out[i] = jax.device_put(g, sharding)
+    return out
+
+
 # ------------------------------------------------------------------ built-ins
+#
+# The rule LIBRARY — TPU ports of the high-value hand-written rules from
+# /root/reference/paddle/phi/infermeta/spmd_rules/ (113 files there; the ones
+# that matter are the ones GSPMD's generic propagation gets wrong or lazy:
+# matmul.cc, embedding.cc, layer_norm.cc, softmax.cc, elementwise.cc,
+# reduction.cc, reshape.cc, transpose.cc, concat.cc, slice.cc, dropout.cc,
+# flash_attention.cc, fused_rope.cc, c_softmax_with_cross_entropy.cc).
+#
+# REVERSE rules: the reference registers a reverse (grad) rule per op that
+# infers input-grad placements from output-grad placements. Here the eager
+# backward dispatches every grad op through `engine.apply` under the name
+# ``grad_<op>`` (core/engine.py `backward`), so a reverse rule is simply a
+# rule registered under that name. The grad dispatch's tensor inputs are
+# [primal tensor inputs..., cotangents...] and its outputs are the grads of
+# the primal inputs that require grad — the canonical reverse decision
+# "each grad follows its primal's placements" is expressible directly.
+#
+# On Partial: the reference's CE/vocab-parallel rules emit Partial outputs
+# and defer the allreduce to a later exchange. In this framework eager
+# values are GLOBAL jax.Arrays — GSPMD completes every op's reduction inside
+# the op itself, so a Partial OUTPUT never exists to record; declaring one
+# would make the next dispatch re-reduce an already-reduced value
+# (engine._reduced_if_partial). Rules therefore declare the post-reduction
+# layout (Replicate/Shard); Partial remains an input/API concept
+# (placement.Partial, local_map) exactly as GSPMD treats it.
+
+
+def _shard_map(pl):
+    """placement list → {tensor_dim: mesh_axis_idx} (first shard wins)."""
+    from .placement import Shard
+    out = {}
+    if pl is None:
+        return out
+    for ax, p in enumerate(pl):
+        if isinstance(p, Shard) and p.get_dim() not in out:
+            out[p.get_dim()] = ax
+    return out
+
+
+def _pl(n_axes, dim_to_axis):
+    """{tensor_dim: mesh_axis_idx} → placement list."""
+    from .placement import Replicate, Shard
+    out = [Replicate()] * n_axes
+    for d, ax in dim_to_axis.items():
+        out[ax] = Shard(d)
+    return out
+
+
+def _follow_primals(ctx, n_primals):
+    """Reverse decision: grad_i follows primal_i's placements (only emitted
+    when every primal is a float tensor — the posthook abstains on leaf-count
+    mismatch otherwise)."""
+    outs = [list(p) if p is not None else None
+            for p in ctx.placements[:n_primals]]
+    if all(o is None for o in outs):
+        return None
+    from .placement import Replicate
+    n_axes = len(ctx.mesh.shape)
+    outs = [o if o is not None else [Replicate()] * n_axes for o in outs]
+    return SpmdDecision(inputs=[], outputs=outs)
+
 
 def _install_builtin_rules():
-    """The ops the reference hand-writes rules for (embedding.cc,
-    c_softmax_with_cross_entropy.cc, flash_attention.cc, fused_rope.cc)."""
-    from .placement import Replicate, Shard
+    from .placement import Partial, Replicate, Shard
 
+    # ---------------- matmul (reference spmd_rules/matmul.cc) ----------------
+    @register_spmd_rule("matmul")
+    def _matmul_rule(ctx):
+        # x [..., M, K] @ w [K, N] — the Megatron cases:
+        #   w col-sharded (N dim on axis a)            → out[..., N/a]
+        #   w row-sharded (K dim) & x[..., K/a] aligned → out contracted:
+        #     GSPMD inserts the allreduce; out keeps only x's batch shards
+        #   x batch/M shards always propagate
+        # transpose_x/transpose_y arrive as static kwargs (linalg.matmul);
+        # dot/inner/outer/kron/multi_dot dispatch under their own names and
+        # never reach this rule.
+        if len(ctx.shapes) < 2 or ctx.kwargs.get("transpose_x"):
+            return None
+        x_pl, w_pl = ctx.placements[0], ctx.placements[1]
+        if x_pl is None and w_pl is None:
+            return None
+        x_nd, w_nd = len(ctx.shapes[0]), len(ctx.shapes[1])
+        if w_nd != 2 or x_nd < 2:
+            return None
+        # with transpose_y, w is [N, K]: its col(N)/contract(K) dims swap
+        col_dim, k_dim = (0, 1) if ctx.kwargs.get("transpose_y") else (1, 0)
+        n_axes = len(ctx.mesh.shape)
+        out_nd = x_nd
+        xm, wm = _shard_map(x_pl), _shard_map(w_pl)
+        out = {}
+        for d, ax in xm.items():
+            if d < x_nd - 1:  # batch + M shards survive
+                out[d] = ax
+        if col_dim in wm:  # column parallel
+            out[out_nd - 1] = wm[col_dim]
+        dec_inputs = [None, None]
+        if k_dim in wm and xm.get(x_nd - 1) != wm[k_dim]:
+            # row-parallel weight demands the activation's K dim on the same
+            # axis (the reference reshards the lhs; GSPMD would instead
+            # all-gather the weight)
+            xin = dict(xm)
+            xin.pop(x_nd - 1, None)
+            xin[x_nd - 1] = wm[k_dim]
+            dec_inputs = [_pl(n_axes, xin), None]
+        return SpmdDecision(inputs=dec_inputs, outputs=[_pl(n_axes, out)])
+
+    @register_spmd_rule("grad_matmul")
+    def _matmul_rev(ctx):
+        return _follow_primals(ctx, 2)
+
+    # ---------------- embedding (reference spmd_rules/embedding.cc) ----------
     @register_spmd_rule("embedding")
     def _embedding_rule(ctx):
-        # inputs: (ids[..., ], weight[V, H])
+        # inputs: (ids[...], weight [V, H])
         if len(ctx.shapes) < 2:
             return None
-        ids_shape, w_shape = ctx.shapes[0], ctx.shapes[1]
+        ids_shape = ctx.shapes[0]
         ids_pl, w_pl = ctx.placements[0], ctx.placements[1]
         if w_pl is None:
             return None
         n_axes = len(ctx.mesh.shape)
         out_ndim = len(ids_shape) + 1
-        out = [Replicate()] * n_axes
-        # ids batch shards propagate to the same output dims
-        if ids_pl is not None:
-            for ax, p in enumerate(ids_pl):
-                if isinstance(p, Shard):
-                    out[ax] = Shard(p.get_dim())
-        # weight hidden-dim shard (Megatron col-parallel) → out last dim
-        for ax, p in enumerate(w_pl):
-            if isinstance(p, Shard) and p.get_dim() == 1:
-                out[ax] = Shard(out_ndim - 1)
-            elif isinstance(p, Shard) and p.get_dim() == 0:
-                # vocab-parallel: table rows sharded; keep the gather local by
-                # replicating ids and let XLA all-reduce the masked lookup —
-                # output is global (engine reduces partials at dispatch)
-                out[ax] = Replicate()
-        return SpmdDecision(inputs=[None, None], outputs=[out])
+        out = {}
+        for d, ax in _shard_map(ids_pl).items():
+            out[d] = ax
+        wm = _shard_map(w_pl)
+        if 1 in wm:  # Megatron col-parallel table → hidden dim of out
+            out[out_ndim - 1] = wm[1]
+        # vocab-parallel (dim 0): the gather's reduction happens inside the
+        # op under GSPMD (masked lookup + allreduce); ids stay replicated
+        # along that axis and the output carries no vocab shard.
+        return SpmdDecision(inputs=[None, None],
+                            outputs=[_pl(n_axes, out)])
 
-    @register_spmd_rule("softmax_with_cross_entropy")
+    @register_spmd_rule("grad_embedding")
+    def _embedding_rev(ctx):
+        # table grad follows the table's sharding (row/col parallel alike).
+        # outputs are indexed by tensor-input ordinal — slot 0 is ids
+        # (integer, grad skipped as float0), slot 1 is the weight
+        if len(ctx.placements) < 2 or ctx.placements[1] is None:
+            return None
+        return SpmdDecision(inputs=[],
+                            outputs=[None, list(ctx.placements[1])])
+
+    # ------------- cross entropy (c_softmax_with_cross_entropy.cc) ----------
     def _ce_rule(ctx):
-        # logits [..., C]: class-dim shard stays (parallel CE handles it);
-        # loss output keeps only the batch shards
+        # logits [..., C]: batch shards survive to the loss; a class-dim
+        # shard stays on the logits input (GSPMD computes the softmax
+        # reduction across the axis in-op — the reference's parallel CE)
         if not ctx.shapes:
             return None
         lg_pl = ctx.placements[0]
@@ -229,16 +415,30 @@ def _install_builtin_rules():
             return None
         n_axes = len(ctx.mesh.shape)
         logits_ndim = len(ctx.shapes[0])
-        out = [Replicate()] * n_axes
-        for ax, p in enumerate(lg_pl):
-            if isinstance(p, Shard) and p.get_dim() < logits_ndim - 1:
-                out[ax] = Shard(p.get_dim())
-        return SpmdDecision(inputs=[], outputs=[out])
+        out = {d: ax for d, ax in _shard_map(lg_pl).items()
+               if d < logits_ndim - 1}
+        return SpmdDecision(inputs=[], outputs=[_pl(n_axes, out)])
 
+    register_spmd_rule("softmax_with_cross_entropy", _ce_rule)
+    register_spmd_rule("cross_entropy_with_softmax", _ce_rule)
+    register_spmd_rule("cross_entropy", _ce_rule)
+
+    def _ce_rev(ctx):
+        # dlogits follows the logits layout (incl. a class-dim shard)
+        if not ctx.placements or ctx.placements[0] is None:
+            return None
+        return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
+
+    register_spmd_rule("grad_softmax_with_cross_entropy", _ce_rev)
+    register_spmd_rule("grad_cross_entropy_with_softmax", _ce_rev)
+    register_spmd_rule("grad_cross_entropy", _ce_rev)
+
+    # ---------------- flash attention (flash_attention.cc) ----------------
     @register_spmd_rule("flash_attention")
     def _flash_rule(ctx):
-        # q/k/v [B, T, H, D] (our ops/flash_attention layout): demand q's
-        # batch/head layout on k and v; output follows q
+        # q/k/v [B, T, H, D]: demand q's batch/head layout on k and v
+        # (sequence shards must NOT survive into the kernel's kv operands);
+        # output follows q
         if len(ctx.shapes) < 3:
             return None
         q_pl = ctx.placements[0]
@@ -247,11 +447,174 @@ def _install_builtin_rules():
         return SpmdDecision(inputs=[None, list(q_pl), list(q_pl)],
                             outputs=[list(q_pl)])
 
-    @register_spmd_rule("rope")
+    @register_spmd_rule("grad_flash_attention")
+    def _flash_rev(ctx):
+        return _follow_primals(ctx, 3)
+
+    # ---------------- rope (fused_rope.cc) ----------------
     def _rope_rule(ctx):
         if not ctx.placements or ctx.placements[0] is None:
             return None
         return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
+
+    register_spmd_rule("rope", _rope_rule)
+    register_spmd_rule("fused_rope", _rope_rule)
+    register_spmd_rule("grad_rope", lambda ctx: _follow_primals(ctx, 1))
+    register_spmd_rule("grad_fused_rope", lambda ctx: _follow_primals(ctx, 1))
+
+    # ---------------- normalization (layer_norm.cc) ----------------
+    def _norm_rule(n_stats):
+        def rule(ctx):
+            # x [..., H]: the feature dim is reduced over — a shard there
+            # must be ungathered BEFORE the op (the reference reshards;
+            # GSPMD would compute distributed mean/var with extra
+            # collectives per statistic). Batch shards pass through.
+            if not ctx.shapes:
+                return None
+            x_pl = ctx.placements[0]
+            if x_pl is None:
+                return None
+            n_axes = len(ctx.mesh.shape)
+            x_nd = len(ctx.shapes[0])
+            xm = _shard_map(x_pl)
+            feat = x_nd - 1
+            demand = None
+            if feat in xm:
+                keep = {d: a for d, a in xm.items() if d != feat}
+                demand = _pl(n_axes, keep)
+            out = _pl(n_axes, {d: a for d, a in xm.items() if d != feat})
+            return SpmdDecision(
+                inputs=[demand] + [None] * (len(ctx.shapes) - 1),
+                outputs=out)
+        return rule
+
+    register_spmd_rule("layer_norm", _norm_rule(2))
+    register_spmd_rule("rms_norm", _norm_rule(1))
+    register_spmd_rule("grad_layer_norm", lambda ctx: _follow_primals(
+        ctx, len(ctx.shapes) - 1))
+
+    # ---------------- softmax (softmax.cc) ----------------
+    @register_spmd_rule("softmax")
+    def _softmax_rule(ctx):
+        # softmax reduces the last dim: demand it unsharded, keep the rest
+        if not ctx.shapes:
+            return None
+        x_pl = ctx.placements[0]
+        if x_pl is None:
+            return None
+        n_axes = len(ctx.mesh.shape)
+        x_nd = len(ctx.shapes[0])
+        xm = _shard_map(x_pl)
+        if x_nd - 1 in xm:
+            keep = {d: a for d, a in xm.items() if d != x_nd - 1}
+            return SpmdDecision(inputs=[_pl(n_axes, keep)],
+                                outputs=[_pl(n_axes, keep)])
+        return SpmdDecision(inputs=[], outputs=[list(x_pl)])
+
+    # ---------------- elementwise (elementwise.cc) ----------------
+    def _ew_binary_rule(ctx):
+        # align conflicting layouts onto the first SHARDED operand
+        # (reference elementwise.cc merges input dims_mappings). When the
+        # first operand carries no shard, abstain — GSPMD's default keeps
+        # the second operand's layout, and forcing replication would insert
+        # a pointless all-gather on every residual-add.
+        if len(ctx.shapes) < 2:
+            return None
+        a_pl, b_pl = ctx.placements[0], ctx.placements[1]
+        a_nd, b_nd = len(ctx.shapes[0]), len(ctx.shapes[1])
+        if a_nd != b_nd:
+            return None  # broadcasting: leave to GSPMD
+        am = _shard_map(a_pl) if a_pl is not None else {}
+        bm = _shard_map(b_pl) if b_pl is not None else {}
+        if not am:
+            return None
+        n_axes = len(ctx.mesh.shape)
+        demand_b = None
+        if bm != am:
+            ok = {d: ax for d, ax in am.items()
+                  if ctx.shapes[1][d] == ctx.shapes[0][d]}
+            demand_b = _pl(n_axes, ok)
+        return SpmdDecision(inputs=[None, demand_b],
+                            outputs=[_pl(n_axes, am)])
+
+    register_spmd_rule("add", _ew_binary_rule)
+    register_spmd_rule("multiply", _ew_binary_rule)
+
+    # ---------------- reductions (reduction.cc) ----------------
+    def _reduce_rule(ctx):
+        # sum/mean over `axis`: the output keeps shards of surviving dims
+        # (renumbered when keepdims=False); shards ON a reduced dim vanish —
+        # GSPMD finishes that reduction inside the op.
+        if not ctx.shapes or ctx.placements[0] is None:
+            return None
+        x_nd = len(ctx.shapes[0])
+        axis = ctx.kwargs.get("axis")
+        keepdims = bool(ctx.kwargs.get("keepdims"))
+        if axis is None:
+            reduced = set(range(x_nd))
+        elif isinstance(axis, (list, tuple)):
+            reduced = {a % x_nd for a in axis}
+        else:
+            reduced = {int(axis) % x_nd}
+        xm = _shard_map(ctx.placements[0])
+        out = {}
+        for d, ax in xm.items():
+            if d in reduced:
+                continue
+            nd = d if keepdims else d - len([r for r in reduced if r < d])
+            out[nd] = ax
+        n_axes = len(ctx.mesh.shape)
+        return SpmdDecision(inputs=[], outputs=[_pl(n_axes, out)])
+
+    register_spmd_rule("sum", _reduce_rule)
+    register_spmd_rule("mean", _reduce_rule)
+
+    # ---------------- layout ops ----------------
+    @register_spmd_rule("transpose")
+    def _transpose_rule(ctx):
+        # out dim j = in dim perm[j] → a shard on in-dim d lands on the
+        # out position where perm[j] == d (reference transpose.cc)
+        perm = ctx.kwargs.get("perm")
+        if perm is None or not ctx.placements or ctx.placements[0] is None:
+            return None
+        xm = _shard_map(ctx.placements[0])
+        inv = {int(p): j for j, p in enumerate(perm)}
+        out = {inv[d]: ax for d, ax in xm.items() if d in inv}
+        n_axes = len(ctx.mesh.shape)
+        return SpmdDecision(inputs=[], outputs=[_pl(n_axes, out)])
+
+    @register_spmd_rule("concat")
+    def _concat_rule(ctx):
+        # all inputs demanded onto the first's layout (non-concat dims)
+        if len(ctx.shapes) < 2:
+            return None
+        a_pl = ctx.placements[0]
+        if a_pl is None:
+            return None
+        demands = [None]
+        for k in range(1, len(ctx.shapes)):
+            if len(ctx.shapes[k]) == len(ctx.shapes[0]):
+                demands.append(list(a_pl))
+            else:
+                demands.append(None)
+        return SpmdDecision(inputs=demands, outputs=[list(a_pl)])
+
+    @register_spmd_rule("slice")
+    def _slice_rule(ctx):
+        # slicing a sharded dim in eager GSPMD is correct but resharding —
+        # keep the input layout on the output so downstream ops don't
+        # cascade into replication
+        if not ctx.placements or ctx.placements[0] is None:
+            return None
+        return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
+
+    @register_spmd_rule("dropout")
+    def _dropout_rule(ctx):
+        if not ctx.placements or ctx.placements[0] is None:
+            return None
+        return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
+
+    register_spmd_rule("grad_dropout", lambda ctx: _follow_primals(ctx, 1))
 
 
 _install_builtin_rules()

@@ -31,7 +31,7 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
-           "PrecisionType"]
+           "PrecisionType", "LLMPredictor"]
 
 
 class PrecisionType:
@@ -238,3 +238,75 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class LLMPredictor:
+    """Serving predictor for causal-LM decode — the TPU analog of the
+    reference inference engine's LLM path (AnalysisPredictor + the
+    masked/block multihead-attention decode ops,
+    /root/reference/paddle/fluid/inference/api/analysis_predictor.h:105).
+
+    Holds the weight tree at serving precision (bf16 IO / int8 weight-only
+    via ``Config``), and serves ``generate()`` through the compiled
+    prefill + scanned KV-cache decode (models/llama_decode.py) — O(T) per
+    emitted token. One executable per (B, T, N) signature; pad prompts to a
+    few fixed lengths to keep the cache warm.
+    """
+
+    def __init__(self, model_config, params, config: Config | None = None):
+        self._model_config = model_config
+        self._config = config or Config()
+        self._run_times: list = []
+        self._gen_cache: dict = {}
+        precision = self._config.precision_mode()
+        self._dequant = None
+        if precision == PrecisionType.Int8:
+            from ..quantization import (weight_only_dequantize,
+                                        weight_only_quantize)
+            self._params = weight_only_quantize(params)
+            self._dequant = weight_only_dequantize
+        elif precision in (PrecisionType.Bfloat16, PrecisionType.Half):
+            self._params = _cast_tree(params, jnp.dtype(precision))
+        else:
+            self._params = params
+
+    def _gen_fn(self, max_new_tokens, temperature, top_k):
+        """One compiled generate per (N, temperature, top_k). The int8
+        dequant runs INSIDE this jit so the dense weights never materialise
+        in HBM — dequant fuses into the consuming matmuls (same contract as
+        Predictor's int8 path above)."""
+        sig = (max_new_tokens, temperature, top_k)
+        fn = self._gen_cache.get(sig)
+        if fn is None:
+            from ..models.llama_decode import llama_generate
+            dequant, cfg = self._dequant, self._model_config
+
+            def f(p, toks, key):
+                if dequant is not None:
+                    p = dequant(p)
+                return llama_generate(p, toks, cfg, max_new_tokens,
+                                      temperature, top_k, key=key)
+
+            fn = self._gen_cache[sig] = jax.jit(f)
+        return fn
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, seed=0):
+        """input_ids [B, T] → np.ndarray [B, T+N] (prompt + generated)."""
+        toks = jnp.asarray(
+            input_ids.numpy() if isinstance(input_ids, Tensor) else input_ids,
+            jnp.int32)
+        t0 = time.perf_counter()
+        fn = self._gen_fn(int(max_new_tokens), float(temperature), int(top_k))
+        new = fn(self._params, toks, jax.random.PRNGKey(seed))
+        out = np.concatenate([np.asarray(toks), np.asarray(new)], axis=1)
+        if self._config._enable_profile:
+            self._run_times.append(time.perf_counter() - t0)
+        return out
+
+    def profile_report(self) -> dict:
+        ts = self._run_times
+        if not ts:
+            return {"runs": 0}
+        return {"runs": len(ts), "total_s": sum(ts),
+                "avg_ms": 1e3 * sum(ts) / len(ts)}

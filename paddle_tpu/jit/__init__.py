@@ -55,7 +55,9 @@ class StaticFunction:
         self._eager_fn = self._fn
         # AST control-flow capture (dy2static): if tensor → lax.cond, etc.
         self._fn = dy2static.convert_to_static(self._fn)
-        self._broke = False
+        # graph breaks are scoped per input signature: other signatures of
+        # the same function may still trace fine (SOT guard semantics)
+        self._broken_sigs: set = set()
         functools.update_wrapper(self, self._fn)
 
         layer = self._layer
@@ -72,29 +74,54 @@ class StaticFunction:
 
         self._jitted = jax.jit(traced)
 
+    @staticmethod
+    def _sig_key(args, kwargs):
+        """Abstract input signature (shape/dtype/tree) — the same key jax's
+        trace cache uses, so a break recorded here exactly covers the inputs
+        that would re-trace into the same break."""
+        def leaf(x):
+            v = x._value if isinstance(x, Tensor) else x
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return ("arr", tuple(v.shape), str(v.dtype))
+            return ("obj", type(v).__name__, repr(v)[:64])
+
+        flat, treedef = jax.tree.flatten((args, kwargs))
+        return (tuple(leaf(x) for x in flat), str(treedef))
+
     def __call__(self, *args, **kwargs):
         from . import dy2static
 
-        if not _to_static_enabled or self._broke:
+        if not _to_static_enabled:
+            return self._eager_fn(*args, **kwargs)
+        # the signature key is only needed once a break exists — don't pay
+        # the tree-flatten + repr on every hot-loop call
+        if self._broken_sigs and self._sig_key(args, kwargs) in self._broken_sigs:
             return self._eager_fn(*args, **kwargs)
         if self._layer is not None:
             entries = self._layer.state_dict()
             values = {k: v._value for k, v in entries.items()}
         else:
             values = {}
-        key = _rng.split_key()
+        # split off the jit key WITHOUT advancing the global generator: on a
+        # graph break the eager re-run must see the pre-attempt RNG state
+        # (otherwise the failed attempt consumes a draw the eager path never
+        # made, and reproducibility diverges between broken/unbroken runs)
+        base = _rng.get_rng_state()
+        new_base, key = jax.random.split(base)
         try:
-            return self._jitted(values, key, args, kwargs)
+            out = self._jitted(values, key, args, kwargs)
         except dy2static.GRAPH_BREAK_ERRORS as e:
             if self._full_graph:
                 raise
-            # SOT-style graph break: fall back to eager for this function
+            # SOT-style graph break: fall back to eager for this signature
             dy2static.logger.warning(
                 "to_static: graph break in %s (%s); falling back to eager",
                 getattr(self._eager_fn, "__qualname__", self._eager_fn),
                 type(e).__name__)
-            self._broke = True
+            self._broken_sigs.add(self._sig_key(args, kwargs))
             return self._eager_fn(*args, **kwargs)
+        _rng.set_rng_state(new_base)  # commit only after the jit path ran
+        return out
 
     @property
     def code(self):

@@ -40,7 +40,7 @@ __all__ = ["LlamaConfig", "llama_init_params", "llama_forward", "llama_loss",
            "LlamaForCausalLM", "shard_llama_params", "llama_param_specs"]
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)  # hashable → usable as a static jit arg
 class LlamaConfig:
     vocab_size: int = 32000
     hidden_size: int = 4096
@@ -337,26 +337,45 @@ def _decoder_layer(x, lp, config, mesh, positions):
                                   lp["moe_w_down"], c)
         x = x + moe_out
         return x, aux
+
     ff = jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])
     x = x + (ff @ lp["w_down"])
     return x, jnp.zeros((), jnp.float32)
 
 
-def remat_policy():
+def remat_policy(no_save_rhs_dim: int | None = None):
     """Selective rematerialisation policy for the decoder scan: save matmul
     outputs, recompute the cheap elementwise rest. Measured on v5e (850M,
     seq 2048, bf16): 491ms/step vs 533ms full remat (~8%); also saving the
     named 'flash_out' residual measured *slower* (527ms — the extra VMEM/HBM
-    pressure outweighs skipping the flash recompute), so it is not saved."""
-    return jax.checkpoint_policies.dots_saveable
+    pressure outweighs skipping the flash recompute), so it is not saved.
+
+    no_save_rhs_dim: additionally EXCLUDE dots whose rhs operand's last dim
+    equals this value — passing intermediate_size drops the gate/up FFN
+    projections (the two largest saved residuals, ~370 MB/layer at B=8
+    T=2048) while keeping every other dot. The policy predicate receives
+    the eqn's input avals, so the filter is shape-exact."""
+    if no_save_rhs_dim is None:
+        return jax.checkpoint_policies.dots_saveable
+
+    def policy(prim, *avals, **params):
+        if prim.name in ("dot_general", "conv_general_dilated"):
+            if (len(avals) >= 2 and getattr(avals[-1], "shape", None)
+                    and avals[-1].shape[-1] == no_save_rhs_dim):
+                return False
+            return True
+        return False
+
+    return policy
 
 
 def llama_trunk(x, stacked_layer_params, config, mesh=None, positions=None,
                 remat=True):
     """Scan the decoder stack over layer-stacked params.
 
-    remat: False | True (selective policy) | "full" (save nothing — the
-    lowest-memory schedule, the pre-tuning behavior)."""
+    remat: False | True (selective dots policy) | "full" (save nothing —
+    the lowest-memory schedule) | "dots_noffn" (dots policy with the MLP
+    nested-rematerialised: fits batch 8 on one 16 GB v5e)."""
     if positions is None:
         positions = jnp.arange(x.shape[1])[None, :].astype(jnp.int32)
         positions = jnp.broadcast_to(positions, (x.shape[0], x.shape[1]))
@@ -369,6 +388,9 @@ def llama_trunk(x, stacked_layer_params, config, mesh=None, positions=None,
         fn = body
     elif remat == "full":
         fn = jax.checkpoint(body)
+    elif remat == "dots_noffn":
+        fn = jax.checkpoint(
+            body, policy=remat_policy(config.intermediate_size))
     else:
         fn = jax.checkpoint(body, policy=remat_policy())
     x, auxes = jax.lax.scan(fn, x, stacked_layer_params)
@@ -385,30 +407,86 @@ def split_layer_params(params):
     return layer, other
 
 
+def resolve_head(other):
+    """The lm head matrix [D, V] (tied → transposed embedding)."""
+    head = other.get("lm_head")
+    if head is None:
+        head = other["embed_tokens"].T
+    return head
+
+
+def lm_head_logits(x, other, config: LlamaConfig):
+    """Final rmsnorm + lm-head projection — THE single epilogue shared by
+    training forward, chunked loss, prefill and incremental decode (any
+    head-handling change lands in exactly one place).
+
+    bf16 operands + f32 accumulation: runs at bf16 MXU rate (an f32 lm-head
+    GEMM is 2-4x slower on TPU) while keeping f32 logits for the softmax."""
+    x = _rmsnorm(x, other["norm"], config.rms_norm_eps)
+    head = resolve_head(other)
+    return jax.lax.dot_general(
+        x, head.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def llama_forward(params, tokens, config: LlamaConfig, mesh=None, remat=True):
     """tokens [B, T] int32 → logits [B, T, V] (compute dtype per config)."""
     layer_p, other = split_layer_params(params)
     x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(config.dtype)
     x, aux = llama_trunk(x, layer_p, config, mesh, remat=remat)
-    x = _rmsnorm(x, other["norm"], config.rms_norm_eps)
-    head = other.get("lm_head")
-    if head is None:
-        head = other["embed_tokens"].T
-    # bf16 operands + f32 accumulation: runs at bf16 MXU rate (an f32 lm-head
-    # GEMM is 2-4x slower on TPU) while keeping f32 logits for the softmax
-    logits = jax.lax.dot_general(
-        x, head.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    return logits, aux
+    return lm_head_logits(x, other, config), aux
+
+
+def _chunked_ce(x, head, labels, chunk):
+    """Sequence-chunked cross-entropy: materialises logits only one
+    [B, chunk, V] block at a time (the block is rematerialised in the
+    backward), so the full [B, T, V] f32 logits tensor never hits HBM —
+    at B=8 T=2048 V=32000 that tensor alone is 2.1 GB, the difference
+    between fitting and OOM on a 16 GB v5e. Returns (sum_nll, n_tokens)."""
+    B, T, D = x.shape
+    assert T % chunk == 0
+    xs = x.reshape(B, T // chunk, chunk, D).swapaxes(0, 1)
+    ls = labels.reshape(B, T // chunk, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def one(xc, lc):
+        logits = jax.lax.dot_general(
+            xc, head.astype(xc.dtype), (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, lc[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+        mask = (lc >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask), jnp.sum(mask)
+
+    def body(carry, xl):
+        nll, n = one(*xl)
+        return (carry[0] + nll, carry[1] + n), None
+
+    (nll, n), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)), (xs, ls))
+    return nll, n
 
 
 def llama_loss(params, tokens, labels, config: LlamaConfig, mesh=None, remat=True,
-               aux_weight=0.01):
-    logits, aux = llama_forward(params, tokens, config, mesh, remat)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    mask = (labels >= 0).astype(jnp.float32)
-    loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+               aux_weight=0.01, loss_chunk: int | None = None):
+    """loss_chunk: sequence-chunk size for the cross-entropy (None = dense
+    [B,T,V] logits). Chunking trades a second lm-head matmul in the backward
+    for ~2 GB of logits HBM — measured neutral at B=4 but it is what lets
+    B=8 fit under the dots_saveable remat policy (benchmarks/ROUND3_PERF.md)."""
+    if loss_chunk:
+        layer_p, other = split_layer_params(params)
+        x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(config.dtype)
+        jm = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
+        x, aux = llama_trunk(x, layer_p, config, jm, remat=remat)
+        x = _rmsnorm(x, other["norm"], config.rms_norm_eps)
+        nll, n = _chunked_ce(x, resolve_head(other), labels, loss_chunk)
+        loss = nll / jnp.maximum(n, 1.0)
+    else:
+        logits, aux = llama_forward(params, tokens, config, mesh, remat)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     if config.num_experts > 0:
         loss = loss + aux_weight * aux
     return loss
@@ -446,17 +524,15 @@ class LlamaForCausalLM(Layer):
 
     @jax.profiler.annotate_function
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0):
-        """Greedy/sampled decode (KV-cache decode path lands with the
-        inference milestone; this recomputes the prefix)."""
+        """KV-cache incremental decode: one compiled prefill + a scanned
+        single-token step (O(T) per token; see models/llama_decode.py).
+        Replaces the r2 full-prefix recompute (O(T²))."""
         from ..core import random as _rng
+        from .llama_decode import llama_generate
         toks = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-        params = self._param_tree()
-        for _ in range(max_new_tokens):
-            logits, _ = llama_forward(params, toks, self.config, remat=False)
-            last = logits[:, -1, :]
-            if temperature > 0:
-                nxt = jax.random.categorical(_rng.split_key(), last / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
-            toks = jnp.concatenate([toks, nxt[:, None].astype(toks.dtype)], axis=1)
-        return Tensor(toks)
+        toks = toks.astype(jnp.int32)
+        key = _rng.split_key() if temperature > 0 else None
+        new = llama_generate(self._param_tree(), toks, self.config,
+                             int(max_new_tokens), float(temperature),
+                             int(top_k), key=key)
+        return Tensor(jnp.concatenate([toks, new.astype(toks.dtype)], axis=1))

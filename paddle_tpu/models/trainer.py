@@ -31,7 +31,8 @@ class LlamaTrainStep:
 
     def __init__(self, config: L.LlamaConfig, mesh: ProcessMesh | None = None,
                  optimizer: Optimizer | None = None, num_microbatches: int = 1,
-                 remat: bool = True, seed: int = 0, pp_schedule: str = "gpipe"):
+                 remat: bool = True, seed: int = 0, pp_schedule: str = "gpipe",
+                 loss_chunk: int | None = None):
         self.config = config
         self.mesh = mesh
         self.optimizer = optimizer or AdamW(learning_rate=3e-4, weight_decay=0.1)
@@ -80,8 +81,13 @@ class LlamaTrainStep:
                 return stage_fn
 
             def head_loss(norm_w, head, x, labels):
-                # rmsnorm -> lm head -> masked-mean token cross-entropy
+                # rmsnorm -> lm head -> masked-mean token cross-entropy;
+                # loss_chunk applies here too (the pp head would otherwise
+                # silently materialise the dense [B,T,V] logits)
                 x = L._rmsnorm(x, norm_w, cfg.rms_norm_eps)
+                if loss_chunk:
+                    nll, n = L._chunked_ce(x, head, labels, loss_chunk)
+                    return nll / jnp.maximum(n, 1.0)
                 logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
@@ -95,7 +101,8 @@ class LlamaTrainStep:
 
         if not use_pp:
             def loss_fn(p, tokens, labels):
-                return L.llama_loss(p, tokens, labels, cfg, mesh=jm, remat=do_remat)
+                return L.llama_loss(p, tokens, labels, cfg, mesh=jm,
+                                    remat=do_remat, loss_chunk=loss_chunk)
 
             def value_and_grad_fn(p, tokens, labels):
                 return jax.value_and_grad(loss_fn)(p, tokens, labels)

@@ -54,12 +54,10 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    crows = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
-    cols = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
-    vals = np.asarray(values._value if isinstance(values, Tensor) else values)
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    return sparse_coo_tensor(np.stack([rows, cols]), vals, shape,
-                             stop_gradient=stop_gradient)
+    """Real CSR storage (crows/cols/values) — see sparse/csr.py."""
+    from .csr import csr_tensor
+    return csr_tensor(crows, cols, values, shape, dtype=dtype,
+                      stop_gradient=stop_gradient)
 
 
 def to_dense(x):
@@ -98,11 +96,20 @@ def to_sparse_coo(x, sparse_dim=None, name=None):
 
 
 def to_sparse_csr(x, name=None):
-    """Dense/COO → CSR-semantics tensor (reference to_sparse_csr). Stored as
-    BCOO (XLA's TPU-lowerable format); crows()/cols() views derive from it."""
+    """Dense/COO → real CSR tensor (reference to_sparse_csr)."""
+    from .csr import CsrTensor
+    if isinstance(x, CsrTensor):
+        return x
     t = to_sparse_coo(x)
-    t._is_csr = True
-    return t
+    idx = np.asarray(t._bcoo.indices)
+    order = np.lexsort((idx[:, 1], idx[:, 0]))
+    rows, cols = idx[order, 0], idx[order, 1]
+    crows = np.zeros(t._bcoo.shape[0] + 1, np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows).astype(np.int32)
+    return CsrTensor(crows, cols, t._bcoo.data[jnp.asarray(order)],
+                     t._bcoo.shape, stop_gradient=x.stop_gradient
+                     if isinstance(x, Tensor) else True)
 
 
 def values(x, name=None):
@@ -158,3 +165,139 @@ def conv3d_implicit_gemm(x, kernel, bias=None, stride=1, padding=0,
 
 __all__ += ["to_sparse_coo", "to_sparse_csr", "values", "divide_scalar",
             "batch_norm_", "conv3d_implicit_gemm"]
+
+
+# ---------------------------------------------------------------- CSR + kernels
+from .csr import (CsrTensor, coalesce, csr_tensor, fused_attention, mask_as,
+                  masked_matmul, maxpool)
+
+__all__ += ["CsrTensor", "csr_tensor", "coalesce", "masked_matmul", "maxpool",
+            "fused_attention", "mask_as"]
+
+
+# ------------------------------------------------------------- value-wise zoo
+# Reference python/paddle/sparse/unary.py: elementwise ops that preserve the
+# sparsity pattern act on the stored values only (zero-preserving fns).
+
+def _valuewise(fn_name, jfn):
+    def op(x, name=None):
+        from .csr import CsrTensor
+        if isinstance(x, CsrTensor):
+            return CsrTensor(x._crows, x._cols, jfn(x._vals), x._dense_shape,
+                             stop_gradient=x.stop_gradient)
+        if isinstance(x, SparseTensor):
+            b = jsparse.BCOO((jfn(x._bcoo.data), x._bcoo.indices),
+                             shape=x._bcoo.shape)
+            return SparseTensor(b, stop_gradient=x.stop_gradient)
+        return Tensor(jfn(x._value if isinstance(x, Tensor)
+                          else jnp.asarray(x)))
+
+    op.__name__ = fn_name
+    op.__doc__ = (f"paddle.sparse.{fn_name} (reference sparse/unary.py): "
+                  "value-wise on the stored entries, pattern preserved.")
+    return op
+
+
+_UNARY = {
+    "sin": jnp.sin, "tan": jnp.tan, "asin": jnp.arcsin, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "tanh": jnp.tanh, "asinh": jnp.arcsinh,
+    "atanh": jnp.arctanh, "sqrt": jnp.sqrt, "square": jnp.square,
+    "log1p": jnp.log1p, "abs": jnp.abs, "neg": jnp.negative,
+    "expm1": jnp.expm1, "rad2deg": jnp.rad2deg, "deg2rad": jnp.deg2rad,
+    "isnan": jnp.isnan,
+}
+for _n, _f in _UNARY.items():
+    globals()[_n] = _valuewise(_n, _f)
+__all__ += list(_UNARY)
+
+
+def pow(x, factor, name=None):  # noqa: A001 — reference name
+    return _valuewise("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Cast values and/or indices (reference unary.cast honors BOTH)."""
+    from .csr import CsrTensor
+    out = _valuewise("cast", lambda v: v.astype(value_dtype)
+                     if value_dtype else v)(x)
+    if index_dtype is not None:
+        if isinstance(out, CsrTensor):
+            out._crows = out._crows.astype(index_dtype)
+            out._cols = out._cols.astype(index_dtype)
+        elif isinstance(out, SparseTensor):
+            out._bcoo = jsparse.BCOO(
+                (out._bcoo.data, out._bcoo.indices.astype(index_dtype)),
+                shape=out._bcoo.shape)
+    return out
+
+
+def scale(x, scale_, bias=0.0, bias_after_scale=True, name=None):
+    return _valuewise("scale", lambda v: v * scale_ + bias)(x)
+
+
+def subtract(x, y, name=None):
+    return Tensor(to_dense(x)._value - to_dense(y)._value)
+
+
+def divide(x, y, name=None):
+    return Tensor(to_dense(x)._value / to_dense(y)._value)
+
+
+def mv(x, vec, name=None):
+    """Sparse [M,N] @ dense [N] → dense [M] (reference binary.mv)."""
+    vv = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return matmul(x, vv.reshape(-1, 1)).reshape([-1])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) (reference multiary.addmm)."""
+    return Tensor(beta * to_dense(input)._value
+                  + alpha * matmul(x, y)._value)
+
+
+def is_same_shape(x, y, name=None):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def transpose(x, perm, name=None):
+    """Pattern transpose (reference unary.transpose); 2-D sparse only."""
+    from .csr import CsrTensor, _coo_parts
+    if isinstance(x, (CsrTensor, SparseTensor)) and list(perm) == [1, 0]:
+        rows, cols, vals, shape = _coo_parts(x)
+        out = sparse_coo_tensor(np.stack([cols, rows]), np.asarray(vals),
+                                (shape[1], shape[0]))
+        return to_sparse_csr(out) if isinstance(x, CsrTensor) else out
+    v = to_dense(x)._value
+    return Tensor(jnp.transpose(v, perm))
+
+
+def reshape(x, shape, name=None):
+    from .csr import CsrTensor
+    v = to_dense(x)._value
+    out = Tensor(jnp.reshape(v, shape))
+    if isinstance(x, CsrTensor):
+        return to_sparse_csr(out)
+    if isinstance(x, SparseTensor):
+        return to_sparse_coo(out)
+    return out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    v = to_dense(x)._value
+    return Tensor(jnp.sum(v, axis=axis, dtype=dtype, keepdims=keepdim))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    v = to_dense(x)._value
+    idx = [builtins_slice(None)] * v.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = builtins_slice(s, e)
+    return Tensor(v[tuple(idx)])
+
+
+import builtins as _builtins
+
+builtins_slice = _builtins.slice
+
+__all__ += ["pow", "cast", "scale", "subtract", "divide", "mv", "addmm",
+            "is_same_shape", "transpose", "reshape", "sum", "slice"]

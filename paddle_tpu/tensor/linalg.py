@@ -12,14 +12,17 @@ from ..core.tensor import Tensor
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    def f(a, b):
+    # transpose flags ride as static kwargs so the matmul SPMD rule sees
+    # the true contraction (reference spmd_rules/matmul.cc reads trans_x/y)
+    def f(a, b, transpose_x, transpose_y):
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
         return jnp.matmul(a, b)
 
-    return apply(f, x, y, name="matmul")
+    return apply(f, x, y, name="matmul",
+                 transpose_x=transpose_x, transpose_y=transpose_y)
 
 
 mm = matmul
@@ -30,7 +33,9 @@ def bmm(x, y, name=None):
 
 
 def dot(x, y, name=None):
-    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="matmul")
+    # NOT name="matmul": dot contracts the last dim of BOTH operands — the
+    # matmul SPMD rule's [K,N]-weight shape contract does not apply
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
 
 
 def mv(x, vec, name=None):
@@ -216,7 +221,8 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 
 def multi_dot(x, name=None):
-    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x, name="matmul")
+    # own name: N-operand chain, not the matmul rule's 2-operand contract
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x, name="multi_dot")
 
 
 def householder_product(x, tau, name=None):

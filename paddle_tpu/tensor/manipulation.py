@@ -41,7 +41,10 @@ def view(x, shape_or_dtype, name=None):
 
 def transpose(x, perm, name=None):
     perm = _ilist(perm)
-    return apply(lambda a: jnp.transpose(a, perm), x, name="transpose")
+    # perm rides as a static kwarg so the transpose SPMD rule can map
+    # sharded dims through the permutation (reference spmd_rules/transpose.cc)
+    return apply(lambda a, perm: jnp.transpose(a, perm), x,
+                 name="transpose", perm=tuple(perm))
 
 
 def t_(x, name=None):

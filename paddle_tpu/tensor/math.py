@@ -273,12 +273,16 @@ def multiplex(inputs, index, name=None):
 # ---------------- reductions ----------------
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     ax = _axis(axis)
-    return apply(lambda a: jnp.sum(a, axis=ax, dtype=dtype, keepdims=keepdim), x, name="sum")
+    return apply(lambda a, axis, keepdims: jnp.sum(a, axis=axis, dtype=dtype,
+                                                   keepdims=keepdims),
+                 x, name="sum", axis=ax, keepdims=keepdim)
 
 
 def mean(x, axis=None, keepdim=False, name=None):
     ax = _axis(axis)
-    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x, name="mean")
+    return apply(lambda a, axis, keepdims: jnp.mean(a, axis=axis,
+                                                    keepdims=keepdims),
+                 x, name="mean", axis=ax, keepdims=keepdim)
 
 
 def max(x, axis=None, keepdim=False, name=None):
@@ -373,16 +377,19 @@ def add_n(inputs, name=None):
     return apply(lambda *xs: jax.tree.reduce(jnp.add, list(xs)), *inputs, name="add_n")
 
 
+# inner/outer/kron dispatch under their OWN names: their contraction
+# semantics differ from matmul's [.., K] @ [K, N] contract, so the matmul
+# SPMD rule must not fire on them
 def inner(x, y, name=None):
-    return apply(jnp.inner, x, y, name="matmul")
+    return apply(jnp.inner, x, y, name="inner")
 
 
 def outer(x, y, name=None):
-    return apply(lambda a, b: jnp.outer(a, b), x, y, name="matmul")
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
 
 
 def kron(x, y, name=None):
-    return apply(jnp.kron, x, y, name="matmul")
+    return apply(jnp.kron, x, y, name="kron")
 
 
 def trace(x, offset=0, axis1=0, axis2=1, name=None):

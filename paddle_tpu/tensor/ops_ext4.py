@@ -1132,3 +1132,89 @@ def weighted_sample_neighbors(row, colptr, edge_weight, x, sample_size=5,
         return out, counts
     return apply_nondiff(f, row, colptr, edge_weight, x,
                          name="weighted_sample_neighbors")
+
+
+def _extract_chunks(seq, scheme, num_chunk_types, excluded):
+    """Chunk extraction for one tag sequence (reference
+    phi/kernels/cpu/chunk_eval_kernel.cc semantics): tag = chunk_type *
+    num_tag_types + tag_type; any tag outside [0, num_chunk_types*n_tag) is
+    'outside'. Returns a set of (start, end, chunk_type)."""
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    chunks = set()
+    start = ctype = None
+
+    def close(end):
+        nonlocal start
+        if start is not None:
+            chunks.add((start, end, ctype))
+            start = None
+
+    for i, t in enumerate(seq):
+        t = int(t)
+        if t < 0 or t >= num_chunk_types * n_tag:
+            close(i - 1)
+            continue
+        ct, tt = divmod(t, n_tag)
+        if scheme == "plain":
+            chunks.add((i, i, ct))
+        elif scheme == "IOB":
+            if start is None or tt == 0 or ct != ctype:
+                close(i - 1)
+                start, ctype = i, ct
+        elif scheme == "IOE":
+            if start is None or ct != ctype:
+                close(i - 1)
+                start, ctype = i, ct
+            if tt == 1:  # E ends the chunk
+                chunks.add((start, i, ctype))
+                start = None
+        else:  # IOBES
+            if tt == 3:  # S: singleton
+                close(i - 1)
+                chunks.add((i, i, ct))
+                continue
+            if tt == 0 or start is None or ct != ctype:
+                close(i - 1)
+                start, ctype = i, ct
+            if tt == 2:  # E
+                chunks.add((start, i, ctype))
+                start = None
+    close(len(seq) - 1)
+    return {c for c in chunks if c[2] not in excluded}
+
+
+@_export
+def chunk_eval(inference, label, seq_length=None, num_chunk_types=1,
+               chunk_scheme="IOB", excluded_chunk_types=(), name=None):
+    """Reference ops.yaml chunk_eval: chunking (NER-style) precision /
+    recall / F1 between predicted and gold tag sequences. Outputs the six
+    tensors the yaml declares: (precision, recall, f1, num_infer_chunks,
+    num_label_chunks, num_correct_chunks). Host-side metric (non-diff),
+    like the reference CPU kernel."""
+    import numpy as np
+
+    inf = np.asarray(_v(inference)).reshape(
+        np.asarray(_v(inference)).shape[0], -1)
+    lab = np.asarray(_v(label)).reshape(np.asarray(_v(label)).shape[0], -1)
+    lens = None if seq_length is None else np.asarray(_v(seq_length)).reshape(-1)
+    excluded = set(excluded_chunk_types or ())
+
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b]) if lens is not None else inf.shape[1]
+        ci = _extract_chunks(inf[b, :L], chunk_scheme, num_chunk_types,
+                             excluded)
+        cl = _extract_chunks(lab[b, :L], chunk_scheme, num_chunk_types,
+                             excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    precision = n_cor / n_inf if n_inf else 0.0
+    recall = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * precision * recall / (precision + recall) \
+        if precision + recall else 0.0
+    mk = lambda v, dt: Tensor(jnp.asarray([v], dt))
+    icount = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return (mk(precision, jnp.float32), mk(recall, jnp.float32),
+            mk(f1, jnp.float32), mk(n_inf, icount),
+            mk(n_lab, icount), mk(n_cor, icount))

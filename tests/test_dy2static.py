@@ -193,7 +193,16 @@ def test_graph_break_falls_back_to_eager():
     got = sf(t([1.0]))
     want = f(t([1.0]))
     np.testing.assert_allclose(got.numpy(), want.numpy())
-    assert sf._broke
+    # the break is recorded per input signature, not function-wide
+    assert len(sf._broken_sigs) == 1
+    # same signature: straight to eager (no re-trace), still correct
+    np.testing.assert_allclose(sf(t([1.0])).numpy(), want.numpy())
+    assert len(sf._broken_sigs) == 1
+    # a different signature gets its own trace attempt (breaks again here,
+    # but is recorded separately)
+    got2 = sf(t([1.0, 1.0]))
+    np.testing.assert_allclose(got2.numpy(), f(t([1.0, 1.0])).numpy())
+    assert len(sf._broken_sigs) == 2
 
 
 def test_graph_break_raises_under_full_graph():

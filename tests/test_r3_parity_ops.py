@@ -1,0 +1,163 @@
+"""The four op names the r2 yaml audit found missing: chunk_eval,
+add_group_norm_silu, fused_embedding_fc_lstm, fused_moe — numeric tests
+against dense/numpy references (OpTest pattern)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.tensor.fused_ops import (add_group_norm_silu,
+                                         fused_embedding_fc_lstm, fused_moe)
+from paddle_tpu.tensor.ops_ext4 import chunk_eval
+
+
+class TestChunkEval:
+    def test_iob_perfect(self):
+        # tags: B-0=0, I-0=1, outside=2
+        seq = np.array([[0, 1, 2, 0, 1, 1]])
+        p, r, f1, ni, nl, nc = chunk_eval(pt.to_tensor(seq),
+                                          pt.to_tensor(seq),
+                                          num_chunk_types=1)
+        assert float(p.numpy()[0]) == 1.0
+        assert float(r.numpy()[0]) == 1.0
+        assert int(ni.numpy()[0]) == 2 and int(nc.numpy()[0]) == 2
+
+    def test_iob_partial(self):
+        label = np.array([[0, 1, 2, 0, 1, 1]])   # chunks (0,1), (3,5)
+        inf = np.array([[0, 1, 2, 2, 0, 1]])     # chunks (0,1), (4,5)
+        p, r, f1, ni, nl, nc = chunk_eval(pt.to_tensor(inf),
+                                          pt.to_tensor(label),
+                                          num_chunk_types=1)
+        assert int(nc.numpy()[0]) == 1
+        assert float(p.numpy()[0]) == 0.5
+        assert float(r.numpy()[0]) == 0.5
+        np.testing.assert_allclose(float(f1.numpy()[0]), 0.5)
+
+    def test_iobes_singleton(self):
+        # IOBES: B=0 I=1 E=2 S=3 (type 0); outside=4
+        label = np.array([[3, 4, 0, 1, 2]])      # chunks (0,0), (2,4)
+        p, r, f1, ni, nl, nc = chunk_eval(pt.to_tensor(label),
+                                          pt.to_tensor(label),
+                                          num_chunk_types=1,
+                                          chunk_scheme="IOBES")
+        assert int(nl.numpy()[0]) == 2 and int(nc.numpy()[0]) == 2
+
+    def test_seq_length_and_excluded(self):
+        label = np.array([[0, 1, 2, 0, 1, 1]])
+        p, r, f1, ni, nl, nc = chunk_eval(
+            pt.to_tensor(label), pt.to_tensor(label),
+            seq_length=pt.to_tensor(np.array([3])), num_chunk_types=1)
+        assert int(nl.numpy()[0]) == 1  # only the first chunk inside len 3
+
+
+class TestAddGroupNormSilu:
+    def test_matches_composed_reference(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 8, 4, 4).astype(np.float32)
+        res = rng.rand(2, 8, 4, 4).astype(np.float32)
+        scale = rng.rand(8).astype(np.float32)
+        bias = rng.rand(8).astype(np.float32)
+        y, res_out, mean, var = add_group_norm_silu(
+            pt.to_tensor(x), pt.to_tensor(res), pt.to_tensor(scale),
+            pt.to_tensor(bias), epsilon=1e-5, groups=2)
+        h = x + res
+        hg = h.reshape(2, 2, 4, 4, 4)  # [N, G, C/G, H, W]
+        mu = hg.mean(axis=(2, 3, 4), keepdims=True)
+        vv = hg.var(axis=(2, 3, 4), keepdims=True)
+        norm = ((hg - mu) / np.sqrt(vv + 1e-5)).reshape(2, 8, 4, 4)
+        norm = norm * scale[None, :, None, None] + bias[None, :, None, None]
+        want = norm / (1 + np.exp(-norm))  # silu
+        np.testing.assert_allclose(np.asarray(y.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(res_out.numpy()), h, rtol=1e-6)
+
+
+class TestFusedEmbeddingFcLstm:
+    def test_recurrence_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        V, H, B, T = 10, 4, 2, 5
+        emb = rng.randn(V, 4 * H).astype(np.float32) * 0.1
+        wh = rng.randn(H, 4 * H).astype(np.float32) * 0.1
+        bias = rng.randn(1, 4 * H).astype(np.float32) * 0.1
+        ids = rng.randint(0, V, (B, T, 1))
+        hid, cell = fused_embedding_fc_lstm(
+            pt.to_tensor(ids), pt.to_tensor(emb), pt.to_tensor(wh),
+            pt.to_tensor(bias))
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        want_h = []
+        for t in range(T):
+            g = emb[ids[:, t, 0]] + h @ wh + bias[0]
+            gi, gf, gc, go = np.split(g, 4, axis=-1)
+            i, f, o = sig(gi), sig(gf), sig(go)
+            c = f * c + i * np.tanh(gc)
+            h = o * np.tanh(c)
+            want_h.append(h.copy())
+        np.testing.assert_allclose(np.asarray(hid.numpy()),
+                                   np.stack(want_h, 1), rtol=1e-4, atol=1e-5)
+
+    def test_reverse_runs(self):
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 6, (2, 4, 1))
+        emb = rng.randn(6, 12).astype(np.float32) * 0.1
+        wh = rng.randn(3, 12).astype(np.float32) * 0.1
+        bias = rng.randn(1, 12).astype(np.float32) * 0.1
+        hid, cell = fused_embedding_fc_lstm(
+            pt.to_tensor(ids), pt.to_tensor(emb), pt.to_tensor(wh),
+            pt.to_tensor(bias), is_reverse=True)
+        assert tuple(hid.shape) == (2, 4, 3)
+
+
+class TestFusedMoe:
+    def _ref(self, x, gw, w1, w2, topk, norm):
+        toks = x.reshape(-1, x.shape[-1])
+        logits = toks @ gw
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        topi = np.argsort(-probs, axis=-1)[:, :topk]
+        topv = np.take_along_axis(probs, topi, axis=-1)
+        if norm:
+            topv = topv / topv.sum(-1, keepdims=True)
+        out = np.zeros_like(toks)
+        F = w2.shape[1]
+        for n in range(toks.shape[0]):
+            for s in range(topk):
+                ex = topi[n, s]
+                h = toks[n] @ w1[ex]
+                if h.shape[-1] == 2 * F:
+                    g, u = h[:F], h[F:]
+                    h = (g / (1 + np.exp(-g))) * u
+                else:
+                    h = h / (1 + np.exp(-h))
+                out[n] += topv[n, s] * (h @ w2[ex])
+        return out.reshape(x.shape)
+
+    def test_matches_reference_silu(self):
+        rng = np.random.RandomState(3)
+        B, T, D, F, E = 2, 3, 8, 16, 4
+        x = rng.randn(B, T, D).astype(np.float32) * 0.3
+        gw = rng.randn(D, E).astype(np.float32)
+        w1 = rng.randn(E, D, F).astype(np.float32) * 0.2
+        w2 = rng.randn(E, F, D).astype(np.float32) * 0.2
+        out = fused_moe(pt.to_tensor(x), pt.to_tensor(gw), pt.to_tensor(w1),
+                        ffn2_weight=pt.to_tensor(w2), moe_topk=2)
+        want = self._ref(x, gw, w1, w2, 2, True)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_swiglu_variant(self):
+        rng = np.random.RandomState(4)
+        B, T, D, F, E = 1, 4, 6, 8, 3
+        x = rng.randn(B, T, D).astype(np.float32) * 0.3
+        gw = rng.randn(D, E).astype(np.float32)
+        w1 = rng.randn(E, D, 2 * F).astype(np.float32) * 0.2
+        w2 = rng.randn(E, F, D).astype(np.float32) * 0.2
+        out = fused_moe(pt.to_tensor(x), pt.to_tensor(gw), pt.to_tensor(w1),
+                        ffn2_weight=pt.to_tensor(w2), moe_topk=2)
+        want = self._ref(x, gw, w1, w2, 2, True)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-3, atol=1e-4)

@@ -112,3 +112,182 @@ class TestBuiltinRules:
         loss = F.softmax_with_cross_entropy(dl, dt)
         assert loss.placements is not None
         assert loss.placements[0].is_shard(0)
+
+
+class TestRuleLibrary:
+    """The reference's high-value rules ported onto the surface (VERDICT r2
+    next #4): matmul, layer_norm, softmax, elementwise, reductions,
+    transpose, concat, slice, dropout — forward AND reverse (grad_<op>)."""
+
+    def _np(self, *shape, seed=0):
+        return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+    def test_matmul_column_parallel(self, mesh1d):
+        x = self._np(4, 16)
+        w = self._np(16, 24, seed=1)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Replicate()])
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Shard(1)])
+        out = pt.matmul(dx, dw)
+        assert out.placements[0].is_shard(1)  # N stays sharded (Megatron col)
+        np.testing.assert_allclose(_global(out), x @ w, rtol=1e-5)
+
+    def test_matmul_row_parallel_demands_lhs_and_contracts(self, mesh1d):
+        x = self._np(4, 16)
+        w = self._np(16, 24, seed=1)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Replicate()])
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Shard(0)])
+        out = pt.matmul(dx, dw)
+        # contracted over the sharded K: output carries no shard
+        assert out.placements[0].is_replicate()
+        np.testing.assert_allclose(_global(out), x @ w, rtol=1e-5)
+
+    def test_matmul_batch_shard_survives(self, mesh1d):
+        x = self._np(8, 16)
+        w = self._np(16, 24, seed=1)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Replicate()])
+        out = pt.matmul(dx, dw)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), x @ w, rtol=1e-5)
+
+    def test_matmul_transpose_y_column_parallel(self, mesh1d):
+        # w [N, K] with transpose_y: Shard(0) is the N (column) dim
+        x = self._np(4, 16)
+        w = self._np(24, 16, seed=1)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Replicate()])
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Shard(0)])
+        out = pt.matmul(dx, dw, transpose_y=True)
+        assert out.placements[0].is_shard(1)
+        np.testing.assert_allclose(_global(out), x @ w.T, rtol=1e-5)
+
+    def test_dot_not_misread_as_matmul(self, mesh1d):
+        # dot contracts both operands' last dim — must not hit the matmul
+        # rule's [K,N] weight contract (it dispatches under its own name)
+        a = self._np(8, 16)
+        b = self._np(8, 16, seed=2)
+        da = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+        db = dist.shard_tensor(pt.to_tensor(b), mesh1d, [Shard(0)])
+        out = pt.dot(da, db)
+        np.testing.assert_allclose(_global(out), (a * b).sum(-1), rtol=1e-5)
+
+    def test_grad_matmul_reverse_follows_primals(self, mesh1d):
+        # reverse rule: dW follows W's placements, dX follows X's
+        x = self._np(8, 16)
+        w = self._np(16, 24, seed=1)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        dw = dist.shard_tensor(pt.to_tensor(w), mesh1d, [Shard(1)])
+        dx.stop_gradient = False
+        dw.stop_gradient = False
+        out = pt.matmul(dx, dw)
+        loss = pt.sum(out)
+        loss.backward()
+        import jax
+        from jax.sharding import NamedSharding
+        gw = dw._grad_value
+        gx = dx._grad_value
+        assert isinstance(gw.sharding, NamedSharding)
+        assert gw.sharding.spec == jax.sharding.PartitionSpec(None, "x")
+        assert gx.sharding.spec == jax.sharding.PartitionSpec("x")
+
+    def test_layer_norm_demands_feature_gather(self, mesh1d):
+        # a feature-dim shard must be ungathered before the reduction;
+        # batch shard passes through untouched
+        x = self._np(8, 16)
+        dxf = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(1)])
+        out = F.layer_norm(dxf, 16)
+        assert out.placements[0].is_replicate()
+        ref = F.layer_norm(pt.to_tensor(x), 16).numpy()
+        np.testing.assert_allclose(_global(out), ref, rtol=1e-4, atol=1e-5)
+        dxb = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out2 = F.layer_norm(dxb, 16)
+        assert out2.placements[0].is_shard(0)
+
+    def test_rms_norm_keeps_batch_shard(self, mesh1d):
+        x = self._np(8, 16)
+        w = pt.ones([16])
+        dxb = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = F.rms_norm(dxb, w, epsilon=1e-5)
+        assert out.placements[0].is_shard(0)
+
+    def test_softmax_unshards_reduced_dim(self, mesh1d):
+        x = self._np(4, 8)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(1)])
+        out = F.softmax(dx)
+        assert out.placements[0].is_replicate()
+        ref = F.softmax(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(_global(out), ref, rtol=1e-5)
+
+    def test_add_aligns_second_operand(self, mesh1d):
+        a = self._np(8, 16)
+        b = self._np(8, 16, seed=2)
+        da = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+        db = dist.shard_tensor(pt.to_tensor(b), mesh1d, [Shard(1)])
+        out = pt.add(da, db)
+        # rule aligns b onto a's layout; output follows a
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), a + b, rtol=1e-6)
+
+    def test_sum_keeps_surviving_shard(self, mesh1d):
+        x = self._np(8, 4)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = pt.sum(dx, axis=1)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), x.sum(1), rtol=1e-5)
+
+    def test_mean_drops_reduced_shard(self, mesh1d):
+        x = self._np(8, 4)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = pt.mean(dx, axis=0)
+        assert out.placements[0].is_replicate()
+        np.testing.assert_allclose(_global(out), x.mean(0), rtol=1e-5)
+
+    def test_transpose_maps_shard_through_perm(self, mesh1d):
+        x = self._np(8, 4, 2)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = pt.transpose(dx, [2, 0, 1])
+        # input dim 0 lands at output position 1
+        assert out.placements[0].is_shard(1)
+        np.testing.assert_allclose(_global(out), x.transpose(2, 0, 1),
+                                   rtol=1e-6)
+
+    def test_concat_aligns_inputs(self, mesh1d):
+        a = self._np(8, 4)
+        b = self._np(8, 4, seed=3)
+        da = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Shard(0)])
+        db = dist.shard_tensor(pt.to_tensor(b), mesh1d, [Replicate()])
+        out = pt.concat([da, db], axis=1)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), np.concatenate([a, b], 1),
+                                   rtol=1e-6)
+
+    def test_dropout_eval_keeps_layout(self, mesh1d):
+        x = self._np(8, 4)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = F.dropout(dx, p=0.5, training=False)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), x, rtol=1e-6)
+
+    def test_rule_changes_layout_vs_gspmd_default(self, mesh1d):
+        """The library is not a no-op: with the layer_norm rule removed,
+        GSPMD's propagation keeps the feature shard on a feature-sharded
+        input's output; the rule instead demands the gather."""
+        from paddle_tpu.distributed import spmd_rules as S
+        x = self._np(8, 16)
+        saved = S.get_spmd_rule("layer_norm")
+        S.unregister_spmd_rule("layer_norm")
+        try:
+            dxf = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(1)])
+            out_default = F.layer_norm(dxf, 16)
+            default_pl = out_default.placements
+        finally:
+            S.register_spmd_rule("layer_norm", saved)
+        dxf = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(1)])
+        out_ruled = F.layer_norm(dxf, 16)
+        assert out_ruled.placements[0].is_replicate()
+        # the rule genuinely changed the layout: GSPMD's default keeps the
+        # feature shard on the elementwise-shaped output
+        assert default_pl is not None and str(default_pl) != str(
+            out_ruled.placements)
+        ref = F.layer_norm(pt.to_tensor(x), 16).numpy()
+        np.testing.assert_allclose(_global(out_ruled), ref,
+                                   rtol=1e-4, atol=1e-5)
