@@ -15,6 +15,7 @@ benchmarks/ROUND2_PERF.md).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -58,12 +59,13 @@ def main():
     float(jax.device_get(loss))
     compile_s = time.time() - t_build
 
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        loss = step(toks, labels)
-        float(jax.device_get(loss))
-        times.append(time.perf_counter() - t0)
+    chain = int(os.environ.get("R3_CHAIN", "0"))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from benchmarks._timing import timed_chain
+    # chain=0 → per-step sync (chain of 1); see _timing.py for the protocol
+    times = timed_chain(lambda: step(toks, labels), chain or 1, iters)
+    loss = step(toks, labels)
 
     dt = float(np.median(times))
     tokens_per_sec = B * T / dt
@@ -72,7 +74,7 @@ def main():
     mfu = fpt_honest * tokens_per_sec / 197e12
     print(json.dumps({
         "config": {"B": B, "T": T, "moments": md, "remat": remat,
-                   "loss_chunk": loss_chunk},
+                   "loss_chunk": loss_chunk, "chain": chain},
         "step_ms_median": round(dt * 1e3, 1),
         "step_ms_min": round(min(times) * 1e3, 1),
         "step_ms_mean": round(float(np.mean(times)) * 1e3, 1),

@@ -24,4 +24,17 @@ def __getattr__(name):
     }
     if name in aliases and aliases[name] in table:
         return table[aliases[name]]
+    # ops whose home namespace mirrors the reference layout: fused serving
+    # ops live in incubate.nn.functional, collective static ops in
+    # distributed, sparse ops in paddle.sparse — resolve them lazily
+    for modname in ("paddle_tpu.incubate.nn.functional",
+                    "paddle_tpu.distributed", "paddle_tpu.sparse"):
+        import importlib
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        fn = getattr(mod, name, None)
+        if fn is not None and callable(fn):
+            return fn
     raise AttributeError(f"_C_ops has no op {name!r}")

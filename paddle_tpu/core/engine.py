@@ -420,6 +420,134 @@ def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+# ---------------------------------------------------------------- vjp cache
+# Eager tape dispatch pays a full jax.vjp re-trace per op per call — measured
+# ~1 ms/op vs ~40 µs for no_grad dispatch (benchmarks/eager_microbench.py),
+# the exact host-latency hot loop the reference engineers around with
+# generated per-op GradNodes (SURVEY §3.1 step 5). jax's vjp_fn is a pytree
+# (tree_util.Partial), so `jax.jit(lambda *a: jax.vjp(f, *a))` is cacheable:
+# repeated (code, closure-cells, static-kwargs, aval) signatures replay a
+# compiled forward that RETURNS the residual pytree (~20 µs). Fns that
+# branch on input VALUES can't trace abstractly — first failure poisons the
+# key and that op falls back to raw jax.vjp forever.
+
+_VJP_JIT_CACHE: dict = {}
+_VJP_CACHE_CAP = 1024
+_VJP_RAW = object()  # poisoned-key sentinel
+_VJP_CODE_STATS: dict = {}    # code-key → [distinct_keys, hits]
+_VJP_RAW_CODES: set = set()   # code-keys that churn keys → always raw
+_VJP_CODE_MISS_CAP = 32
+
+
+_VALUE_TYPES = (int, float, bool, str, bytes, type(None), complex)
+
+
+def _value_hashable(x) -> bool:
+    """Hashable BY VALUE — identity-hashed objects are rejected: two
+    distinct instances with equal meaning (or one instance MUTATED between
+    calls) would alias or miss cache keys, silently replaying the wrong
+    compiled op. Primitives, dtypes and tuples thereof only."""
+    if isinstance(x, _VALUE_TYPES):
+        return True
+    if isinstance(x, tuple):
+        return all(_value_hashable(e) for e in x)
+    if isinstance(x, (jnp.dtype,)) or type(x).__module__ == "numpy":
+        try:
+            hash(x)
+            return True
+        except TypeError:
+            return False
+    return False
+
+
+def _vjp_cache_key(fn, static_kwargs, arrs):
+    """(key, static_argnums) or None. Scalars ride as STATIC jit args so
+    fns that branch on them keep exact python semantics (the scalar value
+    is part of the key)."""
+    if getattr(fn, "__self__", None) is not None:
+        # bound method: per-instance state is invisible to a __code__ key
+        # (confirmed wrong-gradient repro) — always raw
+        return None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # jnp ufuncs (jnp.add, …) are stable module-level singletons
+        if isinstance(fn, jnp.ufunc) or (callable(fn) and
+                                         (getattr(fn, "__module__", "")
+                                          or "").startswith("jax")):
+            code = fn
+        else:
+            return None
+    if code in _VJP_RAW_CODES:
+        return None
+    cells = ()
+    if getattr(fn, "__closure__", None):
+        try:
+            cells = tuple(c.cell_contents for c in fn.__closure__)
+        except ValueError:  # empty cell
+            return None
+        if not all(_value_hashable(c) for c in cells):
+            return None
+    defaults = getattr(fn, "__defaults__", None) or ()
+    if not all(_value_hashable(d) for d in defaults):
+        return None
+    sk = tuple(sorted(static_kwargs.items())) if static_kwargs else ()
+    if not all(_value_hashable(v) for _, v in sk):
+        return None
+    sig = []
+    static_argnums = []
+    for i, a in enumerate(arrs):
+        if a is None:
+            sig.append(None)
+        elif hasattr(a, "shape") and hasattr(a, "dtype") \
+                and not isinstance(a, jax.core.Tracer):
+            sig.append((tuple(a.shape), str(a.dtype)))
+        elif isinstance(a, (bool, int, float, str)):
+            sig.append(("py", type(a).__name__, a))
+            static_argnums.append(i)
+        else:
+            return None
+    return (code, cells, sk, tuple(sig), defaults), tuple(static_argnums)
+
+
+def _tape_vjp(f, fn, static_kwargs, arrs):
+    """(out, vjp_fn) — through the jit cache when the op signature allows."""
+    keyinfo = _vjp_cache_key(fn, static_kwargs, arrs)
+    if keyinfo is None:
+        return jax.vjp(f, *arrs)
+    key, static_argnums = keyinfo
+    entry = _VJP_JIT_CACHE.get(key)
+    if entry is _VJP_RAW:
+        return jax.vjp(f, *arrs)
+    if entry is None:
+        # churn guard: a code object that keeps producing fresh keys that
+        # are never REUSED (identity-hashed closure contents) would compile
+        # per call — worse than the raw re-trace it replaces. Demote only
+        # when distinct keys pile up without a matching hit rate, so a hot
+        # polymorphic op (many shapes, each replayed) stays cached.
+        code = key[0]
+        st = _VJP_CODE_STATS.setdefault(code, [0, 0])
+        st[0] += 1
+        if st[0] > _VJP_CODE_MISS_CAP and st[0] > 4 * st[1]:
+            _VJP_RAW_CODES.add(code)
+            return jax.vjp(f, *arrs)
+        if len(_VJP_JIT_CACHE) >= _VJP_CACHE_CAP:
+            _VJP_JIT_CACHE.clear()
+        entry = jax.jit(lambda *a, _f=f: jax.vjp(_f, *a),
+                        static_argnums=static_argnums or None)
+        _VJP_JIT_CACHE[key] = entry
+    else:
+        st = _VJP_CODE_STATS.get(key[0])
+        if st is not None:
+            st[1] += 1
+    try:
+        return entry(*arrs)
+    except Exception:
+        # abstract tracing failed (value-dependent python control flow):
+        # poison this key, run the concrete-trace path
+        _VJP_JIT_CACHE[key] = _VJP_RAW
+        return jax.vjp(f, *arrs)
+
+
 def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **static_kwargs):
     """Dispatch a differentiable op.
 
@@ -482,7 +610,7 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         wrapped = wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
         return _finish(wrapped)
 
-    out, vjp_fn = jax.vjp(f, *arrs)
+    out, vjp_fn = _tape_vjp(f, fn, static_kwargs, arrs)
     _check_nan_inf(name, out)
     leaves, treedef = jax.tree.flatten(out)
     node = GradNode(

@@ -140,7 +140,11 @@ class Engine:
         self._pp_vpp = False
         self._pp_counts = None  # per-stage layer counts (uneven segmentation)
         if self.use_pp:
-            self._check_pp_dropout_free(model)
+            if (self.strategy.pp_schedule or "").lower() in ("1f1b", "vpp"):
+                # gpipe/fthenb thread a per-stage RNG through the schedule
+                # (RNGStatesTracker analog) — only the explicit tick
+                # schedules still require dropout-free models
+                self._check_pp_dropout_free(model)
             # internal pp layout: block params live stacked+chunked under
             # "_blocks.<subkey>", sharded on 'pp' AT REST — no per-step
             # restack, and each device holds only its stages.
@@ -208,17 +212,18 @@ class Engine:
 
     @staticmethod
     def _check_pp_dropout_free(model):
-        """The compiled pp schedules run without a per-step RNG (the key
-        would be a closed-over tracer inside shard_map), so a dropout mask
-        would be baked at trace time — reject instead of silently corrupting
-        regularization."""
+        """The explicit 1f1b/vpp tick schedules run without a per-step RNG,
+        so a dropout mask would be baked at trace time — reject instead of
+        silently corrupting regularization. (gpipe/fthenb DO thread a
+        per-stage key — use those to pipeline dropout models.)"""
         from ..nn.layer.common import Dropout, Dropout2D, Dropout3D
         for name, sub in model.named_sublayers(include_self=True):
             if isinstance(sub, (Dropout, Dropout2D, Dropout3D)) and sub.p > 0:
                 raise ValueError(
-                    f"pipeline Engine requires dropout p=0 (found p={sub.p} "
-                    f"at '{name}'): per-step RNG cannot thread through the "
-                    "compiled pp schedule yet")
+                    f"pp_schedule '1f1b'/'vpp' requires dropout p=0 (found "
+                    f"p={sub.p} at '{name}'): the explicit tick schedules "
+                    "cannot thread a per-step RNG yet — use "
+                    "pp_schedule='gpipe' to pipeline dropout models")
 
     # ---------------- placement ----------------
     def _user_spec(self, name, value):
@@ -342,11 +347,27 @@ class Engine:
             lambda v: v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating) else v,
             tree)
 
+    def _cast_inputs(self, inputs):
+        """AMP O2: float inputs follow the params to the compute dtype —
+        mixed f32-input/bf16-weight convs are a dtype error in lax, and the
+        reference's amp_decorate casts inputs the same way."""
+        if self._amp_dtype is None:
+            return inputs
+        dt = self._amp_dtype
+
+        def one(x):
+            v = _as_value(x)
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(dt)
+            return v
+        return tuple(one(x) for x in inputs)
+
     def _call_loss(self, values, inputs, labels, capture_buffers=False):
         """Run model (+ loss) under swapped state. Returns (loss, new_buffers):
         with capture_buffers, stateful buffer updates made during the forward
         (batch-norm running stats) are read back before the swap restores."""
         model, loss = self.model, self.loss
+        inputs = self._cast_inputs(inputs)
         if self._functional:
             return _as_value(loss(values,
                                   *[_as_value(x) for x in inputs],
@@ -474,6 +495,10 @@ class Engine:
                 out = template(Tensor(carry))
             return _as_value(out)
 
+        def apply_block_keyed(carry, bp, k):
+            with _rng.rng_guard(k):
+                return apply_block(carry, bp)
+
         if not uneven:
             def stage_fn(sp, act):
                 def body(carry, bp):
@@ -481,6 +506,19 @@ class Engine:
 
                 body_fn = jax.checkpoint(body) if st.remat else body
                 out, _ = jax.lax.scan(body_fn, act, sp)
+                return out
+
+            def stage_fn_keyed(sp, act, key):
+                # per-layer keys (RNGStatesTracker analog): block i draws
+                # from fold_in(stage_tick_key, i)
+                def body(carry, xs):
+                    i, bp = xs
+                    return apply_block_keyed(carry, bp,
+                                             jax.random.fold_in(key, i)), None
+
+                body_fn = jax.checkpoint(body) if st.remat else body
+                L = jax.tree.leaves(sp)[0].shape[0]
+                out, _ = jax.lax.scan(body_fn, act, (jnp.arange(L), sp))
                 return out
         else:
             # uneven segmentation: stages scan Lmax padded slots and skip
@@ -501,9 +539,28 @@ class Engine:
                                       (jnp.arange(Lmax), sp))
                 return out
 
+            def stage_fn_keyed(sp, act, key):
+                n = counts_arr[jax.lax.axis_index("pp")]
+
+                def body(carry, xs):
+                    slot, bp = xs
+                    y = jax.lax.cond(
+                        slot < n,
+                        lambda c, b: apply_block_keyed(
+                            c, b, jax.random.fold_in(key, slot)),
+                        lambda c, b: c, carry, bp)
+                    return y, None
+
+                body_fn = jax.checkpoint(body) if st.remat else body
+                Lmax = jax.tree.leaves(sp)[0].shape[0]
+                out, _ = jax.lax.scan(body_fn, act,
+                                      (jnp.arange(Lmax), sp))
+                return out
+
         def run_embed(other_vals, buffers, inputs):
             values = dict(other_vals)
             values.update(buffers)
+            inputs = self._cast_inputs(inputs)
             with model._swapped_state(values):
                 act = plan.embed(model, *[Tensor(_as_value(x)) for x in inputs])
             return _as_value(act)
@@ -516,10 +573,17 @@ class Engine:
                                 *[Tensor(_as_value(x)) for x in labels])
             return _as_value(out)
 
-        def pp_loss(p, buffers, inputs, labels):
-            """Forward-only pipelined loss (also the eval path)."""
+        def pp_loss(p, buffers, inputs, labels, key=None):
+            """Forward-only pipelined loss (also the eval path). With a key
+            (gpipe/fthenb), per-stage randomness (dropout) threads through
+            the schedule — embed/head run outside the shard_map under their
+            own fold_in keys."""
             chunked, other = pp_split(self._cast(p))
-            act = run_embed(other, buffers, inputs)
+            if key is not None:
+                with _rng.rng_guard(jax.random.fold_in(key, 1)):
+                    act = run_embed(other, buffers, inputs)
+            else:
+                act = run_embed(other, buffers, inputs)
             B = act.shape[0]
             assert B % M == 0, f"batch {B} % microbatches {M} != 0"
             mbs = act.reshape((M, B // M) + act.shape[1:])
@@ -527,21 +591,31 @@ class Engine:
                 outs = pipeline_apply_interleaved(
                     stage_fn, chunked, mbs, mesh, st.pp_num_chunks, "pp",
                     remat=st.remat)
+            elif key is not None:
+                outs = pipeline_apply(stage_fn_keyed, chunked, mbs, mesh,
+                                      "pp", remat=st.remat,
+                                      key=jax.random.fold_in(key, 0))
             else:
                 outs = pipeline_apply(stage_fn, chunked, mbs, mesh, "pp",
                                       remat=st.remat)
             y = outs.reshape((B,) + outs.shape[2:])
+            if key is not None:
+                with _rng.rng_guard(jax.random.fold_in(key, 2)):
+                    return run_head(other, buffers, y, labels)
             return run_head(other, buffers, y, labels)
 
         def value_and_grad_fn(p, buffers, key, inputs, labels):
-            # compiled schedules can't thread a per-step key: any random
-            # draw (incl. functional dropout) raises instead of baking
+            if sched in ("gpipe", "fthenb"):
+                # per-step key threads through the schedule (per-stage
+                # RNG, the reference RNGStatesTracker capability)
+                loss, grads = jax.value_and_grad(
+                    lambda p_: pp_loss(p_, buffers, inputs, labels,
+                                       key=key))(p)
+                return loss, grads, dict(buffers)
+            # 1f1b/vpp: the explicit tick schedules can't thread a per-step
+            # key yet — any random draw raises instead of baking
             del key
-            with _rng.forbid_rng("the compiled pipeline schedule"):
-                if sched in ("gpipe", "fthenb"):
-                    loss, grads = jax.value_and_grad(
-                        lambda p_: pp_loss(p_, buffers, inputs, labels))(p)
-                    return loss, grads, dict(buffers)
+            with _rng.forbid_rng("the compiled 1f1b/vpp pipeline schedule"):
 
                 # explicit 1F1B / VPP: the head/loss runs INSIDE the pp
                 # shard_map, so model buffers (closed-over tracers there)
@@ -585,8 +659,10 @@ class Engine:
                 return loss, grads, dict(buffers)
 
         def loss_only_fn(p, buffers, key, inputs, labels):
+            if sched in ("gpipe", "fthenb"):
+                return pp_loss(p, buffers, inputs, labels, key=key)
             del key
-            with _rng.forbid_rng("the compiled pipeline schedule"):
+            with _rng.forbid_rng("the compiled 1f1b/vpp pipeline schedule"):
                 return pp_loss(p, buffers, inputs, labels)
 
         return value_and_grad_fn, loss_only_fn

@@ -125,6 +125,30 @@ def _make_elastic(args, node_id: str):
     return mgr, server
 
 
+def _stop_procs(procs, grace: float = 5.0):
+    """Terminate children, escalating to SIGKILL after `grace`.
+
+    Escalation is NOT optional: trainers that ran jax.distributed install a
+    preemption notifier that CATCHES SIGTERM (it's a graceful-shutdown
+    signal to jax), so terminate() alone leaves them running — observed as
+    orphaned trainers holding the coordination-service port and crashing
+    the relaunched world with 'different incarnation' fatals."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.2)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except Exception:
+            pass
+
+
 def launch(argv=None):
     import socket
 
@@ -145,14 +169,28 @@ def launch(argv=None):
     nnodes = args.nnodes
     restarts = 0
     rc = 0
+    procs: list = []
+    stop_sig = {"sig": None}
+
+    def on_term(sig, _frm):
+        # record and let the supervision/wait loops stop the pod AND the
+        # launcher (terminating only children leaves launchers lingering
+        # when children swallow SIGTERM; dying instantly skips _stop_procs)
+        stop_sig["sig"] = sig
+
+    signal.signal(signal.SIGTERM, on_term)
     try:
         while True:
+            if stop_sig["sig"] is not None:  # SIGTERM during a restart path
+                return 128 + int(stop_sig["sig"])
             if mgr is not None:
                 # wait until ≥ min_nodes members are up AND our own heartbeat
                 # is visible with an in-range rank; a node beyond max_np is a
                 # spare and stays in standby until membership changes
                 deadline = time.time() + args.elastic_timeout
                 while True:
+                    if stop_sig["sig"] is not None:
+                        return 128 + int(stop_sig["sig"])
                     mgr.watch()
                     nnodes = max(args.min_nodes, min(mgr.np, args.max_nodes))
                     rank = mgr.rank_of(node_id)
@@ -169,19 +207,20 @@ def launch(argv=None):
                 node_rank = rank
             world = nnodes * args.nproc_per_node
             base = node_rank * args.nproc_per_node
-            procs = [_spawn(args, i, world, base, nnodes)
-                     for i in range(args.nproc_per_node)]
+            # append as we spawn: if _spawn rank k raises, ranks 0..k-1 are
+            # already in `procs` and the finally's _stop_procs reaps them
+            # (a discarded list-comprehension would orphan them)
+            procs.clear()
+            for i in range(args.nproc_per_node):
+                procs.append(_spawn(args, i, world, base, nnodes))
 
-            def kill_all(*_):
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-
-            signal.signal(signal.SIGTERM, kill_all)
             # supervision loop (reference controller.py:87 watch)
             failed = None
             decision = None
             while True:
+                if stop_sig["sig"] is not None:
+                    _stop_procs(procs)
+                    return 128 + int(stop_sig["sig"])
                 alive = 0
                 for p in procs:
                     prc = p.poll()
@@ -190,7 +229,7 @@ def launch(argv=None):
                     elif prc != 0 and failed is None:
                         failed = prc
                 if failed is not None:
-                    kill_all()
+                    _stop_procs(procs)
                     break
                 if alive == 0:
                     return 0
@@ -200,12 +239,12 @@ def launch(argv=None):
                         decision = st
                         print(f"[launch] elastic: membership changed → "
                               f"relaunch at np={mgr.np}", file=sys.stderr)
-                        kill_all()
+                        _stop_procs(procs)
                         break
                     if st is not None and st.value == "error":
                         print("[launch] elastic: below min_np past timeout",
                               file=sys.stderr)
-                        kill_all()
+                        _stop_procs(procs)
                         return 1
                 time.sleep(0.5)
             if decision is not None:
@@ -218,6 +257,7 @@ def launch(argv=None):
                 continue
             return failed or 1
     finally:
+        _stop_procs(procs)  # never orphan trainers past the launcher
         if mgr is not None:
             mgr.stop()
         if server is not None:

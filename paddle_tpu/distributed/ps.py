@@ -20,7 +20,8 @@ import numpy as np
 
 from . import rpc as _rpc
 
-__all__ = ["SparseTable", "PsServer", "PsWorker", "TheOnePSRuntime"]
+__all__ = ["SparseTable", "PsServer", "PsWorker", "TheOnePSRuntime",
+           "CtrAccessor", "CtrSparseTable", "GeoSgdWorker"]
 
 _SERVER: dict = {}  # table name -> SparseTable (in server processes)
 _SERVER_LOCK = threading.Lock()
@@ -147,6 +148,170 @@ class PsWorker:
     def table_size(self, name):
         return sum(_rpc.rpc_sync(s, _srv_size, (name,))
                    for s in self.servers)
+
+
+# ---------------------------------------------------------------- CTR zoo
+class CtrAccessor:
+    """Feature lifecycle policy for CTR tables (reference
+    ps/table/ctr_accessor.cc + sparse_accessor.h): per-row show/click
+    statistics, a score = nonclk_coeff·(show−click) + click_coeff·click,
+    daily time-decay, and threshold eviction (`shrink`)."""
+
+    def __init__(self, nonclk_coeff=0.1, click_coeff=1.0,
+                 show_click_decay_rate=0.98, delete_threshold=0.8,
+                 delete_after_unseen_days=30):
+        self.nonclk_coeff = nonclk_coeff
+        self.click_coeff = click_coeff
+        self.decay = show_click_decay_rate
+        self.delete_threshold = delete_threshold
+        self.delete_after_unseen_days = delete_after_unseen_days
+
+    def score(self, show, click):
+        return self.nonclk_coeff * max(show - click, 0.0) \
+            + self.click_coeff * click
+
+
+class CtrSparseTable(SparseTable):
+    """SparseTable + CTR accessor statistics (reference
+    memory_sparse_table.cc with a CtrCommonAccessor): rows carry
+    (show, click, unseen_days); `update_days` decays statistics and ages
+    rows; `shrink` evicts rows whose score fell below the threshold or
+    that were unseen too long — the knob that keeps a trillion-row CTR
+    table bounded."""
+
+    def __init__(self, name, dim, accessor: CtrAccessor | None = None,
+                 **kw):
+        super().__init__(name, dim, **kw)
+        self.accessor = accessor or CtrAccessor()
+        self._stats: dict = {}  # rid -> [show, click, unseen_days]
+
+    def _row(self, rid):
+        # every materialized row gets a stats entry, so pulled-only /
+        # gradient-only rows age and evict like any other — without this,
+        # rows outside _stats would be immortal and the table unbounded
+        self._stats.setdefault(int(rid), [0.0, 0.0, 0])
+        return super()._row(rid)
+
+    def push_show_click(self, ids, shows, clicks):
+        with self._lock:
+            for i, s, c in zip(ids, shows, clicks):
+                st = self._stats.setdefault(int(i), [0.0, 0.0, 0])
+                st[0] += float(s)
+                st[1] += float(c)
+                st[2] = 0  # seen today
+        return len(ids)
+
+    def update_days(self):
+        """End-of-day tick: decay show/click, age unseen rows."""
+        a = self.accessor
+        with self._lock:
+            for st in self._stats.values():
+                st[0] *= a.decay
+                st[1] *= a.decay
+                st[2] += 1
+
+    def shrink(self):
+        """Evict by score/age; returns evicted row count."""
+        a = self.accessor
+        with self._lock:
+            drop = [rid for rid, st in self._stats.items()
+                    if a.score(st[0], st[1]) < a.delete_threshold
+                    or st[2] >= a.delete_after_unseen_days]
+            for rid in drop:
+                self._stats.pop(rid, None)
+                self._rows.pop(rid, None)
+        return len(drop)
+
+    def stats(self, rid):
+        st = self._stats.get(int(rid))
+        return None if st is None else tuple(st)
+
+
+# ---------------------------------------------------------------- GeoSGD
+class GeoSgdWorker:
+    """Geometric-SGD sync (reference GeoSGD: fleet ps-mode geo strategy,
+    ps/table/sparse_geo_table.cc): workers train on a LOCAL copy and every
+    `geo_step` steps push only the accumulated DELTA (local − base) to the
+    server, then rebase from the server's merged state — trading sync
+    frequency for throughput on sparse CTR workloads."""
+
+    def __init__(self, worker: PsWorker, name, dim, geo_step=10, **kw):
+        self.worker = worker
+        self.name = name
+        self.dim = dim
+        self.geo_step = geo_step
+        worker.create_table(name, dim, **kw)
+        self._local: dict = {}   # rid -> current local row
+        self._base: dict = {}    # rid -> row value at last sync
+        self._step = 0
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        need = [i for i in ids.tolist() if i not in self._local]
+        if need:
+            rows = self.worker.pull(self.name, np.asarray(need))
+            for i, r in zip(need, rows):
+                self._local[i] = r.copy()
+                self._base[i] = r.copy()
+        return np.stack([self._local[int(i)] for i in ids])
+
+    def push(self, ids, grads, lr=0.05):
+        """LOCAL update only; sync happens on the geo_step boundary."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        for i, gi in zip(ids.tolist(), g):
+            self._local[i] = self._local[i] - lr * gi
+        self._step += 1
+        if self._step % self.geo_step == 0:
+            self.sync()
+
+    def sync(self):
+        """Push deltas, rebase from the merged server state."""
+        ids = [i for i in self._local
+               if not np.array_equal(self._local[i], self._base[i])]
+        if ids:
+            arr = np.asarray(ids, np.int64)
+            deltas = np.stack([self._local[i] - self._base[i] for i in ids])
+            n = len(self.worker.servers)
+            for k in range(n):
+                # vectorized modulo sharding (same placement as
+                # PsWorker._shard) — no per-id list scans
+                idx = np.where(arr % n == k)[0]
+                if idx.size == 0:
+                    continue
+                _rpc.rpc_sync(self.worker.servers[k], _srv_push_delta,
+                              (self.name, arr[idx], deltas[idx]))
+        if self._local:
+            allids = np.asarray(sorted(self._local))
+            fresh = self.worker.pull(self.name, allids)
+            for i, r in zip(allids.tolist(), fresh):
+                self._local[i] = r.copy()
+                self._base[i] = r.copy()
+
+
+def _srv_push_delta(name, ids, deltas):
+    t = _SERVER[name]
+    with t._lock:
+        for i, d in zip(ids, deltas):
+            t._rows[int(i)] = t._row(i) + np.asarray(d, np.float32)
+    return len(ids)
+
+
+def _srv_create_ctr(name, dim, init_range, lr, seed):
+    with _SERVER_LOCK:
+        if name not in _SERVER:
+            _SERVER[name] = CtrSparseTable(name, dim, init_range=init_range,
+                                           lr=lr, seed=seed)
+    return True
+
+
+def _srv_push_show_click(name, ids, shows, clicks):
+    return _SERVER[name].push_show_click(ids, shows, clicks)
+
+
+def _srv_shrink(name):
+    _SERVER[name].update_days()
+    return _SERVER[name].shrink()
 
 
 class TheOnePSRuntime:

@@ -26,7 +26,9 @@ from ..core.tensor import Tensor
 from .train_step import TrainStep  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "StaticFunction",
-           "TrainStep", "save", "load", "enable_to_static"]
+           "TrainStep", "save", "load", "enable_to_static", "sot"]
+
+from . import sot  # noqa: E402,F401  (the bytecode frontend package)
 
 _to_static_enabled = True
 
@@ -37,10 +39,25 @@ def enable_to_static(flag: bool):
 
 
 class StaticFunction:
+    """backend: None (AST dy2static + jax.jit trace, the default) or
+    "sot" — the bytecode frontend (jit.sot.symbolic_translate): symbolic
+    opcode interpretation with guards + executor cache; graph breaks fall
+    back to eager per call site. Both frontends ship, as the reference's
+    do (jit/sot + jit/dy2static)."""
+
     def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
                  full_graph=False, donate_args=()):
         from ..nn import Layer
         from . import dy2static
+
+        if backend is not None and str(backend).lower() == "sot":
+            fn = function.forward if isinstance(function, Layer) else function
+            from .sot import symbolic_translate
+            self._sot = symbolic_translate(fn)
+            self._eager_fn = fn
+            functools.update_wrapper(self, fn)
+            return  # the AST path is never consulted for sot — don't build it
+        self._sot = None
 
         self._layer = None
         if isinstance(function, Layer):
@@ -93,6 +110,8 @@ class StaticFunction:
 
         if not _to_static_enabled:
             return self._eager_fn(*args, **kwargs)
+        if self._sot is not None:
+            return self._sot(*args, **kwargs)
         # the signature key is only needed once a break exists — don't pay
         # the tree-flatten + repr on every hot-loop call
         if self._broken_sigs and self._sig_key(args, kwargs) in self._broken_sigs:

@@ -1,0 +1,95 @@
+"""Guard system (reference jit/sot/opcode_translator/executor/guard.py).
+
+A guard is a predicate over the CALL ARGUMENTS that must hold for a cached
+compiled entry to be reused. The translator emits guards for every
+input-derived decision it resolved concretely:
+
+  * TENSOR args    → (is Tensor/array, shape, dtype) — covers every branch
+    taken on `x.shape`/`x.dtype`/`x.ndim` (the full shape is pinned);
+  * non-tensor args → type + equality (a different int/str/bool/None
+    retranslates);
+  * globals the trace CALLED → identity (monkeypatching a called function
+    invalidates the entry).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+__all__ = ["Guard", "GuardSet", "tensor_meta"]
+
+
+def tensor_meta(v):
+    """(shape, dtype) of a Tensor/jax array, else None."""
+    from ...core.tensor import Tensor
+    if isinstance(v, Tensor):
+        v = v._value
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return tuple(v.shape), str(v.dtype)
+    return None
+
+
+@dataclass(frozen=True)
+class Guard:
+    kind: str        # "tensor" | "value" | "global_id"
+    path: tuple      # ("arg", i) / ("kwarg", name) / ("global", name)
+    expect: Any
+
+    def check(self, args, kwargs, globals_ns) -> bool:
+        if self.kind == "global_id":
+            name = self.path[1]
+            got = globals_ns.get(name, _MISSING)
+            return got is not _MISSING and id(got) == self.expect
+        where, key = self.path
+        try:
+            v = args[key] if where == "arg" else kwargs[key]
+        except (IndexError, KeyError):
+            return False
+        if self.kind == "tensor":
+            return tensor_meta(v) == self.expect
+        # value guard: type identity + equality (bool-vs-int safe)
+        et, ev = self.expect
+        if type(v) is not et:
+            return False
+        try:
+            return bool(v == ev)
+        except Exception:
+            return v is ev
+
+    def describe(self) -> str:
+        return f"{self.kind}@{'.'.join(map(str, self.path))}=={self.expect!r}"
+
+
+_MISSING = object()
+
+
+class GuardSet:
+    """The conjunction of guards for one cache entry."""
+
+    def __init__(self):
+        self._guards: dict = {}
+
+    def add_tensor(self, path, v):
+        self._guards.setdefault(("tensor", path),
+                                Guard("tensor", path, tensor_meta(v)))
+
+    def add_value(self, path, v):
+        self._guards.setdefault(("value", path),
+                                Guard("value", path, (type(v), v)))
+
+    def add_global(self, name, v):
+        self._guards.setdefault(("global", name),
+                                Guard("global_id", ("global", name), id(v)))
+
+    def guards(self):
+        return list(self._guards.values())
+
+    def check(self, args, kwargs, globals_ns) -> bool:
+        return all(g.check(args, kwargs, globals_ns)
+                   for g in self._guards.values())
+
+    def __len__(self):
+        return len(self._guards)
+
+    def describe(self):
+        return [g.describe() for g in self._guards.values()]

@@ -1,0 +1,499 @@
+"""Symbolic bytecode interpreter + FunctionGraph (reference:
+jit/sot/opcode_translator/executor/opcode_executor.py and
+executor/function_graph.py).
+
+`OpcodeExecutor` walks a function's CPython 3.12 bytecode with a shadow
+stack of `Var`s. A `Var` carries the CONCRETE value (from the example
+call) plus an optional graph `ref` marking it as derived from tensor
+inputs. Ops touching tracked vars are recorded into the `FunctionGraph`
+and executed concretely (so shapes/dtypes and python control flow resolve
+at translate time — loops over concrete iterables UNROLL, exactly like
+the reference's executor). Ops on pure-python values just run.
+
+A conditional jump whose predicate is a TRACKED value (a tensor's truth
+value) cannot be resolved symbolically → `GraphBreakError`, and the
+caller falls back to eager — the reference's graph-break semantics.
+"""
+from __future__ import annotations
+
+import dis
+import operator
+import types
+from typing import Any
+
+import jax
+
+from ...core.tensor import Tensor
+from .guards import GuardSet
+
+__all__ = ["OpcodeExecutor", "FunctionGraph", "GraphBreakError", "Var"]
+
+
+class GraphBreakError(Exception):
+    """Bytecode the symbolic executor cannot stay symbolic through."""
+
+
+_NULL = object()        # CPython's PUSH_NULL marker
+_MISSING = object()
+
+
+def _is_tensorish(v) -> bool:
+    return isinstance(v, Tensor) or isinstance(v, jax.Array)
+
+
+def _contains_tensor(v) -> bool:
+    if _is_tensorish(v):
+        return True
+    if isinstance(v, (tuple, list)):
+        return any(_contains_tensor(x) for x in v)
+    return False
+
+
+class Var:
+    """value: concrete example value; ref: graph provenance or None
+    (pure python, reproducible from guarded inputs)."""
+
+    __slots__ = ("value", "ref")
+
+    def __init__(self, value, ref=None):
+        self.value = value
+        self.ref = ref
+
+    @property
+    def tracked(self):
+        return self.ref is not None
+
+    def __repr__(self):
+        return f"Var({type(self.value).__name__}, ref={self.ref})"
+
+
+class FunctionGraph:
+    """Straight-line record of tensor ops: node = (callable, arg_refs,
+    kwarg_refs). A ref is ("in", i) | ("node", j) | ("const", v)."""
+
+    def __init__(self):
+        self.nodes: list = []
+
+    def add(self, fn, arg_refs, kwarg_refs) -> int:
+        self.nodes.append((fn, tuple(arg_refs), tuple(kwarg_refs.items())))
+        return len(self.nodes) - 1
+
+    def replay(self, inputs):
+        """inputs: list of Tensors. Returns the per-node outputs."""
+        outs = []
+
+        def mat(ref):
+            kind, x = ref
+            if kind == "in":
+                return inputs[x]
+            if kind == "node":
+                return outs[x]
+            if kind == "tuple":
+                return tuple(mat(r) for r in x)
+            return x  # const
+
+        for fn, arg_refs, kw_items in self.nodes:
+            args = [mat(r) for r in arg_refs]
+            kwargs = {k: mat(r) for k, r in kw_items}
+            outs.append(fn(*args, **kwargs))
+        return outs
+
+    def __len__(self):
+        return len(self.nodes)
+
+
+def _call_method(name):
+    def call(self_, *a, **k):
+        return getattr(self_, name)(*a, **k)
+    call.__name__ = f"method_{name}"
+    return call
+
+
+def _get_attr(name):
+    def get(o):
+        return getattr(o, name)
+    get.__name__ = f"attr_{name}"
+    return get
+
+
+_BINOPS = {
+    "+": operator.add, "-": operator.sub, "*": operator.mul,
+    "/": operator.truediv, "//": operator.floordiv, "%": operator.mod,
+    "@": operator.matmul, "**": operator.pow, "&": operator.and_,
+    "|": operator.or_, "^": operator.xor, "<<": operator.lshift,
+    ">>": operator.rshift,
+    # in-place variants resolve to the same functional ops under trace
+    "+=": operator.add, "-=": operator.sub, "*=": operator.mul,
+    "/=": operator.truediv, "//=": operator.floordiv, "%=": operator.mod,
+    "@=": operator.matmul, "**=": operator.pow, "&=": operator.and_,
+    "|=": operator.or_, "^=": operator.xor, "<<=": operator.lshift,
+    ">>=": operator.rshift,
+}
+
+_CMPOPS = {"<": operator.lt, "<=": operator.le, "==": operator.eq,
+           "!=": operator.ne, ">": operator.gt, ">=": operator.ge}
+
+# builtins that stay CONCRETE even on tensor args (their results are pinned
+# by the tensor shape/dtype guards)
+_CONCRETE_BUILTINS = {len, isinstance, type, id, repr, str, hash}
+
+
+class OpcodeExecutor:
+    """One symbolic pass over `fn`'s bytecode with example (args, kwargs).
+
+    Produces (graph, out_ref, guards). Raises GraphBreakError when the
+    bytecode leaves the supported symbolic subset.
+    """
+
+    MAX_STEPS = 100_000  # unrolled-loop safety net
+
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.code = fn.__code__
+        self.globals_ns = fn.__globals__
+        self.guards = GuardSet()
+        self.graph = FunctionGraph()
+        self.stack: list[Var] = []
+        self.locals: dict[str, Var] = {}
+        self.kw_names: tuple = ()
+        self._bind(args, kwargs)
+
+    # ---------------- setup ----------------
+    def _bind(self, args, kwargs):
+        code = self.code
+        if code.co_flags & 0x08:  # **kwargs — out of scope
+            raise GraphBreakError("**kwargs signature")
+        names = code.co_varnames[:code.co_argcount]
+        defaults = self.fn.__defaults__ or ()
+        bound = {}
+        for i, name in enumerate(names):
+            if i < len(args):
+                bound[name] = ("arg", i, args[i])
+            elif name in kwargs:
+                bound[name] = ("kwarg", name, kwargs[name])
+            else:
+                d = len(names) - len(defaults)
+                if i >= d:
+                    bound[name] = (None, None, defaults[i - d])
+                else:
+                    raise GraphBreakError(f"missing argument {name!r}")
+        extra = set(kwargs) - set(names)
+        if extra:
+            raise GraphBreakError(f"unexpected kwargs {sorted(extra)}")
+        if code.co_flags & 0x04:  # *args
+            star = code.co_varnames[code.co_argcount]
+            rest = args[code.co_argcount:]
+            if _contains_tensor(rest):
+                raise GraphBreakError("tensors in *args")
+            bound[star] = (None, None, tuple(rest))
+
+        self.n_tensor_inputs = 0
+        self.tensor_input_paths = []
+        for name, (where, key, v) in bound.items():
+            if _is_tensorish(v):
+                idx = self.n_tensor_inputs
+                self.n_tensor_inputs += 1
+                self.tensor_input_paths.append((where, key))
+                if where is not None:
+                    self.guards.add_tensor((where, key), v)
+                self.locals[name] = Var(v, ("in", idx))
+            else:
+                if where is not None:
+                    if _contains_tensor(v):
+                        raise GraphBreakError(
+                            f"tensor nested inside argument {name!r}")
+                    self.guards.add_value((where, key), v)
+                self.locals[name] = Var(v)
+
+    # ---------------- ref helpers ----------------
+    def _ref_of(self, var: Var):
+        if var.ref is not None:
+            return var.ref
+        if _contains_tensor(var.value):
+            raise GraphBreakError("untracked tensor value (external state)")
+        return ("const", var.value)
+
+    def _record(self, fn, arg_vars, kwarg_vars, out_value):
+        refs = [self._ref_of(v) for v in arg_vars]
+        krefs = {k: self._ref_of(v) for k, v in kwarg_vars.items()}
+        j = self.graph.add(fn, refs, krefs)
+        return Var(out_value, ("node", j))
+
+    # ---------------- main loop ----------------
+    def run(self):
+        instrs = list(dis.get_instructions(self.code))
+        by_offset = {i.offset: n for n, i in enumerate(instrs)}
+        pc = 0
+        steps = 0
+        push, pop = self.stack.append, self.stack.pop
+        while True:
+            steps += 1
+            if steps > self.MAX_STEPS:
+                raise GraphBreakError("unrolled-loop budget exceeded")
+            ins = instrs[pc]
+            op, arg = ins.opname, ins.argval
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL",
+                      "MAKE_CELL", "COPY_FREE_VARS"):
+                pass
+            elif op == "POP_TOP":
+                pop()
+            elif op == "PUSH_NULL":
+                push(Var(_NULL))
+            elif op in ("LOAD_CONST",):
+                push(Var(arg))
+            elif op == "RETURN_CONST":
+                return self._finish(Var(arg))
+            elif op == "LOAD_FAST" or op == "LOAD_FAST_CHECK":
+                if arg not in self.locals:
+                    raise GraphBreakError(f"unbound local {arg!r}")
+                push(self.locals[arg])
+            elif op == "LOAD_FAST_AND_CLEAR":
+                push(self.locals.get(arg, Var(_MISSING)))
+                self.locals.pop(arg, None)
+            elif op == "STORE_FAST":
+                self.locals[arg] = pop()
+            elif op == "DELETE_FAST":
+                self.locals.pop(arg, None)
+            elif op == "LOAD_GLOBAL":
+                # 3.12: oparg low bit → also push NULL before the global
+                if ins.arg & 1:
+                    push(Var(_NULL))
+                v = self.globals_ns.get(arg, _MISSING)
+                if v is _MISSING:
+                    import builtins
+                    v = getattr(builtins, arg, _MISSING)
+                    if v is _MISSING:
+                        raise GraphBreakError(f"unresolved global {arg!r}")
+                else:
+                    self.guards.add_global(arg, v)
+                push(Var(v))
+            elif op == "LOAD_DEREF":
+                try:
+                    cell = dict(zip(
+                        self.code.co_freevars,
+                        [c.cell_contents
+                         for c in (self.fn.__closure__ or ())]))[arg]
+                except (KeyError, ValueError):
+                    raise GraphBreakError(f"unresolved closure cell {arg!r}")
+                if _contains_tensor(cell):
+                    raise GraphBreakError("tensor captured in closure")
+                push(Var(cell))
+            elif op == "LOAD_ATTR":
+                o = pop()
+                is_method = bool(ins.arg & 1)
+                concrete = getattr(o.value, arg)
+                if is_method and callable(concrete) \
+                        and not isinstance(concrete, type):
+                    push(Var(("method", o, arg)))
+                    push(o)
+                elif o.tracked and _contains_tensor(concrete):
+                    push(self._record(_get_attr(arg), [o], {}, concrete))
+                else:
+                    push(Var(concrete))
+            elif op == "BINARY_OP":
+                b, a = pop(), pop()
+                fn = _BINOPS.get(ins.argrepr)
+                if fn is None:
+                    raise GraphBreakError(f"BINARY_OP {ins.argrepr!r}")
+                push(self._apply(fn, [a, b]))
+            elif op == "COMPARE_OP":
+                b, a = pop(), pop()
+                fn = _CMPOPS.get(ins.argval.rstrip("="))
+                fn = _CMPOPS.get(ins.argval, fn)
+                if fn is None:
+                    raise GraphBreakError(f"COMPARE_OP {ins.argval!r}")
+                push(self._apply(fn, [a, b]))
+            elif op == "IS_OP":
+                b, a = pop(), pop()
+                res = a.value is b.value
+                push(Var(res if not ins.argval else not res))
+            elif op == "CONTAINS_OP":
+                b, a = pop(), pop()
+                if a.tracked or b.tracked:
+                    raise GraphBreakError("membership test on tensor")
+                res = a.value in b.value
+                push(Var(res if not ins.argval else not res))
+            elif op == "UNARY_NEGATIVE":
+                push(self._apply(operator.neg, [pop()]))
+            elif op == "UNARY_INVERT":
+                push(self._apply(operator.invert, [pop()]))
+            elif op == "UNARY_NOT":
+                v = pop()
+                if v.tracked:
+                    raise GraphBreakError("`not` on a tensor value")
+                push(Var(not v.value))
+            elif op == "TO_BOOL":
+                v = self.stack[-1]
+                if v.tracked:
+                    raise GraphBreakError("truth test on a tensor value")
+            elif op == "BINARY_SUBSCR":
+                idx, o = pop(), pop()
+                push(self._apply(operator.getitem, [o, idx]))
+            elif op == "BINARY_SLICE":
+                end, start, o = pop(), pop(), pop()
+                sl = Var(slice(start.value, end.value))
+                push(self._apply(operator.getitem, [o, sl]))
+            elif op in ("STORE_SUBSCR", "STORE_ATTR", "STORE_GLOBAL",
+                        "DELETE_SUBSCR", "IMPORT_NAME"):
+                raise GraphBreakError(f"side-effecting opcode {op}")
+            elif op == "BUILD_TUPLE":
+                items = [pop() for _ in range(ins.arg)][::-1]
+                push(self._build_seq(tuple, items))
+            elif op == "BUILD_LIST":
+                items = [pop() for _ in range(ins.arg)][::-1]
+                push(self._build_seq(list, items))
+            elif op == "BUILD_MAP":
+                kv = [pop() for _ in range(2 * ins.arg)][::-1]
+                if any(v.tracked for v in kv):
+                    raise GraphBreakError("tensor inside dict literal")
+                push(Var({kv[i].value: kv[i + 1].value
+                          for i in range(0, len(kv), 2)}))
+            elif op == "LIST_EXTEND":
+                seq = pop()
+                if seq.tracked or _contains_tensor(seq.value):
+                    raise GraphBreakError("tensor in list extend")
+                self.stack[-ins.arg].value.extend(seq.value)
+            elif op == "LIST_APPEND":
+                v = pop()
+                tgt = self.stack[-ins.arg]
+                if v.tracked or tgt.tracked:
+                    raise GraphBreakError("tensor list append in loop")
+                tgt.value.append(v.value)
+            elif op == "UNPACK_SEQUENCE":
+                seq = pop()
+                vals = list(seq.value)
+                if len(vals) != ins.arg:
+                    raise GraphBreakError("unpack arity mismatch")
+                for k in range(len(vals) - 1, -1, -1):
+                    if seq.tracked and _contains_tensor(vals[k]):
+                        push(self._apply(operator.getitem, [seq, Var(k)]))
+                    else:
+                        push(Var(vals[k]))
+            elif op == "GET_ITER":
+                v = pop()
+                if v.tracked:
+                    raise GraphBreakError("iteration over a tensor")
+                push(Var(iter(v.value)))
+            elif op == "FOR_ITER":
+                it = self.stack[-1]
+                try:
+                    nxt = next(it.value)
+                except StopIteration:
+                    pc = by_offset[ins.argval]
+                    ins2 = instrs[pc]
+                    if ins2.opname == "END_FOR":
+                        pop()
+                        pc += 1
+                    continue
+                if _contains_tensor(nxt):
+                    raise GraphBreakError("tensor yielded by iterator")
+                push(Var(nxt))
+            elif op == "END_FOR":
+                pop()
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD",
+                        "JUMP_BACKWARD_NO_INTERRUPT"):
+                pc = by_offset[ins.argval]
+                continue
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                v = pop()
+                if v.tracked:
+                    raise GraphBreakError(
+                        "branch on a tensor value (data-dependent control "
+                        "flow) — use lax.cond or fall back to eager")
+                truth = bool(v.value)
+                if (op.endswith("TRUE")) == truth:
+                    pc = by_offset[ins.argval]
+                    continue
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                v = pop()
+                is_none = v.value is None and not v.tracked
+                if (op.endswith("_NONE") and not op.endswith("NOT_NONE")) \
+                        == is_none:
+                    pc = by_offset[ins.argval]
+                    continue
+            elif op == "SWAP":
+                i = ins.arg
+                self.stack[-i], self.stack[-1] = \
+                    self.stack[-1], self.stack[-i]
+            elif op == "COPY":
+                push(self.stack[-ins.arg])
+            elif op == "KW_NAMES":
+                self.kw_names = arg
+            elif op == "CALL":
+                argc = ins.arg
+                kwn = self.kw_names
+                self.kw_names = ()
+                args_v = [pop() for _ in range(argc)][::-1]
+                x2 = pop()
+                x1 = pop()
+                kwargs_v = {}
+                if kwn:
+                    for name in reversed(kwn):
+                        kwargs_v[name] = args_v.pop()
+                    kwargs_v = dict(reversed(list(kwargs_v.items())))
+                if x1.value is _NULL:
+                    push(self._call(x2, args_v, kwargs_v))
+                elif isinstance(x1.value, tuple) \
+                        and len(x1.value) == 3 and x1.value[0] == "method":
+                    _, self_var, name = x1.value
+                    push(self._call_method_var(self_var, name, args_v,
+                                               kwargs_v))
+                else:
+                    push(self._call(x1, [x2] + args_v, kwargs_v))
+            elif op == "CALL_FUNCTION_EX":
+                raise GraphBreakError("CALL_FUNCTION_EX (*args call)")
+            elif op == "RETURN_VALUE":
+                return self._finish(pop())
+            elif op in ("MAKE_FUNCTION", "SETUP_ANNOTATIONS", "YIELD_VALUE",
+                        "RAISE_VARARGS", "SETUP_FINALLY", "BEFORE_WITH",
+                        "RERAISE", "PUSH_EXC_INFO", "LOAD_BUILD_CLASS"):
+                raise GraphBreakError(f"unsupported opcode {op}")
+            else:
+                raise GraphBreakError(f"unknown opcode {op}")
+            pc += 1
+
+    # ---------------- call/op plumbing ----------------
+    def _build_seq(self, ctor, items):
+        if any(v.tracked for v in items):
+            refs = tuple(self._ref_of(v) for v in items)
+            return Var(ctor(v.value for v in items), ("tuple", refs))
+        return Var(ctor(v.value for v in items))
+
+    def _apply(self, fn, arg_vars, kwarg_vars=None):
+        kwarg_vars = kwarg_vars or {}
+        tracked = any(v.tracked for v in arg_vars) \
+            or any(v.tracked for v in kwarg_vars.values())
+        args = [v.value for v in arg_vars]
+        kwargs = {k: v.value for k, v in kwarg_vars.items()}
+        try:
+            out = fn(*args, **kwargs)
+        except Exception as e:
+            raise GraphBreakError(f"concrete eval failed: {e}") from e
+        if tracked and _contains_tensor(out):
+            return self._record(fn, arg_vars, kwarg_vars, out)
+        if tracked and _is_tensorish(args[0] if args else None) \
+                and isinstance(out, (bool,)):
+            raise GraphBreakError("python bool from tensor op")
+        return Var(out)
+
+    def _call(self, fn_var, arg_vars, kwarg_vars):
+        fn = fn_var.value
+        if fn_var.tracked:
+            raise GraphBreakError("calling a traced value")
+        if not callable(fn):
+            raise GraphBreakError(f"calling non-callable {type(fn)}")
+        if fn in _CONCRETE_BUILTINS:
+            args = [v.value for v in arg_vars]
+            return Var(fn(*args))
+        return self._apply(fn, arg_vars, kwarg_vars)
+
+    def _call_method_var(self, self_var, name, arg_vars, kwarg_vars):
+        if self_var.tracked:
+            return self._apply(_call_method(name), [self_var] + arg_vars,
+                               kwarg_vars)
+        bound = getattr(self_var.value, name)
+        return self._apply(bound, arg_vars, kwarg_vars)
+
+    # ---------------- output ----------------
+    def _finish(self, out_var: Var):
+        return self.graph, self._ref_of(out_var), self.guards

@@ -52,30 +52,42 @@ __all__ = ["pipeline_apply", "pipeline_train_1f1b", "pipeline_apply_interleaved"
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: ProcessMesh,
-                   pp_axis: str = "pp", remat: bool = True):
+                   pp_axis: str = "pp", remat: bool = True, key=None):
     """Run the stage-stacked pipeline.
 
     stage_fn(params_of_one_stage, x) -> y with y.shape == x.shape (a
     transformer trunk). stacked_params: pytree, leaves [S, ...] (stage-major),
     ideally already sharded on the pp axis. microbatches: [M, mb, ...].
     Returns [M, mb, ...] outputs (last stage's results, replicated over pp).
+
+    key: optional PRNG key threading per-stage randomness (dropout) through
+    the schedule — the TPU analog of the reference's RNGStatesTracker
+    (fleet/layers/mpu/random.py): each (stage, tick) gets a distinct
+    fold_in-derived key, and stage_fn must then accept (params, x, key).
+    The backward (jax.grad through this function) replays the same keys, so
+    fwd/bwd dropout masks agree by construction.
     """
     jm = mesh.jax_mesh
     S = mesh.get_dim_size(pp_axis)
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    keyed = key is not None
 
-    def local_fn(params_local, mbs):
+    def local_fn(params_local, mbs, *maybe_key):
         params1 = jax.tree.map(lambda p: p[0], params_local)
         idx = jax.lax.axis_index(pp_axis)
         M = mbs.shape[0]
         T = M + S - 1
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        stage_key = jax.random.fold_in(maybe_key[0], idx) if keyed else None
 
         def body(carry, t):
             state, out_acc = carry
             mb_in = jnp.take(mbs, jnp.clip(t, 0, M - 1), axis=0)
             inp = jnp.where(idx == 0, mb_in, state)
-            y = fn(params1, inp)
+            if keyed:
+                y = fn(params1, inp, jax.random.fold_in(stage_key, t))
+            else:
+                y = fn(params1, inp)
             nxt = jax.lax.ppermute(y, pp_axis, fwd_perm)
             mb_idx = t - (S - 1)
             slot = jnp.clip(mb_idx, 0, M - 1)
@@ -94,14 +106,19 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: Proce
         return outs
 
     in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params), P())
+    operands = (stacked_params, microbatches)
+    if keyed:
+        in_specs = in_specs + (P(),)
+        operands = operands + (key,)
     shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
                              axis_names=frozenset({pp_axis}), check_vma=False)
-    return shmapped(stacked_params, microbatches)
+    return shmapped(*operands)
 
 
 def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
                         loss_params, microbatches, labels, mesh: ProcessMesh,
-                        pp_axis: str = "pp", remat: bool = False):
+                        pp_axis: str = "pp", remat: bool = False,
+                        split_wgrad: bool = False):
     """Explicit compiled 1F1B schedule: loss + grads in one scan.
 
     remat defaults to False: the schedule already rebuilds each stage's vjp
@@ -151,6 +168,20 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
 
         def mid_tick(p, x_in, x_saved, dy_in):
             y = fn(p, x_in)
+            if split_wgrad:
+                # ZBH1-decomposition probe (benchmarks/pp_schedules.py):
+                # dgrad (dx, unblocks the upstream stage) and wgrad (dp)
+                # as SEPARATE transpose passes, with wgrad data-dependent
+                # on dgrad so XLA cannot co-schedule them — the explicit
+                # B/W split zero-bubble schedules perform. The fused tick
+                # below computes both in one transpose pass; comparing the
+                # two measures whether a split could ever pay here.
+                _, pull_x = jax.vjp(lambda x_: fn(p, x_), x_saved)
+                (dx,) = pull_x(dy_in)
+                dy_w, _ = jax.lax.optimization_barrier((dy_in, dx))
+                _, pull_p = jax.vjp(lambda p_: fn(p_, x_saved), p)
+                (dp,) = pull_p(dy_w)
+                return y, jnp.zeros((), jnp.float32), dp, dx, zero_lp_grad
             _, pull = jax.vjp(lambda p_, x_: fn(p_, x_), p, x_saved)
             dp, dx = pull(dy_in)
             return y, jnp.zeros((), jnp.float32), dp, dx, zero_lp_grad

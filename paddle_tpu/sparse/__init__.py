@@ -114,7 +114,16 @@ def to_sparse_csr(x, name=None):
 
 def values(x, name=None):
     """Reference sparse_ops.yaml `values` op (function form of .values())."""
-    return x.values() if isinstance(x, SparseTensor) else Tensor(x)
+    return x.values() if hasattr(x, "values") else Tensor(x)
+
+
+def indices(x, name=None):
+    """Reference sparse_ops.yaml `indices` op (function form of
+    .indices()); CSR inputs report their COO-equivalent indices."""
+    from .csr import CsrTensor
+    if isinstance(x, CsrTensor):
+        return x.to_sparse_coo().indices()
+    return x.indices()
 
 
 def divide_scalar(x, scalar, name=None):
@@ -163,7 +172,8 @@ def conv3d_implicit_gemm(x, kernel, bias=None, stride=1, padding=0,
     return out
 
 
-__all__ += ["to_sparse_coo", "to_sparse_csr", "values", "divide_scalar",
+__all__ += ["to_sparse_coo", "to_sparse_csr", "values", "indices",
+            "divide_scalar",
             "batch_norm_", "conv3d_implicit_gemm"]
 
 

@@ -1,0 +1,89 @@
+"""Trainer for the elastic end-to-end drill (VERDICT r2 next #6).
+
+Phase 1 (world==2): both ranks rendezvous, build a sharded parameter, run 3
+"steps" (param += 1), save the sharded checkpoint, touch a PHASE1_DONE
+marker, then idle — until the harness kills node 1's launcher and node 0's
+launcher relaunches this script at world=1.
+
+Phase 2 (world==1): single process loads the 2-shard checkpoint into one
+process (cross-topology resume), asserts the trained values and step count,
+prints ELASTIC_RESUMED, exits 0 — letting the launcher finish cleanly.
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.core.tensor import Tensor
+
+ROWS, COLS, STEPS = 4, 3, 3
+
+
+def main():
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ckpt = os.environ["ELASTIC_CKPT"]
+    marker = os.environ["ELASTIC_MARKER"]
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    mesh = dist.get_mesh()
+    jm = mesh.jax_mesh
+
+    if world == 2:
+        full = np.zeros((ROWS, COLS), np.float32)
+        sharding = NamedSharding(jm, P("world"))
+        arr = jax.make_array_from_callback(full.shape, sharding,
+                                           lambda idx: full[idx])
+        t = Tensor(arr)
+        t._dist = (mesh, [dist.Shard(0)])
+        for _ in range(STEPS):  # "training": param += 1 per step
+            t._value = t._value + 1.0
+        os.makedirs(ckpt, exist_ok=True)
+        dist.checkpoint.save_state_dict({"w": t}, ckpt, unique_id=0)
+        if rank == 0:
+            with open(os.path.join(ckpt, "step.json"), "w") as f:
+                json.dump({"step": STEPS}, f)
+        with open(marker + f".r{rank}", "w") as f:
+            f.write("done")
+        print(f"rank {rank}: PHASE1_SAVED world=2", flush=True)
+        # idle until the drill kills us (launcher SIGTERMs on membership
+        # change); cap so an undisturbed run can't hang the suite forever
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            time.sleep(0.5)
+        return 1  # should never exit this way during the drill
+
+    # world == 1: either a startup race (the launcher saw only itself
+    # before the peer registered — idle; the membership change will
+    # relaunch us at world=2) or the post-drill relaunch (ckpt exists →
+    # cross-topology resume, 2 shards → 1 proc).
+    meta = os.path.join(ckpt, "0_metadata.json")
+    if not os.path.exists(meta):
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            time.sleep(0.5)
+        return 1  # never relaunched — drill broken
+
+    sharding = NamedSharding(jm, P())
+    tgt = Tensor(jax.make_array_from_callback(
+        (ROWS, COLS), sharding, lambda idx: np.zeros((ROWS, COLS),
+                                                     np.float32)[idx]))
+    dist.checkpoint.load_state_dict({"w": tgt}, ckpt)
+    got = np.asarray(tgt._value.addressable_shards[0].data)
+    np.testing.assert_allclose(got, np.full((ROWS, COLS), float(STEPS)))
+    with open(os.path.join(ckpt, "step.json")) as f:
+        assert json.load(f)["step"] == STEPS
+    print(f"rank {rank}: ELASTIC_RESUMED step={STEPS} world=1", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

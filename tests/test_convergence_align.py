@@ -1,0 +1,89 @@
+"""Convergence + acc-align on FRESH batches (VERDICT r2 next #3).
+
+Two properties the r2 bench (one memorized batch) could not establish:
+ 1. the model LEARNS structure it has never seen verbatim — loss on a
+    Zipf-Markov stream falls toward the corpus's bigram entropy, with a
+    fresh batch every step;
+ 2. acc-align (reference semi_auto_llama_acc_align.py pattern): the eager
+    tape path and the jitted train step produce the SAME loss trajectory
+    from the same init/data — the compiled graph computes what eager does.
+"""
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.io.token_loader import (TokenDataLoader, synthetic_corpus,
+                                        write_token_file)
+from paddle_tpu.models import LlamaConfig, LlamaTrainStep
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss
+from paddle_tpu.optimizer import AdamW
+
+V, B, T = 128, 8, 64
+
+
+@pytest.fixture(scope="module")
+def corpus_file():
+    corpus = synthetic_corpus(200_000, vocab_size=V, seed=3)
+    f = tempfile.NamedTemporaryFile(suffix=".tok", delete=False)
+    write_token_file(f.name, corpus)
+    yield f.name
+    os.unlink(f.name)
+
+
+def _cfg():
+    return LlamaConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=T, dtype=jnp.float32)
+
+
+def test_loss_falls_on_fresh_batches(corpus_file):
+    loader = TokenDataLoader(corpus_file, batch_size=B, seq_len=T, seed=11)
+    step = LlamaTrainStep(_cfg(), mesh=None, remat=False,
+                          optimizer=AdamW(learning_rate=3e-3))
+    losses = []
+    for _ in range(60):
+        toks, labels = next(loader)  # never the same batch twice
+        losses.append(float(jax.device_get(step(toks, labels))))
+    loader.close()
+    # start ≈ uniform entropy log(128)=4.85; must drop well below it on
+    # UNSEEN batches — only possible by learning the transition structure
+    assert losses[0] > 4.0, losses[0]
+    tail = float(np.mean(losses[-5:]))
+    assert tail < losses[0] - 1.0, (losses[0], tail)
+
+
+def test_acc_align_eager_vs_jit(corpus_file):
+    """Same init, same data: eager tape trajectory == jitted trajectory."""
+    loader = TokenDataLoader(corpus_file, batch_size=B, seq_len=T, seed=13)
+    batches = [next(loader) for _ in range(5)]
+    loader.close()
+    cfg = _cfg()
+
+    # jitted functional path
+    step = LlamaTrainStep(cfg, mesh=None, remat=False, seed=0,
+                          optimizer=AdamW(learning_rate=1e-3))
+    jit_losses = [float(jax.device_get(step(t, l))) for t, l in batches]
+
+    # eager tape path: same init (seed 0), same optimizer hyperparams
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.llama import llama_init_params
+    init = llama_init_params(cfg, jax.random.PRNGKey(0))
+    for k, p in model._parameters.items():
+        p._value = init[k]
+    opt = AdamW(learning_rate=1e-3,
+                parameters=list(model._parameters.values()))
+    eager_losses = []
+    for toks, labels in batches:
+        loss = model(jnp.asarray(toks), labels=jnp.asarray(labels))
+        eager_losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=2e-3,
+                               atol=2e-3)

@@ -303,3 +303,71 @@ class TestEngineOtherModels:
         l0 = float(eng.step((x, t, ctx), noise))
         l1 = float(eng.step((x, t, ctx), noise))
         assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+class TestPipelineDropout:
+    """gpipe/fthenb thread a per-stage RNG through the schedule (VERDICT r2
+    next #9 — reference RNGStatesTracker capability): the Engine must
+    pipeline a model WITH dropout, train it, and draw fresh masks per step."""
+
+    def test_gpipe_trains_dropout_model(self):
+        pt.seed(41)
+        cfg = GPTConfig.tiny(num_hidden_layers=4,
+                             hidden_dropout_prob=0.2,
+                             attention_probs_dropout_prob=0.0)
+        model = GPTForCausalLM(cfg)
+        mesh = dist.ProcessMesh(np.arange(4), ["pp"])
+        eng = Engine(model, optimizer=AdamW(learning_rate=1e-2), mesh=mesh,
+                     strategy=Strategy(num_microbatches=4,
+                                       pp_schedule="gpipe"))
+        toks, labels = _batch(cfg)
+        losses = [float(eng.step(toks, labels)) for _ in range(6)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_gpipe_dropout_masks_fresh_per_step(self):
+        # with lr=0 params never change: any loss difference across steps
+        # can only come from fresh dropout masks (per-step key)
+        pt.seed(43)
+        cfg = GPTConfig.tiny(num_hidden_layers=4, hidden_dropout_prob=0.3)
+        model = GPTForCausalLM(cfg)
+        mesh = dist.ProcessMesh(np.arange(4), ["pp"])
+        eng = Engine(model, optimizer=SGD(learning_rate=0.0), mesh=mesh,
+                     strategy=Strategy(num_microbatches=2,
+                                       pp_schedule="gpipe"))
+        toks, labels = _batch(cfg)
+        l1 = float(eng.step(toks, labels))
+        l2 = float(eng.step(toks, labels))
+        assert l1 != l2, "dropout mask was baked at trace time"
+
+    def test_gpipe_dropout_uneven_stages(self):
+        # 6 layers on 4 stages → uneven keyed stage path (cond-masked
+        # padded slots must not consume draws or bake masks)
+        pt.seed(45)
+        cfg = GPTConfig.tiny(num_hidden_layers=6, hidden_dropout_prob=0.25)
+        model = GPTForCausalLM(cfg)
+        mesh = dist.ProcessMesh(np.arange(4), ["pp"])
+        eng = Engine(model, optimizer=SGD(learning_rate=0.0), mesh=mesh,
+                     strategy=Strategy(num_microbatches=2,
+                                       pp_schedule="gpipe"))
+        toks, labels = _batch(cfg)
+        l1 = float(eng.step(toks, labels))
+        l2 = float(eng.step(toks, labels))
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l1 != l2, "uneven keyed path baked the dropout mask"
+        # and it trains
+        eng2 = Engine(GPTForCausalLM(cfg),
+                      optimizer=AdamW(learning_rate=1e-2), mesh=mesh,
+                      strategy=Strategy(num_microbatches=2,
+                                        pp_schedule="gpipe"))
+        losses = [float(eng2.step(toks, labels)) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_1f1b_still_rejects_dropout(self):
+        pt.seed(47)
+        cfg = GPTConfig.tiny(num_hidden_layers=4, hidden_dropout_prob=0.1)
+        model = GPTForCausalLM(cfg)
+        mesh = dist.ProcessMesh(np.arange(4), ["pp"])
+        with pytest.raises(ValueError, match="gpipe"):
+            Engine(model, optimizer=SGD(learning_rate=0.1), mesh=mesh,
+                   strategy=Strategy(num_microbatches=4, pp_schedule="1f1b"))

@@ -1,0 +1,171 @@
+"""SOT bytecode frontend (VERDICT r2 missing #2).
+
+Reference: jit/sot — OpcodeExecutor symbolic bytecode interpretation,
+FunctionGraph capture, guards gating executor-cache reuse, graph-break
+fallback. These tests assert each capability on the TPU-native
+re-implementation (paddle_tpu/jit/sot)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit.sot import GraphBreakError, symbolic_translate
+from paddle_tpu.jit.sot.opcode_executor import OpcodeExecutor
+
+
+def _t(a):
+    return pt.to_tensor(np.asarray(a, np.float32))
+
+
+class TestCapture:
+    def test_arith_chain(self):
+        @symbolic_translate
+        def f(x, y):
+            return (x + y) * 2.0 - y / 4.0
+
+        x, y = _t([1.0, 2.0]), _t([4.0, 8.0])
+        out = f(x, y)
+        np.testing.assert_allclose(out.numpy(), (np.array([1, 2.]) + [4, 8.])
+                                   * 2 - np.array([4, 8.]) / 4)
+        assert f.cache_size == 1 and not f.fell_back
+
+    def test_paddle_api_and_methods(self):
+        @symbolic_translate
+        def f(x, w):
+            h = pt.matmul(x, w)
+            return h.sum() + x.mean()
+
+        x, w = _t(np.ones((3, 4))), _t(np.ones((4, 2)))
+        out = f(x, w)
+        np.testing.assert_allclose(float(out.numpy()), 24.0 + 1.0)
+
+    def test_python_loop_unrolls(self):
+        @symbolic_translate
+        def f(x, n):
+            acc = x
+            for i in range(n):
+                acc = acc + float(i)
+            return acc
+
+        out = f(_t([0.0]), 4)
+        np.testing.assert_allclose(out.numpy(), [6.0])
+        assert f.cache_size == 1
+
+    def test_tuple_results_and_unpack(self):
+        @symbolic_translate
+        def f(x):
+            a, b = x * 2.0, x + 1.0
+            return a, b
+
+        a, b = f(_t([3.0]))
+        np.testing.assert_allclose(a.numpy(), [6.0])
+        np.testing.assert_allclose(b.numpy(), [4.0])
+
+    def test_graph_is_replayed_not_baked(self):
+        # same shape, DIFFERENT values must flow through the compiled entry
+        @symbolic_translate
+        def f(x):
+            return x * 3.0
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [3.0])
+        np.testing.assert_allclose(f(_t([5.0])).numpy(), [15.0])
+        assert f.cache_size == 1  # one entry, two value sets
+
+
+class TestGuards:
+    def test_shape_branch_specializes(self):
+        @symbolic_translate
+        def f(x):
+            if x.shape[0] > 2:
+                return x - 1.0
+            return x + 1.0
+
+        big = f(_t([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(big.numpy(), [0.0, 0.0, 0.0])
+        small = f(_t([1.0]))
+        np.testing.assert_allclose(small.numpy(), [2.0])
+        assert f.cache_size == 2  # one specialization per shape decision
+        # replay the first again: guard hit, still correct
+        np.testing.assert_allclose(f(_t([2.0, 2.0, 2.0])).numpy(),
+                                   [1.0, 1.0, 1.0])
+        assert f.cache_size == 2
+
+    def test_dtype_guard(self):
+        @symbolic_translate
+        def f(x):
+            return x * 2
+
+        f(pt.to_tensor(np.ones(2, np.float32)))
+        f(pt.to_tensor(np.ones(2, np.int32)))
+        assert f.cache_size == 2
+
+    def test_python_value_guard(self):
+        @symbolic_translate
+        def f(x, scale):
+            return x * scale
+
+        np.testing.assert_allclose(f(_t([1.0]), 2.0).numpy(), [2.0])
+        np.testing.assert_allclose(f(_t([1.0]), 5.0).numpy(), [5.0])
+        assert f.cache_size == 2  # scale is guarded by value
+
+    def test_global_identity_guard(self):
+        # build a function whose `helper` is a true GLOBAL (exec into a
+        # fresh namespace) so the identity guard covers it
+        glob = {"helper": lambda v: v * 2.0}
+        exec("def body(x):\n    return helper(x)\n", glob)
+        sf = symbolic_translate(glob["body"])
+        out = sf(_t([2.0]))
+        np.testing.assert_allclose(out.numpy(), [4.0])
+        # monkeypatch the global → guard must miss → retranslate
+        glob["helper"] = lambda v: v * 10.0
+        out2 = sf(_t([2.0]))
+        np.testing.assert_allclose(out2.numpy(), [20.0])
+        assert sf.cache_size == 2
+
+
+class TestGraphBreak:
+    def test_tensor_value_branch_falls_back(self):
+        @symbolic_translate
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x
+
+        out = f(_t([1.0, 1.0]))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+        assert f.fell_back  # eager fallback, correct result
+
+    def test_side_effect_opcode_falls_back(self):
+        store = {}
+
+        @symbolic_translate
+        def f(x):
+            store["x"] = 1
+            return x + 1.0
+
+        out = f(_t([1.0]))
+        np.testing.assert_allclose(out.numpy(), [2.0])
+        assert f.fell_back
+        assert store["x"] == 1  # the eager run performed the side effect
+
+    def test_executor_raises_graph_break_directly(self):
+        def f(x):
+            if x.sum() > 0:
+                return x
+            return -x
+
+        ex = OpcodeExecutor(f, (_t([1.0]),), {})
+        with pytest.raises(GraphBreakError):
+            ex.run()
+
+
+class TestToStaticIntegration:
+    def test_backend_sot(self):
+        from paddle_tpu.jit import to_static
+
+        @to_static(backend="sot")
+        def f(x):
+            return x * 2.0 + 1.0
+
+        out = f(_t([1.0, 2.0]))
+        np.testing.assert_allclose(out.numpy(), [3.0, 5.0])
+        assert f._sot is not None and f._sot.cache_size == 1
