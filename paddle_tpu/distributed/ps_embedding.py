@@ -1,0 +1,67 @@
+"""PS-backed embedding layer — the trainer-side integration of the
+parameter server (reference: the ps trainer pass zoo,
+distributed/passes/ps_trainer_pass.py + paddle.static.nn.sparse_embedding:
+the pass rewrites embedding lookups into PS pull ops and grad pushes).
+
+TPU-native: no program rewriting — `PsEmbedding` IS the integration. Its
+forward pulls the touched rows from the sharded host tables (host memory ≫
+HBM: the tables never materialize on-chip); a grad hook on the pulled rows
+pushes the row gradients back, where the server applies its own optimizer
+(SGD on the table). The dense trunk trains on-chip as usual — only the
+sparse edge crosses the host boundary, which is the whole point of the PS
+pattern.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["PsEmbedding", "sparse_embedding"]
+
+
+class PsEmbedding(Layer):
+    """Embedding whose table lives on the parameter servers.
+
+    worker: a `ps.PsWorker` (or any object with the same named-table
+    surface: create_table(name, dim, ...), pull(name, ids) and
+    push(name, ids, grads) — NOT GeoSgdWorker, whose pull/push are bound
+    to one table and skip names). Rows are pulled per batch; the
+    registered grad hook pushes `d rows` which the server folds into the
+    table with ITS optimizer (the reference's table-side
+    accessor/optimizer split).
+    """
+
+    def __init__(self, worker, name, num_embeddings, embedding_dim,
+                 init_range=0.01, lr=0.05):
+        super().__init__()
+        self._worker = worker
+        self._table = name
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        worker.create_table(name, embedding_dim, init_range=init_range,
+                            lr=lr)
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        rows = self._worker.pull(self._table, ids_np)  # [*, dim] f32
+        t = Tensor(rows, stop_gradient=False)
+        worker, table = self._worker, self._table
+
+        def push_grad(g):
+            worker.push(table, ids_np, np.asarray(
+                g._value if isinstance(g, Tensor) else g))
+            return g
+
+        t.register_hook(push_grad)
+        return t
+
+    def table_size(self):
+        return self._worker.table_size(self._table)
+
+
+def sparse_embedding(worker, name, num_embeddings, embedding_dim, **kw):
+    """Functional ctor mirroring paddle.static.nn.sparse_embedding."""
+    return PsEmbedding(worker, name, num_embeddings, embedding_dim, **kw)

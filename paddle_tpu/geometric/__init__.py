@@ -10,7 +10,8 @@ from ..core.engine import apply
 from ..core.tensor import Tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum", "segment_mean",
-           "segment_max", "segment_min", "reindex_graph", "sample_neighbors"]
+           "segment_max", "segment_min", "reindex_graph", "reindex_heter_graph",
+           "sample_neighbors", "weighted_sample_neighbors"]
 
 
 def _num_segments(count, data_len):
@@ -123,6 +124,51 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, nam
     remap = np.vectorize(order.get)
     return (Tensor(jnp.asarray(remap(nb))), Tensor(jnp.asarray(np.asarray(out_nodes))),
             Tensor(jnp.asarray(remap(xa))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reference geometric/reindex.py reindex_heter_graph: reindex a
+    heterogeneous graph — `neighbors`/`count` are LISTS (one per edge
+    type); ids are renumbered over ONE shared node table (x first, then
+    first-seen neighbor order across all types)."""
+    import numpy as np
+    xa = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nbs = [np.asarray(n._value if isinstance(n, Tensor) else n)
+           for n in neighbors]
+    order: dict = {}
+    out_nodes = []
+    for v in np.concatenate([xa] + nbs):
+        if v not in order:
+            order[v] = len(order)
+            out_nodes.append(v)
+    remap = np.vectorize(order.get)
+    reindexed = [Tensor(jnp.asarray(remap(nb) if nb.size else nb))
+                 for nb in nbs]
+    return (reindexed, Tensor(jnp.asarray(np.asarray(out_nodes))),
+            Tensor(jnp.asarray(remap(xa))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Reference geometric/sampling: weight-biased neighbor sampling —
+    rides the shared op implementation (tensor/ops_ext4.py, Gumbel
+    top-k over edge weights). sample_size=-1 means 'all neighbors'
+    (resolved to the max degree; rows pad with -1 as the op documents)."""
+    import numpy as np
+    if eids is not None or return_eids:
+        raise NotImplementedError(
+            "weighted_sample_neighbors: eids/return_eids are not supported "
+            "on the TPU path (edge ids are not threaded through the "
+            "Gumbel-top-k kernel)")
+    if sample_size is None or sample_size < 0:
+        cp = np.asarray(colptr._value if isinstance(colptr, Tensor)
+                        else colptr)
+        sample_size = int(np.max(np.diff(cp))) if len(cp) > 1 else 1
+    from ..tensor.ops_ext4 import weighted_sample_neighbors as _w
+    return _w(row, colptr, edge_weight, input_nodes,
+              sample_size=sample_size)
 
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,

@@ -43,7 +43,7 @@ def _load_hubconf(repo_dir: str):
     return module
 
 
-def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
     """List callable entrypoints defined in the repo's hubconf.py."""
     _check_source(source)
     module = _load_hubconf(repo_dir)
@@ -51,7 +51,7 @@ def list(repo_dir: str, source: str = "github", force_reload: bool = False):
             if callable(fn) and not name.startswith("_")]
 
 
-def help(repo_dir: str, model: str, source: str = "github", force_reload: bool = False):
+def help(repo_dir: str, model: str, source: str = "local", force_reload: bool = False):
     """Return the docstring of an entrypoint."""
     _check_source(source)
     module = _load_hubconf(repo_dir)
@@ -61,7 +61,7 @@ def help(repo_dir: str, model: str, source: str = "github", force_reload: bool =
     return fn.__doc__
 
 
-def load(repo_dir: str, model: str, source: str = "github", force_reload: bool = False,
+def load(repo_dir: str, model: str, source: str = "local", force_reload: bool = False,
          **kwargs):
     """Instantiate an entrypoint: calls hubconf.<model>(**kwargs)."""
     _check_source(source)

@@ -8,7 +8,9 @@ import numpy as np
 from ...core.tensor import Tensor
 
 __all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
-           "set_excluded_layers", "reset_excluded_layers"]
+           "set_excluded_layers", "reset_excluded_layers", "check_mask_1d",
+           "check_mask_2d", "get_mask_2d_greedy", "check_sparsity",
+           "add_supported_layer"]
 
 _excluded: set = set()
 _masks: dict = {}
@@ -30,6 +32,67 @@ def create_mask(tensor, func_name="mask_1d", n=2, m=4):
     return Tensor(mask.reshape(a.shape).astype(a.dtype))
 
 
+def check_mask_1d(mat, n=2, m=4):
+    """True iff every m consecutive weights keep ≤ n nonzeros (reference
+    asp/utils.py check_mask_1d)."""
+    a = np.asarray(mat._value if isinstance(mat, Tensor) else mat)
+    if a.size % m:
+        return False
+    return bool(((a.reshape(-1, m) != 0).sum(axis=1) <= n).all())
+
+
+def check_mask_2d(mat, n=2, m=4):
+    """True iff every m×m block keeps ≤ n nonzeros per row AND column."""
+    a = np.asarray(mat._value if isinstance(mat, Tensor) else mat)
+    if a.ndim != 2 or a.shape[0] % m or a.shape[1] % m:
+        return False
+    blocks = a.reshape(a.shape[0] // m, m, a.shape[1] // m, m) \
+        .transpose(0, 2, 1, 3)
+    nz = blocks != 0
+    return bool((nz.sum(axis=3) <= n).all() and (nz.sum(axis=2) <= n).all())
+
+
+def get_mask_2d_greedy(mat, n=2, m=4):
+    """Greedy 2-D n:m mask (reference get_mask_2d_greedy): per m×m block,
+    pick the largest-|w| entries subject to ≤ n per row and per column."""
+    a = np.asarray(mat._value if isinstance(mat, Tensor) else mat)
+    mask = np.zeros_like(a)
+    for bi in range(0, a.shape[0], m):
+        for bj in range(0, a.shape[1], m):
+            blk = np.abs(a[bi:bi + m, bj:bj + m])
+            order = np.dstack(np.unravel_index(
+                np.argsort(-blk, axis=None), blk.shape))[0]
+            rcount = np.zeros(m, int)
+            ccount = np.zeros(m, int)
+            for r, c in order:
+                if rcount[r] < n and ccount[c] < n:
+                    mask[bi + r, bj + c] = 1.0
+                    rcount[r] += 1
+                    ccount[c] += 1
+    return Tensor(mask.astype(a.dtype))
+
+
+def check_sparsity(mat, n=2, m=4, func_name="mask_1d"):
+    """Dispatch to the matching pattern checker (reference check_sparsity)."""
+    if "2d" in func_name:
+        return check_mask_2d(mat, n, m)
+    return check_mask_1d(mat, n, m)
+
+
+# layer types prune_model considers (reference supported_layer_list:
+# Linear/Conv by default; add_supported_layer extends it)
+_DEFAULT_SUPPORTED = {"Linear", "Conv1D", "Conv2D", "Conv3D"}
+_supported_layer_types: set = set(_DEFAULT_SUPPORTED)
+
+
+def add_supported_layer(layer_type):
+    """Register an extra layer type whose weights prune_model may prune
+    (reference supported_layer_list.add_supported_layer)."""
+    _supported_layer_types.add(layer_type if isinstance(layer_type, str)
+                               else getattr(layer_type, "__name__",
+                                            str(layer_type)))
+
+
 def set_excluded_layers(param_names, main_program=None):
     _excluded.update(param_names)
 
@@ -39,11 +102,24 @@ def reset_excluded_layers(main_program=None):
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
-    """Apply 2:4 masks to all eligible weights in place."""
+    """Apply n:m masks to eligible weights in place. Eligible = parameters
+    of SUPPORTED layer types (Linear/Conv by default; extend via
+    add_supported_layer), not excluded, ndim ≥ 2, last dim divisible by m
+    — the reference's supported_layer_list gating."""
+    eligible_params = None
+    if hasattr(model, "named_sublayers"):
+        eligible_params = set()
+        for _, sub in model.named_sublayers(include_self=True):
+            if type(sub).__name__ in _supported_layer_types:
+                eligible_params.update(id(p) for _, p
+                                       in sub.named_parameters())
     for name, p in model.named_parameters():
         if name in _excluded or p.ndim < 2 or p.shape[-1] % m != 0:
             continue
-        mask = create_mask(p, mask_algo, n, m)
+        if eligible_params is not None and id(p) not in eligible_params:
+            continue
+        mask = create_mask(p, mask_algo, n, m) if "2d" not in mask_algo \
+            else get_mask_2d_greedy(p, n, m)
         p.set_value(p._value * mask._value)
         _masks[name] = mask
     return _masks

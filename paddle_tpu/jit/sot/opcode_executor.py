@@ -51,13 +51,16 @@ def _contains_tensor(v) -> bool:
 
 class Var:
     """value: concrete example value; ref: graph provenance or None
-    (pure python, reproducible from guarded inputs)."""
+    (pure python, reproducible from guarded inputs); origin: "arg" marks
+    caller-supplied objects whose MUTATION would be a side effect the
+    cached replay cannot reproduce."""
 
-    __slots__ = ("value", "ref")
+    __slots__ = ("value", "ref", "origin")
 
-    def __init__(self, value, ref=None):
+    def __init__(self, value, ref=None, origin=None):
         self.value = value
         self.ref = ref
+        self.origin = origin
 
     @property
     def tracked(self):
@@ -90,6 +93,8 @@ class FunctionGraph:
                 return outs[x]
             if kind == "tuple":
                 return tuple(mat(r) for r in x)
+            if kind == "list":
+                return [mat(r) for r in x]
             return x  # const
 
         for fn, arg_refs, kw_items in self.nodes:
@@ -203,7 +208,7 @@ class OpcodeExecutor:
                         raise GraphBreakError(
                             f"tensor nested inside argument {name!r}")
                     self.guards.add_value((where, key), v)
-                self.locals[name] = Var(v)
+                self.locals[name] = Var(v, origin="arg")
 
     # ---------------- ref helpers ----------------
     def _ref_of(self, var: Var):
@@ -356,9 +361,7 @@ class OpcodeExecutor:
             elif op == "LIST_APPEND":
                 v = pop()
                 tgt = self.stack[-ins.arg]
-                if v.tracked or tgt.tracked:
-                    raise GraphBreakError("tensor list append in loop")
-                tgt.value.append(v.value)
+                self._list_append(tgt, v)
             elif op == "UNPACK_SEQUENCE":
                 seq = pop()
                 vals = list(seq.value)
@@ -455,9 +458,34 @@ class OpcodeExecutor:
     # ---------------- call/op plumbing ----------------
     def _build_seq(self, ctor, items):
         if any(v.tracked for v in items):
+            if ctor is list:
+                # mutable ref list — LIST_APPEND extends it in place (the
+                # `outs.append(f(x))`-in-a-loop pattern)
+                return Var([v.value for v in items],
+                           ("list", [self._ref_of(v) for v in items]))
             refs = tuple(self._ref_of(v) for v in items)
             return Var(ctor(v.value for v in items), ("tuple", refs))
         return Var(ctor(v.value for v in items))
+
+    def _list_append(self, tgt, v):
+        """Append to a list Var, promoting it to a tracked ("list", refs)
+        container when a tracked element arrives. Only lists CREATED inside
+        the trace are appendable — mutating a caller-supplied list is a
+        side effect the cached replay would not reproduce (and its value
+        guard would either go stale or force a retrace per call)."""
+        if tgt.origin == "arg":
+            raise GraphBreakError(
+                "append to a caller-supplied list (side effect outside the "
+                "graph)")
+        if tgt.tracked and tgt.ref[0] not in ("list",):
+            raise GraphBreakError("append to a non-list tracked value")
+        if v.tracked or tgt.tracked:
+            if not tgt.tracked:  # promote: existing elements become consts
+                if _contains_tensor(tgt.value):
+                    raise GraphBreakError("untracked tensor already in list")
+                tgt.ref = ("list", [("const", e) for e in tgt.value])
+            tgt.ref[1].append(self._ref_of(v))
+        tgt.value.append(v.value)
 
     def _apply(self, fn, arg_vars, kwarg_vars=None):
         kwarg_vars = kwarg_vars or {}
@@ -487,7 +515,19 @@ class OpcodeExecutor:
             return Var(fn(*args))
         return self._apply(fn, arg_vars, kwarg_vars)
 
+    _MUTATING_METHODS = frozenset({
+        "append", "extend", "insert", "pop", "remove", "clear", "sort",
+        "reverse", "update", "setdefault", "popitem", "add", "discard"})
+
     def _call_method_var(self, self_var, name, arg_vars, kwarg_vars):
+        if self_var.origin == "arg" and name in self._MUTATING_METHODS:
+            raise GraphBreakError(
+                f"mutating method .{name}() on a caller-supplied object "
+                "(side effect outside the graph)")
+        if isinstance(self_var.value, list) and name == "append" \
+                and len(arg_vars) == 1 and not kwarg_vars:
+            self._list_append(self_var, arg_vars[0])
+            return Var(None)
         if self_var.tracked:
             return self._apply(_call_method(name), [self_var] + arg_vars,
                                kwarg_vars)

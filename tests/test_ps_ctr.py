@@ -63,7 +63,57 @@ class _LocalWorker(PsWorker):
 
     def pull(self, name, ids):
         from paddle_tpu.distributed import ps as P
-        return P._srv_pull(name, np.asarray(ids).reshape(-1))
+        ids = np.asarray(ids)
+        flat = P._srv_pull(name, ids.reshape(-1))
+        return flat.reshape(tuple(ids.shape) + (-1,))
+
+    def push(self, name, ids, grads):
+        from paddle_tpu.distributed import ps as P
+        ids = np.asarray(ids).reshape(-1)
+        return P._srv_push(name, ids,
+                           np.asarray(grads).reshape(len(ids), -1))
+
+    def table_size(self, name):
+        from paddle_tpu.distributed import ps as P
+        return P._srv_size(name)
+
+
+class TestPsEmbedding:
+    """PS-backed embedding (the trainer-pass integration, D25): forward
+    pulls host-table rows, backward pushes row grads, the SERVER applies
+    its optimizer — the dense trunk never sees the table."""
+
+    def test_train_through_ps_embedding(self):
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.ps_embedding import PsEmbedding
+
+        w = _LocalWorker()
+        emb = PsEmbedding(w, "emb_t", num_embeddings=100, embedding_dim=4,
+                          lr=0.5)
+        ids = np.array([3, 7])
+        from paddle_tpu.distributed import ps as P
+        before = P._srv_pull("emb_t", ids).copy()
+
+        rows = emb(pt.to_tensor(ids))           # pull
+        loss = rows.sum()
+        loss.backward()                         # hook pushes d rows = 1
+
+        after = P._srv_pull("emb_t", ids)
+        # server-side SGD: row -= lr * grad = row - 0.5
+        np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+
+    def test_untouched_rows_unchanged(self):
+        import paddle_tpu as pt
+        from paddle_tpu.distributed import ps as P
+        from paddle_tpu.distributed.ps_embedding import PsEmbedding
+
+        w = _LocalWorker()
+        emb = PsEmbedding(w, "emb_u", num_embeddings=50, embedding_dim=3)
+        other = P._srv_pull("emb_u", np.array([40])).copy()
+        rows = emb(pt.to_tensor(np.array([1])))
+        rows.sum().backward()
+        np.testing.assert_allclose(P._srv_pull("emb_u", np.array([40])),
+                                   other)
 
 
 class TestGeoSgd:

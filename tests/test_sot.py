@@ -60,6 +60,25 @@ class TestCapture:
         np.testing.assert_allclose(a.numpy(), [6.0])
         np.testing.assert_allclose(b.numpy(), [4.0])
 
+    def test_list_append_in_loop(self):
+        # the `outs.append(f(x))` accumulation pattern: unrolled, tracked
+        @symbolic_translate
+        def f(x):
+            outs = []
+            for i in range(3):
+                outs.append(x * float(i + 1))
+            return outs
+
+        outs = f(_t([2.0]))
+        assert isinstance(outs, list) and len(outs) == 3
+        np.testing.assert_allclose(outs[0].numpy(), [2.0])
+        np.testing.assert_allclose(outs[2].numpy(), [6.0])
+        assert not f.fell_back
+        # replay with different values through the cached entry
+        outs2 = f(_t([10.0]))
+        np.testing.assert_allclose(outs2[1].numpy(), [20.0])
+        assert f.cache_size == 1
+
     def test_graph_is_replayed_not_baked(self):
         # same shape, DIFFERENT values must flow through the compiled entry
         @symbolic_translate

@@ -48,6 +48,30 @@ class TestGeometric:
                                    [[2.0], [4.0]])
 
 
+    def test_reindex_heter_graph(self):
+        import paddle_tpu.geometric as G
+        x = pt.to_tensor(np.array([1, 5]))
+        nbs = [pt.to_tensor(np.array([5, 9])), pt.to_tensor(np.array([9, 2]))]
+        reindexed, nodes, xr = G.reindex_heter_graph(x, nbs, None)
+        # shared node table: x first, then first-seen neighbors across types
+        np.testing.assert_array_equal(nodes.numpy(), [1, 5, 9, 2])
+        np.testing.assert_array_equal(xr.numpy(), [0, 1])
+        np.testing.assert_array_equal(reindexed[0].numpy(), [1, 2])
+        np.testing.assert_array_equal(reindexed[1].numpy(), [2, 3])
+
+    def test_weighted_sample_neighbors_export(self):
+        import paddle_tpu.geometric as G
+        # CSC graph: node 0 has nbrs [1,2,3], node 1 has [3]
+        row = pt.to_tensor(np.array([1, 2, 3, 3]))
+        colptr = pt.to_tensor(np.array([0, 3, 4]))
+        w = pt.to_tensor(np.array([1.0, 1.0, 1.0, 1.0], np.float32))
+        nodes = pt.to_tensor(np.array([0, 1]))
+        out, counts = G.weighted_sample_neighbors(row, colptr, w, nodes,
+                                                  sample_size=2)
+        assert tuple(out.shape)[0] == 2
+        assert int(counts.numpy()[0]) == 2 and int(counts.numpy()[1]) == 1
+
+
 class TestText:
     def test_viterbi_simple(self):
         from paddle_tpu.text import viterbi_decode
