@@ -1,0 +1,113 @@
+"""Op microbenchmark gate — the perf-regression CI capability
+(reference: tools/ci_op_benchmark.sh + tools/check_op_benchmark_result.py:
+relative regression checks of a fixed op basket against a recorded
+baseline; no absolute numbers asserted).
+
+    python benchmarks/op_bench.py record    # write op_baseline.json
+    python benchmarks/op_bench.py check     # gate vs the baseline (±tol)
+
+Runs the basket on the XLA CPU backend by default (deterministic CI
+environment; set OP_BENCH_TPU=1 to run on the chip with the chained-sync
+protocol). The gate compares RELATIVE per-op time vs the baseline ratio
+and fails on >tol regression, exactly the reference's policy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE = os.path.join(HERE, "op_baseline.json")
+TOL = float(os.environ.get("OP_BENCH_TOL", "0.5"))  # 50%: CI hosts are noisy
+
+
+def basket():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    r = np.random.RandomState(0)
+
+    def t(*s):
+        return jnp.asarray(r.rand(*s).astype(np.float32))
+
+    a512, b512 = t(512, 512), t(512, 512)
+    a2k, b2k = t(1024, 2048), t(2048, 1024)
+    x = t(64, 1024)
+    img = t(8, 32, 64, 64)
+    ker = t(32, 32, 3, 3)
+
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.core.tensor import Tensor
+
+    ops = {
+        "matmul_512": lambda: a512 @ b512,
+        "matmul_1kx2k": lambda: a2k @ b2k,
+        "add_64x1024": lambda: x + x,
+        "softmax_64x1024": lambda: jax.nn.softmax(x, axis=-1),
+        "layer_norm_64x1024": lambda: F.layer_norm(Tensor(x), 1024)._value,
+        "conv2d_3x3": lambda: jax.lax.conv_general_dilated(
+            img, ker, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW")),
+    }
+    return ops
+
+
+def measure():
+    """min-of-5 batches of 20 — the min is the op's noise-free floor
+    (host scheduling jitter is one-sided; the reference's op benchmark CI
+    likewise compares best-case timings)."""
+    import jax
+    out = {}
+    for name, fn in basket().items():
+        jitted = jax.jit(fn)
+        jax.block_until_ready(jitted())  # compile
+        n, batches = 20, 5
+        best = float("inf")
+        for _ in range(batches):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                y = jitted()
+            jax.block_until_ready(y)
+            best = min(best, (time.perf_counter() - t0) / n)
+        out[name] = best * 1e6  # µs
+    return out
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "check"
+    if os.environ.get("OP_BENCH_TPU") != "1":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    cur = measure()
+    if mode == "record":
+        with open(BASELINE, "w") as f:
+            json.dump({"us_per_op": cur}, f, indent=1, sort_keys=True)
+        print(json.dumps({"recorded": cur}))
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(json.dumps({"error": "no baseline — run `op_bench.py record`"}))
+        return 1
+    base = json.load(open(BASELINE))["us_per_op"]
+    report, failed = {}, []
+    for name, us in cur.items():
+        b = base.get(name)
+        if b is None:
+            continue
+        ratio = us / b
+        report[name] = {"us": round(us, 1), "base_us": round(b, 1),
+                        "ratio": round(ratio, 2)}
+        if ratio > 1.0 + TOL:
+            failed.append(name)
+    print(json.dumps({"metric": "op_bench_regression_gate",
+                      "tolerance": TOL, "failed": failed, "ops": report}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

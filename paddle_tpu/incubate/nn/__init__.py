@@ -1,7 +1,10 @@
 """incubate.nn — fused op APIs (reference: python/paddle/incubate/nn/
-functional/fused_*.py). On TPU "fused" means XLA-fused or a Pallas kernel;
-these wrappers keep the reference's call signatures."""
+functional/fused_*.py + layer/fused_*.py). On TPU "fused" means XLA-fused
+or a Pallas kernel; these wrappers keep the reference's call signatures."""
 from . import functional  # noqa: F401
+from .layer import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
+                    FusedDropout, FusedDropoutAdd, FusedFeedForward,
+                    FusedLinear, FusedMultiHeadAttention,
+                    FusedMultiTransformer, FusedTransformerEncoderLayer)
 
 from ...nn.layer.norm import RMSNorm as FusedRMSNorm  # noqa: F401
-from ...nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: F401

@@ -1,0 +1,277 @@
+"""incubate.nn Layer classes (reference:
+python/paddle/incubate/nn/layer/{fused_linear,fused_dropout_add,
+fused_transformer}.py — the Layer wrappers over the fused functional ops).
+
+On TPU "fused" is what XLA emits for the composed graph, so each class is
+a thin parameter-owning wrapper over the corresponding
+incubate.nn.functional entry — same signatures, same state_dict layout
+intent."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+from ...nn.layer.layers import Layer
+from . import functional as IF
+
+__all__ = ["FusedLinear", "FusedDropout", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedMultiTransformer"]
+
+
+def _uniform(shape, fan_in, seed_arr=[0]):
+    seed_arr[0] += 1
+    rng = np.random.RandomState(seed_arr[0])
+    k = 1.0 / math.sqrt(max(fan_in, 1))
+    return jnp.asarray(rng.uniform(-k, k, shape).astype(np.float32))
+
+
+class FusedLinear(Layer):
+    """Reference incubate/nn/layer/fused_linear.py FusedLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = (out_features, in_features) if transpose_weight \
+            else (in_features, out_features)
+        # Layer.__setattr__ auto-registers Parameter attributes
+        self.weight = Parameter(_uniform(shape, in_features), name="weight")
+        self.bias = None if bias_attr is False else Parameter(
+            jnp.zeros((out_features,), jnp.float32), name="bias")
+
+    def forward(self, x):
+        return IF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self._transpose)
+
+
+class FusedDropout(Layer):
+    """Reference incubate/nn/layer/fused_dropout_add.py style wrapper."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        from ...nn import functional as F
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode)
+
+
+class FusedDropoutAdd(Layer):
+    """y = dropout(x) + residual (reference FusedDropoutAdd)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return IF.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                    mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Reference FusedBiasDropoutResidualLayerNorm layer."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.p = dropout_rate
+        self.eps = epsilon
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32),
+                                     name="linear_bias")
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), jnp.float32),
+                                  name="ln_scale")
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32),
+                                 name="ln_bias")
+
+    def forward(self, x, residual):
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.p, ln_epsilon=self.eps,
+            training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference incubate/nn/layer/fused_transformer.py
+    FusedMultiHeadAttention: packed qkv weight [3, H, hd, D] + out proj,
+    optional pre/post layernorm, residual add — one functional call."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        hd = embed_dim // num_heads
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.eps = epsilon
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv_weight = Parameter(
+            _uniform((3, num_heads, hd, embed_dim), embed_dim),
+            name="qkv_weight")
+        self.qkv_bias = Parameter(
+            jnp.zeros((3, num_heads, hd), jnp.float32), name="qkv_bias")
+        self.linear_weight = Parameter(
+            _uniform((embed_dim, embed_dim), embed_dim),
+            name="linear_weight")
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32),
+                                     name="linear_bias")
+        # only the LN the forward path actually applies owns parameters
+        # (pre-LN when normalize_before, post-LN otherwise) — dead params
+        # would pollute state_dict and optimizer state
+        if normalize_before:
+            self.pre_ln_scale = Parameter(
+                jnp.ones((embed_dim,), jnp.float32), name="pre_ln_scale")
+            self.pre_ln_bias = Parameter(
+                jnp.zeros((embed_dim,), jnp.float32), name="pre_ln_bias")
+            self.ln_scale = self.ln_bias = None
+        else:
+            self.ln_scale = Parameter(jnp.ones((embed_dim,), jnp.float32),
+                                      name="ln_scale")
+            self.ln_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32),
+                                     name="ln_bias")
+            self.pre_ln_scale = self.pre_ln_bias = None
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        # the fused op packs self-attention qkv from ONE input (reference
+        # layer has the same restriction); reject silent wrong answers
+        if key is not None and key is not query:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention is self-attention only (packed "
+                "qkv): key/value must be None or the query itself — use "
+                "nn.MultiHeadAttention for cross-attention")
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention: incremental cache decoding rides "
+                "models/llama_decode.py-style caches; pass cache=None here")
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.eps, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate, ln_epsilon=self.eps,
+            training=self.training, num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    """Reference FusedFeedForward layer."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate
+                                 if act_dropout_rate is not None
+                                 else dropout_rate)
+        self.eps = epsilon
+        self.linear1_weight = Parameter(
+            _uniform((d_model, dim_feedforward), d_model),
+            name="linear1_weight")
+        self.linear1_bias = Parameter(
+            jnp.zeros((dim_feedforward,), jnp.float32), name="linear1_bias")
+        self.linear2_weight = Parameter(
+            _uniform((dim_feedforward, d_model), dim_feedforward),
+            name="linear2_weight")
+        self.linear2_bias = Parameter(jnp.zeros((d_model,), jnp.float32),
+                                      name="linear2_bias")
+        self.ln1_scale = Parameter(jnp.ones((d_model,), jnp.float32),
+                                   name="ln1_scale")
+        self.ln1_bias = Parameter(jnp.zeros((d_model,), jnp.float32),
+                                  name="ln1_bias")
+        self.ln2_scale = Parameter(jnp.ones((d_model,), jnp.float32),
+                                   name="ln2_scale")
+        self.ln2_bias = Parameter(jnp.zeros((d_model,), jnp.float32),
+                                  name="ln2_bias")
+
+    def forward(self, src, cache=None):
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.eps, ln2_epsilon=self.eps,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference FusedTransformerEncoderLayer = FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate
+                               if attn_dropout_rate is not None
+                               else dropout_rate),
+            normalize_before=normalize_before, epsilon=epsilon)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before, epsilon=epsilon)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer: incremental caches are not "
+                "supported on this path")
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """Reference FusedMultiTransformer: the N-layer inference transformer
+    as ONE stacked module (serving path; see also tensor/ops_ext3
+    fused_multi_transformer). Dropout-free by contract (inference)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 num_layers=1, dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, epsilon=1e-5, name=None):
+        super().__init__()
+        self.layers = []
+        for i in range(num_layers):
+            lyr = FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before, epsilon=epsilon)
+            self.layers.append(lyr)
+            self.add_sublayer(f"layer_{i}", lyr)
+
+    def forward(self, src, attn_mask=None, caches=None):
+        if caches is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: incremental caches ride the "
+                "models/llama_decode.py path; pass caches=None here")
+        out = src
+        for lyr in self.layers:
+            out = lyr(out, src_mask=attn_mask)
+        return out

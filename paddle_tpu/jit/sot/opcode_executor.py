@@ -334,8 +334,20 @@ class OpcodeExecutor:
             elif op == "BINARY_SUBSCR":
                 idx, o = pop(), pop()
                 push(self._apply(operator.getitem, [o, idx]))
+            elif op == "BUILD_SLICE":
+                if ins.arg == 3:
+                    step_v, stop_v, start_v = pop(), pop(), pop()
+                else:
+                    step_v, (stop_v, start_v) = Var(None), (pop(), pop())
+                if any(v.tracked for v in (start_v, stop_v, step_v)):
+                    raise GraphBreakError("slice bounds from tensor values")
+                push(Var(slice(start_v.value, stop_v.value, step_v.value)))
             elif op == "BINARY_SLICE":
                 end, start, o = pop(), pop(), pop()
+                if start.tracked or end.tracked:
+                    # a tensor-derived bound would be baked as a constant
+                    # into the cached graph (same hazard as BUILD_SLICE)
+                    raise GraphBreakError("slice bounds from tensor values")
                 sl = Var(slice(start.value, end.value))
                 push(self._apply(operator.getitem, [o, sl]))
             elif op in ("STORE_SUBSCR", "STORE_ATTR", "STORE_GLOBAL",
