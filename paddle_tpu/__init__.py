@@ -38,6 +38,7 @@ from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import framework  # noqa: F401
+from . import decomposition  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
 from . import models  # noqa: F401

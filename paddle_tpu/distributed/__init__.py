@@ -35,6 +35,7 @@ from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401
+from . import utils  # noqa: F401
 from .ps_embedding import PsEmbedding, sparse_embedding  # noqa: F401
 
 

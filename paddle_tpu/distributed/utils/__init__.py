@@ -1,0 +1,110 @@
+"""paddle.distributed.utils (reference:
+python/paddle/distributed/utils/ — launcher helpers + the MoE all-to-all
+dispatch ops global_scatter/global_gather in moe_utils.py:20,153).
+
+TPU-native: global_scatter/global_gather are the expert-parallel exchange
+— rows routed to experts living on other ranks. Under GSPMD the exchange
+is an `all_to_all` the compiler schedules on ICI; eager single-process
+semantics (the reference's local fallback) reorder rows by expert count
+so the MoE layer's contract holds with or without a mesh."""
+from __future__ import annotations
+
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.engine import apply
+from ...core.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather", "find_free_ports",
+           "get_host_name_ip", "get_logger"]
+
+
+def _concrete_counts(c, what):
+    """Counts size the output — they are HOST values by contract (the
+    reference computes them with count() on host before the op). A traced
+    count cannot size a static-shaped TPU program."""
+    v = c._value if isinstance(c, Tensor) else c
+    if isinstance(v, jax.core.Tracer):
+        raise NotImplementedError(
+            f"global_scatter/global_gather: {what} must be concrete host "
+            "counts (the output row count is data-dependent); inside jit "
+            "use the sharded MoE dispatch in parallel.moe instead")
+    return np.asarray(v)
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Route rows to experts (reference moe_utils.py:20 global_scatter).
+
+    x [N, D]: rows ordered by (expert, source); local_count [n_expert *
+    world]: rows THIS rank sends per (expert, rank) bucket; global_count:
+    rows this rank RECEIVES. This is the EAGER/host-level utility (the
+    reference's op has the same host-count contract); the compiled
+    expert-parallel exchange — GSPMD all_to_all over the mesh — lives in
+    parallel.moe (MoELayer), which the trainer uses. Multi-process eager
+    dispatch is not supported here."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "global_scatter: multi-process eager dispatch is not wired — "
+            "use parallel.moe.MoELayer (GSPMD all_to_all) for the sharded "
+            "exchange")
+    n_out = int(_concrete_counts(global_count, "global_count").sum())
+
+    def f(xv, lc, gc):
+        return xv[:n_out]
+
+    return apply(f, x, local_count, global_count, name="global_scatter")
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse exchange (reference moe_utils.py:153): expert outputs return
+    to their source ranks. Same host-count contract as global_scatter."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "global_gather: multi-process eager dispatch is not wired — "
+            "use parallel.moe.MoELayer (GSPMD all_to_all) for the sharded "
+            "exchange")
+    n_out = int(_concrete_counts(local_count, "local_count").sum())
+
+    def f(xv, lc, gc):
+        return xv[:n_out]
+
+    return apply(f, x, local_count, global_count, name="global_gather")
+
+
+def find_free_ports(num):
+    """Reference utils find_free_ports — n distinct free TCP ports."""
+    out = set()
+    socks = []
+    try:
+        while len(out) < num:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            out.add(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return out
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return None
+
+
+def get_logger(log_level=20, name="root"):
+    import logging
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    return logger

@@ -23,11 +23,13 @@ __all__ = ["FusedLinear", "FusedDropout", "FusedDropoutAdd",
            "FusedMultiTransformer"]
 
 
-def _uniform(shape, fan_in, seed_arr=[0]):
-    seed_arr[0] += 1
-    rng = np.random.RandomState(seed_arr[0])
+def _uniform(shape, fan_in):
+    import jax
+
+    from ...core import random as _rng
     k = 1.0 / math.sqrt(max(fan_in, 1))
-    return jnp.asarray(rng.uniform(-k, k, shape).astype(np.float32))
+    # framework generator: paddle.seed-reproducible, distinct per draw
+    return jax.random.uniform(_rng.split_key(), shape, jnp.float32, -k, k)
 
 
 class FusedLinear(Layer):

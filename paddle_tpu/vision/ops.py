@@ -1,0 +1,110 @@
+"""paddle.vision.ops — the detection/vision op surface (reference:
+python/paddle/vision/ops.py). Every function rides the shared op
+implementations in tensor/ops_ext*.py (TPU-native, fixed-shape padded
+outputs for the NMS family); this module is the reference-shaped entry
+point plus the Layer-class wrappers (DeformConv2D, RoIAlign, RoIPool,
+PSRoIPool)."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from ..tensor.ops_ext import nms  # noqa: F401
+from ..tensor.ops_ext2 import (box_coder, deformable_conv,  # noqa: F401
+                               distribute_fpn_proposals, generate_proposals,
+                               matrix_nms, prior_box, psroi_pool, roi_align,
+                               roi_pool, yolo_box, yolo_loss)
+from ..tensor.ops_ext2 import multiclass_nms3 as multiclass_nms  # noqa: F401
+
+__all__ = ["yolo_box", "yolo_loss", "prior_box", "box_coder",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "roi_pool", "RoIPool", "roi_align",
+           "RoIAlign", "psroi_pool", "PSRoIPool", "nms", "matrix_nms",
+           "multiclass_nms"]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Reference vision/ops.py deform_conv2d (v1 when mask is None, v2
+    with mask) over the shared deformable_conv op."""
+    out = deformable_conv(x, offset, weight, mask=mask, stride=stride,
+                          padding=padding, dilation=dilation,
+                          deformable_groups=deformable_groups, groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+class DeformConv2D(Layer):
+    """Reference vision/ops.py DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        import math
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import random as _rng
+        from ..core.tensor import Parameter
+        ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size, kernel_size)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                           deformable_groups=deformable_groups, groups=groups)
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        k = 1.0 / math.sqrt(max(fan_in, 1))
+        # draw from the framework generator (paddle.seed reproducible;
+        # distinct instances get distinct weights)
+        self.weight = Parameter(jax.random.uniform(
+            _rng.split_key(),
+            (out_channels, in_channels // groups, ks[0], ks[1]),
+            jnp.float32, -k, k), name="weight")
+        self.bias = None if bias_attr is False else Parameter(
+            jnp.zeros((out_channels,), jnp.float32), name="bias")
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             mask=mask, **self._attrs)
+
+
+class RoIAlign(Layer):
+    """Reference vision/ops.py RoIAlign layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num=boxes_num,
+                         output_size=self._output_size,
+                         spatial_scale=self._spatial_scale)
+
+
+class RoIPool(Layer):
+    """Reference vision/ops.py RoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num=boxes_num,
+                        output_size=self._output_size,
+                        spatial_scale=self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    """Reference vision/ops.py PSRoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num=boxes_num,
+                          output_size=self._output_size,
+                          spatial_scale=self._spatial_scale)

@@ -1,0 +1,102 @@
+"""User-surface namespaces added for reference parity: vision.ops,
+distributed.utils (global_scatter/gather), decomposition."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestVisionOps:
+    def test_surface_complete(self):
+        from paddle_tpu.vision import ops as V
+        for name in ["yolo_box", "yolo_loss", "prior_box", "box_coder",
+                     "deform_conv2d", "DeformConv2D", "roi_align",
+                     "RoIAlign", "roi_pool", "RoIPool", "psroi_pool",
+                     "PSRoIPool", "nms", "matrix_nms", "multiclass_nms",
+                     "distribute_fpn_proposals", "generate_proposals"]:
+            assert callable(getattr(V, name)), name
+
+    def test_roi_align_layer(self):
+        from paddle_tpu.vision.ops import RoIAlign
+        x = pt.to_tensor(np.random.rand(1, 4, 8, 8).astype(np.float32))
+        boxes = pt.to_tensor(np.array([[0., 0., 7., 7.]], np.float32))
+        out = RoIAlign(output_size=2)(x, boxes,
+                                      pt.to_tensor(np.array([1])))
+        assert tuple(out.shape) == (1, 4, 2, 2)
+
+    def test_deform_conv2d_zero_offset_matches_conv(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = pt.to_tensor(rng.rand(1, 3, 6, 6).astype(np.float32))
+        w = pt.to_tensor(rng.rand(5, 3, 3, 3).astype(np.float32))
+        off = pt.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        got = deform_conv2d(x, off, w, padding=1)
+        want = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestDistributedUtils:
+    def test_global_scatter_gather_single_process(self):
+        from paddle_tpu.distributed.utils import (global_gather,
+                                                  global_scatter)
+        x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        lc = pt.to_tensor(np.array([2, 2]))
+        gc = pt.to_tensor(np.array([2, 2]))
+        out = global_scatter(x, lc, gc)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(x.numpy()))
+        back = global_gather(out, lc, gc)
+        np.testing.assert_allclose(np.asarray(back.numpy()),
+                                   np.asarray(x.numpy()))
+
+    def test_find_free_ports(self):
+        from paddle_tpu.distributed.utils import find_free_ports
+        ports = find_free_ports(4)
+        assert len(ports) == 4 and all(1024 < p < 65536 for p in ports)
+
+
+class TestDecomposition:
+    def test_decompose_and_replay(self):
+        from paddle_tpu import decomposition as D
+        import paddle_tpu.nn.functional as F
+        x = pt.to_tensor(np.random.rand(2, 8).astype(np.float32))
+        cj = D.decompose(lambda a: F.softmax(a), x)
+        out = D.run_decomposed(cj, x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(F.softmax(x).numpy()),
+                                   rtol=1e-6)
+
+    def test_primitive_histogram(self):
+        from paddle_tpu import decomposition as D
+        import paddle_tpu.nn.functional as F
+        x = pt.to_tensor(np.random.rand(2, 8).astype(np.float32))
+        hist = D.primitives_of(lambda a: F.softmax(a), x)
+        # the composite is GONE: only primitives remain
+        assert "exp" in hist and "div" in hist
+        assert "softmax" not in hist
+
+    @pytest.mark.parametrize("name,ref_fn", [
+        ("softmax", lambda x: np.exp(x - x.max(-1, keepdims=True))
+         / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+        ("rsqrt", lambda x: 1.0 / np.sqrt(x)),
+        ("silu", lambda x: x / (1 + np.exp(-x))),
+    ])
+    def test_rules_numeric(self, name, ref_fn):
+        from paddle_tpu import decomposition as D
+        rule = D.get_decomp_rule(name)
+        x = np.random.RandomState(1).rand(3, 5).astype(np.float32) + 0.1
+        np.testing.assert_allclose(np.asarray(rule(x)), ref_fn(x),
+                                   rtol=1e-5)
+
+    def test_register_custom_rule(self):
+        from paddle_tpu import decomposition as D
+
+        @D.register_decomp("my_square_op")
+        def rule(x):
+            return x * x
+
+        assert D.get_decomp_rule("my_square_op") is rule
