@@ -134,19 +134,27 @@ def apply_rule(rule, tensor_inputs, arrs, static_kwargs=None):
             continue
         i = tensor_slots[k]
         cur = placements[k]
-        if cur is not None and list(cur) != list(req):
-            new_arrs[i] = reshard_value(
-                tensor_inputs[i]._value, mesh, cur, replicate_partials(req))
-        elif cur is None:
-            # undistributed input joining a dist op: place it per the rule
-            spec = placements_to_spec(mesh, replicate_partials(req),
-                                      len(shapes[k]))
-            sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
-            v = tensor_inputs[i]._value
-            if isinstance(v, jax.core.Tracer):
-                new_arrs[i] = jax.lax.with_sharding_constraint(v, sharding)
-            else:
-                new_arrs[i] = jax.device_put(v, sharding)
+        try:
+            if cur is not None and list(cur) != list(req):
+                new_arrs[i] = reshard_value(
+                    tensor_inputs[i]._value, mesh, cur,
+                    replicate_partials(req))
+            elif cur is None:
+                # undistributed input joining a dist op: place per the rule
+                spec = placements_to_spec(mesh, replicate_partials(req),
+                                          len(shapes[k]))
+                sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
+                v = tensor_inputs[i]._value
+                if isinstance(v, jax.core.Tracer):
+                    new_arrs[i] = jax.lax.with_sharding_constraint(
+                        v, sharding)
+                else:
+                    new_arrs[i] = jax.device_put(v, sharding)
+        except ValueError:
+            # a demanded layout is an OPTIMIZATION: a rule blind to some
+            # static attr may demand a shard that doesn't divide this
+            # input's extent — never fail the op over it
+            continue
 
     out_pl = decision.outputs
     if out_pl is None:
@@ -183,12 +191,19 @@ def apply_rule(rule, tensor_inputs, arrs, static_kwargs=None):
             spec = placements_to_spec(mesh, replicate_partials(pl),
                                       leaf._value.ndim)
             sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
-            if isinstance(leaf._value, jax.core.Tracer):
-                leaf._value = jax.lax.with_sharding_constraint(
-                    leaf._value, sharding)
-            else:
-                leaf._value = jax.device_put(leaf._value, sharding)
-            leaf._dist = (mesh, pl)
+            try:
+                if isinstance(leaf._value, jax.core.Tracer):
+                    leaf._value = jax.lax.with_sharding_constraint(
+                        leaf._value, sharding)
+                else:
+                    leaf._value = jax.device_put(leaf._value, sharding)
+                leaf._dist = (mesh, pl)
+            except ValueError:
+                # a layout is an OPTIMIZATION: a declared shard that does
+                # not divide the actual output extent (rule blind to a
+                # static attr) must never fail the op — leave GSPMD's
+                # placement in effect
+                pass
         return out_tree
 
     return new_arrs, posthook
@@ -615,6 +630,90 @@ def _install_builtin_rules():
         return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
 
     register_spmd_rule("grad_dropout", lambda ctx: _follow_primals(ctx, 1))
+
+    # ---------------- more layout ops (stack/tile/pad/gather family;
+    # reference spmd_rules/{stack,tile,pad,gather,cast}.cc) ----------------
+    def _identity_layout_rule(ctx):
+        """Elementwise-shaped op: output keeps the input's layout."""
+        if not ctx.placements or ctx.placements[0] is None:
+            return None
+        return SpmdDecision(inputs=[], outputs=[list(ctx.placements[0])])
+
+    register_spmd_rule("cast", _identity_layout_rule)
+    register_spmd_rule("grad_cast", lambda ctx: _follow_primals(ctx, 1))
+
+    @register_spmd_rule("stack")
+    def _stack_rule(ctx):
+        # stack inserts a new leading-ish dim: demand all inputs aligned to
+        # the first's layout; output shards shift past the new axis.
+        # The new axis index is a static kwarg only on some call paths —
+        # abstain on the output when unknown, still align the inputs.
+        if len(ctx.shapes) < 2 or ctx.placements[0] is None:
+            return None
+        demands = [None] + [list(ctx.placements[0])
+                            for _ in range(len(ctx.shapes) - 1)]
+        return SpmdDecision(inputs=demands, outputs=None)
+
+    @register_spmd_rule("tile")
+    def _tile_rule(ctx):
+        # the repeat counts are closure state — with len(reps) > ndim the
+        # output prepends dims and any kept shard would re-anchor onto a
+        # repeat dim; abstain (GSPMD lays the tiled result out)
+        return None
+
+    @register_spmd_rule("pad")
+    def _pad_rule(ctx):
+        # the padded dims are closure attrs this rule can't see, and a
+        # shard kept on a padded dim may no longer divide the new extent —
+        # abstain and let GSPMD lay the padded result out
+        return None
+
+    @register_spmd_rule("gather")
+    def _gather_rule(ctx):
+        # out = take(x, index, axis): out dims = x[:axis] + index.dims +
+        # x[axis+1:] (reference gather.cc). The index's dim-k shard lands
+        # on output dim axis+k; x's non-gathered shards survive with dims
+        # past `axis` shifted by (index_ndim - 1).
+        if len(ctx.placements) < 2:
+            return None
+        axis = ctx.kwargs.get("axis")
+        if axis is None:
+            return None
+        x_pl, idx_pl = ctx.placements[0], ctx.placements[1]
+        if x_pl is None and idx_pl is None:
+            return None
+        x_nd = len(ctx.shapes[0])
+        idx_nd = len(ctx.shapes[1])
+        axis = axis % x_nd
+        out = {}
+        for d, ax in _shard_map(x_pl).items():
+            if d < axis:
+                out[d] = ax
+            elif d > axis:
+                out[d + idx_nd - 1] = ax
+        for d, ax in _shard_map(idx_pl).items():
+            out.setdefault(axis + d, ax)
+        n_axes = len(ctx.mesh.shape)
+        return SpmdDecision(inputs=[], outputs=[_pl(n_axes, out)])
+
+    @register_spmd_rule("take_along_axis")
+    def _take_along_rule(ctx):
+        # index and x are rank-aligned: demand index onto x's layout —
+        # but only on dims whose EXTENTS match (an un-divisible demand
+        # would force a failed reshard); output follows the index
+        if len(ctx.placements) < 2 or ctx.placements[0] is None:
+            return None
+        if len(ctx.shapes[1]) != len(ctx.shapes[0]):
+            return None
+        xm = _shard_map(ctx.placements[0])
+        ok = {d: ax for d, ax in xm.items()
+              if ctx.shapes[1][d] == ctx.shapes[0][d]}
+        n_axes = len(ctx.mesh.shape)
+        pl = _pl(n_axes, ok)
+        return SpmdDecision(inputs=[None, pl], outputs=[pl])
+
+    # expand/broadcast_to may PREPEND dims (reps unknown here) — a copied
+    # placement would re-anchor onto the wrong output dim; no rule.
 
 
 _install_builtin_rules()

@@ -190,7 +190,11 @@ def roll(x, shifts, axis=None, name=None):
 def gather(x, index, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
-    return apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index, name="gather")
+    # axis rides as a static kwarg so the gather SPMD rule can anchor the
+    # index's shard onto the right output dim (reference spmd gather.cc)
+    return apply(lambda a, i, axis: jnp.take(a, i.astype(jnp.int32),
+                                             axis=axis),
+                 x, index, name="gather", axis=axis)
 
 
 def gather_nd(x, index, name=None):

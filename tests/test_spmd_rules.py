@@ -267,6 +267,46 @@ class TestRuleLibrary:
         assert out.placements[0].is_shard(0)
         np.testing.assert_allclose(_global(out), x, rtol=1e-6)
 
+    def test_cast_keeps_layout(self, mesh1d):
+        x = self._np(8, 4)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = pt.cast(dx, "float64") if hasattr(pt, "cast") else None
+        if out is None:
+            pytest.skip("no cast op")
+        assert out.placements[0].is_shard(0)
+
+    def test_take_along_axis_aligns_index(self, mesh1d):
+        x = self._np(8, 4)
+        idx = np.zeros((8, 4), np.int64)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        didx = dist.shard_tensor(pt.to_tensor(idx, dtype="int64"), mesh1d,
+                                 [Replicate()])
+        out = pt.take_along_axis(dx, didx, axis=1)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out),
+                                   np.take_along_axis(x, idx, 1), rtol=1e-6)
+
+    def test_pad_sharded_input_value_correct(self, mesh1d):
+        # the pad rule ABSTAINS (padded dims are closure attrs a rule
+        # cannot see) — this pins the load-bearing property: padding a
+        # sharded tensor never crashes and the VALUE is exact
+        x = self._np(8, 4)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = pt.nn.functional.pad(dx, [0, 0, 1, 1])
+        want = np.pad(x, [(1, 1), (0, 0)])
+        np.testing.assert_allclose(_global(out), want, rtol=1e-6)
+
+    def test_gather_axis1_anchors_index_shard(self, mesh1d):
+        # index Shard(0) gathered on axis=1 lands on OUTPUT dim 1
+        x = self._np(4, 8)
+        idx = np.arange(8)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Replicate()])
+        didx = dist.shard_tensor(pt.to_tensor(idx, dtype="int64"), mesh1d,
+                                 [Shard(0)])
+        out = pt.gather(dx, didx, axis=1)
+        assert out.placements[0].is_shard(1)
+        np.testing.assert_allclose(_global(out), x[:, idx], rtol=1e-6)
+
     def test_rule_changes_layout_vs_gspmd_default(self, mesh1d):
         """The library is not a no-op: with the layer_norm rule removed,
         GSPMD's propagation keeps the feature shard on a feature-sharded

@@ -1,0 +1,145 @@
+"""The eager tape's vjp jit-cache (core/engine.py _tape_vjp) — the
+dispatch-latency fix (benchmarks/eager_microbench.py: ~1 ms/op → ~100 µs)
+must never trade speed for wrong numerics. These tests pin the safety
+contract the r3 reviews established."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core import engine
+
+
+def _t(a, grad=False):
+    t = pt.to_tensor(np.asarray(a, np.float32))
+    if grad:
+        t.stop_gradient = False
+    return t
+
+
+class TestCacheHits:
+    def test_repeated_shape_reuses_entry(self):
+        engine._VJP_JIT_CACHE.clear()
+        engine._VJP_CODE_STATS.clear()
+
+        def op(a, b):
+            return a * b + a
+
+        x = _t([1.0, 2.0], grad=True)
+        y = _t([3.0, 4.0])
+        before = len(engine._VJP_JIT_CACHE)
+        engine.apply(op, x, y, name="op")
+        engine.apply(op, x, y, name="op")
+        after = len(engine._VJP_JIT_CACHE)
+        assert after == before + 1  # one entry, second call hit
+
+    def test_values_flow_not_baked(self):
+        def op(a):
+            return a * 3.0
+
+        x1 = _t([1.0], grad=True)
+        x2 = _t([5.0], grad=True)
+        o1 = engine.apply(op, x1, name="op3")
+        o2 = engine.apply(op, x2, name="op3")
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [3.0])
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [15.0])
+
+    def test_static_scalar_specializes(self):
+        # python scalars ride as static jit args: exact branch semantics
+        def op(a, k):
+            if k > 0:
+                return a * k
+            return a - k
+
+        x = _t([2.0], grad=True)
+        o1 = engine.apply(op, x, 3.0, name="opk")
+        o2 = engine.apply(op, x, -3.0, name="opk")
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [6.0])
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [5.0])
+
+
+class TestCacheSafety:
+    def test_bound_methods_never_cached(self):
+        # per-instance state is invisible to a __code__ key — must be raw
+        class Op:
+            def __init__(self, k):
+                self.k = k
+
+            def fwd(self, a):
+                return a * self.k
+
+        o1, o2 = Op(2.0), Op(5.0)
+        x = _t([1.0, 1.0, 1.0], grad=True)
+        y1 = engine.apply(o1.fwd, x, name="bm")
+        y2 = engine.apply(o2.fwd, x, name="bm")
+        np.testing.assert_allclose(np.asarray(y1.numpy()), [2.0] * 3)
+        np.testing.assert_allclose(np.asarray(y2.numpy()), [5.0] * 3)
+        y2.sum().backward()
+        np.testing.assert_allclose(np.asarray(x._grad_value), [5.0] * 3)
+
+    def test_identity_hashed_closure_not_cached(self):
+        # a mutated captured object must be re-read every call
+        class Cfg:
+            pass
+
+        cfg = Cfg()
+        cfg.k = 2.0
+
+        def op(a):
+            return a * cfg.k
+
+        x = _t([1.0], grad=True)
+        o1 = engine.apply(op, x, name="mut")
+        cfg.k = 7.0
+        o2 = engine.apply(op, x, name="mut")
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [7.0])
+
+    def test_value_hashable_closure_is_cached(self):
+        engine._VJP_JIT_CACHE.clear()
+        engine._VJP_CODE_STATS.clear()
+        scale = 4.0  # float closure cell: value-hashable → cacheable
+
+        def op(a):
+            return a * scale
+
+        x = _t([2.0], grad=True)
+        before = len(engine._VJP_JIT_CACHE)
+        engine.apply(op, x, name="cc")
+        assert len(engine._VJP_JIT_CACHE) == before + 1
+
+    def test_grads_match_raw_path(self):
+        # cached-path gradients == raw jax.vjp gradients
+        def op(a, b):
+            return jnp.tanh(a) * b + jnp.exp(-a)
+
+        xv = np.array([0.3, -0.7, 1.1], np.float32)
+        yv = np.array([1.0, 2.0, 0.5], np.float32)
+        x, y = _t(xv, grad=True), _t(yv, grad=True)
+        out = engine.apply(op, x, y, name="gm")
+        out.sum().backward()
+        ref = jax.grad(lambda a, b: (jnp.tanh(a) * b + jnp.exp(-a)).sum(),
+                       argnums=(0, 1))(jnp.asarray(xv), jnp.asarray(yv))
+        np.testing.assert_allclose(np.asarray(x._grad_value), np.asarray(ref[0]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(y._grad_value), np.asarray(ref[1]),
+                                   rtol=1e-6)
+
+
+class TestChurnGuard:
+    def test_polymorphic_shapes_stay_cached_when_replayed(self):
+        engine._VJP_JIT_CACHE.clear()
+        engine._VJP_CODE_STATS.clear()
+        engine._VJP_RAW_CODES.clear()
+
+        def op(a):
+            return a + 1.0
+
+        # many distinct shapes, each REPLAYED: hits keep pace with misses,
+        # so the code object must not be demoted to raw
+        for n in range(1, 40):
+            x = _t(np.ones(n), grad=True)
+            engine.apply(op, x, name="poly")
+            engine.apply(op, x, name="poly")  # hit
+        assert op.__code__ not in engine._VJP_RAW_CODES
