@@ -359,6 +359,51 @@ class OpcodeExecutor:
             elif op == "BUILD_LIST":
                 items = [pop() for _ in range(ins.arg)][::-1]
                 push(self._build_seq(list, items))
+            elif op == "FORMAT_VALUE":
+                # 3.12 oparg: low bits = conversion (1=str 2=repr 3=ascii),
+                # 0x04 = a format spec rides on top of the stack
+                flags = ins.arg or 0
+                spec = pop().value if flags & 0x04 else ""
+                v = pop()
+                if v.tracked:
+                    raise GraphBreakError("formatting a tensor value")
+                conv = {1: str, 2: repr, 3: ascii}.get(flags & 0x03)
+                val = conv(v.value) if conv else v.value
+                push(Var(format(val, spec or "")))
+            elif op in ("FORMAT_SIMPLE", "FORMAT_WITH_SPEC"):  # 3.13 names
+                spec = pop().value if op == "FORMAT_WITH_SPEC" else ""
+                v = pop()
+                if v.tracked:
+                    raise GraphBreakError("formatting a tensor value")
+                push(Var(format(v.value, spec or "")))
+            elif op == "CONVERT_VALUE":
+                conv = {1: str, 2: repr, 3: ascii}.get(ins.arg, str)
+                v = pop()
+                if v.tracked:
+                    raise GraphBreakError("str/repr of a tensor value")
+                push(Var(conv(v.value)))
+            elif op == "BUILD_STRING":
+                parts = [pop() for _ in range(ins.arg)][::-1]
+                if any(p.tracked for p in parts):
+                    raise GraphBreakError("tensor inside f-string")
+                push(Var("".join(str(p.value) for p in parts)))
+            elif op == "BUILD_SET":
+                items = [pop() for _ in range(ins.arg)][::-1]
+                if any(v.tracked for v in items):
+                    raise GraphBreakError("tensor inside set literal")
+                push(Var(set(v.value for v in items)))
+            elif op == "SET_ADD":
+                v = pop()
+                tgt = self.stack[-ins.arg]
+                if v.tracked or tgt.tracked:
+                    raise GraphBreakError("tensor in set comprehension")
+                tgt.value.add(v.value)
+            elif op == "MAP_ADD":
+                val, key_v = pop(), pop()
+                tgt = self.stack[-ins.arg]
+                if val.tracked or key_v.tracked or tgt.tracked:
+                    raise GraphBreakError("tensor in dict comprehension")
+                tgt.value[key_v.value] = val.value
             elif op == "BUILD_MAP":
                 kv = [pop() for _ in range(2 * ins.arg)][::-1]
                 if any(v.tracked for v in kv):

@@ -79,6 +79,35 @@ class TestCapture:
         np.testing.assert_allclose(outs2[1].numpy(), [20.0])
         assert f.cache_size == 1
 
+    def test_fstring_dict_set_comprehensions(self):
+        # common python scaffolding around the tensor math must trace
+        @symbolic_translate
+        def f(x, n):
+            label = f"scale_{n}"
+            factors = {f"k{i}": float(i + 1) for i in range(3)}
+            tags = {t for t in ("a", "b")}
+            if "a" in tags and label == "scale_7":
+                return x * factors["k2"]
+            return x
+
+        out = f(_t([1.0]), 7)
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        assert not f.fell_back
+
+    def test_fstring_spec_and_conversion_flags(self):
+        # FORMAT_VALUE oparg flags: format specs ({n:03d}) and conversions
+        # ({s!r}) must produce exactly python's string
+        @symbolic_translate
+        def f(x, n, s):
+            label = f"v{n:03d}-{s!r}"
+            if label == "v007-'ab'":
+                return x + 1.0
+            return x - 1.0
+
+        out = f(_t([1.0]), 7, "ab")
+        np.testing.assert_allclose(out.numpy(), [2.0])
+        assert not f.fell_back
+
     def test_graph_is_replayed_not_baked(self):
         # same shape, DIFFERENT values must flow through the compiled entry
         @symbolic_translate
