@@ -187,6 +187,21 @@ class nn:
     def embedding(input, size, **kw):
         raise NotImplementedError("use paddle_tpu.nn.Embedding in both modes")
 
+    @staticmethod
+    def sparse_embedding(input, size, worker=None, table_name="embedding",
+                         **kw):
+        """Reference paddle.static.nn.sparse_embedding — the PS-backed
+        embedding (table lives on the parameter servers). Needs a live
+        `ps.PsWorker`; the Layer form is
+        distributed.PsEmbedding(worker, name, V, D)."""
+        if worker is None:
+            raise ValueError(
+                "sparse_embedding requires a ps.PsWorker (start the PS "
+                "runtime first: distributed.ps.TheOnePSRuntime)")
+        from ..distributed.ps_embedding import PsEmbedding
+        layer = PsEmbedding(worker, table_name, size[0], size[1], **kw)
+        return layer(input)
+
 
 def _not_impl():
     raise NotImplementedError("legacy static.nn builders: use paddle_tpu.nn "
