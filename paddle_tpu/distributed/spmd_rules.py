@@ -188,6 +188,13 @@ def apply_rule(rule, tensor_inputs, arrs, static_kwargs=None):
             if pl is None:
                 continue
             pl = list(pl)
+            from .placement import Shard as _Shard
+            if any(isinstance(p, _Shard) and p.get_dim() >= leaf._value.ndim
+                   for p in pl):
+                # a rule blind to a rank-changing attr (e.g. cumsum's
+                # flattening axis=None) declared a dim the output doesn't
+                # have — the layout is meaningless for this output, skip
+                continue
             spec = placements_to_spec(mesh, replicate_partials(pl),
                                       leaf._value.ndim)
             sharding = jax.sharding.NamedSharding(mesh.jax_mesh, spec)
@@ -554,6 +561,29 @@ def _install_builtin_rules():
 
     register_spmd_rule("add", _ew_binary_rule)
     register_spmd_rule("multiply", _ew_binary_rule)
+    register_spmd_rule("subtract", _ew_binary_rule)
+    register_spmd_rule("divide", _ew_binary_rule)
+    register_spmd_rule("maximum", _ew_binary_rule)
+    register_spmd_rule("minimum", _ew_binary_rule)
+
+    @register_spmd_rule("where")
+    def _where_rule(ctx):
+        # ternary elementwise: align value operands onto the condition's
+        # layout and let the output follow it — but ONLY in the
+        # no-broadcast case (equal ranks and extents); broadcasting
+        # right-aligns dims, so the condition's dim indices would not be
+        # the output's (same abstention _ew_binary_rule applies)
+        if len(ctx.shapes) < 3 or ctx.placements[0] is None:
+            return None
+        c_shape = ctx.shapes[0]
+        if any(ctx.shapes[k] != c_shape for k in (1, 2)):
+            return None
+        cm = _shard_map(ctx.placements[0])
+        if not cm:
+            return None
+        n_axes = len(ctx.mesh.shape)
+        pl = _pl(n_axes, cm)
+        return SpmdDecision(inputs=[None, pl, pl], outputs=[pl])
 
     # ---------------- reductions (reduction.cc) ----------------
     def _reduce_rule(ctx):
@@ -641,6 +671,11 @@ def _install_builtin_rules():
 
     register_spmd_rule("cast", _identity_layout_rule)
     register_spmd_rule("grad_cast", lambda ctx: _follow_primals(ctx, 1))
+    # shape-preserving unary ops: layout passes straight through
+    # (reference has a per-op rule file for each; one predicate serves)
+    for _n in ("cumsum", "tril", "triu", "clip"):
+        register_spmd_rule(_n, _identity_layout_rule)
+        register_spmd_rule("grad_" + _n, lambda ctx: _follow_primals(ctx, 1))
 
     @register_spmd_rule("stack")
     def _stack_rule(ctx):

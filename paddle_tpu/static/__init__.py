@@ -187,19 +187,27 @@ class nn:
     def embedding(input, size, **kw):
         raise NotImplementedError("use paddle_tpu.nn.Embedding in both modes")
 
+    _sparse_layers: dict = {}
+
     @staticmethod
     def sparse_embedding(input, size, worker=None, table_name="embedding",
                          **kw):
         """Reference paddle.static.nn.sparse_embedding — the PS-backed
         embedding (table lives on the parameter servers). Needs a live
         `ps.PsWorker`; the Layer form is
-        distributed.PsEmbedding(worker, name, V, D)."""
+        distributed.PsEmbedding(worker, name, V, D). The layer is
+        memoized per (worker, table) so a per-step call doesn't re-issue
+        create_table RPCs to every server."""
         if worker is None:
             raise ValueError(
                 "sparse_embedding requires a ps.PsWorker (start the PS "
                 "runtime first: distributed.ps.TheOnePSRuntime)")
         from ..distributed.ps_embedding import PsEmbedding
-        layer = PsEmbedding(worker, table_name, size[0], size[1], **kw)
+        key = (id(worker), table_name)
+        layer = nn._sparse_layers.get(key)
+        if layer is None:
+            layer = PsEmbedding(worker, table_name, size[0], size[1], **kw)
+            nn._sparse_layers[key] = layer
         return layer(input)
 
 

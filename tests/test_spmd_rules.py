@@ -307,6 +307,25 @@ class TestRuleLibrary:
         assert out.placements[0].is_shard(1)
         np.testing.assert_allclose(_global(out), x[:, idx], rtol=1e-6)
 
+    def test_where_aligns_and_follows_condition(self, mesh1d):
+        c = np.random.RandomState(0).rand(8, 16) > 0.5
+        a = self._np(8, 16, seed=1)
+        b = self._np(8, 16, seed=2)
+        dc = dist.shard_tensor(pt.to_tensor(c), mesh1d, [Shard(0)])
+        da = dist.shard_tensor(pt.to_tensor(a), mesh1d, [Replicate()])
+        db = dist.shard_tensor(pt.to_tensor(b), mesh1d, [Shard(1)])
+        out = pt.where(dc, da, db)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), np.where(c, a, b),
+                                   rtol=1e-6)
+
+    def test_cumsum_keeps_layout(self, mesh1d):
+        x = self._np(8, 4)
+        dx = dist.shard_tensor(pt.to_tensor(x), mesh1d, [Shard(0)])
+        out = pt.cumsum(dx, axis=1)
+        assert out.placements[0].is_shard(0)
+        np.testing.assert_allclose(_global(out), np.cumsum(x, 1), rtol=1e-5)
+
     def test_rule_changes_layout_vs_gspmd_default(self, mesh1d):
         """The library is not a no-op: with the layer_norm rule removed,
         GSPMD's propagation keeps the feature shard on a feature-sharded
