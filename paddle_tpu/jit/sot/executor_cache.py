@@ -1,15 +1,30 @@
-"""Executor cache + compiled entry (reference:
+"""Executor cache + compiled segment tree (reference:
 jit/sot/opcode_translator/executor/executor_cache.py).
 
-Per code object, a list of (GuardSet, compiled) entries. A call scans the
-entries in insertion order and runs the first whose guards pass; no match
-→ translate again (a NEW specialization — different shapes/dtypes/python
-values coexist, the reference's cache precision). Translation failures
-(graph breaks) mark the code object for eager fallback.
+Per code object, a list of root entries (GuardSet, _Segment, tensor
+paths). A call scans the entries in insertion order and runs the first
+whose guards pass; no match → translate again (a NEW specialization —
+different shapes/dtypes/python values coexist, the reference's cache
+precision).
+
+Graph breaks follow the reference's BreakGraph + resume-function design
+(opcode_executor.py:240-242 upstream): a tensor-predicate branch splits
+the function into compiled SEGMENTS. Each break segment's compiled prefix
+returns (predicate, *live tensors); the predicate is evaluated eagerly
+(one host sync), and the taken branch's continuation is translated lazily
+and cached as a child segment — so a function with a tensor-value branch
+still runs fully compiled, one subgraph per segment.
+
+Non-resumable breaks (side-effecting opcodes, unsupported bytecode) fall
+back to eager PER INPUT SIGNATURE — the same scoping the AST path uses
+(`jit/__init__.py` `_broken_sigs`); other signatures keep compiling.
+Genuine translation/compile bugs are counted separately (`sot_stats()
+["errors"]`) and logged, never silently conflated with graph breaks.
 """
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any
 
 import jax
@@ -19,8 +34,13 @@ from .opcode_executor import GraphBreakError, OpcodeExecutor
 
 __all__ = ["symbolic_translate", "SotFunction", "sot_stats"]
 
-_STATS = {"translations": 0, "hits": 0, "misses": 0, "breaks": 0}
+logger = logging.getLogger("paddle_tpu.jit.sot")
+
+_STATS = {"translations": 0, "resumes": 0, "hits": 0, "misses": 0,
+          "breaks": 0, "errors": 0}
 _MAX_ENTRIES_PER_CODE = 32
+_MAX_SEGMENT_DEPTH = 8   # tensor-predicate while-loops unroll one segment
+                         # per iteration — bound the tree
 
 
 def sot_stats():
@@ -31,9 +51,19 @@ def _as_value(x):
     return x._value if isinstance(x, Tensor) else x
 
 
-def _compile_entry(graph, out_ref, n_inputs):
-    """jax.jit over a replay of the recorded graph (the analog of SOT's
-    generated bytecode running the captured program)."""
+def _is_tensor_leaf(v):
+    return isinstance(v, Tensor)
+
+
+def _wrap_out(out):
+    return jax.tree.map(
+        lambda v: Tensor(v) if hasattr(v, "dtype") else v, out)
+
+
+def _compile_segment(graph, out_refs):
+    """jax.jit over a replay of one segment's recorded graph, returning
+    the list of values for `out_refs` (the analog of SOT's generated
+    bytecode running the captured program)."""
 
     def resolve(ref, inputs, outs):
         kind, x = ref
@@ -45,16 +75,41 @@ def _compile_entry(graph, out_ref, n_inputs):
             return tuple(resolve(r, inputs, outs) for r in x)
         if kind == "list":
             return [resolve(r, inputs, outs) for r in x]
-        return x
+        return x  # const
 
     def raw(*arrs):
         inputs = [Tensor(a) for a in arrs]
         outs = graph.replay(inputs)
-        result = resolve(out_ref, inputs, outs)
-        return jax.tree.map(_as_value, result,
-                            is_leaf=lambda v: isinstance(v, Tensor))
+        results = [resolve(r, inputs, outs) for r in out_refs]
+        return [jax.tree.map(_as_value, r, is_leaf=_is_tensor_leaf)
+                for r in results]
 
     return jax.jit(raw)
+
+
+class _Segment:
+    """One compiled piece of the function. kind == "done": compiled
+    returns [result]. kind == "break": compiled returns [pred, *live];
+    children[bool] is the continuation for that branch direction."""
+
+    __slots__ = ("kind", "compiled", "brk", "children")
+
+    def __init__(self, kind, compiled, brk=None):
+        self.kind = kind
+        self.compiled = compiled
+        self.brk = brk
+        self.children: dict = {}
+
+
+def _build_segment(run_result):
+    """(graph, out refs) → compiled _Segment, from an executor result."""
+    status = run_result[0]
+    if status == "done":
+        _, graph, out_ref, _g = run_result
+        return _Segment("done", _compile_segment(graph, [out_ref]))
+    _, graph, brk, _g = run_result
+    compiled = _compile_segment(graph, [brk.pred_ref] + list(brk.live_refs))
+    return _Segment("break", compiled, brk)
 
 
 class SotFunction:
@@ -62,8 +117,9 @@ class SotFunction:
 
     def __init__(self, fn):
         self._fn = fn
-        self._entries: list = []   # (GuardSet, compiled, tensor_paths)
-        self._fallback = False     # permanent eager after a graph break
+        self._entries: list = []     # (GuardSet, _Segment, tensor_paths)
+        self._broken_sigs: set = set()  # eager, per input signature
+        self._error_sigs: set = set()   # ditto, but a bug — logged
         functools.update_wrapper(self, fn)
 
     # -- introspection (tests/poking) --
@@ -73,7 +129,40 @@ class SotFunction:
 
     @property
     def fell_back(self):
-        return self._fallback
+        """True if ANY signature has fallen back to eager."""
+        return bool(self._broken_sigs or self._error_sigs)
+
+    def segment_count(self):
+        """Total compiled segments across all entries (tree walk)."""
+        n = 0
+        stack = [seg for _, seg, _ in self._entries]
+        while stack:
+            s = stack.pop()
+            n += 1
+            stack.extend(s.children.values())
+        return n
+
+    @staticmethod
+    def _sig_key(args, kwargs):
+        def leaf(x):
+            v = _as_value(x)
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return ("arr", tuple(v.shape), str(v.dtype))
+            return ("obj", type(v).__name__, repr(v)[:64])
+
+        flat, treedef = jax.tree.flatten((args, kwargs))
+        return (tuple(leaf(x) for x in flat), str(treedef))
+
+    def _cells(self):
+        code = self._fn.__code__
+        closure = self._fn.__closure__ or ()
+        out = {}
+        for name, cell in zip(code.co_freevars, closure):
+            try:
+                out[name] = cell.cell_contents
+            except ValueError:
+                pass
+        return out
 
     def _tensor_args(self, paths, args, kwargs):
         out = []
@@ -83,40 +172,108 @@ class SotFunction:
         return out
 
     def __call__(self, *args, **kwargs):
-        if self._fallback:
-            return self._fn(*args, **kwargs)
+        sig = None
+        if self._broken_sigs or self._error_sigs:
+            sig = self._sig_key(args, kwargs)
+            if sig in self._broken_sigs or sig in self._error_sigs:
+                return self._fn(*args, **kwargs)
         gns = self._fn.__globals__
-        for guards, compiled, paths in self._entries:
-            if guards.check(args, kwargs, gns):
+        cells = self._cells() if self._fn.__closure__ else None
+        for guards, seg, paths in self._entries:
+            if guards.check(args, kwargs, gns, cells):
                 _STATS["hits"] += 1
-                out = compiled(*self._tensor_args(paths, args, kwargs))
-                return jax.tree.map(
-                    lambda v: Tensor(v) if hasattr(v, "dtype") else v, out)
+                arrs = self._tensor_args(paths, args, kwargs)
+                try:
+                    return self._run_segments(seg, arrs, guards)
+                except GraphBreakError as e:
+                    # e.g. segment-depth exceeded, or a lazily-translated
+                    # continuation broke — contract is eager fallback,
+                    # never a GraphBreakError escaping to user code
+                    self._mark_break(sig, args, kwargs, e)
+                    return self._fn(*args, **kwargs)
+                except Exception as e:
+                    self._mark_error(sig, args, kwargs, e)
+                    return self._fn(*args, **kwargs)
         _STATS["misses"] += 1
-        return self._translate_and_run(args, kwargs)
+        return self._translate_and_run(args, kwargs, sig)
 
-    def _translate_and_run(self, args, kwargs):
+    # ---------------- translation ----------------
+    def _mark_break(self, sig, args, kwargs, exc):
+        _STATS["breaks"] += 1
+        self._broken_sigs.add(sig or self._sig_key(args, kwargs))
+        logger.debug("sot: graph break in %s (%s); eager for this "
+                     "signature", self.__qualname__, exc)
+
+    def _mark_error(self, sig, args, kwargs, exc):
+        _STATS["errors"] += 1
+        self._error_sigs.add(sig or self._sig_key(args, kwargs))
+        logger.warning(
+            "sot: translation/compile ERROR in %s — this is a bug in the "
+            "translator, not a graph break; eager for this signature: %r",
+            self.__qualname__, exc)
+
+    def _translate_and_run(self, args, kwargs, sig):
         try:
             ex = OpcodeExecutor(self._fn, args, kwargs)
-            graph, out_ref, guards = ex.run()
-            compiled = _compile_entry(graph, out_ref, ex.n_tensor_inputs)
-            tensors = self._tensor_args(ex.tensor_input_paths, args, kwargs)
-            out = compiled(*tensors)  # compile errors surface HERE
-        except GraphBreakError:
-            _STATS["breaks"] += 1
-            self._fallback = True
+            result = ex.run()
+        except GraphBreakError as e:
+            self._mark_break(sig, args, kwargs, e)
             return self._fn(*args, **kwargs)
-        except Exception:
-            # replay/compile failed (e.g. a black-box callee branched on a
-            # tracer) — same graph-break semantics
-            _STATS["breaks"] += 1
-            self._fallback = True
+        except Exception as e:
+            self._mark_error(sig, args, kwargs, e)
+            return self._fn(*args, **kwargs)
+        guards = result[3]
+        try:
+            seg = _build_segment(result)
+            arrs = self._tensor_args(ex.tensor_input_paths, args, kwargs)
+            out = self._run_segments(seg, arrs, guards)
+        except GraphBreakError as e:
+            self._mark_break(sig, args, kwargs, e)
+            return self._fn(*args, **kwargs)
+        except Exception as e:
+            self._mark_error(sig, args, kwargs, e)
             return self._fn(*args, **kwargs)
         _STATS["translations"] += 1
         if len(self._entries) < _MAX_ENTRIES_PER_CODE:
-            self._entries.append((guards, compiled, ex.tensor_input_paths))
-        return jax.tree.map(
-            lambda v: Tensor(v) if hasattr(v, "dtype") else v, out)
+            self._entries.append((guards, seg, ex.tensor_input_paths))
+        return out
+
+    # ---------------- runtime ----------------
+    def _run_segments(self, seg, arrs, root_guards):
+        """Walk the segment tree: run compiled pieces, evaluating break
+        predicates eagerly and translating missing continuations lazily.
+        Raises (GraphBreakError or a translator bug) propagate to the
+        caller, which falls back to a full eager re-run — segments are
+        pure, so the prefix work has no side effects to undo."""
+        depth = 0
+        while True:
+            outs = seg.compiled(*arrs)
+            if seg.kind == "done":
+                return _wrap_out(outs[0])
+            depth += 1
+            if depth > _MAX_SEGMENT_DEPTH:
+                raise GraphBreakError(
+                    "segment depth exceeded (tensor-predicate loop?)")
+            pred = bool(jax.device_get(outs[0]))
+            live = outs[1:]
+            child = seg.children.get(pred)
+            if child is None:
+                child = self._translate_resume(seg, pred, live,
+                                               root_guards)
+                seg.children[pred] = child
+            seg, arrs = child, live
+
+    def _translate_resume(self, parent, branch, live, root_guards):
+        ex = OpcodeExecutor.for_resume(
+            self._fn, parent.brk, [Tensor(a) for a in live], branch)
+        result = ex.run()
+        _STATS["resumes"] += 1
+        # globals/closure cells first read AFTER the break were guarded on
+        # the continuation's GuardSet — fold them into the root entry so a
+        # later rebind invalidates the whole tree (cache entries are only
+        # selected by the root guards)
+        root_guards.merge(result[3])
+        return _build_segment(result)
 
 
 def symbolic_translate(fn=None):

@@ -9,10 +9,15 @@ input-derived decision it resolved concretely:
   * non-tensor args → type + equality (a different int/str/bool/None
     retranslates);
   * globals the trace CALLED → identity (monkeypatching a called function
-    invalidates the entry).
+    invalidates the entry);
+  * closure cells the trace READ → type + equality against a deep-copied
+    snapshot (a nonlocal counter or captured config that changes between
+    calls invalidates the entry rather than silently replaying stale
+    constants).
 """
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass
 from typing import Any
 
@@ -31,15 +36,26 @@ def tensor_meta(v):
 
 @dataclass(frozen=True)
 class Guard:
-    kind: str        # "tensor" | "value" | "global_id"
-    path: tuple      # ("arg", i) / ("kwarg", name) / ("global", name)
+    kind: str        # "tensor" | "value" | "global_id" | "cell"
+    path: tuple      # ("arg", i)/("kwarg", name)/("global", n)/("cell", n)
     expect: Any
 
-    def check(self, args, kwargs, globals_ns) -> bool:
+    def check(self, args, kwargs, globals_ns, cells=None) -> bool:
         if self.kind == "global_id":
             name = self.path[1]
             got = globals_ns.get(name, _MISSING)
             return got is not _MISSING and id(got) == self.expect
+        if self.kind == "cell":
+            got = (cells or {}).get(self.path[1], _MISSING)
+            if got is _MISSING:
+                return False
+            et, ev = self.expect
+            if type(got) is not et:
+                return False
+            try:
+                return bool(got == ev)
+            except Exception:
+                return got is ev
         where, key = self.path
         try:
             v = args[key] if where == "arg" else kwargs[key]
@@ -81,11 +97,39 @@ class GuardSet:
         self._guards.setdefault(("global", name),
                                 Guard("global_id", ("global", name), id(v)))
 
+    def add_cell(self, name, v) -> bool:
+        """Value guard for a closure cell. Returns False when the content
+        cannot be snapshotted for later comparison (caller graph-breaks).
+        Callables are guarded by identity, like globals."""
+        if ("cell", name) in self._guards:
+            return True
+        if callable(v) or isinstance(v, type):
+            self._guards[("cell", name)] = Guard(
+                "cell", ("cell", name), (type(v), v))
+            return True
+        try:
+            snap = copy.deepcopy(v)
+            if not (v == snap):  # must be self-comparable
+                return False
+        except Exception:
+            return False
+        self._guards[("cell", name)] = Guard(
+            "cell", ("cell", name), (type(v), snap))
+        return True
+
+    def merge(self, other: "GuardSet"):
+        """Adopt another set's guards (used to fold guards discovered
+        while translating a resume continuation — globals/closure cells
+        first read after a break — into the ROOT entry's guards, so a
+        later rebind still invalidates the whole segment tree)."""
+        for k, g in other._guards.items():
+            self._guards.setdefault(k, g)
+
     def guards(self):
         return list(self._guards.values())
 
-    def check(self, args, kwargs, globals_ns) -> bool:
-        return all(g.check(args, kwargs, globals_ns)
+    def check(self, args, kwargs, globals_ns, cells=None) -> bool:
+        return all(g.check(args, kwargs, globals_ns, cells)
                    for g in self._guards.values())
 
     def __len__(self):

@@ -11,14 +11,22 @@ at translate time — loops over concrete iterables UNROLL, exactly like
 the reference's executor). Ops on pure-python values just run.
 
 A conditional jump whose predicate is a TRACKED value (a tensor's truth
-value) cannot be resolved symbolically → `GraphBreakError`, and the
-caller falls back to eager — the reference's graph-break semantics.
+value) cannot be resolved symbolically. Mirroring the reference's
+`Stop(state="BreakGraph")` + resume-function design
+(jit/sot/opcode_translator/executor/opcode_executor.py:240-242 upstream),
+`run()` RETURNS a ("break", prefix_graph, BreakPoint, guards) result: the
+prefix graph is compiled, the predicate is evaluated eagerly at runtime,
+and symbolic translation RESUMES from the taken branch's offset with the
+live locals/stack re-seeded as fresh graph inputs (see
+executor_cache._Segment). Only breaks that are NOT resumable this way
+(side-effecting opcodes, unsupported bytecode) raise `GraphBreakError`.
 """
 from __future__ import annotations
 
 import dis
 import operator
 import types
+from dataclasses import dataclass, field
 from typing import Any
 
 import jax
@@ -26,15 +34,47 @@ import jax
 from ...core.tensor import Tensor
 from .guards import GuardSet
 
-__all__ = ["OpcodeExecutor", "FunctionGraph", "GraphBreakError", "Var"]
+__all__ = ["OpcodeExecutor", "FunctionGraph", "GraphBreakError", "Var",
+           "BreakPoint"]
 
 
 class GraphBreakError(Exception):
     """Bytecode the symbolic executor cannot stay symbolic through."""
 
 
+@dataclass
+class BreakPoint:
+    """A resumable graph break at a tensor-predicate conditional jump.
+
+    The prefix graph computes `pred_ref` plus every live tracked value;
+    the runtime evaluates the predicate eagerly (one host sync — exactly
+    what the reference's BreakGraph does) and resumes translation at
+    `true_offset` or `false_offset`, seeding locals/stack from the specs.
+    A spec is ("t", i) — the i-th live tensor — or ("c", value, origin),
+    a constant reproducible under the entry's guards.
+    """
+
+    pred_ref: tuple
+    true_offset: int
+    false_offset: int
+    live_refs: list = field(default_factory=list)
+    locals_spec: list = field(default_factory=list)   # (name, spec)
+    stack_spec: list = field(default_factory=list)    # spec, bottom→top
+
+
 _NULL = object()        # CPython's PUSH_NULL marker
 _MISSING = object()
+
+import collections.abc as _cabc
+
+_ITERATOR_ABC = _cabc.Iterator
+# iterator types whose remaining items can be drained into a list without
+# side effects (generator/file/etc. iterators cannot)
+_DRAINABLE_ITERS = frozenset({
+    type(iter([])), type(iter(())), type(iter(range(0))),
+    type(iter("")), type(iter({})), type(iter({}.items())),
+    type(iter({}.values())), type(iter(set())),
+})
 
 
 def _is_tensorish(v) -> bool:
@@ -161,7 +201,103 @@ class OpcodeExecutor:
         self.stack: list[Var] = []
         self.locals: dict[str, Var] = {}
         self.kw_names: tuple = ()
-        self._bind(args, kwargs)
+        self.start_offset = 0
+        self.n_tensor_inputs = 0
+        self.tensor_input_paths = []
+        if args is not None:
+            self._bind(args, kwargs)
+
+    @classmethod
+    def for_resume(cls, fn, brk: BreakPoint, live_tensors, branch: bool):
+        """Continuation executor: same code object, but starting at the
+        taken branch's offset with locals/stack seeded from the break's
+        live state. Live tensors become fresh graph inputs ("in", i); the
+        const seeds are reproducible under the root entry's guards, so the
+        resumed segment needs no guards of its own (it is reached only
+        through its parent segment + branch direction)."""
+        ex = cls(fn, None, None)
+        ex.start_offset = brk.true_offset if branch else brk.false_offset
+        ex.n_tensor_inputs = len(live_tensors)
+
+        memo: dict[int, Var] = {}  # shared Var identity (iterators) survives
+
+        def seed(spec):
+            if spec[0] == "t":
+                return Var(live_tensors[spec[1]], ("in", spec[1]))
+            if spec[0] == "it":
+                if id(spec) not in memo:
+                    memo[id(spec)] = Var(iter(list(spec[1])))
+                return memo[id(spec)]
+            if spec[0] == "cc":  # mutable snapshot: fresh copy per resume
+                import copy as _copy
+                if id(spec) not in memo:
+                    memo[id(spec)] = Var(_copy.deepcopy(spec[1]),
+                                         origin=spec[2])
+                return memo[id(spec)]
+            return Var(spec[1], origin=spec[2])
+
+        for name, spec in brk.locals_spec:
+            ex.locals[name] = seed(spec)
+        ex.stack = [seed(s) for s in brk.stack_spec]
+        return ex
+
+    def _make_break(self, pred_var: Var, true_offset: int,
+                    false_offset: int) -> BreakPoint:
+        """Snapshot the live state for a resumable break. Tracked vars
+        become live tensor outputs of the prefix graph; composite tracked
+        containers (tuple/list refs) are not resumable — the seeding would
+        need a flatten/unflatten protocol — so they raise and the caller
+        falls back per-signature."""
+        brk = BreakPoint(self._ref_of(pred_var), true_offset, false_offset)
+        by_id: dict[int, int] = {}
+        drained: dict[int, tuple] = {}
+
+        def spec_of(v: Var):
+            if v.tracked:
+                if v.ref[0] in ("tuple", "list"):
+                    raise GraphBreakError(
+                        "container of tensors live at a graph break")
+                if id(v) not in by_id:
+                    by_id[id(v)] = len(brk.live_refs)
+                    brk.live_refs.append(v.ref)
+                return ("t", by_id[id(v)])
+            if _contains_tensor(v.value):
+                raise GraphBreakError("untracked tensor live at a break")
+            if isinstance(v.value, tuple) and len(v.value) == 3 \
+                    and v.value[0] == "method" \
+                    and isinstance(v.value[1], Var):
+                raise GraphBreakError("bound-method marker live at a break")
+            if type(v.value) in _DRAINABLE_ITERS:
+                # a live iterator (break inside a for-loop): drain the
+                # REMAINING items now — both branch resumes then re-seed a
+                # fresh iter() over the snapshot, so translating the second
+                # branch on a later call does not see a consumed iterator
+                if id(v) not in drained:
+                    drained[id(v)] = ("it", list(v.value))
+                return drained[id(v)]
+            if isinstance(v.value, _ITERATOR_ABC):
+                raise GraphBreakError(
+                    "non-snapshotable iterator live at a break")
+            if isinstance(v.value, (list, dict, set, bytearray)):
+                # trace-created mutables must be snapshotted BY VALUE:
+                # translating one branch may mutate the object (append in
+                # the True arm), and the other branch's later translation
+                # must seed from the state AT the break, not after
+                import copy as _copy
+                try:
+                    return ("cc", _copy.deepcopy(v.value), v.origin)
+                except Exception:
+                    raise GraphBreakError(
+                        "undeepcopyable mutable live at a break")
+            return ("c", v.value, v.origin)
+
+        for name, v in self.locals.items():
+            brk.locals_spec.append((name, spec_of(v)))
+        for v in self.stack:
+            if v.value is _NULL or v.value is _MISSING:
+                raise GraphBreakError("stack sentinel live at a break")
+            brk.stack_spec.append(spec_of(v))
+        return brk
 
     # ---------------- setup ----------------
     def _bind(self, args, kwargs):
@@ -228,7 +364,7 @@ class OpcodeExecutor:
     def run(self):
         instrs = list(dis.get_instructions(self.code))
         by_offset = {i.offset: n for n, i in enumerate(instrs)}
-        pc = 0
+        pc = by_offset.get(self.start_offset, 0)
         steps = 0
         push, pop = self.stack.append, self.stack.pop
         while True:
@@ -271,7 +407,7 @@ class OpcodeExecutor:
                         raise GraphBreakError(f"unresolved global {arg!r}")
                 else:
                     self.guards.add_global(arg, v)
-                push(Var(v))
+                push(Var(v, origin="external"))
             elif op == "LOAD_DEREF":
                 try:
                     cell = dict(zip(
@@ -282,7 +418,14 @@ class OpcodeExecutor:
                     raise GraphBreakError(f"unresolved closure cell {arg!r}")
                 if _contains_tensor(cell):
                     raise GraphBreakError("tensor captured in closure")
-                push(Var(cell))
+                # a closure cell's content can change between calls
+                # (nonlocal counter, captured config): guard its VALUE so
+                # the cached entry is invalidated, or break if the value
+                # cannot be snapshotted for comparison
+                if not self.guards.add_cell(arg, cell):
+                    raise GraphBreakError(
+                        f"unsnapshotable closure cell {arg!r}")
+                push(Var(cell, origin="external"))
             elif op == "LOAD_ATTR":
                 o = pop()
                 is_method = bool(ins.arg & 1)
@@ -294,7 +437,9 @@ class OpcodeExecutor:
                 elif o.tracked and _contains_tensor(concrete):
                     push(self._record(_get_attr(arg), [o], {}, concrete))
                 else:
-                    push(Var(concrete))
+                    # attribute of an external object is itself external
+                    # (mutating it would be a side effect outside the graph)
+                    push(Var(concrete, origin=o.origin))
             elif op == "BINARY_OP":
                 b, a = pop(), pop()
                 fn = _BINOPS.get(ins.argrepr)
@@ -328,9 +473,9 @@ class OpcodeExecutor:
                     raise GraphBreakError("`not` on a tensor value")
                 push(Var(not v.value))
             elif op == "TO_BOOL":
-                v = self.stack[-1]
-                if v.tracked:
-                    raise GraphBreakError("truth test on a tensor value")
+                # on a tracked value, leave the tensor in place: the
+                # following POP_JUMP_IF_* turns it into a resumable break
+                pass
             elif op == "BINARY_SUBSCR":
                 idx, o = pop(), pop()
                 push(self._apply(operator.getitem, [o, idx]))
@@ -457,9 +602,17 @@ class OpcodeExecutor:
             elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
                 v = pop()
                 if v.tracked:
-                    raise GraphBreakError(
-                        "branch on a tensor value (data-dependent control "
-                        "flow) — use lax.cond or fall back to eager")
+                    # resumable break: compile the prefix, evaluate the
+                    # predicate eagerly at runtime, resume at the taken
+                    # branch (the reference's BreakGraph + resume-fn)
+                    target = ins.argval
+                    fallthrough = instrs[pc + 1].offset
+                    if op.endswith("TRUE"):
+                        t_off, f_off = target, fallthrough
+                    else:
+                        t_off, f_off = fallthrough, target
+                    brk = self._make_break(v, t_off, f_off)
+                    return ("break", self.graph, brk, self.guards)
                 truth = bool(v.value)
                 if (op.endswith("TRUE")) == truth:
                     pc = by_offset[ins.argval]
@@ -530,10 +683,10 @@ class OpcodeExecutor:
         the trace are appendable — mutating a caller-supplied list is a
         side effect the cached replay would not reproduce (and its value
         guard would either go stale or force a retrace per call)."""
-        if tgt.origin == "arg":
+        if tgt.origin in ("arg", "external"):
             raise GraphBreakError(
-                "append to a caller-supplied list (side effect outside the "
-                "graph)")
+                "append to a list not created inside the trace (side "
+                "effect outside the graph)")
         if tgt.tracked and tgt.ref[0] not in ("list",):
             raise GraphBreakError("append to a non-list tracked value")
         if v.tracked or tgt.tracked:
@@ -559,7 +712,10 @@ class OpcodeExecutor:
         if tracked and _is_tensorish(args[0] if args else None) \
                 and isinstance(out, (bool,)):
             raise GraphBreakError("python bool from tensor op")
-        return Var(out)
+        # an item pulled out of an external container stays external
+        origin = arg_vars[0].origin if (
+            fn is operator.getitem and arg_vars) else None
+        return Var(out, origin=origin)
 
     def _call(self, fn_var, arg_vars, kwarg_vars):
         fn = fn_var.value
@@ -577,10 +733,13 @@ class OpcodeExecutor:
         "reverse", "update", "setdefault", "popitem", "add", "discard"})
 
     def _call_method_var(self, self_var, name, arg_vars, kwarg_vars):
-        if self_var.origin == "arg" and name in self._MUTATING_METHODS:
+        if self_var.origin in ("arg", "external") \
+                and name in self._MUTATING_METHODS:
+            # covers caller-supplied objects AND module globals / closure
+            # cells: a cached replay would silently skip the side effect
             raise GraphBreakError(
-                f"mutating method .{name}() on a caller-supplied object "
-                "(side effect outside the graph)")
+                f"mutating method .{name}() on an object not created "
+                "inside the trace (side effect outside the graph)")
         if isinstance(self_var.value, list) and name == "append" \
                 and len(arg_vars) == 1 and not kwarg_vars:
             self._list_append(self_var, arg_vars[0])
@@ -593,4 +752,4 @@ class OpcodeExecutor:
 
     # ---------------- output ----------------
     def _finish(self, out_var: Var):
-        return self.graph, self._ref_of(out_var), self.guards
+        return ("done", self.graph, self._ref_of(out_var), self.guards)

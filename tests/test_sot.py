@@ -171,16 +171,49 @@ class TestGuards:
 
 
 class TestGraphBreak:
-    def test_tensor_value_branch_falls_back(self):
+    def test_tensor_value_branch_resumes(self):
+        # reference BreakGraph + resume-fn semantics: the function still
+        # runs COMPILED — a prefix segment up to the predicate, then the
+        # taken branch's continuation segment (translated lazily per
+        # direction), no eager fallback
+        @symbolic_translate
+        def f(x):
+            y = x + 1.0
+            if y.sum() > 4.0:
+                return y * 2.0
+            return y - 1.0
+
+        out = f(_t([1.0, 2.0]))          # sum=5 → True branch
+        np.testing.assert_allclose(out.numpy(), [4.0, 6.0])
+        assert not f.fell_back
+        assert f.cache_size == 1
+        assert f.segment_count() == 2    # prefix + True continuation
+        out2 = f(_t([-2.0, 0.0]))        # same shapes, y sums to 0 → False
+        np.testing.assert_allclose(out2.numpy(), [-2.0, 0.0])
+        assert not f.fell_back
+        assert f.cache_size == 1         # same root entry
+        assert f.segment_count() == 3    # + False continuation
+        # both branches now cached: replay each without retranslation
+        from paddle_tpu.jit.sot.executor_cache import sot_stats
+        before = sot_stats()["resumes"]
+        np.testing.assert_allclose(f(_t([3.0, 3.0])).numpy(), [8.0, 8.0])
+        np.testing.assert_allclose(f(_t([-3.0, 0.0])).numpy(), [-3.0, 0.0])
+        assert sot_stats()["resumes"] == before
+
+    def test_chained_tensor_branches_resume(self):
         @symbolic_translate
         def f(x):
             if x.sum() > 0:
-                return x * 2.0
+                x = x * 2.0
+            if x.mean() > 10.0:
+                return x + 100.0
             return x
 
-        out = f(_t([1.0, 1.0]))
-        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
-        assert f.fell_back  # eager fallback, correct result
+        np.testing.assert_allclose(f(_t([6.0])).numpy(), [112.0])
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(f(_t([-1.0])).numpy(), [-1.0])
+        assert not f.fell_back
+        assert f.segment_count() >= 4    # prefix + chained continuations
 
     def test_side_effect_opcode_falls_back(self):
         store = {}
@@ -195,15 +228,146 @@ class TestGraphBreak:
         assert f.fell_back
         assert store["x"] == 1  # the eager run performed the side effect
 
-    def test_executor_raises_graph_break_directly(self):
+    def test_fallback_is_per_signature(self):
+        # a break for one input signature must not poison others — the
+        # same scoping the AST path's _broken_sigs gives (r2 advisor fix)
+        calls = []
+
+        @symbolic_translate
+        def f(x, flag):
+            if flag:              # python branch — fine
+                calls.append(1)   # closure-list append → non-resumable
+                return x + 1.0
+            return x * 3.0
+
+        out = f(_t([1.0]), True)   # breaks (side effect) → eager
+        np.testing.assert_allclose(out.numpy(), [2.0])
+        assert f.fell_back and calls == [1]
+        out2 = f(_t([1.0]), False)  # different signature: still compiles
+        np.testing.assert_allclose(out2.numpy(), [3.0])
+        assert f.cache_size == 1
+        # broken signature stays eager (side effect preserved each call)
+        f(_t([1.0]), True)
+        assert calls == [1, 1]
+
+    def test_break_stats_distinguish_bugs(self):
+        from paddle_tpu.jit.sot.executor_cache import sot_stats
+        s0 = sot_stats()
+
+        @symbolic_translate
+        def f(x):
+            import os  # IMPORT_NAME → GraphBreak, not an error
+            return x + 1.0
+
+        f(_t([1.0]))
+        s1 = sot_stats()
+        assert s1["breaks"] == s0["breaks"] + 1
+        assert s1["errors"] == s0["errors"]
+
+    def test_executor_returns_break_result(self):
         def f(x):
             if x.sum() > 0:
                 return x
             return -x
 
         ex = OpcodeExecutor(f, (_t([1.0]),), {})
-        with pytest.raises(GraphBreakError):
-            ex.run()
+        result = ex.run()
+        assert result[0] == "break"
+        brk = result[2]
+        assert brk.true_offset != brk.false_offset
+
+    def test_break_inside_for_loop_resumes(self):
+        # a live (drainable) iterator at the break is snapshotted so the
+        # second branch translated on a LATER call sees the same items
+        @symbolic_translate
+        def f(x):
+            acc = x
+            for i in range(4):
+                if acc.sum() > 100.0:
+                    acc = acc - 1.0
+                else:
+                    acc = acc + float(i)
+            return acc
+
+        np.testing.assert_allclose(f(_t([0.0])).numpy(), [6.0])
+        np.testing.assert_allclose(f(_t([200.0])).numpy(), [196.0])
+        assert not f.fell_back
+
+
+class TestClosureGuards:
+    def test_closure_cell_change_invalidates(self):
+        # r3 advisor medium: a nonlocal/captured value baked as a const
+        # must be guarded — REBINDING the cell between calls on the SAME
+        # cached SotFunction must miss the guard and retranslate
+        scale = 2.0
+
+        def f(x):
+            return x * scale
+
+        sf = symbolic_translate(f)
+        np.testing.assert_allclose(sf(_t([3.0])).numpy(), [6.0])
+        assert sf.cache_size == 1
+        scale = 5.0  # rebind the nonlocal — same function object
+        np.testing.assert_allclose(sf(_t([3.0])).numpy(), [15.0])
+        assert sf.cache_size == 2  # guard missed → new specialization
+
+    def test_cell_read_after_break_still_guarded(self):
+        # a closure cell first read AFTER a tensor-predicate break is
+        # guarded on the resumed segment — its guard must still protect
+        # the ROOT cache entry
+        bonus = 10.0
+
+        def f(x):
+            if x.sum() > 0:
+                return x + bonus
+            return x
+
+        sf = symbolic_translate(f)
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [11.0])
+        assert not sf.fell_back
+        bonus = 99.0
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [100.0])
+
+    def test_branch_mutation_of_trace_list_snapshotted(self):
+        # a trace-created mutable live at a break is snapshotted by VALUE:
+        # translating the True arm (which mutates it) must not poison the
+        # False arm's later translation
+        @symbolic_translate
+        def f(x):
+            acc = [1.0]
+            if x.sum() > 0:
+                acc.append(2.0)
+            else:
+                acc.append(3.0)
+            return x * sum(acc)
+
+        np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])   # 1+2
+        np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-8.0])  # 1+3
+        assert not f.fell_back
+
+    def test_nonlocal_counter_guarded(self):
+        cfg = {"k": 2.0}
+
+        def f(x):
+            return x * cfg["k"]
+
+        sf = symbolic_translate(f)
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+        cfg["k"] = 7.0  # mutate the captured dict IN PLACE
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [7.0])
+        assert sf.cache_size == 2  # cell value guard missed → retranslate
+
+    def test_global_container_mutation_breaks(self):
+        # r3 advisor medium: LOG.append(x) on a module-global list must
+        # graph-break (cached replay would skip the side effect)
+        glob = {"LOG": []}
+        exec("def body(x):\n    LOG.append(1)\n    return x + 1.0\n", glob)
+        sf = symbolic_translate(glob["body"])
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+        assert sf.fell_back
+        assert glob["LOG"] == [1]
+        sf(_t([1.0]))
+        assert glob["LOG"] == [1, 1]  # eager every call, effect preserved
 
 
 class TestToStaticIntegration:
