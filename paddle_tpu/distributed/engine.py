@@ -140,11 +140,6 @@ class Engine:
         self._pp_vpp = False
         self._pp_counts = None  # per-stage layer counts (uneven segmentation)
         if self.use_pp:
-            if (self.strategy.pp_schedule or "").lower() in ("1f1b", "vpp"):
-                # gpipe/fthenb thread a per-stage RNG through the schedule
-                # (RNGStatesTracker analog) — only the explicit tick
-                # schedules still require dropout-free models
-                self._check_pp_dropout_free(model)
             # internal pp layout: block params live stacked+chunked under
             # "_blocks.<subkey>", sharded on 'pp' AT REST — no per-step
             # restack, and each device holds only its stages.
@@ -209,21 +204,6 @@ class Engine:
         """Front-loaded balanced segmentation (reference SegmentLayers)."""
         base, rem = divmod(nlayers, S)
         return [base + 1] * rem + [base] * (S - rem)
-
-    @staticmethod
-    def _check_pp_dropout_free(model):
-        """The explicit 1f1b/vpp tick schedules run without a per-step RNG,
-        so a dropout mask would be baked at trace time — reject instead of
-        silently corrupting regularization. (gpipe/fthenb DO thread a
-        per-stage key — use those to pipeline dropout models.)"""
-        from ..nn.layer.common import Dropout, Dropout2D, Dropout3D
-        for name, sub in model.named_sublayers(include_self=True):
-            if isinstance(sub, (Dropout, Dropout2D, Dropout3D)) and sub.p > 0:
-                raise ValueError(
-                    f"pp_schedule '1f1b'/'vpp' requires dropout p=0 (found "
-                    f"p={sub.p} at '{name}'): the explicit tick schedules "
-                    "cannot thread a per-step RNG yet — use "
-                    "pp_schedule='gpipe' to pipeline dropout models")
 
     # ---------------- placement ----------------
     def _user_spec(self, name, value):
@@ -499,15 +479,10 @@ class Engine:
             with _rng.rng_guard(k):
                 return apply_block(carry, bp)
 
+        # every schedule path threads the per-(stage, microbatch) key —
+        # the engine's step/evaluate always supply one (split_key), so no
+        # unkeyed stage variant exists
         if not uneven:
-            def stage_fn(sp, act):
-                def body(carry, bp):
-                    return apply_block(carry, bp), None
-
-                body_fn = jax.checkpoint(body) if st.remat else body
-                out, _ = jax.lax.scan(body_fn, act, sp)
-                return out
-
             def stage_fn_keyed(sp, act, key):
                 # per-layer keys (RNGStatesTracker analog): block i draws
                 # from fold_in(stage_tick_key, i)
@@ -524,21 +499,6 @@ class Engine:
             # uneven segmentation: stages scan Lmax padded slots and skip
             # the tail via cond (padded params never run; their grads are
             # exactly zero) — reference SegmentLayers semantics
-            def stage_fn(sp, act):
-                n = counts_arr[jax.lax.axis_index("pp")]
-
-                def body(carry, xs):
-                    slot, bp = xs
-                    y = jax.lax.cond(slot < n, apply_block,
-                                     lambda c, b: c, carry, bp)
-                    return y, None
-
-                body_fn = jax.checkpoint(body) if st.remat else body
-                Lmax = jax.tree.leaves(sp)[0].shape[0]
-                out, _ = jax.lax.scan(body_fn, act,
-                                      (jnp.arange(Lmax), sp))
-                return out
-
             def stage_fn_keyed(sp, act, key):
                 n = counts_arr[jax.lax.axis_index("pp")]
 
@@ -573,36 +533,28 @@ class Engine:
                                 *[Tensor(_as_value(x)) for x in labels])
             return _as_value(out)
 
-        def pp_loss(p, buffers, inputs, labels, key=None):
-            """Forward-only pipelined loss (also the eval path). With a key
-            (gpipe/fthenb), per-stage randomness (dropout) threads through
-            the schedule — embed/head run outside the shard_map under their
-            own fold_in keys."""
+        def pp_loss(p, buffers, inputs, labels, key):
+            """Forward-only pipelined loss (also the eval path). The
+            per-stage randomness (dropout) threads through the schedule —
+            embed/head run outside the shard_map under their own fold_in
+            keys."""
             chunked, other = pp_split(self._cast(p))
-            if key is not None:
-                with _rng.rng_guard(jax.random.fold_in(key, 1)):
-                    act = run_embed(other, buffers, inputs)
-            else:
+            with _rng.rng_guard(jax.random.fold_in(key, 1)):
                 act = run_embed(other, buffers, inputs)
             B = act.shape[0]
             assert B % M == 0, f"batch {B} % microbatches {M} != 0"
             mbs = act.reshape((M, B // M) + act.shape[1:])
             if sched == "vpp":
                 outs = pipeline_apply_interleaved(
-                    stage_fn, chunked, mbs, mesh, st.pp_num_chunks, "pp",
-                    remat=st.remat)
-            elif key is not None:
+                    stage_fn_keyed, chunked, mbs, mesh, st.pp_num_chunks,
+                    "pp", remat=st.remat, key=jax.random.fold_in(key, 0))
+            else:
                 outs = pipeline_apply(stage_fn_keyed, chunked, mbs, mesh,
                                       "pp", remat=st.remat,
                                       key=jax.random.fold_in(key, 0))
-            else:
-                outs = pipeline_apply(stage_fn, chunked, mbs, mesh, "pp",
-                                      remat=st.remat)
             y = outs.reshape((B,) + outs.shape[2:])
-            if key is not None:
-                with _rng.rng_guard(jax.random.fold_in(key, 2)):
-                    return run_head(other, buffers, y, labels)
-            return run_head(other, buffers, y, labels)
+            with _rng.rng_guard(jax.random.fold_in(key, 2)):
+                return run_head(other, buffers, y, labels)
 
         def value_and_grad_fn(p, buffers, key, inputs, labels):
             if sched in ("gpipe", "fthenb"):
@@ -612,58 +564,56 @@ class Engine:
                     lambda p_: pp_loss(p_, buffers, inputs, labels,
                                        key=key))(p)
                 return loss, grads, dict(buffers)
-            # 1f1b/vpp: the explicit tick schedules can't thread a per-step
-            # key yet — any random draw raises instead of baking
-            del key
-            with _rng.forbid_rng("the compiled 1f1b/vpp pipeline schedule"):
 
-                # explicit 1F1B / VPP: the head/loss runs INSIDE the pp
-                # shard_map, so model buffers (closed-over tracers there)
-                # are not supported on these schedules — gpipe runs the
-                # head outside
-                if self._buffers:
-                    raise NotImplementedError(
-                        f"pp_schedule={sched!r} with model buffers: use "
-                        "'gpipe' (buffers would be closed over inside "
-                        "shard_map)")
-                if len(labels) != 1:
-                    raise NotImplementedError(
-                        f"pp_schedule={sched!r} threads exactly one label "
-                        f"array through the schedule (got {len(labels)}); "
-                        "use 'gpipe' for multi-label losses")
+            # explicit 1F1B / VPP: the head/loss runs INSIDE the pp
+            # shard_map, so model buffers (closed-over tracers there)
+            # are not supported on these schedules — gpipe runs the
+            # head outside
+            if self._buffers:
+                raise NotImplementedError(
+                    f"pp_schedule={sched!r} with model buffers: use "
+                    "'gpipe' (buffers would be closed over inside "
+                    "shard_map)")
+            if len(labels) != 1:
+                raise NotImplementedError(
+                    f"pp_schedule={sched!r} threads exactly one label "
+                    f"array through the schedule (got {len(labels)}); "
+                    "use 'gpipe' for multi-label losses")
 
-                chunked, other = pp_split(self._cast(p))
+            chunked, other = pp_split(self._cast(p))
 
-                def embed_f(op):
+            def embed_f(op):
+                with _rng.rng_guard(jax.random.fold_in(key, 1)):
                     act = run_embed(op, buffers, inputs)
-                    B = act.shape[0]
-                    assert B % M == 0, f"batch {B} % microbatches {M} != 0"
-                    return act.reshape((M, B // M) + act.shape[1:])
+                B = act.shape[0]
+                assert B % M == 0, f"batch {B} % microbatches {M} != 0"
+                return act.reshape((M, B // M) + act.shape[1:])
 
-                mbs, embed_pull = jax.vjp(embed_f, other)
-                lb = _as_value(labels[0])
-                lbls = lb.reshape((M, lb.shape[0] // M) + lb.shape[1:])
+            mbs, embed_pull = jax.vjp(embed_f, other)
+            lb = _as_value(labels[0])
+            lbls = lb.reshape((M, lb.shape[0] // M) + lb.shape[1:])
 
-                def loss_fn_pp(op, y, lbl):
+            def loss_fn_pp(op, y, lbl, k):
+                # per-microbatch head key derived by the schedule
+                with _rng.rng_guard(k):
                     return run_head(op, buffers, y, (lbl,))
 
-                train = pipeline_train_vpp if sched == "vpp" \
-                    else pipeline_train_1f1b
-                loss, g_chunked, g_other, g_mbs = train(
-                    stage_fn, loss_fn_pp, chunked, other, mbs, lbls, mesh,
-                    "pp", remat=st.remat)
-                (d_emb,) = embed_pull(g_mbs)
-                g_other_total = jax.tree.map(jnp.add, g_other, d_emb)
-                grads = {_BLOCK_NS + sub: g for sub, g in g_chunked.items()}
-                grads.update(g_other_total)
-                return loss, grads, dict(buffers)
+            # per-(stage/chunk, microbatch) dropout keys thread through
+            # the tick schedules (the compiled RNGStatesTracker analog) —
+            # the backward recompute replays the forward's mask
+            train = pipeline_train_vpp if sched == "vpp" \
+                else pipeline_train_1f1b
+            loss, g_chunked, g_other, g_mbs = train(
+                stage_fn_keyed, loss_fn_pp, chunked, other, mbs, lbls,
+                mesh, "pp", remat=st.remat, key=jax.random.fold_in(key, 0))
+            (d_emb,) = embed_pull(g_mbs)
+            g_other_total = jax.tree.map(jnp.add, g_other, d_emb)
+            grads = {_BLOCK_NS + sub: g for sub, g in g_chunked.items()}
+            grads.update(g_other_total)
+            return loss, grads, dict(buffers)
 
         def loss_only_fn(p, buffers, key, inputs, labels):
-            if sched in ("gpipe", "fthenb"):
-                return pp_loss(p, buffers, inputs, labels, key=key)
-            del key
-            with _rng.forbid_rng("the compiled 1f1b/vpp pipeline schedule"):
-                return pp_loss(p, buffers, inputs, labels)
+            return pp_loss(p, buffers, inputs, labels, key=key)
 
         return value_and_grad_fn, loss_only_fn
 

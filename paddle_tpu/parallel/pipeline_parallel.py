@@ -118,7 +118,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: Proce
 def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
                         loss_params, microbatches, labels, mesh: ProcessMesh,
                         pp_axis: str = "pp", remat: bool = False,
-                        split_wgrad: bool = False):
+                        split_wgrad: bool = False, key=None):
     """Explicit compiled 1F1B schedule: loss + grads in one scan.
 
     remat defaults to False: the schedule already rebuilds each stage's vjp
@@ -132,6 +132,15 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
     stacked_params: pytree, leaves [S, ...] sharded on pp_axis.
     microbatches: [M, mb, ...]; labels: [M, mb, ...].
 
+    key: optional PRNG key threading per-(stage, microbatch) randomness
+    (dropout) through the schedule — the compiled analog of the reference's
+    RNGStatesTracker (fleet/layers/mpu/random.py:34). When given, stage_fn
+    must accept (params, x, key) and loss_fn (lp, y, lbl, key). The forward
+    of microbatch m on stage s uses fold_in(fold_in(key, s), m); the
+    backward tick REBUILDS the vjp from the saved input with the SAME
+    (s, m_b) key, so the recompute replays the identical dropout mask —
+    grads stay consistent with the forward that produced the loss.
+
     Returns (mean_loss, grads_stacked [S,...], grads_loss_params, grads_mbs
     [M, mb, ...]) — grads_mbs lets the caller chain backward into whatever
     produced the microbatch activations (e.g. an embedding outside the trunk).
@@ -144,30 +153,40 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
     """
     jm = mesh.jax_mesh
     S = mesh.get_dim_size(pp_axis)
+    keyed = key is not None
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     M = microbatches.shape[0]
     W = min(M, 2 * S - 1)
     T = M + 2 * S - 2
     inv_m = 1.0 / M
 
-    def local_fn(params_local, lp, mbs, lbls):
+    def local_fn(params_local, lp, mbs, lbls, *maybe_key):
         params1 = jax.tree.map(lambda p: p[0], params_local)
         idx = jax.lax.axis_index(pp_axis)
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
         bwd_perm = [(i + 1, i) for i in range(S - 1)]
         zero_lp_grad = jax.tree.map(jnp.zeros_like, lp)
+        if keyed:
+            k_stage = jax.random.fold_in(maybe_key[0], idx)
+            # S is one past any stage index → head keys never collide
+            k_head = jax.random.fold_in(maybe_key[0], S)
 
-        def last_tick(p, x_in, lbl, dy_in):
+        def run_stage(p, x, k):
+            return fn(p, x, k) if keyed else fn(p, x)
+
+        def last_tick(p, x_in, lbl, dy_in, kf, kh):
             # forward + loss + backward of the SAME microbatch in one tick
             def g(p_, x_, lp_):
-                return loss_fn(lp_, fn(p_, x_), lbl)
+                y_ = run_stage(p_, x_, kf)
+                return loss_fn(lp_, y_, lbl, kh) if keyed \
+                    else loss_fn(lp_, y_, lbl)
             loss_m, pull = jax.vjp(g, p, x_in, lp)
             dp, dx, dlp = pull(jnp.asarray(inv_m, loss_m.dtype))
             y_send = jnp.zeros_like(x_in)  # no stage after the last one
             return y_send, loss_m * inv_m, dp, dx, dlp
 
-        def mid_tick(p, x_in, x_saved, dy_in):
-            y = fn(p, x_in)
+        def mid_tick(p, x_in, x_saved, dy_in, kf, kb):
+            y = run_stage(p, x_in, kf)
             if split_wgrad:
                 # ZBH1-decomposition probe (benchmarks/pp_schedules.py):
                 # dgrad (dx, unblocks the upstream stage) and wgrad (dp)
@@ -176,13 +195,16 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
                 # B/W split zero-bubble schedules perform. The fused tick
                 # below computes both in one transpose pass; comparing the
                 # two measures whether a split could ever pay here.
-                _, pull_x = jax.vjp(lambda x_: fn(p, x_), x_saved)
+                _, pull_x = jax.vjp(lambda x_: run_stage(p, x_, kb), x_saved)
                 (dx,) = pull_x(dy_in)
                 dy_w, _ = jax.lax.optimization_barrier((dy_in, dx))
-                _, pull_p = jax.vjp(lambda p_: fn(p_, x_saved), p)
+                _, pull_p = jax.vjp(lambda p_: run_stage(p_, x_saved, kb), p)
                 (dp,) = pull_p(dy_w)
                 return y, jnp.zeros((), jnp.float32), dp, dx, zero_lp_grad
-            _, pull = jax.vjp(lambda p_, x_: fn(p_, x_), p, x_saved)
+            # the backward rebuilds the vjp from the saved input with the
+            # SAME (stage, m_b) key the forward used → identical dropout
+            # mask, consistent gradients
+            _, pull = jax.vjp(lambda p_, x_: run_stage(p_, x_, kb), p, x_saved)
             dp, dx = pull(dy_in)
             return y, jnp.zeros((), jnp.float32), dp, dx, zero_lp_grad
 
@@ -192,6 +214,13 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
             fwd_valid = jnp.logical_and(m_f >= 0, m_f < M)
             m_b = t - (2 * S - 2 - idx)
             bwd_valid = jnp.logical_and(m_b >= 0, m_b < M)
+
+            if keyed:
+                kf = jax.random.fold_in(k_stage, jnp.clip(m_f, 0, M - 1))
+                kb = jax.random.fold_in(k_stage, jnp.clip(m_b, 0, M - 1))
+                kh = jax.random.fold_in(k_head, jnp.clip(m_f, 0, M - 1))
+            else:
+                kf = kb = kh = None
 
             mb_in = jnp.take(mbs, jnp.clip(m_f, 0, M - 1), axis=0)
             x_in = jnp.where(idx == 0, mb_in, fwd_state)
@@ -207,8 +236,8 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
 
             y, loss_m, dp, dx, dlp = jax.lax.cond(
                 idx == S - 1,
-                lambda: last_tick(params1, x_in, lbl, bwd_state),
-                lambda: mid_tick(params1, x_in, x_saved, bwd_state))
+                lambda: last_tick(params1, x_in, lbl, bwd_state, kf, kh),
+                lambda: mid_tick(params1, x_in, x_saved, bwd_state, kf, kb))
 
             grad_acc = jax.tree.map(
                 lambda a, g: a + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
@@ -251,10 +280,14 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
                 jax.tree.map(lambda _: P(), loss_params), P(), P())
     out_specs = (P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
                  jax.tree.map(lambda _: P(), loss_params), P())
+    operands = (stacked_params, loss_params, microbatches, labels)
+    if keyed:
+        in_specs = in_specs + (P(),)
+        operands = operands + (key,)
     shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs,
                              out_specs=out_specs,
                              axis_names=frozenset({pp_axis}), check_vma=False)
-    return shmapped(stacked_params, loss_params, microbatches, labels)
+    return shmapped(*operands)
 
 
 def _vpp_fwd_coords(t, r, S, V, M):
@@ -275,7 +308,8 @@ def _vpp_fwd_coords(t, r, S, V, M):
 
 def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
                                mesh: ProcessMesh, num_chunks: int,
-                               pp_axis: str = "pp", remat: bool = True):
+                               pp_axis: str = "pp", remat: bool = True,
+                               key=None):
     """VPP/circular forward schedule (differentiable; autodiff mirrors it).
 
     stacked_params: pytree, leaves [V, S, ...] — chunk j = v*S + r lives on
@@ -287,12 +321,17 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
     V-times-larger stages — the warmup bubble shrinks by ~V.
 
     microbatches: [M, mb, ...] with M % S == 0. Returns [M, mb, ...].
+
+    key: optional PRNG key for per-(chunk, microbatch) randomness; stage_fn
+    must then accept (params, x, key) — chunk j on microbatch m draws from
+    fold_in(fold_in(key, j), m), matching pipeline_train_vpp's derivation.
     """
     jm = mesh.jax_mesh
     S = mesh.get_dim_size(pp_axis)
     V = int(num_chunks)
     if V < 1:
         raise ValueError(f"num_chunks must be >= 1, got {num_chunks}")
+    keyed = key is not None
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     M = microbatches.shape[0]
     if M % S != 0:
@@ -300,7 +339,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
     SV = S * V
     T = M * V + S - 1
 
-    def local_fn(params_local, mbs):
+    def local_fn(params_local, mbs, *maybe_key):
         # local leaves are [V, 1, ...] — drop the sharded rank axis
         pv = jax.tree.map(lambda p: p[:, 0], params_local)
         r = jax.lax.axis_index(pp_axis)
@@ -315,7 +354,13 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
             x_in = jnp.where(inject, mb_in, state)
 
             p_t = jax.tree.map(lambda p: jnp.take(p, v, axis=0), pv)
-            y = fn(p_t, x_in)
+            if keyed:
+                k = jax.random.fold_in(
+                    jax.random.fold_in(maybe_key[0], j),
+                    jnp.clip(m, 0, M - 1))
+                y = fn(p_t, x_in, k)
+            else:
+                y = fn(p_t, x_in)
 
             done = jnp.logical_and(j == SV - 1, valid)  # rank S-1 only
             slot = jnp.clip(m, 0, M - 1)
@@ -332,14 +377,18 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
         return jax.lax.psum(outs * mask, pp_axis)
 
     in_specs = (jax.tree.map(lambda _: P(None, pp_axis), stacked_params), P())
+    operands = (stacked_params, microbatches)
+    if keyed:
+        in_specs = in_specs + (P(),)
+        operands = operands + (key,)
     shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
                              axis_names=frozenset({pp_axis}), check_vma=False)
-    return shmapped(stacked_params, microbatches)
+    return shmapped(*operands)
 
 
 def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
                        loss_params, microbatches, labels, mesh: ProcessMesh,
-                       pp_axis: str = "pp", remat: bool = False):
+                       pp_axis: str = "pp", remat: bool = False, key=None):
     """Explicit interleaved-VPP training: loss + grads, no autodiff-of-scan.
 
     The schedule is the reference's PipelineParallelWithInterleaveFthenB
@@ -359,10 +408,18 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
     microbatches: [M, mb, ...] with M % S == 0; labels [M, mb, ...].
 
     Returns (mean_loss, grads [V, S, ...], grads_loss_params, grads_mbs).
+
+    key: optional PRNG key for per-(chunk, microbatch) randomness (dropout)
+    — the compiled RNGStatesTracker analog. stage_fn must then accept
+    (params, x, key) and loss_fn (lp, y, lbl, key). Chunk j on microbatch m
+    draws from fold_in(fold_in(key, j), m) in BOTH the forward pass and the
+    backward recompute, so the rebuilt vjp replays the forward's mask; the
+    head uses fold_in(fold_in(key, S*V), m).
     """
     jm = mesh.jax_mesh
     S = mesh.get_dim_size(pp_axis)
     V = int(jax.tree.leaves(stacked_params)[0].shape[0])
+    keyed = key is not None
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     M = microbatches.shape[0]
     if M % S != 0:
@@ -370,11 +427,18 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
     SV = S * V
     T = M * V + S - 1
 
-    def local_fn(params_local, lp, mbs, lbls):
+    def local_fn(params_local, lp, mbs, lbls, *maybe_key):
         pv = jax.tree.map(lambda p: p[:, 0], params_local)   # [V, ...]
         r = jax.lax.axis_index(pp_axis)
         ring = [(i, (i + 1) % S) for i in range(S)]
         ring_rev = [(i, (i - 1) % S) for i in range(S)]
+
+        def chunk_key(j, m_c):
+            return jax.random.fold_in(
+                jax.random.fold_in(maybe_key[0], j), m_c)
+
+        def run_chunk(p_t, x, j, m_c):
+            return fn(p_t, x, chunk_key(j, m_c)) if keyed else fn(p_t, x)
 
         # ---- phase 1: interleaved forward, saving each chunk's input ----
         def fwd_body(carry, t):
@@ -392,7 +456,7 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
             inbuf = inbuf.at[m_c, v_c].set(jnp.where(valid, x_in, cur))
 
             p_t = jax.tree.map(lambda p: jnp.take(p, v_c, axis=0), pv)
-            y = fn(p_t, x_in)
+            y = run_chunk(p_t, x_in, j, m_c)
 
             done = jnp.logical_and(j == SV - 1, valid)   # rank S-1 only
             cur_o = jnp.take(outs, m_c, axis=0)
@@ -412,9 +476,20 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
         outs = jax.lax.psum(outs * mask, pp_axis)
 
         # ---- phase 2: loss + output cotangents (replicated compute) ----
-        def loss_all(lp_, outs_):
-            per_mb = jax.vmap(loss_fn, in_axes=(None, 0, 0))(lp_, outs_, lbls)
-            return jnp.mean(per_mb)
+        if keyed:
+            head_keys = jax.vmap(
+                lambda m: jax.random.fold_in(
+                    jax.random.fold_in(maybe_key[0], SV), m))(jnp.arange(M))
+
+            def loss_all(lp_, outs_):
+                per_mb = jax.vmap(loss_fn, in_axes=(None, 0, 0, 0))(
+                    lp_, outs_, lbls, head_keys)
+                return jnp.mean(per_mb)
+        else:
+            def loss_all(lp_, outs_):
+                per_mb = jax.vmap(loss_fn, in_axes=(None, 0, 0))(
+                    lp_, outs_, lbls)
+                return jnp.mean(per_mb)
 
         loss, pull = jax.vjp(loss_all, lp, outs)
         g_lp, douts = pull(jnp.ones((), loss.dtype))
@@ -438,7 +513,10 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
 
             x_saved = inbuf[m_c, v_c]
             p_t = jax.tree.map(lambda p: jnp.take(p, v_c, axis=0), pv)
-            _, vjp_pull = jax.vjp(lambda p_, x_: fn(p_, x_), p_t, x_saved)
+            # rebuild with the SAME (j, m) key as the forward pass, so the
+            # recomputed chunk replays the identical dropout mask
+            _, vjp_pull = jax.vjp(
+                lambda p_, x_: run_chunk(p_, x_, j, m_c), p_t, x_saved)
             dp, dx = vjp_pull(dy_in)
 
             grad_acc = jax.tree.map(
@@ -471,10 +549,14 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
                 jax.tree.map(lambda _: P(), loss_params), P(), P())
     out_specs = (P(), jax.tree.map(lambda _: P(None, pp_axis), stacked_params),
                  jax.tree.map(lambda _: P(), loss_params), P())
+    operands = (stacked_params, loss_params, microbatches, labels)
+    if keyed:
+        in_specs = in_specs + (P(),)
+        operands = operands + (key,)
     shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs,
                              out_specs=out_specs,
                              axis_names=frozenset({pp_axis}), check_vma=False)
-    return shmapped(stacked_params, loss_params, microbatches, labels)
+    return shmapped(*operands)
 
 
 def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp"):

@@ -208,6 +208,113 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(g_mbs), np.asarray(gr_mbs),
                                    rtol=1e-3, atol=1e-5)
 
+    def test_1f1b_keyed_dropout_matches_reference(self, pp_mesh):
+        # the per-(stage, microbatch) key contract: forward of mb m on
+        # stage s draws from fold_in(fold_in(key, s), m), the head from
+        # fold_in(fold_in(key, S), m), and the backward recompute replays
+        # the SAME mask — grads must match a dense per-microbatch reference
+        # computed with identical keys EXACTLY
+        from paddle_tpu.parallel.pipeline_parallel import (
+            pipeline_train_1f1b, stack_stage_params)
+        S, M, B, D = 4, 8, 2, 8
+        key = jax.random.PRNGKey(7)
+        rng = np.random.RandomState(11)
+        stage_params = [{"w": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+                        for _ in range(S)]
+        stacked = stack_stage_params(stage_params, pp_mesh)
+        lp = {"head": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+        mbs = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+        lbls = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+
+        def stage_fn(params, x, k):
+            h = jnp.tanh(x @ params["w"])
+            keep = jax.random.bernoulli(k, 0.8, h.shape)
+            return h * keep.astype(h.dtype) / 0.8
+
+        def loss_fn(lp_, y, lbl, k):
+            keep = jax.random.bernoulli(k, 0.9, y.shape)
+            y = y * keep.astype(y.dtype) / 0.9
+            return jnp.mean((y @ lp_["head"] - lbl) ** 2)
+
+        loss, g_stack, g_lp, g_mbs = pipeline_train_1f1b(
+            stage_fn, loss_fn, stacked, lp, mbs, lbls, pp_mesh, key=key)
+
+        def ref(plist, lp_, mbs_):
+            total = 0.0
+            for m in range(M):
+                x = mbs_[m]
+                for s in range(S):
+                    ks = jax.random.fold_in(jax.random.fold_in(key, s), m)
+                    x = stage_fn(plist[s], x, ks)
+                kh = jax.random.fold_in(jax.random.fold_in(key, S), m)
+                total = total + loss_fn(lp_, x, lbls[m], kh)
+            return total / M
+
+        rl, (gr_p, gr_lp, gr_mbs) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(stage_params, lp, mbs)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        for s in range(S):
+            np.testing.assert_allclose(
+                np.asarray(g_stack["w"][s]), np.asarray(gr_p[s]["w"]),
+                rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_lp["head"]),
+                                   np.asarray(gr_lp["head"]),
+                                   rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_mbs), np.asarray(gr_mbs),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_vpp_keyed_dropout_matches_reference(self, pp_mesh):
+        # chunk j on mb m draws fold_in(fold_in(key, j), m); head
+        # fold_in(fold_in(key, S*V), m) — exact match vs dense reference
+        from paddle_tpu.parallel.pipeline_parallel import pipeline_train_vpp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        S, V, M, B, D = 4, 2, 8, 2, 8
+        SV = S * V
+        key = jax.random.PRNGKey(13)
+        rng = np.random.RandomState(12)
+        chunks = rng.rand(V, S, D, D).astype(np.float32) * 0.2
+        stacked = {"w": jax.device_put(
+            jnp.asarray(chunks),
+            NamedSharding(pp_mesh.jax_mesh, P(None, "pp")))}
+        lp = {"head": jnp.asarray(rng.rand(D, D).astype(np.float32) * 0.3)}
+        mbs = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+        lbls = jnp.asarray(rng.rand(M, B, D).astype(np.float32))
+
+        def stage_fn(params, x, k):
+            h = jnp.tanh(x @ params["w"])
+            keep = jax.random.bernoulli(k, 0.8, h.shape)
+            return h * keep.astype(h.dtype) / 0.8
+
+        def loss_fn(lp_, y, lbl, k):
+            keep = jax.random.bernoulli(k, 0.9, y.shape)
+            y = y * keep.astype(y.dtype) / 0.9
+            return jnp.mean((y @ lp_["head"] - lbl) ** 2)
+
+        loss, g_stack, g_lp, g_mbs = pipeline_train_vpp(
+            stage_fn, loss_fn, stacked, lp, mbs, lbls, pp_mesh, key=key)
+
+        def ref(chunks_, lp_, mbs_):
+            total = 0.0
+            for m in range(M):
+                x = mbs_[m]
+                for j in range(SV):
+                    kj = jax.random.fold_in(jax.random.fold_in(key, j), m)
+                    x = stage_fn({"w": chunks_[j // S, j % S]}, x, kj)
+                kh = jax.random.fold_in(jax.random.fold_in(key, SV), m)
+                total = total + loss_fn(lp_, x, lbls[m], kh)
+            return total / M
+
+        rl, (gr_c, gr_lp, gr_mbs) = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(jnp.asarray(chunks), lp, mbs)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_stack["w"]),
+                                   np.asarray(gr_c), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_lp["head"]),
+                                   np.asarray(gr_lp["head"]),
+                                   rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_mbs), np.asarray(gr_mbs),
+                                   rtol=1e-3, atol=1e-5)
+
     def test_1f1b_single_stage_degenerates(self):
         # S=1: every tick is fwd+bwd of one microbatch (pure accumulation)
         from paddle_tpu.parallel.pipeline_parallel import pipeline_train_1f1b
