@@ -30,16 +30,30 @@ _SERVER_LOCK = threading.Lock()
 class SparseTable:
     """Host-memory sparse embedding table with lazy row init + SGD update
     (reference table/memory_sparse_table.cc semantics, simplified: optimizer
-    = sgd, initializer = uniform)."""
+    = sgd, initializer = uniform).
 
-    def __init__(self, name, dim, init_range=0.01, lr=0.05, seed=0):
+    Persistence (reference memory_sparse_table.h:68-75 Save/Load):
+    `save(dirname, mode)` writes this shard's rows to
+    {dirname}/{table}/part-{shard}.npz — mode 0 = full snapshot, mode 1 =
+    DELTA (only rows touched since the last save, appended as
+    delta-{shard}-{seq}.npz; the reference's incremental save). `load`
+    replays the full part then the deltas in sequence, keeping only ids
+    that hash to this shard — so a table saved from N servers restores
+    onto M servers (elastic restart re-shards on load)."""
+
+    def __init__(self, name, dim, init_range=0.01, lr=0.05, seed=0,
+                 shard_idx=0):
         self.name = name
         self.dim = dim
         self.lr = lr
         self.init_range = init_range
+        self.shard_idx = int(shard_idx)
         self._rows: dict = {}
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
+        self._dirty: set = set()   # rids touched since the last save
+        self._evicted: set = set()  # rids evicted since the last save
+        self._save_seq = 0         # delta-file sequence number
 
     def _row(self, rid):
         r = self._rows.get(int(rid))
@@ -47,6 +61,7 @@ class SparseTable:
             r = self._rng.uniform(-self.init_range, self.init_range,
                                   self.dim).astype(np.float32)
             self._rows[int(rid)] = r
+            self._dirty.add(int(rid))
         return r
 
     def pull(self, ids):
@@ -57,10 +72,122 @@ class SparseTable:
         with self._lock:
             for i, g in zip(ids, grads):
                 self._rows[int(i)] = self._row(i) - self.lr * g
+                self._dirty.add(int(i))
         return len(ids)
 
     def size(self):
         return len(self._rows)
+
+    # ---- persistence ----
+    def _drop_row(self, rid):
+        """Remove a row (tombstone replay); subclasses drop side state."""
+        self._rows.pop(rid, None)
+
+    def _extra_state(self, ids):
+        """Subclass hook: extra per-row arrays to persist (CTR stats)."""
+        return {}
+
+    def _load_extra(self, ids, extra):
+        pass
+
+    def _snapshot(self, ids):
+        ids = sorted(ids)
+        arr = np.asarray(ids, np.int64)
+        rows = (np.stack([self._rows[i] for i in ids])
+                if ids else np.zeros((0, self.dim), np.float32))
+        return arr, rows
+
+    def _write_npz(self, path, ids, rows, **extra_arrays):
+        """Atomic npz write shared by save/save_cache: tmp + os.replace —
+        a crash mid-write never corrupts an existing file."""
+        import os
+        payload = {"ids": ids, "rows": rows, "dim": np.int64(self.dim)}
+        payload.update(self._extra_state(ids.tolist()))
+        payload.update(extra_arrays)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+
+    def save(self, dirname, mode: int = 0) -> int:
+        """Persist this shard. mode 0 = full snapshot (also truncates any
+        earlier delta chain — AFTER the new part is durably in place, so a
+        crash between the two leaves a consistent part+delta state); mode
+        1 = delta-since-last-save, including TOMBSTONES for rows evicted by
+        shrink() since the last save. Returns the number of rows written."""
+        import os
+        d = os.path.join(dirname, self.name)
+        os.makedirs(d, exist_ok=True)
+        with self._lock:
+            if mode == 0:
+                ids, rows = self._snapshot(self._rows)
+                path = os.path.join(d, f"part-{self.shard_idx}.npz")
+                self._write_npz(path, ids, rows)
+                # only now is the old delta chain obsolete
+                for f in os.listdir(d):
+                    if f.startswith(f"delta-{self.shard_idx}-"):
+                        os.remove(os.path.join(d, f))
+                self._save_seq = 0
+                self._evicted.clear()  # snapshot already reflects evictions
+            elif mode == 1:
+                ids, rows = self._snapshot(
+                    [i for i in self._dirty if i in self._rows])
+                dead = np.asarray(sorted(self._evicted), np.int64)
+                self._save_seq += 1
+                path = os.path.join(
+                    d, f"delta-{self.shard_idx}-{self._save_seq:06d}.npz")
+                self._write_npz(path, ids, rows, evicted=dead)
+                self._evicted.clear()
+            else:
+                raise ValueError(f"unknown save mode {mode} (0=full 1=delta)")
+            self._dirty.clear()
+        return len(ids)
+
+    def load(self, dirname, n_shards: int = 1) -> int:
+        """Restore this shard: replay every saved shard's full part + its
+        delta chain (in sequence order, applying eviction tombstones),
+        keeping ids % n_shards == shard_idx. Tolerates a different saver
+        shard count (elastic restart re-shards). Restores the delta
+        sequence counter so later delta saves never overwrite a durable
+        delta file. Returns rows loaded."""
+        import os
+        import re as _re
+        d = os.path.join(dirname, self.name)
+        if not os.path.isdir(d):
+            return 0
+        parts = sorted(f for f in os.listdir(d) if f.startswith("part-"))
+        # zero-padded seq numbers sort lexicographically; different saver
+        # shards hold disjoint ids, so their relative order is irrelevant
+        deltas = sorted(f for f in os.listdir(d) if f.startswith("delta-"))
+        n = 0
+        with self._lock:
+            for fname in parts + deltas:
+                with np.load(os.path.join(d, fname)) as z:
+                    ids, rows = z["ids"], z["rows"]
+                    if int(z["dim"]) != self.dim:
+                        raise ValueError(
+                            f"table {self.name!r}: saved dim {int(z['dim'])}"
+                            f" != configured dim {self.dim}")
+                    keep = ids % n_shards == self.shard_idx
+                    for i, r in zip(ids[keep].tolist(), rows[keep]):
+                        self._rows[int(i)] = np.asarray(r, np.float32)
+                        n += 1
+                    self._load_extra(ids[keep].tolist(),
+                                     {k: z[k][keep] for k in z.files
+                                      if k not in ("ids", "rows", "dim",
+                                                   "evicted")})
+                    if "evicted" in z.files:  # delta tombstones
+                        for i in z["evicted"].tolist():
+                            self._drop_row(int(i))
+            # continue the delta chain after the highest seq already on
+            # disk for THIS shard (a fresh delta must never clobber one)
+            seqs = [int(m.group(1)) for f in deltas
+                    for m in [_re.match(
+                        rf"delta-{self.shard_idx}-(\d+)\.npz$", f)] if m]
+            self._save_seq = max(seqs, default=0)
+            self._dirty.clear()
+            self._evicted.clear()
+        return n
 
 
 # ---- functions executed server-side via rpc ----
@@ -69,7 +196,8 @@ def _srv_create(name, dim, init_range, lr, seed):
     # workers must never replace a live table (it would drop pushed rows)
     with _SERVER_LOCK:
         if name not in _SERVER:
-            _SERVER[name] = SparseTable(name, dim, init_range, lr, seed)
+            _SERVER[name] = SparseTable(name, dim, init_range, lr, seed,
+                                        shard_idx=seed)
     return True
 
 
@@ -87,6 +215,22 @@ def _srv_push(name, ids, grads):
 
 def _srv_size(name):
     return _SERVER[name].size()
+
+
+def _srv_save(name, dirname, mode):
+    return _SERVER[name].save(dirname, mode)
+
+
+def _srv_load(name, dirname, n_shards):
+    return _SERVER[name].load(dirname, n_shards)
+
+
+def _srv_save_cache(name, dirname, threshold):
+    return _SERVER[name].save_cache(dirname, threshold)
+
+
+def _srv_load_cache(name, dirname, n_shards):
+    return _SERVER[name].load_cache(dirname, n_shards)
 
 
 class PsServer:
@@ -145,6 +289,50 @@ class PsWorker:
         ]
         return sum(f.result() for f in futs)
 
+    def _fanout(self, fn, args_for):
+        """Dispatch to every server concurrently (checkpoint wall time is
+        the slowest shard, not the sum — the pull/push pattern) and sum
+        the results."""
+        futs = [_rpc.rpc_async(s, fn, args_for(si, s))
+                for si, s in enumerate(self.servers)]
+        return sum(f.result() for f in futs)
+
+    def save(self, name, dirname, mode: int = 0):
+        """Persist table `name`: every server writes its shard's part (or
+        delta) file under {dirname}/{name}/ concurrently. A FULL save also
+        removes stale files left by a larger previous server set (elastic
+        shrink), so a later load cannot replay an old world's shard over
+        fresher data. Returns total rows written."""
+        n = self._fanout(_srv_save, lambda si, s: (name, dirname, mode))
+        if mode == 0:
+            import os
+            d = os.path.join(dirname, name)
+            live = len(self.servers)
+            for f in os.listdir(d) if os.path.isdir(d) else ():
+                for prefix in ("part-", "delta-", "cache-"):
+                    if f.startswith(prefix):
+                        shard = f[len(prefix):].split("-")[0].split(".")[0]
+                        if shard.isdigit() and int(shard) >= live:
+                            os.remove(os.path.join(d, f))
+        return n
+
+    def load(self, name, dirname):
+        """Restore table `name` from disk onto the CURRENT server set —
+        each server keeps the ids hashing to it, so the saver's server
+        count need not match (elastic restart). Returns rows loaded."""
+        n = len(self.servers)
+        return self._fanout(_srv_load, lambda si, s: (name, dirname, n))
+
+    def save_cache(self, name, dirname, threshold=None):
+        """SaveCache: persist only hot rows (CTR tables)."""
+        return self._fanout(_srv_save_cache,
+                            lambda si, s: (name, dirname, threshold))
+
+    def load_cache(self, name, dirname):
+        n = len(self.servers)
+        return self._fanout(_srv_load_cache,
+                            lambda si, s: (name, dirname, n))
+
     def table_size(self, name):
         return sum(_rpc.rpc_sync(s, _srv_size, (name,))
                    for s in self.servers)
@@ -199,19 +387,26 @@ class CtrSparseTable(SparseTable):
                 st[0] += float(s)
                 st[1] += float(c)
                 st[2] = 0  # seen today
+                self._dirty.add(int(i))
         return len(ids)
 
     def update_days(self):
-        """End-of-day tick: decay show/click, age unseen rows."""
+        """End-of-day tick: decay show/click, age unseen rows. Every row's
+        stats mutate, so all become dirty — the next delta save persists
+        the decayed state instead of silently resurrecting it on restore."""
         a = self.accessor
         with self._lock:
-            for st in self._stats.values():
+            for rid, st in self._stats.items():
                 st[0] *= a.decay
                 st[1] *= a.decay
                 st[2] += 1
+                if rid in self._rows:
+                    self._dirty.add(rid)
 
     def shrink(self):
-        """Evict by score/age; returns evicted row count."""
+        """Evict by score/age; returns evicted row count. Evictions are
+        recorded as tombstones so delta saves carry them across restarts
+        (a restore must not resurrect evicted rows)."""
         a = self.accessor
         with self._lock:
             drop = [rid for rid, st in self._stats.items()
@@ -220,11 +415,70 @@ class CtrSparseTable(SparseTable):
             for rid in drop:
                 self._stats.pop(rid, None)
                 self._rows.pop(rid, None)
+                self._dirty.discard(rid)
+                self._evicted.add(rid)
         return len(drop)
+
+    def _drop_row(self, rid):
+        super()._drop_row(rid)
+        self._stats.pop(rid, None)
 
     def stats(self, rid):
         st = self._stats.get(int(rid))
         return None if st is None else tuple(st)
+
+    # ---- persistence: rows + show/click/unseen stats travel together ----
+    def _extra_state(self, ids):
+        st = np.asarray([self._stats.get(i, [0.0, 0.0, 0]) for i in ids],
+                        np.float64).reshape(len(ids), 3)
+        return {"ctr_stats": st}
+
+    def _load_extra(self, ids, extra):
+        st = extra.get("ctr_stats")
+        if st is None:
+            return
+        for i, row in zip(ids, st):
+            self._stats[int(i)] = [float(row[0]), float(row[1]),
+                                   int(row[2])]
+
+    def save_cache(self, dirname, threshold: float | None = None) -> int:
+        """Reference SaveCache (memory_sparse_table.h:73): persist only the
+        HOT rows — accessor score >= threshold (default: the accessor's
+        delete_threshold) — into cache-{shard}.npz, the warm-start subset
+        servable without the full table."""
+        import os
+        a = self.accessor
+        thr = a.delete_threshold if threshold is None else float(threshold)
+        d = os.path.join(dirname, self.name)
+        os.makedirs(d, exist_ok=True)
+        with self._lock:
+            hot = [i for i, st in self._stats.items()
+                   if a.score(st[0], st[1]) >= thr and i in self._rows]
+            ids, rows = self._snapshot(hot)
+            self._write_npz(os.path.join(d, f"cache-{self.shard_idx}.npz"),
+                            ids, rows)
+        return len(ids)
+
+    def load_cache(self, dirname, n_shards: int = 1) -> int:
+        """Warm-start from the cache subset written by save_cache."""
+        import os
+        d = os.path.join(dirname, self.name)
+        if not os.path.isdir(d):
+            return 0
+        n = 0
+        with self._lock:
+            for fname in sorted(f for f in os.listdir(d)
+                                if f.startswith("cache-")):
+                with np.load(os.path.join(d, fname)) as z:
+                    ids, rows = z["ids"], z["rows"]
+                    keep = ids % n_shards == self.shard_idx
+                    for i, r in zip(ids[keep].tolist(), rows[keep]):
+                        self._rows[int(i)] = np.asarray(r, np.float32)
+                        n += 1
+                    self._load_extra(ids[keep].tolist(),
+                                     {k: z[k][keep] for k in z.files
+                                      if k not in ("ids", "rows", "dim")})
+        return n
 
 
 # ---------------------------------------------------------------- GeoSGD
@@ -294,6 +548,7 @@ def _srv_push_delta(name, ids, deltas):
     with t._lock:
         for i, d in zip(ids, deltas):
             t._rows[int(i)] = t._row(i) + np.asarray(d, np.float32)
+            t._dirty.add(int(i))
     return len(ids)
 
 
@@ -301,7 +556,7 @@ def _srv_create_ctr(name, dim, init_range, lr, seed):
     with _SERVER_LOCK:
         if name not in _SERVER:
             _SERVER[name] = CtrSparseTable(name, dim, init_range=init_range,
-                                           lr=lr, seed=seed)
+                                           lr=lr, seed=seed, shard_idx=seed)
     return True
 
 

@@ -1,0 +1,134 @@
+"""PS persistence kill/restart drill (VERDICT r3 next #6).
+
+Reference semantics: ps/table/memory_sparse_table.h:68-75 Save/Load —
+sparse-table state must survive server death.
+
+Phase A (PS_PHASE=a): server0 hosts a CTR table; worker1 trains (pushes
+gradients + show/click), SAVES a full snapshot, trains MORE (dirty,
+unsaved), records both states to disk, then SIGKILLs the server — a real
+process kill mid-train, not a clean shutdown.
+
+Phase B (PS_PHASE=b, fresh rendezvous world): a brand-new server process
+loads the table from disk; the worker verifies pulled rows equal the
+SAVED state (not the lost post-save pushes), CTR stats survived, and
+training continues on the restored table.
+"""
+import os
+import signal
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.distributed.ps as ps
+import paddle_tpu.distributed.rpc as rpc
+
+DIM = 8
+IDS = np.arange(1, 9, dtype=np.int64)
+
+
+def _write_pid(path):
+    with open(path, "w") as f:
+        f.write(str(os.getpid()))
+
+
+def _srv_stats(name, rid):
+    return ps._SERVER[name].stats(rid)
+
+
+def _srv_tables():
+    return sorted(ps._SERVER)
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    phase = os.environ["PS_PHASE"]
+    state_dir = os.environ["PS_STATE_DIR"]
+    table_dir = os.path.join(state_dir, "tables")
+    name = "server0" if rank == 0 else f"worker{rank}"
+    rt = ps.TheOnePSRuntime(name=name, rank=rank, world_size=world)
+
+    if rt.server is not None:
+        _write_pid(os.path.join(state_dir, f"server_{phase}.pid"))
+        if phase == "b":
+            # restart path: restore every table shard from disk BEFORE
+            # serving (the worker polls until the load marker appears)
+            n = ps._SERVER  # empty in a fresh process
+            assert not n, "fresh server process must start empty"
+            ps._srv_create_ctr("ctr", DIM, 0.01, 0.5, 0)
+            loaded = ps._SERVER["ctr"].load(table_dir, n_shards=1)
+            with open(os.path.join(state_dir, "loaded.txt"), "w") as f:
+                f.write(str(loaded))
+        # serve until killed (phase a) or worker finishes (phase b)
+        deadline = time.time() + 300
+        done_f = os.path.join(state_dir, f"done_{phase}.txt")
+        while not os.path.exists(done_f):
+            if time.time() > deadline:
+                raise TimeoutError("server: worker never finished")
+            time.sleep(0.2)
+        time.sleep(1.0)
+        print("PS_PERSIST_SERVER_OK")
+        rt.stop()
+        return
+
+    # ---------------- worker ----------------
+    w = rt.worker
+    if phase == "a":
+        rpc.rpc_sync("server0", ps._srv_create_ctr, ("ctr", DIM, 0.01, 0.5, 0))
+        w.pull("ctr", IDS)                       # materialize
+        w.push("ctr", IDS, np.full((len(IDS), DIM), 0.1, np.float32))
+        rpc.rpc_sync("server0", ps._srv_push_show_click,
+                     ("ctr", [1, 2], [100.0, 5.0], [10.0, 1.0]))
+        saved = w.save("ctr", table_dir, mode=0)  # full snapshot
+        assert saved >= len(IDS), saved
+        expected = w.pull("ctr", IDS)             # state AT the save
+        st1 = rpc.rpc_sync("server0", _srv_stats, ("ctr", 1))
+        # train more — these rows are DIRTY and must be lost with the kill
+        w.push("ctr", IDS, np.full((len(IDS), DIM), 5.0, np.float32))
+        lost = w.pull("ctr", IDS)
+        assert not np.allclose(expected, lost)
+        np.savez(os.path.join(state_dir, "expected.npz"),
+                 expected=expected, lost=lost, st1=np.asarray(st1))
+        # REAL kill: SIGKILL the serving process mid-train
+        with open(os.path.join(state_dir, "server_a.pid")) as f:
+            spid = int(f.read())
+        os.kill(spid, signal.SIGKILL)
+        with open(os.path.join(state_dir, "done_a.txt"), "w") as f:
+            f.write("done")
+        print("PS_PERSIST_PHASE_A_OK")
+        os._exit(0)  # rpc shutdown would hang on the dead server
+
+    # phase b: wait for the fresh server to finish loading
+    deadline = time.time() + 120
+    loaded_f = os.path.join(state_dir, "loaded.txt")
+    while not os.path.exists(loaded_f):
+        if time.time() > deadline:
+            raise TimeoutError("server never loaded")
+        time.sleep(0.2)
+    z = np.load(os.path.join(state_dir, "expected.npz"))
+    got = w.pull("ctr", IDS)
+    # restored state == the SAVED snapshot, not the post-save pushes
+    np.testing.assert_allclose(got, z["expected"], rtol=1e-6)
+    assert not np.allclose(got, z["lost"])
+    # CTR statistics survived the restart
+    st1 = rpc.rpc_sync("server0", _srv_stats, ("ctr", 1))
+    np.testing.assert_allclose(np.asarray(st1), z["st1"], rtol=1e-6)
+    # and training continues on the restored table
+    w.push("ctr", IDS[:2], np.ones((2, DIM), np.float32))
+    after = w.pull("ctr", IDS[:2])
+    np.testing.assert_allclose(after, z["expected"][:2] - 0.5, rtol=1e-5)
+    with open(os.path.join(state_dir, "done_b.txt"), "w") as f:
+        f.write("done")
+    print("PS_PERSIST_PHASE_B_OK")
+    rt.stop()
+
+
+if __name__ == "__main__":
+    main()

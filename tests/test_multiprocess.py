@@ -69,3 +69,24 @@ class TestCommWatchdog(CommunicationTestDistBase):
     def test_watchdog_quiet_on_success(self):
         codes, outs = self.run_test_case("collective_basic.py", nproc=2)
         assert all("comm-watchdog" not in o for o in outs)
+
+
+class TestPsPersistence(CommunicationTestDistBase):
+    def test_ps_kill_restart_from_disk(self, tmp_path):
+        """VERDICT r3 next #6: a SIGKILLed PS server restarts from disk
+        with state intact (reference memory_sparse_table.h Save/Load).
+        Phase A trains + saves + trains-more, then really SIGKILLs the
+        server; phase B is a fresh rendezvous world whose server loads the
+        table and must serve exactly the SAVED state."""
+        env = {"PS_STATE_DIR": str(tmp_path)}
+        # phase A: the server rank dies by SIGKILL → expect_fail
+        codes, outs = self.run_test_case(
+            "ps_persist.py", nproc=2, timeout=300,
+            extra_env={**env, "PS_PHASE": "a"}, expect_fail=True)
+        assert any("PS_PERSIST_PHASE_A_OK" in o for o in outs), outs
+        assert -9 in codes, f"server was not SIGKILLed: {codes}"
+        # phase B: fresh world, server restores from disk
+        codes, outs = self.run_test_case(
+            "ps_persist.py", nproc=2, timeout=300,
+            extra_env={**env, "PS_PHASE": "b"})
+        assert any("PS_PERSIST_PHASE_B_OK" in o for o in outs), outs

@@ -154,3 +154,137 @@ class TestGeoSgd:
         merged = P._srv_pull("geo_m", ids)
         # both deltas landed (geometric merge: base -1 -2)
         np.testing.assert_allclose(merged, base - 3.0, rtol=1e-5)
+
+
+class TestTablePersistence:
+    """Save/Load/SaveCache (reference memory_sparse_table.h:68-75)."""
+
+    def test_full_save_load_roundtrip(self, tmp_path):
+        t = SparseTable("emb", dim=4, lr=0.5, seed=3)
+        ids = np.arange(10, dtype=np.int64)
+        t.pull(ids)
+        t.push(ids, np.full((10, 4), 0.2, np.float32))
+        before = t.pull(ids)
+        n = t.save(str(tmp_path), mode=0)
+        assert n == 10
+        t2 = SparseTable("emb", dim=4, lr=0.5, seed=99)  # different rng
+        assert t2.load(str(tmp_path)) == 10
+        np.testing.assert_allclose(t2.pull(ids), before, rtol=1e-6)
+
+    def test_delta_save_chains(self, tmp_path):
+        t = SparseTable("emb", dim=2, lr=1.0, seed=0)
+        a = np.array([1, 2], np.int64)
+        t.pull(a)
+        t.save(str(tmp_path), mode=0)
+        # touch only row 1 → delta holds just it
+        t.push(np.array([1]), np.ones((1, 2), np.float32))
+        assert t.save(str(tmp_path), mode=1) == 1
+        # touch row 2 → second delta
+        t.push(np.array([2]), np.ones((1, 2), np.float32) * 2)
+        assert t.save(str(tmp_path), mode=1) == 1
+        want = t.pull(a)
+        t2 = SparseTable("emb", dim=2, lr=1.0, seed=7)
+        assert t2.load(str(tmp_path)) == 4  # part(2 rows) + 2 deltas
+        np.testing.assert_allclose(t2.pull(a), want, rtol=1e-6)
+
+    def test_full_save_truncates_delta_chain(self, tmp_path):
+        import os
+        t = SparseTable("emb", dim=2, seed=0)
+        t.pull(np.array([1], np.int64))
+        t.save(str(tmp_path), mode=0)
+        t.push(np.array([1]), np.ones((1, 2), np.float32))
+        t.save(str(tmp_path), mode=1)
+        t.save(str(tmp_path), mode=0)  # fresh full snapshot
+        files = os.listdir(tmp_path / "emb")
+        assert not any(f.startswith("delta-") for f in files), files
+
+    def test_elastic_reshard_on_load(self, tmp_path):
+        # saved from ONE shard, restored onto TWO: each keeps ids % 2 == k
+        t = SparseTable("emb", dim=3, seed=0, shard_idx=0)
+        ids = np.arange(8, dtype=np.int64)
+        t.pull(ids)
+        want = t.pull(ids)
+        t.save(str(tmp_path), mode=0)
+        s0 = SparseTable("emb", dim=3, seed=5, shard_idx=0)
+        s1 = SparseTable("emb", dim=3, seed=6, shard_idx=1)
+        n0 = s0.load(str(tmp_path), n_shards=2)
+        n1 = s1.load(str(tmp_path), n_shards=2)
+        assert n0 == 4 and n1 == 4
+        np.testing.assert_allclose(s0.pull(ids[::2]), want[::2], rtol=1e-6)
+        np.testing.assert_allclose(s1.pull(ids[1::2]), want[1::2], rtol=1e-6)
+
+    def test_ctr_stats_roundtrip_and_save_cache(self, tmp_path):
+        t = CtrSparseTable("ctr", dim=2,
+                           accessor=CtrAccessor(delete_threshold=0.5))
+        ids = np.array([1, 2, 3], np.int64)
+        t.pull(ids)
+        t.push_show_click([1], [100.0], [10.0])   # hot row
+        want = t.pull(ids)
+        t.save(str(tmp_path / "full"), mode=0)
+        t2 = CtrSparseTable("ctr", dim=2)
+        t2.load(str(tmp_path / "full"))
+        np.testing.assert_allclose(t2.pull(ids), want, rtol=1e-6)
+        assert t2.stats(1)[0] == pytest.approx(100.0)
+        assert t2.stats(1)[1] == pytest.approx(10.0)
+        # SaveCache: only the hot row crosses the score threshold
+        n = t.save_cache(str(tmp_path / "cache"))
+        assert n == 1
+        t3 = CtrSparseTable("ctr", dim=2)
+        assert t3.load_cache(str(tmp_path / "cache")) == 1
+        np.testing.assert_allclose(t3.pull(np.array([1])), want[:1],
+                                   rtol=1e-6)
+
+    def test_dim_mismatch_fails_loudly(self, tmp_path):
+        t = SparseTable("emb", dim=4, seed=0)
+        t.pull(np.array([1], np.int64))
+        t.save(str(tmp_path), mode=0)
+        t2 = SparseTable("emb", dim=8, seed=0)
+        with pytest.raises(ValueError, match="dim"):
+            t2.load(str(tmp_path))
+
+    def test_save_seq_restored_after_load(self, tmp_path):
+        # a delta written AFTER a restore must not clobber a durable delta
+        t = SparseTable("emb", dim=2, lr=1.0, seed=0)
+        t.pull(np.array([1, 2], np.int64))
+        t.save(str(tmp_path), mode=0)
+        t.push(np.array([1]), np.ones((1, 2), np.float32))
+        t.save(str(tmp_path), mode=1)           # delta ...-000001 (row 1)
+        want_row1 = t.pull(np.array([1]))
+        t2 = SparseTable("emb", dim=2, lr=1.0, seed=9)
+        t2.load(str(tmp_path))
+        t2.push(np.array([2]), np.ones((1, 2), np.float32))
+        t2.save(str(tmp_path), mode=1)          # must be ...-000002
+        t3 = SparseTable("emb", dim=2, lr=1.0, seed=4)
+        t3.load(str(tmp_path))
+        np.testing.assert_allclose(t3.pull(np.array([1])), want_row1,
+                                   rtol=1e-6)   # row 1's delta survived
+        np.testing.assert_allclose(t3.pull(np.array([2])),
+                                   t2.pull(np.array([2])), rtol=1e-6)
+
+    def test_shrink_tombstones_persist_in_delta(self, tmp_path):
+        t = CtrSparseTable("ctr", dim=2,
+                           accessor=CtrAccessor(delete_threshold=0.5,
+                                                delete_after_unseen_days=99))
+        t.pull(np.array([1, 2], np.int64))
+        t.push_show_click([1], [100.0], [10.0])   # row 1 hot, row 2 cold
+        t.save(str(tmp_path), mode=0)
+        assert t.shrink() == 1                    # evicts cold row 2
+        t.save(str(tmp_path), mode=1)             # delta carries tombstone
+        t2 = CtrSparseTable("ctr", dim=2)
+        t2.load(str(tmp_path))
+        assert t2.stats(2) is None and 2 not in t2._rows, \
+            "restore resurrected an evicted row"
+        assert t2.stats(1) is not None
+
+    def test_decay_persists_in_delta(self, tmp_path):
+        t = CtrSparseTable("ctr", dim=2)
+        t.pull(np.array([1], np.int64))
+        t.push_show_click([1], [100.0], [10.0])
+        t.save(str(tmp_path), mode=0)
+        t.update_days()                           # decay mutates stats
+        t.save(str(tmp_path), mode=1)
+        t2 = CtrSparseTable("ctr", dim=2)
+        t2.load(str(tmp_path))
+        s, c, d = t2.stats(1)
+        assert s == pytest.approx(98.0) and d == 1, \
+            "restore resurrected pre-decay stats"
