@@ -2,6 +2,7 @@
 (coalesce, masked_matmul, maxpool, fused_attention, mask_as) — each checked
 numerically against a dense reference (the reference's OpTest pattern,
 test/legacy_test/op_test.py check_output)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -153,3 +154,150 @@ class TestValuewiseZoo:
         out = sp.transpose(t, [1, 0])
         np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
                                    dense.T, rtol=1e-6)
+
+
+class TestBlockSparseAttention:
+    """fused_attention lowers onto the Pallas block-sparse flash kernel
+    (VERDICT r3 next #7): no [T, T] dense intermediate, fully-masked
+    tiles skipped, numeric parity with the dense path."""
+
+    def _qkv(self, B=2, H=2, T=64, D=8, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+        return mk(), mk(), mk()
+
+    def _band_pattern(self, T, w):
+        rows, cols = [], []
+        for i in range(T):
+            for j in range(max(0, i - w), min(T, i + w + 1)):
+                rows.append(i)
+                cols.append(j)
+        return np.asarray(rows), np.asarray(cols)
+
+    def _csr_mask(self, rows, cols, T):
+        crows = np.zeros(T + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return pt.sparse.sparse_csr_tensor(
+            crows, cols, np.ones(len(cols), np.float32), (T, T))
+
+    def test_parity_vs_dense_path(self):
+        from paddle_tpu.sparse.csr import fused_attention
+        T = 64
+        q, k, v = self._qkv(T=T)
+        rows, cols = self._band_pattern(T, w=9)  # partial 16-blocks
+        mask = self._csr_mask(rows, cols, T)
+        out_block = fused_attention(q, k, v, mask, block_size=16)
+        # dense reference path (additive mask forces the dense lowering)
+        out_dense = fused_attention(q, k, v, mask,
+                                    attn_mask=jnp.zeros((T, T)))
+        np.testing.assert_allclose(np.asarray(out_block.numpy()),
+                                   np.asarray(out_dense.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grads_match_dense(self):
+        from paddle_tpu.ops.block_sparse_attention import \
+            block_sparse_attention
+        T, D = 32, 8
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(1, T, 2, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, T, 2, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, T, 2, D).astype(np.float32))
+        rows, cols = self._band_pattern(T, w=5)
+
+        def f_block(q_, k_, v_):
+            return jnp.sum(block_sparse_attention(
+                q_, k_, v_, rows, cols, block_q=8, block_k=8) ** 2)
+
+        def f_dense(q_, k_, v_):
+            pat = np.zeros((T, T), bool)
+            pat[rows, cols] = True
+            s = jnp.einsum("bthd,bshd->bhts", q_, k_) / np.sqrt(D)
+            s = jnp.where(jnp.asarray(pat)[None, None], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhts,bshd->bthd", p, v_)
+            return jnp.sum(o ** 2)
+
+        gb = jax.grad(f_block, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gb, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_block_map_skips_empty_tiles(self):
+        from paddle_tpu.ops.block_sparse_attention import \
+            pattern_to_block_map
+        T, bs = 256, 32
+        rows, cols = self._band_pattern(T, w=2)
+        bmap, masks = pattern_to_block_map(rows, cols, T, bs, bs)
+        # banded: only the tridiagonal tiles of the 8x8 grid are active
+        assert (bmap > 0).sum() == 22 and bmap.size == 64
+        off = bmap[0, 3]  # far off-diagonal tile: skipped
+        assert off == 0
+        # memory: masks is O(partial tiles), nothing like [T, T]
+        assert masks.shape[0] <= (bmap > 0).sum() + 1
+
+    def test_empty_rows_yield_zero(self):
+        from paddle_tpu.ops.block_sparse_attention import \
+            block_sparse_attention
+        T = 32
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, T, 1, 8).astype(np.float32))
+        k, v = q, q
+        # only the first 8 rows attend anywhere
+        rows = np.repeat(np.arange(8), 4)
+        cols = np.tile(np.arange(4), 8)
+        out = block_sparse_attention(q, k, v, rows, cols,
+                                     block_q=8, block_k=8)
+        out = np.asarray(out)
+        assert np.abs(out[0, 8:]).max() == 0.0
+        assert np.abs(out[0, :8]).max() > 0.0
+
+    def test_long_context_8192_no_dense_intermediate(self):
+        # the r3 blocker: T=8192 sparse attention previously built a
+        # [8192, 8192] dense pattern + logits (256 MB each). The block
+        # path's footprint is O(active tiles); it must simply RUN.
+        from paddle_tpu.ops.block_sparse_attention import (
+            block_sparse_attention, pattern_to_block_map)
+        T, bs = 8192, 512
+        # sliding window ±256 + 64 global tokens (Longformer-style)
+        i = np.arange(T)
+        rows = np.concatenate([np.repeat(i, 2), np.arange(64).repeat(8)])
+        cols = np.concatenate([
+            np.stack([np.maximum(i - 256, 0),
+                      np.minimum(i + 256, T - 1)], 1).reshape(-1),
+            np.tile(np.arange(0, T, T // 8), 64)])
+        bmap, masks = pattern_to_block_map(rows, cols, T, bs, bs)
+        active = int((bmap > 0).sum())
+        assert active < bmap.size // 4, (active, bmap.size)
+        # masks memory = (P+1)·512·512 int8 ≪ T² f32
+        assert masks.nbytes < 64 * 1024 * 1024
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, T, 1, 64).astype(np.float32))
+        out = block_sparse_attention(q, q, q, rows, cols,
+                                     block_q=bs, block_k=bs)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_empty_coalesce(self):
+        t = pt.sparse.sparse_csr_tensor(
+            np.zeros(5, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, np.float32), (4, 6))
+        out = sp.coalesce(t)
+        assert out.nnz == 0
+
+    def test_empty_rows_match_across_lowerings(self):
+        # both paths must agree: empty pattern rows → output 0
+        from paddle_tpu.sparse.csr import fused_attention
+        T = 32
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 1, T, 8).astype(np.float32))
+        rows = np.repeat(np.arange(8), 4)   # rows 8.. have no entries
+        cols = np.tile(np.arange(4), 8)
+        mask = self._csr_mask(rows, cols, T)
+        out_block = fused_attention(q, q, q, mask, block_size=8)
+        out_dense = fused_attention(q, q, q, mask,
+                                    attn_mask=jnp.zeros((T, T)))
+        np.testing.assert_allclose(np.asarray(out_block.numpy()),
+                                   np.asarray(out_dense.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+        assert np.abs(np.asarray(out_block.numpy())[0, 0, 8:]).max() == 0
