@@ -21,6 +21,7 @@ import functools
 import heapq
 import itertools
 import threading
+import types
 from typing import Any, Callable, Sequence
 
 import jax
@@ -440,6 +441,7 @@ _VJP_CODE_MISS_CAP = 32
 
 
 _VALUE_TYPES = (int, float, bool, str, bytes, type(None), complex)
+_MISSING_GLOBAL = object()
 
 
 def _value_hashable(x) -> bool:
@@ -490,6 +492,38 @@ def _vjp_cache_key(fn, static_kwargs, arrs):
     defaults = getattr(fn, "__defaults__", None) or ()
     if not all(_value_hashable(d) for d in defaults):
         return None
+    # Globals the code reads are mutable state invisible to a __code__ key
+    # (advisor r3: `def op(a): return a * CFG.k` — rebinding CFG/K between
+    # calls would replay a stale compiled forward). co_names covers every
+    # LOAD_GLOBAL; modules are stable namespaces, callables/types are
+    # guarded by identity (rebinding → new key), value-hashable constants
+    # ride in the key, anything else demotes to raw — mirroring the care
+    # taken above for closure cells.
+    gvals = ()
+    if code is not fn:
+        gns = getattr(fn, "__globals__", None)
+        if gns is not None:
+            acc = []
+            for n in code.co_names:
+                v = gns.get(n, _MISSING_GLOBAL)
+                if v is _MISSING_GLOBAL or isinstance(v, types.ModuleType):
+                    continue
+                if isinstance(v, (types.FunctionType,
+                                  types.BuiltinFunctionType, type)):
+                    # identity key holding the OBJECT (not id()): keeps the
+                    # referent alive, so a freed-and-reused address can never
+                    # alias a rebound function onto a stale entry
+                    acc.append((n, v))
+                elif callable(v):
+                    # callable INSTANCES (config objects with __call__,
+                    # functools.partial) carry mutable state an identity key
+                    # cannot see — demote to raw, like closure cells do
+                    return None
+                elif _value_hashable(v):
+                    acc.append((n, v))
+                else:
+                    return None
+            gvals = tuple(acc)
     sk = tuple(sorted(static_kwargs.items())) if static_kwargs else ()
     if not all(_value_hashable(v) for _, v in sk):
         return None
@@ -506,7 +540,8 @@ def _vjp_cache_key(fn, static_kwargs, arrs):
             static_argnums.append(i)
         else:
             return None
-    return (code, cells, sk, tuple(sig), defaults), tuple(static_argnums)
+    return (code, cells, sk, tuple(sig), defaults, gvals), \
+        tuple(static_argnums)
 
 
 def _tape_vjp(f, fn, static_kwargs, arrs):

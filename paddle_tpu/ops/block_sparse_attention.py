@@ -362,6 +362,20 @@ def _get_bsa_fn(rows_bytes, cols_bytes, T, block_q, block_k, interpret):
     return jax.jit(f)
 
 
+def compile_pattern(rows, cols, T, block_q: int = 512, block_k: int = 512,
+                    interpret=None):
+    """Resolve (and cache) the compiled kernel closure for one COO pattern.
+    This is the ONLY point that reads the pattern to host (np.asarray) and
+    hashes its bytes — callers that hold a pattern across steps should call
+    this once and reuse the returned fn (csr.fused_attention memoizes it on
+    the mask object), so steady-state steps pay no O(nnz) transfer/hash."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _get_bsa_fn(np.asarray(rows, np.int64).tobytes(),
+                       np.asarray(cols, np.int64).tobytes(),
+                       T, block_q, block_k, bool(interpret))
+
+
 def block_sparse_attention(q, k, v, rows, cols, block_q: int = 512,
                            block_k: int = 512, interpret=None):
     """Attention over the COO pattern (rows, cols) without any [T, T]
@@ -372,9 +386,5 @@ def block_sparse_attention(q, k, v, rows, cols, block_q: int = 512,
     block_k = min(block_k, T)
     assert T % block_q == 0 and T % block_k == 0, \
         f"pattern blocks must tile T: {T} % {block_q}/{block_k}"
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    fn = _get_bsa_fn(np.asarray(rows, np.int64).tobytes(),
-                     np.asarray(cols, np.int64).tobytes(),
-                     T, block_q, block_k, bool(interpret))
+    fn = compile_pattern(rows, cols, T, block_q, block_k, interpret)
     return fn(q, k, v)

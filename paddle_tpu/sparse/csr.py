@@ -212,17 +212,31 @@ def fused_attention(query, key, value, sparse_mask, key_padding_mask=None,
     rows, cols, _, shape = _coo_parts(sparse_mask)
     T = shape[0]
     if key_padding_mask is None and attn_mask is None:
-        from ..ops.block_sparse_attention import block_sparse_attention
+        from ..ops.block_sparse_attention import compile_pattern
         if block_size:
             bs = block_size if T % block_size == 0 else None
         else:  # largest divisor of T up to 512 (tiles must cover T)
             bs = next((b for b in range(min(512, T), 0, -1)
                        if T % b == 0), None)
         if bs is not None and bs >= 8:
-            out = block_sparse_attention(
-                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                jnp.swapaxes(v, 1, 2), np.asarray(rows), np.asarray(cols),
-                block_q=bs, block_k=bs)
+            # memoize the compiled closure ON the mask object: the pattern
+            # arrays are device-resident, and re-reading nnz entries to
+            # host + hashing them per training step would put an O(nnz)
+            # blocking transfer back into the hot path. Sparse tensors are
+            # rebuilt (not mutated) by every op, so object identity is a
+            # sound cache key.
+            memo = getattr(sparse_mask, "_bsa_fn_memo", None)
+            if memo is not None and memo[0] == (T, bs):
+                fn = memo[1]
+            else:
+                fn = compile_pattern(np.asarray(rows), np.asarray(cols), T,
+                                     block_q=bs, block_k=bs)
+                try:
+                    sparse_mask._bsa_fn_memo = ((T, bs), fn)
+                except AttributeError:
+                    pass  # non-Tensor pattern holder without a __dict__
+            out = fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                     jnp.swapaxes(v, 1, 2))
             return Tensor(jnp.swapaxes(out, 1, 2))
         import warnings
         warnings.warn(

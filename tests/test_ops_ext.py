@@ -361,3 +361,26 @@ def test_registry_past_400():
     for name in ("adamw_", "grid_sample", "p_norm", "sequence_mask",
                  "c_allreduce_sum", "flash_attn", "fft_c2c", "top_p_sampling"):
         assert callable(getattr(C, name))
+
+
+def test_c_ops_fallback_is_allowlisted():
+    """advisor r3 low #2: the _C_ops fallback must resolve only the
+    enumerated fused/sparse/collective names — a dense op name missing
+    from the main table must raise, not silently bind to a same-named
+    function with sparse semantics."""
+    import paddle_tpu._C_ops as C
+
+    # allowlisted names resolve to their home namespace
+    assert callable(C.fused_rms_norm)
+    assert callable(C.masked_matmul)
+    assert callable(C.barrier)
+    import paddle_tpu.sparse as sp
+    assert C.fused_attention is sp.fused_attention  # sparse, not incubate
+
+    # names living in those namespaces but NOT allowlisted do not resolve
+    # (paddle_tpu.sparse.values/indices would shadow a dense-table gap)
+    import pytest
+    for bad in ("values", "indices", "batch_norm_", "get_rank",
+                "definitely_not_an_op"):
+        with pytest.raises(AttributeError):
+            getattr(C, bad)

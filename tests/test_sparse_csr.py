@@ -301,3 +301,25 @@ class TestBlockSparseAttention:
                                    np.asarray(out_dense.numpy()),
                                    rtol=2e-4, atol=2e-5)
         assert np.abs(np.asarray(out_block.numpy())[0, 0, 8:]).max() == 0
+
+
+def test_fused_attention_memoizes_compiled_pattern():
+    """Steady-state steps must not re-read the nnz pattern to host: the
+    compiled closure is memoized on the mask object (review r4 finding)."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.sparse as sparse
+
+    T, H, D = 16, 2, 8
+    rng = np.random.RandomState(0)
+    rows, cols = np.tril_indices(T)
+    mask = sparse.sparse_coo_tensor(
+        np.stack([rows, cols]), np.ones(len(rows), np.float32), (T, T))
+    q = pt.to_tensor(rng.rand(1, H, T, D).astype(np.float32))
+    o1 = sparse.fused_attention(q, q, q, mask, block_size=8)
+    memo1 = getattr(mask, "_bsa_fn_memo", None)
+    assert memo1 is not None
+    o2 = sparse.fused_attention(q, q, q, mask, block_size=8)
+    assert getattr(mask, "_bsa_fn_memo")[1] is memo1[1]  # same closure
+    np.testing.assert_allclose(np.asarray(o1.numpy()),
+                               np.asarray(o2.numpy()))

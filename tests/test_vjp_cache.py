@@ -127,6 +127,94 @@ class TestCacheSafety:
                                    rtol=1e-6)
 
 
+K_GLOBAL = 2.0
+
+
+def _op_reads_global(a):
+    return a * K_GLOBAL
+
+
+class _CfgObj:
+    pass
+
+
+CFG_GLOBAL = _CfgObj()
+CFG_GLOBAL.k = 2.0
+
+
+def _op_reads_cfg(a):
+    return a * CFG_GLOBAL.k
+
+
+class _Helper:
+    k = 2.0
+
+    def __call__(self, a):
+        return a * self.k
+
+
+HELPER_GLOBAL = _Helper()
+
+
+def _op_calls_helper(a):
+    return HELPER_GLOBAL(a)
+
+
+class TestGlobalsGuard:
+    """advisor r3 medium #3: fn.__globals__ reads must be part of the key
+    (or demote to raw) — a rebound module constant must never replay a
+    stale compiled forward."""
+
+    def test_rebound_value_global_not_stale(self):
+        global K_GLOBAL
+        K_GLOBAL = 2.0
+        x = _t([1.0], grad=True)
+        o1 = engine.apply(_op_reads_global, x, name="gv")
+        K_GLOBAL = 9.0
+        o2 = engine.apply(_op_reads_global, x, name="gv")
+        K_GLOBAL = 2.0
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [9.0])
+
+    def test_object_global_demotes_to_raw(self):
+        # an identity-hashed global (config instance) cannot be keyed —
+        # the op must run raw so attribute mutation is always re-read
+        CFG_GLOBAL.k = 2.0
+        x = _t([1.0], grad=True)
+        o1 = engine.apply(_op_reads_cfg, x, name="gc")
+        CFG_GLOBAL.k = 7.0
+        o2 = engine.apply(_op_reads_cfg, x, name="gc")
+        CFG_GLOBAL.k = 2.0
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [7.0])
+
+    def test_callable_instance_global_demotes_to_raw(self):
+        # a callable OBJECT read from globals carries mutable state an
+        # identity key cannot see — must run raw (review r4 finding)
+        HELPER_GLOBAL.k = 2.0
+        x = _t([1.0], grad=True)
+        o1 = engine.apply(_op_calls_helper, x, name="gh")
+        HELPER_GLOBAL.k = 9.0
+        o2 = engine.apply(_op_calls_helper, x, name="gh")
+        HELPER_GLOBAL.k = 2.0
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [9.0])
+
+    def test_module_global_still_cached(self):
+        engine._VJP_JIT_CACHE.clear()
+        engine._VJP_CODE_STATS.clear()
+
+        def op(a):
+            return jnp.tanh(a)  # co_names = (jnp, tanh): module → skipped
+
+        x = _t([0.5], grad=True)
+        before = len(engine._VJP_JIT_CACHE)
+        engine.apply(op, x, name="gm")
+        assert len(engine._VJP_JIT_CACHE) == before + 1
+        engine.apply(op, x, name="gm")
+        assert len(engine._VJP_JIT_CACHE) == before + 1  # hit, no new entry
+
+
 class TestChurnGuard:
     def test_polymorphic_shapes_stay_cached_when_replayed(self):
         engine._VJP_JIT_CACHE.clear()
