@@ -1,0 +1,211 @@
+"""Secondary BASELINE.json configs on real hardware (VERDICT r3 next #5).
+
+BASELINE.json lists five configs; llama (north star) and ResNet-50 were
+measured in r2/r3. This bench covers the remaining three:
+
+  bert  — BERT-base (110M) sequence-classification fine-tune step
+          (config 1, "BERT-base / ERNIE-3.0 fine-tune"): fwd+bwd+AdamW
+          through the auto-parallel Engine with AMP bf16, B=32 T=128.
+  unet  — SD2.1-class UNet train step (config 3, "Stable Diffusion 2.1
+          UNet"): the full 865M-param block layout (320/640/1280/1280,
+          context 1024) in bf16, DDPM noise-prediction MSE, B=4 64x64
+          latents (512x512 images).
+  moe   — Mixtral-class MoE decoder (config 4) scaled to one chip
+          (~650M params, 8 experts top-2 dense dispatch): tokens/s on
+          the real TPU. True expert-parallel all-to-all needs multiple
+          chips (ICI); the 8-virtual-device EP sharding is exercised by
+          dryrun_multichip (experts on the dp axis) — this mode measures
+          the MoE compute path itself on hardware.
+
+    python benchmarks/secondary_bench.py bert|unet|moe [chain] [samples]
+
+Each mode prints one JSON line (chained steady-state timing, median —
+see benchmarks/_timing.py for the measurement contract).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(metric, value, unit, cfg, times, compile_s, loss):
+    import jax
+    dt = float(np.median(times))
+    print(json.dumps({
+        "metric": metric, "value": round(value, 1), "unit": unit,
+        "config": cfg,
+        "step_ms_median": round(dt * 1e3, 2),
+        "step_ms_min": round(min(times) * 1e3, 2),
+        "compile_s": round(compile_s, 1),
+        "device": str(getattr(jax.devices()[0], "device_kind", "?")),
+        "loss": loss,
+    }))
+
+
+def bench_bert(chain, samples):
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks._timing import timed_chain
+    from paddle_tpu.distributed.engine import Engine, Strategy
+    from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import AdamW
+
+    B, T = 32, 128
+    cfg = BertConfig()  # base: 12L/768H/110M
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    eng = Engine(model, loss=lambda logits, y: F.cross_entropy(logits, y),
+                 optimizer=AdamW(learning_rate=2e-5,
+                                 moment_dtype=jnp.bfloat16),
+                 strategy=Strategy(amp=True))
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int64)
+    y = jnp.asarray(rng.randint(0, 2, (B, 1)), jnp.int64)
+    jax.block_until_ready(ids)
+
+    t0 = time.time()
+    loss = eng.step(ids, y)
+    float(jax.device_get(loss._value if hasattr(loss, "_value") else loss))
+    compile_s = time.time() - t0
+
+    times = timed_chain(lambda: eng.step(ids, y), chain, samples)
+    loss = eng.step(ids, y)
+    dt = float(np.median(times))
+    _emit("bert_base_finetune_examples_per_sec_per_chip", B / dt,
+          "examples/s",
+          {"batch": B, "seq": T, "layers": cfg.num_hidden_layers,
+           "hidden": cfg.hidden_size, "amp": "bf16",
+           "optimizer": "AdamW bf16-moments"},
+          times, compile_s,
+          float(jax.device_get(loss._value if hasattr(loss, "_value")
+                               else loss)))
+
+
+def bench_unet(chain, samples):
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks._timing import timed_chain
+    from paddle_tpu.models.diffusion import (UNetConfig, ddpm_add_noise,
+                                             ddpm_betas, unet_apply,
+                                             unet_init_params)
+    from paddle_tpu.optimizer import AdamW
+
+    B, HW, CTX = 4, 64, 77
+    cfg = UNetConfig(dtype=jnp.bfloat16)  # SD2.1 layout: 320/640/1280/1280
+    params = unet_init_params(cfg, key=jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(params))
+
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                moment_dtype=jnp.bfloat16)
+    opt_state = opt.init_state(params)
+    betas = ddpm_betas()
+
+    def _train_step(params, opt_state, x0, noise, t, ctx, step_i):
+        def loss_fn(p):
+            xt = ddpm_add_noise(x0, noise, t, betas)
+            eps = unet_apply(p, xt, t, ctx, cfg)
+            return jnp.mean(
+                (eps.astype(jnp.float32) - noise.astype(jnp.float32)) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p, new_s = opt.apply_gradients(grads, params, opt_state,
+                                           lr=1e-4, step=step_i)
+        return new_p, new_s, loss
+
+    train_step = jax.jit(_train_step, donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randn(B, cfg.in_channels, HW, HW), jnp.bfloat16)
+    noise = jnp.asarray(rng.randn(B, cfg.in_channels, HW, HW), jnp.bfloat16)
+    t = jnp.asarray(rng.randint(0, 1000, (B,)), jnp.int32)
+    ctx = jnp.asarray(rng.randn(B, CTX, cfg.context_dim), jnp.bfloat16)
+    jax.block_until_ready(x0)
+
+    state = {"p": params, "s": opt_state, "i": 1}
+
+    def one_step():
+        state["p"], state["s"], loss = train_step(
+            state["p"], state["s"], x0, noise, t, ctx,
+            jnp.int32(state["i"]))
+        state["i"] += 1
+        return loss
+
+    t0 = time.time()
+    loss = one_step()
+    float(jax.device_get(loss))
+    compile_s = time.time() - t0
+
+    times = timed_chain(one_step, chain, samples)
+    loss = one_step()
+    dt = float(np.median(times))
+    _emit("sd21_unet_train_images_per_sec_per_chip", B / dt, "images/s",
+          {"batch": B, "latent": HW, "params": n_params,
+           "blocks": list(cfg.block_channels), "context_dim": cfg.context_dim,
+           "dtype": "bf16", "optimizer": "AdamW bf16-moments"},
+          times, compile_s, float(jax.device_get(loss)))
+
+
+def bench_moe(chain, samples):
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks._timing import timed_chain
+    from paddle_tpu.models import LlamaConfig, LlamaTrainStep
+    from paddle_tpu.optimizer import AdamW
+
+    # Mixtral-shaped, scaled to one 16GB chip: 8 experts, top-2, GQA
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=1024, dtype=jnp.bfloat16,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=2816)
+    B, T = 8, 1024
+    step = LlamaTrainStep(cfg, mesh=None,
+                          optimizer=AdamW(learning_rate=3e-4,
+                                          weight_decay=0.1,
+                                          moment_dtype=jnp.bfloat16),
+                          remat=True)
+    n_params = sum(int(np.prod(v.shape))
+                   for v in jax.tree.leaves(step.params))
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+
+    t0 = time.time()
+    loss = step(toks, labels)
+    float(jax.device_get(loss))
+    compile_s = time.time() - t0
+
+    times = timed_chain(lambda: step(toks, labels), chain, samples)
+    loss = step(toks, labels)
+    dt = float(np.median(times))
+    _emit("mixtral_moe_train_tokens_per_sec_per_chip", B * T / dt,
+          "tokens/s",
+          {"batch": B, "seq": T, "experts": cfg.num_experts,
+           "top_k": cfg.num_experts_per_tok, "params": n_params,
+           "note": "dense top-2 dispatch on one chip; EP all-to-all "
+                   "needs multi-chip ICI (sharding validated by "
+                   "dryrun_multichip: experts on the dp axis)"},
+          times, compile_s, float(jax.device_get(loss)))
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    chain = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    samples = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    {"bert": bench_bert, "unet": bench_unet, "moe": bench_moe}[mode](
+        chain, samples)
+
+
+if __name__ == "__main__":
+    main()

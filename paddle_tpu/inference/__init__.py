@@ -31,7 +31,8 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
-           "PrecisionType", "LLMPredictor"]
+           "PrecisionType", "LLMPredictor", "ContinuousBatcher",
+           "PredictorPool"]
 
 
 class PrecisionType:
@@ -310,3 +311,6 @@ class LLMPredictor:
             return {"runs": 0}
         return {"runs": len(ts), "total_s": sum(ts),
                 "avg_ms": 1e3 * sum(ts) / len(ts)}
+
+
+from .serving import ContinuousBatcher, PredictorPool  # noqa: E402

@@ -1,0 +1,218 @@
+"""Continuous-batching LLM serving (VERDICT r3 next #8).
+
+Reference bar: ``PredictorPool`` (/root/reference/paddle/fluid/inference/
+api/paddle_inference_api.h:253) — the reference serves concurrency by
+pooling whole predictors, one request per predictor at a time. The
+TPU-native design does better: ONE compiled decode whose batch dimension
+is a pool of slots with independent per-slot positions, so requests of
+different prompt lengths and generation budgets share every MXU step
+(iteration-level scheduling, the vLLM/Orca idea, expressed as two XLA
+executables):
+
+  * admit — a queued request prefills into any free slot
+    (``llama_prefill_slot``: prompt bucketed to a few static lengths, one
+    executable per bucket; the cache row-range of just that slot is
+    overwritten);
+  * decode — ``llama_decode_burst`` scans N single-token steps over ALL
+    active slots; a slot retires on EOS or its length budget and emits
+    padding until the host swaps a new request in between bursts.
+
+The scheduler below is plain host Python between device calls: it owns the
+request queue, slot table, and per-request output buffers. burst=1 gives
+token-level admission latency; larger bursts amortize dispatch.
+
+``PredictorPool`` (API parity with the reference) is also provided as a
+thin pool of independent predictors for the thread-per-request style.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ContinuousBatcher", "PredictorPool", "ServedRequest"]
+
+
+@dataclasses.dataclass
+class ServedRequest:
+    rid: int
+    prompt: list
+    max_new_tokens: int
+    out: list = dataclasses.field(default_factory=list)
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Slot-pool serving engine over the compiled llama decode.
+
+    engine = ContinuousBatcher(cfg, params, max_batch=8, max_len=1024)
+    rid = engine.add_request([1, 2, 3], max_new_tokens=64)
+    results = engine.run()          # {rid: [generated token ids]}
+
+    Executable inventory (all compiled once, reused forever):
+    one prefill per prompt bucket + one burst — independent of request
+    count, prompt mix, and admission order.
+    """
+
+    def __init__(self, model_config, params, max_batch: int = 4,
+                 max_len: int = 512,
+                 prompt_buckets: Sequence[int] = (32, 64, 128, 256),
+                 burst: int = 8, eos_id: int | None = None, pad_id: int = 0,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+        from ..models.llama_decode import init_kv_cache
+        self._cfg = model_config
+        self._params = params
+        self.B, self.S = int(max_batch), int(max_len)
+        self._buckets = tuple(sorted(b for b in prompt_buckets
+                                     if b <= max_len))
+        if not self._buckets:
+            raise ValueError("no prompt bucket fits max_len")
+        self.burst = int(burst)
+        self.eos_id = -1 if eos_id is None else int(eos_id)
+        self.pad_id = int(pad_id)
+        self._temp, self._top_k = float(temperature), int(top_k)
+        self._key = jax.random.PRNGKey(seed)
+
+        self._cache = init_kv_cache(model_config, self.B, self.S)
+        # Slot state lives HOST-side as numpy and is uploaded per burst
+        # call (four tiny [B] arrays). The alternative — device arrays
+        # updated with .at[].set per admission and read back per decision —
+        # costs one device→host sync per touch, and on a tunneled TPU a
+        # sync is ~60 ms of RTT: the r4 serving bench measured 200 ms per
+        # ADMISSION before this batching (one int(first) sync each).
+        self._pos = np.zeros(self.B, np.int32)
+        self._tok = np.zeros(self.B, np.int32)
+        self._done = np.ones(self.B, bool)         # done == slot free
+        self._limit = np.zeros(self.B, np.int32)
+        self._slot_req: list[ServedRequest | None] = [None] * self.B
+
+        self._queue: deque[ServedRequest] = deque()
+        self._finished: dict[int, ServedRequest] = {}
+        self._next_rid = 0
+        self.stats = {"bursts": 0, "decode_steps": 0, "prefills": 0}
+
+    # ------------------------------------------------------------- intake
+    def add_request(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self._buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest bucket "
+                f"{self._buckets[-1]}")
+        if len(prompt) + max_new_tokens > self.S:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(ServedRequest(rid, prompt, int(max_new_tokens)))
+        return rid
+
+    def _bucket_len(self, n: int) -> int:
+        return next(b for b in self._buckets if b >= n)
+
+    # ------------------------------------------------------------- admit
+    def _admit(self):
+        from ..models.llama_decode import llama_prefill_slot
+        staged = []  # (req, slot, tlen, first_device_scalar)
+        while self._queue and None in self._slot_req:
+            req = self._queue.popleft()
+            slot = self._slot_req.index(None)
+            tlen = len(req.prompt)
+            tb = self._bucket_len(tlen)
+            toks = np.full(tb, self.pad_id, np.int32)
+            toks[:tlen] = req.prompt
+            self._key, sub = jax.random.split(self._key)
+            first, self._cache = llama_prefill_slot(
+                self._params, self._cache, jnp.asarray(toks),
+                jnp.int32(slot), jnp.int32(tlen), sub,
+                config=self._cfg, max_len=self.S,
+                temperature=self._temp, top_k=self._top_k)
+            self.stats["prefills"] += 1
+            self._slot_req[slot] = req  # reserve; confirmed after the sync
+            staged.append((req, slot, tlen, first))
+        if not staged:
+            return
+        # ONE host sync for the whole admission batch (prefills enqueue
+        # async; syncing per request costs a tunnel RTT each)
+        firsts = [int(v) for v in jax.device_get([f for *_, f in staged])]
+        for (req, slot, tlen, _), first in zip(staged, firsts):
+            req.out.append(first)
+            if req.max_new_tokens <= 1 or first == self.eos_id:
+                req.done = True
+                self._finished[req.rid] = req
+                self._slot_req[slot] = None
+                continue
+            self._pos[slot] = tlen
+            self._tok[slot] = first
+            self._done[slot] = False
+            self._limit[slot] = min(tlen + req.max_new_tokens - 1,
+                                    self.S - 1)
+
+    # ------------------------------------------------------------- decode
+    def step(self):
+        """One scheduling iteration: admit, then one decode burst."""
+        from ..models.llama_decode import llama_decode_burst
+        self._admit()
+        if all(r is None for r in self._slot_req):
+            return
+        old_pos = self._pos.copy()
+        self._key, sub = jax.random.split(self._key)
+        (self._cache, pos_d, tok_d, done_d, emitted) = llama_decode_burst(
+            self._params, self._cache, jnp.asarray(self._pos),
+            jnp.asarray(self._tok), jnp.asarray(self._done),
+            jnp.asarray(self._limit), jnp.int32(self.eos_id), sub,
+            config=self._cfg, n=self.burst, temperature=self._temp,
+            top_k=self._top_k, pad_id=self.pad_id)
+        self.stats["bursts"] += 1
+        self.stats["decode_steps"] += self.burst
+        # ONE host sync for the whole burst result
+        pos, tok, done, emitted = jax.device_get(
+            (pos_d, tok_d, done_d, emitted))
+        self._pos = np.array(pos)    # device_get views are read-only;
+        self._tok = np.array(tok)    # admissions write these in place
+        self._done = np.array(done)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            n_new = int(self._pos[slot] - old_pos[slot])
+            req.out.extend(int(t) for t in np.asarray(emitted)[:n_new, slot])
+            if done[slot]:
+                req.done = True
+                self._finished[req.rid] = req
+                self._slot_req[slot] = None
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(r is not None for r in self._slot_req)
+
+    def run(self) -> dict:
+        """Drain the queue; returns {rid: [generated token ids]}."""
+        while self.pending:
+            self.step()
+        out = {rid: req.out for rid, req in self._finished.items()}
+        self._finished = {}
+        return out
+
+
+class PredictorPool:
+    """Reference-parity pool (paddle_inference_api.h:253): `size`
+    independent predictors sharing nothing, retrieved by index for
+    thread-per-request serving. For throughput, prefer ContinuousBatcher —
+    a pool of whole predictors multiplies weight memory and serializes on
+    the single chip anyway."""
+
+    def __init__(self, config_or_fn, size: int = 1, example_args=None,
+                 params=None, config=None):
+        from . import Predictor
+        self._preds = [Predictor(config_or_fn, example_args=example_args,
+                                 params=params, config=config)
+                       for _ in range(max(1, size))]
+
+    def retrieve(self, idx: int):
+        return self._preds[idx % len(self._preds)]
+
+    Retrieve = retrieve  # reference C++ spelling

@@ -38,8 +38,9 @@ def test_prefill_matches_forward(kw):
     got, cache = llama_prefill(params, toks, cfg, max_len=16)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
-    assert cache["k"].shape == (cfg.num_hidden_layers, 2, 16,
-                                cfg.num_key_value_heads, cfg.head_dim)
+    assert len(cache["k"]) == cfg.num_hidden_layers  # per-layer buffers
+    assert all(b.shape == (2, 16, cfg.num_key_value_heads, cfg.head_dim)
+               for b in cache["k"])
 
 
 @pytest.mark.parametrize("kw", [

@@ -1,0 +1,119 @@
+"""Continuous-batching serving engine (inference/serving.py; VERDICT r3
+next #8, reference bar PredictorPool paddle_inference_api.h:253).
+
+The correctness contract: slot-pool decode with mixed prompt lengths,
+mid-flight admission, and EOS/length retirement must produce EXACTLY the
+tokens per-request ``llama_generate`` (greedy) produces — same params,
+same model — regardless of scheduling order."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig, llama_init_params
+from paddle_tpu.models.llama_decode import llama_generate
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, max_position_embeddings=128)
+    params = llama_init_params(cfg, jax.random.PRNGKey(3))
+    return cfg, params
+
+
+def _reference_generate(cfg, params, prompt, n):
+    toks = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    out = llama_generate(params, toks, cfg, n, temperature=0.0)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _make_engine(cfg, params, **kw):
+    from paddle_tpu.inference import ContinuousBatcher
+    kw.setdefault("max_batch", 3)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("prompt_buckets", (8, 16, 32))
+    kw.setdefault("burst", 4)
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+class TestContinuousBatcher:
+    def test_single_request_matches_generate(self, small_model):
+        cfg, params = small_model
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, cfg.vocab_size, 11).tolist()
+        eng = _make_engine(cfg, params)
+        rid = eng.add_request(prompt, max_new_tokens=9)
+        out = eng.run()
+        assert out[rid] == _reference_generate(cfg, params, prompt, 9)
+
+    def test_mixed_lengths_and_budgets_match(self, small_model):
+        cfg, params = small_model
+        rng = np.random.RandomState(1)
+        reqs = [(rng.randint(1, cfg.vocab_size, n).tolist(), m)
+                for n, m in [(5, 7), (13, 3), (29, 12), (8, 1), (20, 6)]]
+        eng = _make_engine(cfg, params)
+        rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run()
+        for rid, (p, m) in zip(rids, reqs):
+            assert out[rid] == _reference_generate(cfg, params, p, m), \
+                (rid, len(p), m)
+
+    def test_more_requests_than_slots_admits_midflight(self, small_model):
+        cfg, params = small_model
+        rng = np.random.RandomState(2)
+        reqs = [(rng.randint(1, cfg.vocab_size, 4 + i).tolist(), 5 + i % 3)
+                for i in range(7)]  # 7 requests, 3 slots
+        eng = _make_engine(cfg, params)
+        rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run()
+        assert len(out) == 7
+        for rid, (p, m) in zip(rids, reqs):
+            assert out[rid] == _reference_generate(cfg, params, p, m)
+        # the pool really interleaved: fewer prefill+burst launches than a
+        # sequential B=1 loop would need decode steps
+        assert eng.stats["prefills"] == 7
+        assert eng.stats["bursts"] >= 2
+
+    def test_eos_retires_slot_early(self, small_model):
+        cfg, params = small_model
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(1, cfg.vocab_size, 6).tolist()
+        ref = _reference_generate(cfg, params, prompt, 20)
+        # pick the 3rd generated token as "eos" so retirement fires mid-run
+        eos = ref[2]
+        eng = _make_engine(cfg, params, eos_id=eos)
+        rid = eng.add_request(prompt, max_new_tokens=20)
+        out = eng.run()
+        assert out[rid] == ref[:3]  # stops AT the eos token
+        # slot freed: a follow-up request still serves correctly
+        p2 = rng.randint(1, cfg.vocab_size, 9).tolist()
+        rid2 = eng.add_request(p2, max_new_tokens=4)
+        out2 = eng.run()
+        ref2 = _reference_generate(cfg, params, p2, 4)
+        if eos in ref2:
+            ref2 = ref2[:ref2.index(eos) + 1]
+        assert out2[rid2] == ref2
+
+    def test_prompt_too_long_rejected(self, small_model):
+        cfg, params = small_model
+        eng = _make_engine(cfg, params)
+        with pytest.raises(ValueError):
+            eng.add_request(list(range(1, 40)), max_new_tokens=2)  # > bucket
+        with pytest.raises(ValueError):
+            eng.add_request([1, 2], max_new_tokens=200)  # > max_len
+
+
+def test_predictor_pool_parity():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import PredictorPool
+
+    def f(x):
+        return x + 1
+
+    ex = [pt.to_tensor(np.zeros(2, np.float32))]
+    pool = PredictorPool(f, size=2, example_args=ex)
+    p0, p1 = pool.retrieve(0), pool.retrieve(1)
+    assert p0 is not p1
+    assert pool.retrieve(2) is p0  # wraps
+    out = p0.run([pt.to_tensor(np.array([1.0, 2.0], np.float32))])
+    np.testing.assert_allclose(out[0], [2.0, 3.0])
