@@ -442,6 +442,7 @@ _VJP_CODE_MISS_CAP = 32
 
 _VALUE_TYPES = (int, float, bool, str, bytes, type(None), complex)
 _MISSING_GLOBAL = object()
+_Tensor = _wrap_output = _maybe_cast_inputs = None  # bound on first apply()
 
 
 def _value_hashable(x) -> bool:
@@ -462,10 +463,32 @@ def _value_hashable(x) -> bool:
     return False
 
 
-def _vjp_cache_key(fn, static_kwargs, arrs):
-    """(key, static_argnums) or None. Scalars ride as STATIC jit args so
-    fns that branch on them keep exact python semantics (the scalar value
-    is part of the key)."""
+# Per-fn dispatch plan, memoized by id (the fn object is held strongly, so
+# an id can never be reused while its entry lives). The plan folds every
+# call-invariant introspection step — __self__/__code__/ufunc checks,
+# closure presence, co_names — into ONE int-keyed dict get: the key
+# computation runs on every eager op and was ~18% of dispatch latency as
+# ad-hoc getattr chains (benchmarks/eager_microbench.py).
+_FN_PLAN: dict = {}          # id(fn) → (fn, plan | None)
+_FN_PLAN_CAP = 4096
+
+
+class _FnToken:
+    """Identity stand-in for a __code__-less jax callable (jnp.add, …):
+    hashing a jnp.ufunc goes through python-level lambdas; this hashes by
+    object identity in C."""
+
+    __slots__ = ()
+
+
+# jax callables (jnp ufuncs etc.) are module-level singletons: their
+# identity tokens live in a NEVER-cleared table, so a _FN_PLAN cap flush
+# can't orphan their compiled vjp-cache entries by minting fresh tokens
+_JAX_FN_TOKENS: dict = {}    # id(fn) → (fn, token)
+
+
+def _build_plan(fn):
+    """(key0, has_closure, co_names, is_code) or None (always raw)."""
     if getattr(fn, "__self__", None) is not None:
         # bound method: per-instance state is invisible to a __code__ key
         # (confirmed wrong-gradient repro) — always raw
@@ -476,72 +499,100 @@ def _vjp_cache_key(fn, static_kwargs, arrs):
         if isinstance(fn, jnp.ufunc) or (callable(fn) and
                                          (getattr(fn, "__module__", "")
                                           or "").startswith("jax")):
-            code = fn
-        else:
-            return None
-    if code in _VJP_RAW_CODES:
+            ent = _JAX_FN_TOKENS.get(id(fn))
+            if ent is None or ent[0] is not fn:
+                ent = _JAX_FN_TOKENS[id(fn)] = (fn, _FnToken())
+            return (ent[1], False, (), False)
+        return None
+    # __closure__ and co_names are fixed at function creation; __defaults__
+    # is mutable and stays a per-call read
+    return (code, bool(getattr(fn, "__closure__", None)), code.co_names,
+            True)
+
+
+def _fn_plan(fn):
+    ent = _FN_PLAN.get(id(fn))
+    if ent is not None and ent[0] is fn:
+        plan = ent[1]
+        # __code__ can be reassigned in place (hot reload / autoreload):
+        # a stale plan would replay the OLD compiled forward silently
+        if plan is None or not plan[3] or plan[0] is fn.__code__:
+            return plan
+    plan = _build_plan(fn)
+    if len(_FN_PLAN) >= _FN_PLAN_CAP:  # per-call lambdas churn ids
+        _FN_PLAN.clear()
+    _FN_PLAN[id(fn)] = (fn, plan)
+    return plan
+
+
+def _vjp_cache_key(fn, static_kwargs, arrs):
+    """(key, static_argnums) or None. Scalars ride as STATIC jit args so
+    fns that branch on them keep exact python semantics (the scalar value
+    is part of the key)."""
+    plan = _fn_plan(fn)
+    if plan is None:
+        return None
+    key0, has_closure, co_names, is_code = plan
+    if key0 in _VJP_RAW_CODES:
         return None
     cells = ()
-    if getattr(fn, "__closure__", None):
+    if has_closure:
         try:
             cells = tuple(c.cell_contents for c in fn.__closure__)
         except ValueError:  # empty cell
             return None
         if not all(_value_hashable(c) for c in cells):
             return None
-    defaults = getattr(fn, "__defaults__", None) or ()
-    if not all(_value_hashable(d) for d in defaults):
+    defaults = (fn.__defaults__ or ()) if is_code else ()
+    if defaults and not all(_value_hashable(d) for d in defaults):
         return None
     # Globals the code reads are mutable state invisible to a __code__ key
     # (advisor r3: `def op(a): return a * CFG.k` — rebinding CFG/K between
     # calls would replay a stale compiled forward). co_names covers every
-    # LOAD_GLOBAL; modules are stable namespaces, callables/types are
-    # guarded by identity (rebinding → new key), value-hashable constants
-    # ride in the key, anything else demotes to raw — mirroring the care
-    # taken above for closure cells.
+    # LOAD_GLOBAL; modules are stable namespaces, plain functions/types are
+    # guarded by identity (the object itself rides in the key, keeping the
+    # referent alive so a freed id can never alias), value-hashable
+    # constants ride by value, and anything else — notably callable
+    # INSTANCES whose mutable state an identity key cannot see — demotes
+    # to raw, mirroring the care taken above for closure cells.
     gvals = ()
-    if code is not fn:
-        gns = getattr(fn, "__globals__", None)
-        if gns is not None:
-            acc = []
-            for n in code.co_names:
-                v = gns.get(n, _MISSING_GLOBAL)
-                if v is _MISSING_GLOBAL or isinstance(v, types.ModuleType):
-                    continue
-                if isinstance(v, (types.FunctionType,
-                                  types.BuiltinFunctionType, type)):
-                    # identity key holding the OBJECT (not id()): keeps the
-                    # referent alive, so a freed-and-reused address can never
-                    # alias a rebound function onto a stale entry
-                    acc.append((n, v))
-                elif callable(v):
-                    # callable INSTANCES (config objects with __call__,
-                    # functools.partial) carry mutable state an identity key
-                    # cannot see — demote to raw, like closure cells do
-                    return None
-                elif _value_hashable(v):
-                    acc.append((n, v))
-                else:
-                    return None
+    if co_names:
+        gns = fn.__globals__
+        acc = None
+        for n in co_names:
+            v = gns.get(n, _MISSING_GLOBAL)
+            if v is _MISSING_GLOBAL or isinstance(v, types.ModuleType):
+                continue
+            if isinstance(v, (types.FunctionType,
+                              types.BuiltinFunctionType, type)):
+                acc = acc or []
+                acc.append((n, v))
+            elif callable(v):
+                return None
+            elif _value_hashable(v):
+                acc = acc or []
+                acc.append((n, v))
+            else:
+                return None
+        if acc:
             gvals = tuple(acc)
     sk = tuple(sorted(static_kwargs.items())) if static_kwargs else ()
-    if not all(_value_hashable(v) for _, v in sk):
+    if sk and not all(_value_hashable(v) for _, v in sk):
         return None
     sig = []
-    static_argnums = []
+    static_argnums = ()
     for i, a in enumerate(arrs):
         if a is None:
             sig.append(None)
         elif hasattr(a, "shape") and hasattr(a, "dtype") \
                 and not isinstance(a, jax.core.Tracer):
-            sig.append((tuple(a.shape), str(a.dtype)))
+            sig.append((a.shape, a.dtype))  # np.dtype hashes by value
         elif isinstance(a, (bool, int, float, str)):
             sig.append(("py", type(a).__name__, a))
-            static_argnums.append(i)
+            static_argnums = static_argnums + (i,)
         else:
             return None
-    return (code, cells, sk, tuple(sig), defaults, gvals), \
-        tuple(static_argnums)
+    return (key0, cells, sk, tuple(sig), defaults, gvals), static_argnums
 
 
 def _tape_vjp(f, fn, static_kwargs, arrs):
@@ -594,10 +645,15 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
     (reference fluid/eager/auto_code_generator/generator/eager_gen.py): AMP
     cast hooks run first, then the kernel, then grad-node wiring.
     """
-    from .tensor import Tensor, wrap_output
-    from ..amp.auto_cast import maybe_cast_inputs
+    global _Tensor, _wrap_output, _maybe_cast_inputs
+    if _Tensor is None:  # one-time bind (module-load ordering forbids a
+        from .tensor import Tensor, wrap_output  # top-level import cycle)
+        from ..amp.auto_cast import maybe_cast_inputs
+        _Tensor, _wrap_output = Tensor, wrap_output
+        _maybe_cast_inputs = maybe_cast_inputs
+    Tensor, wrap_output = _Tensor, _wrap_output
 
-    args = maybe_cast_inputs(name, args)
+    args = _maybe_cast_inputs(name, args)
 
     arrs = []
     tensor_inputs = []  # parallel list: Tensor or None
@@ -722,14 +778,18 @@ class _TreeVjp:
         return self.vjp_fn(jax.tree.unflatten(self.treedef, list(flat_cots)))
 
 
+_flag_value = None
+
+
 def _check_nan_inf(op_name: str, out):
     """FLAGS_check_nan_inf watchdog (reference:
     fluid/framework/details/nan_inf_utils_detail.h hooked into executors/eager;
     here hooked into the dispatch chokepoint, eager only — under jit use
     jax_debug_nans)."""
-    from ..utils.flags import flag_value
-
-    if not flag_value("check_nan_inf"):
+    global _flag_value
+    if _flag_value is None:
+        from ..utils.flags import flag_value as _flag_value
+    if not _flag_value("check_nan_inf"):
         return
     import numpy as np
 
@@ -737,7 +797,7 @@ def _check_nan_inf(op_name: str, out):
         if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
             bad = int(jnp.sum(~jnp.isfinite(leaf)))
             if bad:
-                level = flag_value("check_nan_inf_level") or 0
+                level = _flag_value("check_nan_inf_level") or 0
                 msg = f"[check_nan_inf] op={op_name or '?'}: {bad} non-finite values"
                 if level == 0:
                     raise FloatingPointError(msg)

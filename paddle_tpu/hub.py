@@ -11,7 +11,8 @@ import importlib.util
 import os
 import sys
 
-__all__ = ["list", "help", "load"]
+__all__ = ["list", "help", "load", "get_dir",
+           "load_state_dict_from_url"]
 
 MODULE_HUBCONF = "hubconf.py"
 VAR_DEPENDENCY = "dependencies"
@@ -70,3 +71,71 @@ def load(repo_dir: str, model: str, source: str = "local", force_reload: bool = 
     if fn is None or not callable(fn):
         raise RuntimeError(f"Cannot find callable entrypoint '{model}' in {repo_dir}")
     return fn(**kwargs)
+
+
+def get_dir() -> str:
+    """Hub cache root (env PADDLE_TPU_HUB_DIR, default ~/.cache/paddle_tpu/hub)."""
+    return os.environ.get(
+        "PADDLE_TPU_HUB_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "hub"))
+
+
+def load_state_dict_from_url(url: str, model_dir: str | None = None,
+                             check_hash: bool = False,
+                             file_name: str | None = None,
+                             map_location=None):
+    """Download a checkpoint to the hub cache (once) and load it.
+
+    Reference capability: torch.hub-style weight download used by
+    paddle.hapi/vision pretrained zoos (hapi/hub.py). Supports http(s) and
+    file:// URLs; a repeated call serves from the cache without touching
+    the network (TPU pods commonly have zero egress — pre-seed the cache
+    dir or use file:// URLs there). check_hash: the reference convention —
+    filename stem ends with '-<8+ hex chars>' of the sha256.
+    """
+    import hashlib
+    import shutil
+    import tempfile
+    import urllib.parse
+    import urllib.request
+
+    model_dir = model_dir or get_dir()
+    os.makedirs(model_dir, exist_ok=True)
+    parts = urllib.parse.urlparse(url)
+    fname = file_name or os.path.basename(parts.path)
+    if not fname:
+        raise ValueError(f"cannot derive a file name from url {url!r}")
+    cached = os.path.join(model_dir, fname)
+
+    if not os.path.exists(cached):
+        # download to a temp file in the same dir, then atomic-rename, so a
+        # crashed download never leaves a half-written "cached" checkpoint
+        fd, tmp = tempfile.mkstemp(dir=model_dir, suffix=".part")
+        os.close(fd)
+        try:
+            if parts.scheme == "file":
+                shutil.copyfile(urllib.request.url2pathname(parts.path), tmp)
+            elif parts.scheme in ("http", "https"):
+                with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+            else:
+                raise ValueError(f"unsupported url scheme {parts.scheme!r}")
+            if check_hash:
+                stem = os.path.splitext(fname)[0]
+                tail = stem.rsplit("-", 1)[-1]
+                h = hashlib.sha256()
+                with open(tmp, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                digest = h.hexdigest()
+                if len(tail) < 8 or not digest.startswith(tail):
+                    raise RuntimeError(
+                        f"hash mismatch for {fname}: expected prefix "
+                        f"{tail!r}, got {digest[:16]!r}")
+            os.replace(tmp, cached)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    from .framework import load
+    return load(cached)

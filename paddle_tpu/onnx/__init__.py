@@ -1,17 +1,71 @@
-"""paddle_tpu.onnx (reference: paddle.onnx.export hooks to paddle2onnx).
+"""paddle_tpu.onnx (reference: paddle.onnx.export hooks to paddle2onnx,
+/root/reference/python/paddle/onnx/export.py:35).
 
-TPU-native deployment path is StableHLO (`static.save_inference_model` via
-jax.export) — the portable compiled format for XLA runtimes. ONNX export of a
-traced function would go StableHLO→ONNX with an external converter; we export
-the StableHLO artifact and metadata here."""
+Two deployment formats:
+  * ``export`` — REAL ONNX: the layer traces to a jaxpr and serializes to
+    an opset-13 ModelProto (export.py; in-tree protobuf wire codec, no
+    external converter). Covers the Linear/Conv/Norm inference subset;
+    out-of-subset primitives raise UnsupportedOnnxExport.
+  * ``export_stablehlo`` — the TPU-native portable artifact
+    (jax.export / StableHLO via static.save_inference_model), the format
+    XLA runtimes consume directly.
+"""
 from __future__ import annotations
 
-__all__ = ["export"]
+from .export import UnsupportedOnnxExport, to_onnx_bytes
+
+__all__ = ["export", "export_stablehlo", "to_onnx_bytes",
+           "UnsupportedOnnxExport"]
 
 
-def export(layer, path, input_spec=None, opset_version=None, **configs):
-    """Exports the model as a StableHLO artifact + params (ONNX conversion
-    requires an external StableHLO->ONNX converter; none is vendored)."""
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export `layer` to a real ONNX file at ``path`` (``.onnx`` appended
+    if missing). input_spec: InputSpec list or example tensors."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+    opset_version = opset_version or 13
+    if not 13 <= opset_version <= 17:
+        # node forms are emitted in opset-13 style (ReduceSum axes as an
+        # input, ReduceMax axes as an attribute — the latter changes at 18)
+        raise ValueError(
+            f"opset_version {opset_version} unsupported: the emitter "
+            "produces opset 13-17 node forms")
+
+    examples = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shape = tuple(1 if d is None or d < 0 else int(d)
+                          for d in s.shape)
+            examples.append(np.zeros(shape, s.dtype or np.float32))
+        elif isinstance(s, Tensor):
+            examples.append(np.asarray(s.numpy()))
+        else:
+            examples.append(np.asarray(s))
+
+    def fn(*args):
+        import jax
+        out = layer(*[Tensor(a) for a in args])
+        return jax.tree.map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    data = to_onnx_bytes(fn, examples, graph_name=type(layer).__name__,
+                         opset=opset_version or 13)
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def export_stablehlo(layer, path, input_spec=None, **configs):
+    """The TPU-native deployment path: StableHLO artifact + params."""
+    from ..framework import save
     from ..static import InputSpec, Program, save_inference_model
 
     if input_spec is None:
@@ -25,6 +79,5 @@ def export(layer, path, input_spec=None, opset_version=None, **configs):
 
     prog = Program(fn, specs)
     save_inference_model(path, specs, None, program=prog)
-    from ..framework import save
     save(layer.state_dict(), path + ".pdparams")
     return path

@@ -1,0 +1,114 @@
+"""Minimal protobuf wire-format codec (encode + decode), dependency-free.
+
+The ONNX model format is protobuf; this build vendors no protobuf runtime
+and has no network egress to fetch one, so the exporter writes the wire
+format directly (varint/length-delimited/fixed32 — the three wire types the
+ONNX schema uses). The decoder exists for round-trip self-checks and tests;
+`onnx_subset.proto` in this package mirrors the field numbers so `protoc
+--decode` can independently validate emitted bytes.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["Msg", "decode"]
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:  # protobuf encodes negative ints as 10-byte two's complement
+        n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Msg:
+    """Append-only protobuf message builder."""
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    # wire type 0: varint
+    def int_field(self, field: int, value: int) -> "Msg":
+        self._parts.append(_varint(field << 3 | 0))
+        self._parts.append(_varint(int(value)))
+        return self
+
+    # wire type 5: fixed 32-bit (float)
+    def float_field(self, field: int, value: float) -> "Msg":
+        self._parts.append(_varint(field << 3 | 5))
+        self._parts.append(struct.pack("<f", float(value)))
+        return self
+
+    # wire type 2: length-delimited
+    def bytes_field(self, field: int, value: bytes) -> "Msg":
+        self._parts.append(_varint(field << 3 | 2))
+        self._parts.append(_varint(len(value)))
+        self._parts.append(value)
+        return self
+
+    def str_field(self, field: int, value: str) -> "Msg":
+        return self.bytes_field(field, value.encode("utf-8"))
+
+    def msg_field(self, field: int, value: "Msg") -> "Msg":
+        return self.bytes_field(field, value.to_bytes())
+
+    def packed_ints(self, field: int, values) -> "Msg":
+        """Packed repeated varints (proto3 default for repeated int64)."""
+        body = b"".join(_varint(int(v)) for v in values)
+        return self.bytes_field(field, body)
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+
+def _read_varint(buf: bytes, i: int):
+    shift, val = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def decode(buf: bytes) -> dict:
+    """buf → {field_number: [value, ...]} with raw wire values (varints as
+    int, length-delimited as bytes, fixed32 as float). Nested messages are
+    decoded lazily by calling decode() on the bytes again."""
+    out: dict = {}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wtype = key >> 3, key & 7
+        if wtype == 0:
+            v, i = _read_varint(buf, i)
+        elif wtype == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wtype == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        elif wtype == 2:
+            n, i = _read_varint(buf, i)
+            v = buf[i:i + n]
+            i += n
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def decode_packed_ints(b: bytes) -> list:
+    vals, i = [], 0
+    while i < len(b):
+        v, i = _read_varint(b, i)
+        vals.append(v)
+    return vals
