@@ -31,6 +31,7 @@ from .align_mode import (  # noqa: F401
 )
 from .engine import Engine, PipelinePlan, Strategy as EngineStrategy  # noqa: F401
 from . import fleet  # noqa: F401
+from . import metric  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401

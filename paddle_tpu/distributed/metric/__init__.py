@@ -1,0 +1,22 @@
+"""Distributed metrics for PS/CTR training.
+
+Reference: /root/reference/python/paddle/distributed/metric/metrics.py —
+yaml-configured AUC monitors whose bucketed stats live in the C++
+FleetWrapper and aggregate across distributed workers before the global
+AUC/MAE/RMSE/COPC line is printed.
+
+TPU-native design: the calculator state is a plain numpy bucket table
+(pos/neg counts per prediction bucket + error accumulators) held
+host-side — CTR metrics are O(batch) host arithmetic, not MXU work.
+Global aggregation sums the tables across workers through
+``distributed.all_gather_object`` when a world is initialized (the
+collective path); ``merge`` composes tables explicitly for PS-style
+runners that ship stats over rpc. AUC from merged buckets is exact for
+any worker split (same invariant the reference's bucketed C++
+calculator relies on).
+"""
+from .metrics import (BucketedAucCalculator, MetricRunner, init_metric,
+                      print_auc, print_metric)
+
+__all__ = ["BucketedAucCalculator", "MetricRunner", "init_metric",
+           "print_metric", "print_auc"]

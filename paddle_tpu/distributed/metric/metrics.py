@@ -1,0 +1,219 @@
+"""Bucketed distributed AUC calculators (reference
+distributed/metric/metrics.py + the C++ MetricMsg family in
+fluid/framework/fleet/metrics.cc — AUC/BUCKET_ERROR/MAE/RMSE/CTR/COPC
+from per-worker bucket tables merged globally)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["BucketedAucCalculator", "MetricRunner", "init_metric",
+           "print_metric", "print_auc"]
+
+
+class BucketedAucCalculator:
+    """Streaming AUC over fixed prediction buckets (mergeable across
+    workers: bucket tables add elementwise, so merged-then-AUC equals
+    AUC over the concatenated stream)."""
+
+    def __init__(self, name: str, label: str = "label",
+                 target: str = "prob", phase: int = -1,
+                 bucket_size: int = 1_000_000, mask: str = ""):
+        self.name, self.label_var, self.target_var = name, label, target
+        self.phase, self.mask_var = phase, mask
+        self.bucket_size = int(bucket_size)
+        self.reset()
+
+    def reset(self):
+        n = self.bucket_size
+        self._pos = np.zeros(n, np.int64)
+        self._neg = np.zeros(n, np.int64)
+        self._sum_pred = 0.0
+        self._sum_label = 0.0
+        self._sum_abs_err = 0.0
+        self._sum_sqr_err = 0.0
+        self._count = 0
+
+    # ---------------------------------------------------------- update
+    def update(self, labels, preds, mask=None):
+        """labels/preds 1-D arraylike in [0, 1]; mask: optional 0/1 keep."""
+        y = np.asarray(labels, np.float64).reshape(-1)
+        p = np.asarray(preds, np.float64).reshape(-1)
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1).astype(bool)
+            y, p = y[keep], p[keep]
+        if y.size == 0:
+            return
+        b = np.clip((p * self.bucket_size).astype(np.int64), 0,
+                    self.bucket_size - 1)
+        pos_mask = y > 0.5
+        np.add.at(self._pos, b[pos_mask], 1)
+        np.add.at(self._neg, b[~pos_mask], 1)
+        self._sum_pred += float(p.sum())
+        self._sum_label += float(y.sum())
+        self._sum_abs_err += float(np.abs(p - y).sum())
+        self._sum_sqr_err += float(((p - y) ** 2).sum())
+        self._count += int(y.size)
+
+    # ----------------------------------------------------------- merge
+    def state(self) -> dict:
+        # sparse encoding: CTR bucket tables are huge and nearly empty
+        nz = np.nonzero(self._pos + self._neg)[0]
+        return {"idx": nz, "pos": self._pos[nz], "neg": self._neg[nz],
+                "sum_pred": self._sum_pred, "sum_label": self._sum_label,
+                "sum_abs_err": self._sum_abs_err,
+                "sum_sqr_err": self._sum_sqr_err, "count": self._count,
+                "bucket_size": self.bucket_size}
+
+    def merge_state(self, st: dict):
+        if st["bucket_size"] != self.bucket_size:
+            raise ValueError("bucket_size mismatch in metric merge")
+        idx = np.asarray(st["idx"], np.int64)
+        np.add.at(self._pos, idx, np.asarray(st["pos"], np.int64))
+        np.add.at(self._neg, idx, np.asarray(st["neg"], np.int64))
+        self._sum_pred += st["sum_pred"]
+        self._sum_label += st["sum_label"]
+        self._sum_abs_err += st["sum_abs_err"]
+        self._sum_sqr_err += st["sum_sqr_err"]
+        self._count += st["count"]
+
+    def merge(self, other: "BucketedAucCalculator"):
+        self.merge_state(other.state())
+
+    def all_reduce(self) -> "BucketedAucCalculator":
+        """Return a SNAPSHOT merged across the initialized world; self is
+        never mutated, so printing a global metric twice is idempotent
+        (the reference computes GetMetricMsg from a gathered copy too).
+        PS runners instead ship `state()` dicts over their rpc and call
+        merge_state on an aggregator."""
+        from .. import get_world_size_safe, is_initialized
+        if not is_initialized() or get_world_size_safe() <= 1:
+            return self
+        from ..collective import all_gather_object
+        from ..env import get_rank
+        snap = BucketedAucCalculator(
+            self.name, self.label_var, self.target_var, phase=self.phase,
+            bucket_size=self.bucket_size, mask=self.mask_var)
+        mine = self.state()
+        snap.merge_state(mine)
+        gathered: list = []
+        all_gather_object(gathered, mine)
+        rank = get_rank()
+        for r, st in enumerate(gathered):
+            # skip our own contribution (already merged) — both by rank
+            # and by object identity: the in-process single-controller
+            # group gathers N references to OUR state (every rank of that
+            # group is this process, which already saw the global batch),
+            # and merging those copies would inflate counts by world size
+            if r == rank or st is mine:
+                continue
+            snap.merge_state(st)
+        return snap
+
+    # ----------------------------------------------------------- value
+    def compute(self) -> dict:
+        nz = np.nonzero(self._pos + self._neg)[0]
+        pos, neg = self._pos[nz].astype(np.float64), \
+            self._neg[nz].astype(np.float64)
+        tot_pos, tot_neg = pos.sum(), neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            auc = 0.5
+        else:
+            # buckets ascend in predicted prob; trapezoid over cum counts
+            neg_below = np.concatenate([[0.0], np.cumsum(neg)[:-1]])
+            auc = float(((neg_below + neg / 2.0) * pos).sum()
+                        / (tot_pos * tot_neg))
+        n = max(self._count, 1)
+        actual_ctr = self._sum_label / n
+        predicted_ctr = self._sum_pred / n
+        copc = actual_ctr / predicted_ctr if predicted_ctr > 0 else 0.0
+        # bucket_error: reference's relative-error over adequately-filled
+        # buckets (fleet metrics.cc): |click - pred*impr| / impr averaged
+        # over buckets with >= kMinIns impressions
+        k_min = 1000
+        impr = pos + neg
+        big = impr >= k_min
+        if big.any():
+            mid = (nz[big].astype(np.float64) + 0.5) / self.bucket_size
+            err = np.abs(pos[big] - mid * impr[big]) / impr[big]
+            bucket_error = float(err.mean())
+        else:
+            bucket_error = 0.0
+        return {
+            "auc": auc,
+            "bucket_error": bucket_error,
+            "mae": self._sum_abs_err / n,
+            "rmse": float(np.sqrt(self._sum_sqr_err / n)),
+            "actual_ctr": actual_ctr,
+            "predicted_ctr": predicted_ctr,
+            "copc": copc,
+            "ins_count": self._count,
+        }
+
+
+class MetricRunner:
+    """The ``metric_ptr`` object init_metric configures (the TPU analog of
+    FleetWrapper's metric table)."""
+
+    def __init__(self):
+        self._metrics: dict[str, BucketedAucCalculator] = {}
+
+    def init_metric(self, method: str, name: str, label: str, target: str,
+                    *args, phase: int = -1, mask: str = "",
+                    bucket_size: int = 1_000_000, **kw):
+        if "Auc" not in method:
+            raise ValueError(f"unsupported metric method {method!r}")
+        self._metrics[name] = BucketedAucCalculator(
+            name, label, target, phase=phase, mask=mask,
+            bucket_size=bucket_size)
+
+    def update(self, name: str, labels, preds, mask=None):
+        self._metrics[name].update(labels, preds, mask)
+
+    def get_metric(self, name: str) -> BucketedAucCalculator:
+        return self._metrics[name]
+
+    def get_metric_msg(self, name: str):
+        m = self._metrics[name].all_reduce().compute()
+        return [m["auc"], m["bucket_error"], m["mae"], m["rmse"],
+                m["actual_ctr"], m["predicted_ctr"], m["copc"],
+                float(m["ins_count"])]
+
+    def get_metric_name_list(self, stage_num: int = -1):
+        return [n for n, m in self._metrics.items()
+                if stage_num == -1 or m.phase in (-1, stage_num)]
+
+
+def init_metric(metric_ptr, metric_yaml_path, cmatch_rank_var="",
+                mask_var="", uid_var="", phase=-1, cmatch_rank_group="",
+                ignore_rank=False, bucket_size=1_000_000):
+    """Reference-parity entry: read the yaml monitor list and register
+    each calculator on ``metric_ptr`` (a MetricRunner here)."""
+    import yaml as _yaml
+
+    with open(metric_yaml_path) as f:
+        content = _yaml.safe_load(f)
+    for runner in content.get("monitors") or []:
+        is_join = runner.get("phase") == "JOINING"
+        metric_ptr.init_metric(
+            runner["method"], runner["name"], runner["label"],
+            runner["target"], phase=1 if is_join else 0,
+            mask=runner.get("mask", mask_var),
+            bucket_size=runner.get("bucket_size", bucket_size))
+
+
+def print_metric(metric_ptr, name):
+    m = metric_ptr.get_metric_msg(name)
+    return (f"{name}: AUC={m[0]:.6f} BUCKET_ERROR={m[1]:.6f} "
+            f"MAE={m[2]:.6f} RMSE={m[3]:.6f} Actual CTR={m[4]:.6f} "
+            f"Predicted CTR={m[5]:.6f} COPC={m[6]:.6f} "
+            f"INS Count={m[7]:.0f}")
+
+
+def print_auc(metric_ptr, is_day, phase="all"):
+    stage = "day" if is_day else "pass"
+    stage_num = -1 if is_day else (1 if phase == "join" else 0)
+    out = []
+    for name in metric_ptr.get_metric_name_list(stage_num):
+        if stage in name and (phase == "all" or phase in name):
+            out.append(print_metric(metric_ptr, name))
+    return out
