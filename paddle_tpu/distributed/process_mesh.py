@@ -112,10 +112,10 @@ _state.stack = []
 _global_mesh = None
 
 
-def set_mesh(mesh: ProcessMesh):
-    """paddle.distributed.auto_parallel.set_mesh equivalent."""
+def set_mesh(mesh: ProcessMesh | None):
+    """paddle.distributed.auto_parallel.set_mesh equivalent (None clears)."""
     global _global_mesh
-    if not isinstance(mesh, ProcessMesh):
+    if mesh is not None and not isinstance(mesh, ProcessMesh):
         mesh = ProcessMesh(mesh)
     _global_mesh = mesh
     return mesh

@@ -572,9 +572,19 @@ def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp")
 
 class PipelineParallel(Layer):
     """Dygraph-style engine (reference pipeline_parallel.py:255): wraps a
-    PipelineLayer + optimizer and exposes train_batch() with eager
-    microbatch accumulation. The compiled overlapping schedules are
-    `pipeline_apply` / `pipeline_train_1f1b` (used by models.trainer)."""
+    PipelineLayer + optimizer and exposes train_batch().
+
+    Execution: when the current global mesh has a 'pp' axis matching the
+    PipelineLayer's stage count AND the trunk is homogeneous (every entry a
+    Layer with the identical param-tree signature, equal-sized stages, no
+    dropout), train_batch runs the COMPILED 1F1B schedule — stages overlap
+    across microbatches exactly as the reference's dygraph
+    forward_backward_pipeline overlaps p2p with compute — and writes the
+    schedule's gradients back into the eager Parameters so the passed-in
+    optimizer/scaler/lr machinery keeps its usual semantics. Anything
+    outside that shape (heterogeneous trunk, no pp mesh, loss-scaler)
+    falls back to sequential microbatch accumulation, which is numerically
+    identical."""
 
     def __init__(self, layers, hcg=None, strategy=None, num_microbatches=None):
         super().__init__()
@@ -582,18 +592,117 @@ class PipelineParallel(Layer):
         self._hcg = hcg
         self.num_microbatches = num_microbatches or (
             strategy.pipeline_configs.get("accumulate_steps", 1) if strategy else 1)
+        self._pp_compiled = None   # ((mesh, n_layers, loss_fn), built)
+        self.last_schedule = "none"
 
     def forward(self, x):
         return self._layers(x)
 
+    # ------------------------------------------------- compiled 1F1B path
+    def _eligible_entries(self):
+        """The homogeneous trunk, or None if the compiled schedule can't
+        represent this PipelineLayer."""
+        pl = self._layers
+        entries = getattr(pl, "_entries", None)
+        segments = getattr(pl, "_segments", None)
+        if entries is None or segments is None:
+            return None
+        if len({len(s) for s in segments}) != 1:
+            return None  # uneven stages
+        layers = []
+        for kind, _, obj in entries:
+            if kind != "layer" or not isinstance(obj, Layer):
+                return None
+            from ..nn import Dropout
+            if any(isinstance(s, Dropout) for s in obj.sublayers(True)):
+                return None  # eager-RNG dropout can't thread the schedule
+            layers.append(obj)
+        if not layers:
+            return None
+        from ..core.tensor import Parameter
+        sig = None
+        for l in layers:
+            sd = l.state_dict()
+            if any(not isinstance(v, Parameter) or not v.trainable
+                   for v in sd.values()):
+                # buffers (BatchNorm running stats) mutate during the eager
+                # forward; the traced schedule would silently freeze them
+                return None
+            s = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in sd.items()))
+            if not s:
+                return None
+            if sig is None:
+                sig = s
+            elif s != sig:
+                return None  # heterogeneous param trees can't stack
+        return layers
+
+    def _maybe_compiled_1f1b(self, loss_fn):
+        mesh = get_mesh()
+        S = getattr(self._layers, "num_stages", None)
+        if mesh is None or S is None or S <= 1 \
+                or "pp" not in mesh.dim_names \
+                or mesh.get_dim_size("pp") != S:
+            return None
+        layers = self._eligible_entries()
+        if layers is None:
+            return None
+        # loss_fn in the key: the compiled run closes over it, so a call
+        # with a different loss must rebuild (the tuple holds mesh and
+        # loss_fn alive — ids cannot be reused while cached)
+        cache_key = (mesh, len(layers), loss_fn)
+        if self._pp_compiled and self._pp_compiled[0] == cache_key:
+            return self._pp_compiled[1]
+        template = layers[0]
+        Lps = len(layers) // S
+
+        def restack():
+            # the eager Parameters are the source of truth (the optimizer
+            # updates THEM between calls): stack [S, Lps, ...] per call
+            per_stage = []
+            for s in range(S):
+                stage_layers = layers[s * Lps:(s + 1) * Lps]
+                per_stage.append(jax.tree.map(
+                    lambda *xs: jnp.stack([x for x in xs], axis=0),
+                    *[{k: v._value for k, v in l.state_dict().items()}
+                      for l in stage_layers]))
+            return stack_stage_params(per_stage, mesh)
+
+        def stage_fn(sp, act):
+            def body(carry, bp):
+                with template._swapped_state(bp):
+                    out = template(Tensor(carry))
+                return out._value if isinstance(out, Tensor) else out, None
+            out, _ = jax.lax.scan(body, act, sp)
+            return out
+
+        def lf(lp, y, lbl):
+            out = loss_fn(Tensor(y), Tensor(lbl))
+            return out._value if isinstance(out, Tensor) else out
+
+        @functools.partial(jax.jit, static_argnames=("M",))
+        def _sched(stacked, inputs_v, labels_v, M):
+            # one traced program per (M, shapes): without the jit wrapper
+            # every train_batch call would re-trace the whole
+            # (M+2S-2)-tick shard_map scan
+            B = inputs_v.shape[0]
+            mbs = inputs_v.reshape((M, B // M) + inputs_v.shape[1:])
+            lbls = labels_v.reshape((M, B // M) + labels_v.shape[1:])
+            loss, g_stacked, _, _ = pipeline_train_1f1b(
+                stage_fn, lf, stacked, {}, mbs, lbls, mesh)
+            return loss, g_stacked
+
+        def run(inputs_v, labels_v, M):
+            return _sched(restack(), inputs_v, labels_v, M=M)
+
+        built = (run, layers, S, Lps)
+        self._pp_compiled = (cache_key, built)
+        return built
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None, loss_fn=None):
         """One training step over `num_microbatches` (reference
-        pipeline_parallel.py:820). Eager semantics: microbatches run
-        sequentially with gradient accumulation — numerically identical to
-        the pipelined schedule (on a single host there is no stage overlap
-        to exploit; the compiled overlapping schedules live in
-        `pipeline_train_1f1b` / `pipeline_apply` and models.trainer).
-        Returns the mean microbatch loss."""
+        pipeline_parallel.py:820). Returns the mean microbatch loss."""
         inputs, labels = data
         loss_fn = loss_fn or getattr(self._layers, "_loss_fn", None)
         if loss_fn is None:
@@ -604,18 +713,50 @@ class PipelineParallel(Layer):
         if B % M != 0:
             raise ValueError(f"batch size {B} not divisible by "
                              f"num_microbatches {M}")
-        mb = B // M
-        total = None
-        for m in range(M):
-            x_mb = inputs[m * mb:(m + 1) * mb]
-            y_mb = labels[m * mb:(m + 1) * mb]
-            out = self._layers(x_mb)
-            loss = loss_fn(out, y_mb) * (1.0 / M)
-            if scaler is not None:
-                scaler.scale(loss).backward()
-            else:
-                loss.backward()
-            total = loss if total is None else total + loss
+
+        # the compiled path discards input cotangents; an input that wants
+        # grads (activations from an upstream trained module) must go
+        # through the sequential path, whose loss.backward() reaches it
+        inputs_want_grad = isinstance(inputs, Tensor) \
+            and not inputs.stop_gradient
+        compiled = None if (scaler is not None or inputs_want_grad) else \
+            self._maybe_compiled_1f1b(loss_fn)
+        if compiled is not None:
+            run, layers, S, Lps = compiled
+            x_v = inputs._value if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+            y_v = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+            loss, g_stacked = run(x_v, y_v, M)
+            # write the schedule's grads back into the eager Parameters so
+            # optimizer.step() below behaves exactly as the eager path
+            for s in range(S):
+                for i in range(Lps):
+                    layer = layers[s * Lps + i]
+                    gtree = jax.tree.map(lambda g: g[s][i], g_stacked)
+                    for k, p in layer.state_dict().items():
+                        if getattr(p, "trainable", True):
+                            g = gtree[k].astype(p._value.dtype)
+                            p._grad_value = g if p._grad_value is None \
+                                else p._grad_value + g
+            total = Tensor(loss)
+            self.last_schedule = "1f1b"
+        else:
+            mb = B // M
+            total = None
+            for m in range(M):
+                x_mb = inputs[m * mb:(m + 1) * mb]
+                y_mb = labels[m * mb:(m + 1) * mb]
+                out = self._layers(x_mb)
+                loss = loss_fn(out, y_mb) * (1.0 / M)
+                # each microbatch's backward walks the SHARED upstream
+                # graph of `inputs` (when it has one): keep it alive until
+                # the last microbatch has traversed it
+                retain = inputs_want_grad and m < M - 1
+                if scaler is not None:
+                    scaler.scale(loss).backward(retain_graph=retain)
+                else:
+                    loss.backward(retain_graph=retain)
+                total = loss if total is None else total + loss
+            self.last_schedule = "sequential"
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

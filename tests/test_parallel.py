@@ -514,3 +514,105 @@ class TestPipelineVPPTrain:
         np.testing.assert_allclose(float(l1), float(lv), rtol=1e-6)
         np.testing.assert_allclose(np.asarray(g1["w"]),
                                    np.asarray(gv["w"][0]), rtol=1e-4, atol=1e-6)
+
+
+class TestEagerPPOverlappedSchedule:
+    """D12 (r3 verdict weak #6): PipelineParallel.train_batch runs the
+    COMPILED overlapped 1F1B when the mesh and trunk allow — same numbers
+    as the sequential fallback, stage-overlapped execution."""
+
+    def _make(self, n_layers=4, stages=2, M=2, seed=7):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel import LayerDesc, PipelineLayer
+        from paddle_tpu.parallel.pipeline_parallel import PipelineParallel
+        pt.seed(seed)
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(n_layers)]
+        layers = PipelineLayer(descs, num_stages=stages, loss_fn=nn.MSELoss())
+        eng = PipelineParallel(layers, num_microbatches=M)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=layers.parameters())
+        return layers, eng, opt
+
+    def test_compiled_matches_sequential_trajectory(self):
+        rng = np.random.RandomState(0)
+        batches = [(rng.rand(4, 8).astype(np.float32),
+                    rng.rand(4, 8).astype(np.float32)) for _ in range(4)]
+
+        # sequential reference (no pp mesh set)
+        dist.set_mesh(None)
+        _, eng_seq, opt_seq = self._make()
+        seq = []
+        for x, y in batches:
+            seq.append(float(eng_seq.train_batch(
+                (pt.to_tensor(x), pt.to_tensor(y)), opt_seq)))
+        assert eng_seq.last_schedule == "sequential"
+
+        # compiled 1F1B on a pp=2 mesh
+        dist.set_mesh(dist.ProcessMesh(np.arange(2), ["pp"]))
+        try:
+            _, eng_pp, opt_pp = self._make()
+            pp = []
+            for x, y in batches:
+                pp.append(float(eng_pp.train_batch(
+                    (pt.to_tensor(x), pt.to_tensor(y)), opt_pp)))
+            assert eng_pp.last_schedule == "1f1b"
+        finally:
+            dist.set_mesh(None)
+        np.testing.assert_allclose(pp, seq, rtol=1e-5, atol=1e-6)
+
+    def test_heterogeneous_trunk_falls_back(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel import LayerDesc, PipelineLayer
+        from paddle_tpu.parallel.pipeline_parallel import PipelineParallel
+        dist.set_mesh(dist.ProcessMesh(np.arange(2), ["pp"]))
+        try:
+            pt.seed(1)
+            descs = [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.Linear, 16, 8),
+                     LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.Linear, 16, 8)]
+            layers = PipelineLayer(descs, num_stages=2, loss_fn=nn.MSELoss())
+            eng = PipelineParallel(layers, num_microbatches=2)
+            opt = pt.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layers.parameters())
+            loss = eng.train_batch((pt.randn([4, 8]), pt.randn([4, 8])), opt)
+            assert np.isfinite(float(loss))
+            assert eng.last_schedule == "sequential"  # shapes can't stack
+        finally:
+            dist.set_mesh(None)
+
+    def test_plain_layer_and_loss_fn_switch(self):
+        """review r4: wrapping a plain Layer must not crash, and switching
+        loss_fn between calls must not reuse the stale compiled run."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel import LayerDesc, PipelineLayer
+        from paddle_tpu.parallel.pipeline_parallel import PipelineParallel
+        # plain Layer (no num_stages): sequential path, no AttributeError
+        seq_model = nn.Sequential(nn.Linear(8, 8))
+        eng0 = PipelineParallel(seq_model, num_microbatches=2)
+        opt0 = pt.optimizer.SGD(learning_rate=0.1,
+                                parameters=seq_model.parameters())
+        loss = eng0.train_batch((pt.randn([4, 8]), pt.randn([4, 8])), opt0,
+                                loss_fn=nn.MSELoss())
+        assert np.isfinite(float(loss))
+
+        dist.set_mesh(dist.ProcessMesh(np.arange(2), ["pp"]))
+        try:
+            pt.seed(3)
+            descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
+            layers = PipelineLayer(descs, num_stages=2)
+            eng = PipelineParallel(layers, num_microbatches=2)
+            opt = pt.optimizer.SGD(learning_rate=0.0,  # freeze params
+                                   parameters=layers.parameters())
+            x, y = pt.randn([4, 8]), pt.randn([4, 8])
+            mse = float(eng.train_batch((x, y), opt, loss_fn=nn.MSELoss()))
+            l1 = float(eng.train_batch((x, y), opt, loss_fn=nn.L1Loss()))
+            assert eng.last_schedule == "1f1b"
+            assert abs(mse - l1) > 1e-6  # stale cache would return mse again
+            # inputs that want grads must take the sequential path
+            xg = pt.randn([4, 8])
+            xg.stop_gradient = False
+            x2 = xg * 1.0
+            eng.train_batch((x2, y), opt, loss_fn=nn.MSELoss())
+            assert eng.last_schedule == "sequential"
+            assert xg._grad_value is not None  # backprop reached upstream
+        finally:
+            dist.set_mesh(None)
