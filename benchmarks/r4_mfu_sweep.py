@@ -1,0 +1,83 @@
+"""MFU sweep beyond the r3 optimum (B=6, dots remat, flash 512x512).
+
+Axes r3 did NOT cover: flash-attention tile sizes (FLAGS_flash_block_q/k)
+and lighter remat at the same batch. Each config runs the bench model
+through one 5-step chain (compile + median-ish signal; a winner gets
+promoted into bench.py and re-measured with the full protocol).
+
+    python benchmarks/r4_mfu_sweep.py [config ...]
+    configs: blocks:BQxBK  (e.g. blocks:1024x512)
+             remat:off | remat:dots (default)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(tag, block_q=0, block_k=0, remat=True, B=6):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import LlamaConfig, LlamaTrainStep
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.utils.flags import set_flags
+
+    set_flags({"flash_block_q": block_q, "flash_block_k": block_k})
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=14, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=2048, dtype=jnp.bfloat16)
+    T = 2048
+    try:
+        step = LlamaTrainStep(
+            cfg, mesh=None, remat=remat,
+            optimizer=AdamW(learning_rate=3e-4, weight_decay=0.1,
+                            moment_dtype=jnp.bfloat16))
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+        labels = np.roll(toks, -1, axis=1)
+        loss = step(toks, labels)
+        float(jax.device_get(loss))          # compile + 1 step
+        t0 = time.perf_counter()
+        for _ in range(5):
+            loss = step(toks, labels)
+        float(jax.device_get(loss))
+        dt = (time.perf_counter() - t0) / 5
+        n_params = sum(int(np.prod(v.shape))
+                       for v in jax.tree.leaves(step.params))
+        embed = 32000 * 2048
+        fpt = 6.0 * (n_params - embed) + 6.0 * 14 * 16 * 128 * T
+        mfu = fpt * (B * T / dt) / 197e12
+        print(json.dumps({"config": tag, "B": B, "step_ms": round(dt * 1e3, 1),
+                          "honest_mfu": round(mfu, 4)}))
+    except Exception as e:  # OOM etc — record and continue
+        print(json.dumps({"config": tag, "B": B,
+                          "error": str(e).splitlines()[0][:120]}))
+    finally:
+        set_flags({"flash_block_q": 0, "flash_block_k": 0})
+
+
+def main():
+    specs = sys.argv[1:] or ["blocks:512x512", "blocks:1024x512",
+                             "blocks:512x1024", "blocks:1024x1024",
+                             "blocks:256x512", "remat:off"]
+    for s in specs:
+        kind, _, val = s.partition(":")
+        if kind == "blocks":
+            bq, bk = (int(x) for x in val.split("x"))
+            run_config(s, block_q=bq, block_k=bk)
+        elif kind == "remat":
+            run_config(s, remat=(val != "off"))
+        else:
+            print(json.dumps({"config": s, "error": "unknown spec"}))
+
+
+if __name__ == "__main__":
+    main()

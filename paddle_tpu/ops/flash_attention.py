@@ -79,7 +79,14 @@ def flash_attention_raw(q, k, v, causal: bool = False, block_q: int = 512,
                         block_k: int = 512):
     """Raw-jnp-array flash attention ([B, L, H, D] in/out) — the shared entry
     for the Tensor API and model code. Falls back to the XLA path for
-    small/ragged sequence lengths or off-TPU."""
+    small/ragged sequence lengths or off-TPU.
+
+    FLAGS_flash_block_q / FLAGS_flash_block_k (env or set_flags) override
+    the tile sizes globally — the tuning knob benchmarks/r4 sweeps use; 0
+    keeps the caller's value."""
+    from ..utils.flags import flag_value
+    block_q = int(flag_value("flash_block_q") or block_q)
+    block_k = int(flag_value("flash_block_k") or block_k)
     L, S, D = q.shape[1], k.shape[1], q.shape[-1]
     if (L % _MIN_BLOCK) or (S % _MIN_BLOCK) or not flash_attention_tpu_available():
         return _fa_reference(q, k, v, causal)

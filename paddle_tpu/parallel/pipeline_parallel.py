@@ -613,9 +613,12 @@ class PipelineParallel(Layer):
         for kind, _, obj in entries:
             if kind != "layer" or not isinstance(obj, Layer):
                 return None
-            from ..nn import Dropout
-            if any(isinstance(s, Dropout) for s in obj.sublayers(True)):
-                return None  # eager-RNG dropout can't thread the schedule
+            # any dropout flavor (Dropout/Dropout2D/3D/AlphaDropout...)
+            # draws from the eager RNG, which a traced schedule would bake
+            # as a constant — forward/backward masks would disagree
+            if any("Dropout" in type(s).__name__
+                   for s in obj.sublayers(True)):
+                return None
             layers.append(obj)
         if not layers:
             return None
@@ -645,15 +648,27 @@ class PipelineParallel(Layer):
                 or "pp" not in mesh.dim_names \
                 or mesh.get_dim_size("pp") != S:
             return None
+        # cache probe FIRST: the trunk is fixed at PipelineLayer
+        # construction, so on (mesh, loss_fn) hits the per-step eligibility
+        # walk (state_dict + sublayer scans over every entry) is skipped.
+        # loss_fn is part of the key because the compiled run closes over
+        # it; the tuple holds mesh and loss_fn alive, so ids can't alias.
+        cache_key = (mesh, loss_fn)
+        if self._pp_compiled and self._pp_compiled[0] == cache_key:
+            return self._pp_compiled[1]
+        # a loss Layer with trainable params (or dropout) would be baked as
+        # trace-time constants and its grads discarded — sequential only
+        if isinstance(loss_fn, Layer):
+            from ..core.tensor import Parameter
+            if any(isinstance(v, Parameter) and v.trainable
+                   for v in loss_fn.state_dict().values()):
+                return None
+            if any("Dropout" in type(s).__name__
+                   for s in loss_fn.sublayers(True)):
+                return None
         layers = self._eligible_entries()
         if layers is None:
             return None
-        # loss_fn in the key: the compiled run closes over it, so a call
-        # with a different loss must rebuild (the tuple holds mesh and
-        # loss_fn alive — ids cannot be reused while cached)
-        cache_key = (mesh, len(layers), loss_fn)
-        if self._pp_compiled and self._pp_compiled[0] == cache_key:
-            return self._pp_compiled[1]
         template = layers[0]
         Lps = len(layers) // S
 
@@ -664,7 +679,7 @@ class PipelineParallel(Layer):
             for s in range(S):
                 stage_layers = layers[s * Lps:(s + 1) * Lps]
                 per_stage.append(jax.tree.map(
-                    lambda *xs: jnp.stack([x for x in xs], axis=0),
+                    lambda *xs: jnp.stack(xs, axis=0),
                     *[{k: v._value for k, v in l.state_dict().items()}
                       for l in stage_layers]))
             return stack_stage_params(per_stage, mesh)

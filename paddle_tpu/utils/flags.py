@@ -78,6 +78,8 @@ def flag_value(name: str):
 define_flag("check_nan_inf", False, "check op outputs for NaN/Inf (debug)")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: log stats")
 define_flag("use_flash_attention", True, "use the Pallas flash-attention kernel")
+define_flag("flash_block_q", 0, "flash attention q-tile override (0 = caller default)")
+define_flag("flash_block_k", 0, "flash attention k-tile override (0 = caller default)")
 define_flag("benchmark", False, "sync after each op for timing")
 define_flag("init_seed", 0, "global RNG seed at startup")
 define_flag("tpu_matmul_precision", "default", "jax matmul precision")
