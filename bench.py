@@ -112,6 +112,14 @@ def main() -> int:
     import jax
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    try:
+        # persistent compile cache: a re-run (driver retry after a tunnel
+        # flap) skips the ~2 min first compile instead of re-paying it
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/paddle_tpu_xla_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     from paddle_tpu.models import LlamaConfig, LlamaTrainStep

@@ -62,9 +62,29 @@ class ContinuousBatcher:
                  max_len: int = 512,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256),
                  burst: int = 8, eos_id: int | None = None, pad_id: int = 0,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 precision: str | None = None):
         from ..models.llama_decode import init_kv_cache
-        self._cfg = model_config
+        self._dequant = None
+        if precision in ("int8", "weight_only_int8"):
+            # int8 weight-only serving: weights live quantized in HBM and
+            # dequantize INSIDE each compiled step (decode is weight-read
+            # bound, so halved weight bytes is the win)
+            from ..quantization import (weight_only_dequantize,
+                                        weight_only_quantize)
+            params = weight_only_quantize(params)
+            self._dequant = weight_only_dequantize
+        elif precision in ("bfloat16", "float16"):
+            dt = jnp.dtype(precision)
+            params = jax.tree.map(
+                lambda v: v.astype(dt) if hasattr(v, "astype") else v, params)
+            # the config drives activation/KV dtype: weights in dt with
+            # activations in cfg.dtype would promote every matmul to f32
+            import dataclasses as _dc
+            model_config = _dc.replace(model_config, dtype=dt)
+        elif precision is not None:
+            raise ValueError(f"unknown serving precision {precision!r}")
+        self._cfg = model_config  # after precision handling: dtype may change
         self._params = params
         self.B, self.S = int(max_batch), int(max_len)
         self._buckets = tuple(sorted(b for b in prompt_buckets
@@ -130,7 +150,8 @@ class ContinuousBatcher:
                 self._params, self._cache, jnp.asarray(toks),
                 jnp.int32(slot), jnp.int32(tlen), sub,
                 config=self._cfg, max_len=self.S,
-                temperature=self._temp, top_k=self._top_k)
+                temperature=self._temp, top_k=self._top_k,
+                dequant=self._dequant)
             self.stats["prefills"] += 1
             self._slot_req[slot] = req  # reserve; confirmed after the sync
             staged.append((req, slot, tlen, first))
@@ -166,7 +187,7 @@ class ContinuousBatcher:
             jnp.asarray(self._tok), jnp.asarray(self._done),
             jnp.asarray(self._limit), jnp.int32(self.eos_id), sub,
             config=self._cfg, n=self.burst, temperature=self._temp,
-            top_k=self._top_k, pad_id=self.pad_id)
+            top_k=self._top_k, pad_id=self.pad_id, dequant=self._dequant)
         self.stats["bursts"] += 1
         self.stats["decode_steps"] += self.burst
         # ONE host sync for the whole burst result

@@ -256,10 +256,12 @@ def llama_decode_step_slots(params, cache, pos, token, config: LlamaConfig):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "config", "max_len", "temperature", "top_k"), donate_argnums=(1,))
+    "config", "max_len", "temperature", "top_k", "dequant"),
+    donate_argnums=(1,))
 def llama_prefill_slot(params, cache, tokens, slot, tlen, key,
                        config: LlamaConfig, max_len: int,
-                       temperature: float = 0.0, top_k: int = 0):
+                       temperature: float = 0.0, top_k: int = 0,
+                       dequant=None):
     """Prefill ONE request (bucket-padded prompt) into cache slot `slot`.
 
     tokens [Tb] int32 padded to a bucket length; tlen = the real prompt
@@ -267,8 +269,13 @@ def llama_prefill_slot(params, cache, tokens, slot, tlen, key,
     that decode overwrites before its valid-mask ever reaches them),
     samples the first generated token from the logits at tlen-1, and
     returns (first_token scalar, cache). One executable per bucket length.
+    dequant: optional static callable (int8 weight-only serving) — runs
+    INSIDE the jit so the dense weights fuse into consumers, never
+    materializing in HBM.
     """
     c = config
+    if dequant is not None:
+        params = dequant(params)
     layer_p, other = split_layer_params(params)
     T = tokens.shape[0]
     x = jnp.take(other["embed_tokens"], tokens[None, :], axis=0).astype(c.dtype)
@@ -302,11 +309,12 @@ def llama_prefill_slot(params, cache, tokens, slot, tlen, key,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "config", "n", "temperature", "top_k", "pad_id"), donate_argnums=(1,))
+    "config", "n", "temperature", "top_k", "pad_id", "dequant"),
+    donate_argnums=(1,))
 def llama_decode_burst(params, cache, pos, tok, done, limit, eos_id, key,
                        config: LlamaConfig, n: int,
                        temperature: float = 0.0, top_k: int = 0,
-                       pad_id: int = 0):
+                       pad_id: int = 0, dequant=None):
     """n scanned slot-decode steps — the serving hot loop.
 
     pos/tok/done/limit [B]; eos_id traced (pass -1 for none). A slot stops
@@ -315,10 +323,15 @@ def llama_decode_burst(params, cache, pos, tok, done, limit, eos_id, key,
     pad_id and freeze. Returns (cache, pos, tok, done, emitted [n, B]) —
     the host scheduler retires finished slots and admits queued requests
     between bursts (iteration-level scheduling; burst=1 ≡ token-level).
+    dequant: applied INSIDE the scan body — decode is weight-read bound,
+    so the int8 representation must be what streams from HBM each step
+    (the dequant fuses into the consuming matmuls); hoisting it out of
+    the scan would materialize dense weights and give the bandwidth back.
     """
     def step(carry, _):
         cache, pos, tok, done, key = carry
-        logits, cache = llama_decode_step_slots(params, cache, pos, tok,
+        p = dequant(params) if dequant is not None else params
+        logits, cache = llama_decode_step_slots(p, cache, pos, tok,
                                                 config)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, temperature, top_k, sub)

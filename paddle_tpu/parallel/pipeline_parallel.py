@@ -570,6 +570,16 @@ def stack_stage_params(stage_param_list, mesh: ProcessMesh, pp_axis: str = "pp")
     return jax.tree.map(place, stacked)
 
 
+def _has_dropout(layer: Layer) -> bool:
+    """Any dropout flavor draws from the eager RNG, which a traced schedule
+    would bake as a constant — forward/backward masks would disagree.
+    isinstance catches user subclasses of nn.Dropout (DropPath-style); the
+    name check catches the independent Dropout2D/3D/AlphaDropout classes."""
+    from ..nn import Dropout
+    return any(isinstance(s, Dropout) or "Dropout" in type(s).__name__
+               for s in layer.sublayers(True))
+
+
 class PipelineParallel(Layer):
     """Dygraph-style engine (reference pipeline_parallel.py:255): wraps a
     PipelineLayer + optimizer and exposes train_batch().
@@ -613,11 +623,7 @@ class PipelineParallel(Layer):
         for kind, _, obj in entries:
             if kind != "layer" or not isinstance(obj, Layer):
                 return None
-            # any dropout flavor (Dropout/Dropout2D/3D/AlphaDropout...)
-            # draws from the eager RNG, which a traced schedule would bake
-            # as a constant — forward/backward masks would disagree
-            if any("Dropout" in type(s).__name__
-                   for s in obj.sublayers(True)):
+            if _has_dropout(obj):
                 return None
             layers.append(obj)
         if not layers:
@@ -656,15 +662,16 @@ class PipelineParallel(Layer):
         cache_key = (mesh, loss_fn)
         if self._pp_compiled and self._pp_compiled[0] == cache_key:
             return self._pp_compiled[1]
-        # a loss Layer with trainable params (or dropout) would be baked as
-        # trace-time constants and its grads discarded — sequential only
+        # a loss Layer with trainable params, mutable buffers, or dropout
+        # would be baked as trace-time constants (and its grads discarded)
+        # — sequential only. Frozen (non-trainable) Parameters are honest
+        # constants (e.g. CrossEntropyLoss class weights) and may ride.
         if isinstance(loss_fn, Layer):
             from ..core.tensor import Parameter
-            if any(isinstance(v, Parameter) and v.trainable
-                   for v in loss_fn.state_dict().values()):
-                return None
-            if any("Dropout" in type(s).__name__
-                   for s in loss_fn.sublayers(True)):
+            for v in loss_fn.state_dict().values():
+                if not isinstance(v, Parameter) or v.trainable:
+                    return None
+            if _has_dropout(loss_fn):
                 return None
         layers = self._eligible_entries()
         if layers is None:

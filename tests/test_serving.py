@@ -117,3 +117,43 @@ def test_predictor_pool_parity():
     assert pool.retrieve(2) is p0  # wraps
     out = p0.run([pt.to_tensor(np.array([1.0, 2.0], np.float32))])
     np.testing.assert_allclose(out[0], [2.0, 3.0])
+
+
+def test_int8_weight_only_serving(small_model):
+    """int8 weight-only composes with continuous batching: quantized
+    weights stay the stored representation (dequant inside the compiled
+    programs), and greedy outputs equal the int8 LLMPredictor path."""
+    import jax.numpy as jnp
+
+    cfg, params = small_model
+    rng = np.random.RandomState(5)
+    reqs = [(rng.randint(1, cfg.vocab_size, n).tolist(), m)
+            for n, m in [(6, 5), (14, 4)]]
+
+    from paddle_tpu.inference import ContinuousBatcher
+    eng = ContinuousBatcher(cfg, params, max_batch=2, max_len=64,
+                            prompt_buckets=(8, 16), burst=4,
+                            precision="int8")
+    from paddle_tpu.quantization import QuantizedWeight
+    import jax
+    assert any(isinstance(l, QuantizedWeight)
+               for l in jax.tree.leaves(
+                   eng._params,
+                   is_leaf=lambda x: isinstance(x, QuantizedWeight)))
+    rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
+    out = eng.run()
+
+    # reference: same quantized weights through per-request generate
+    from paddle_tpu.models.llama_decode import llama_generate
+    from paddle_tpu.quantization import (weight_only_dequantize,
+                                         weight_only_quantize)
+    qp = weight_only_quantize(params)
+
+    def gen(p_ids, m):
+        toks = jnp.asarray(np.asarray(p_ids, np.int32)[None, :])
+        r = llama_generate(weight_only_dequantize(qp), toks, cfg, m,
+                           temperature=0.0)
+        return [int(t) for t in np.asarray(r)[0]]
+
+    for rid, (p, m) in zip(rids, reqs):
+        assert out[rid] == gen(p, m)
