@@ -157,3 +157,21 @@ def test_int8_weight_only_serving(small_model):
 
     for rid, (p, m) in zip(rids, reqs):
         assert out[rid] == gen(p, m)
+
+
+def test_shorter_prompt_reuses_dirty_slot(small_model):
+    """A retired slot's cache rows above the new prompt's tlen hold the
+    PREVIOUS occupant's K/V; the valid-mask/overwrite discipline must keep
+    the new request exact anyway."""
+    cfg, params = small_model
+    rng = np.random.RandomState(7)
+    eng = _make_engine(cfg, params, max_batch=1, burst=4)  # one slot: forced reuse
+    long_p = rng.randint(1, cfg.vocab_size, 30).tolist()
+    short_p = rng.randint(1, cfg.vocab_size, 4).tolist()
+    r1 = eng.add_request(long_p, max_new_tokens=8)
+    out1 = eng.run()
+    assert out1[r1] == _reference_generate(cfg, params, long_p, 8)
+    # slot 0 now has 38 dirty rows; the 4-token prompt must not see them
+    r2 = eng.add_request(short_p, max_new_tokens=10)
+    out2 = eng.run()
+    assert out2[r2] == _reference_generate(cfg, params, short_p, 10)
