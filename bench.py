@@ -37,6 +37,8 @@ import time
 
 import numpy as np
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
 
 def peak_bf16_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
@@ -75,37 +77,97 @@ def _tpu_reachable(timeout_s: int = 240) -> bool:
 
 
 def _wait_for_tpu(deadline_s: float) -> bool:
-    """Bounded retry: the tunnel flaps (r3 lost the driver bench to a single
-    failed probe). Keep probing until the deadline, then give up loudly.
+    """Bounded retry: the tunnel flaps, and r3 AND r4 both lost the driver
+    bench to multi-hour outages that outlasted the old 900 s window. The
+    window now defaults to most of the driver budget (40 of ~45 min, the
+    tail reserved for the bench run itself) with exponential backoff — the
+    persistent compile cache makes a late success cheap.
+    Probe attempts are appended to benchmarks/bench_retry_log.txt so an
+    exhausted window leaves committed evidence.
     BENCH_TPU_WAIT_S overrides the deadline (0 = single probe)."""
     deadline_s = float(os.environ.get("BENCH_TPU_WAIT_S", deadline_s))
     t0 = time.time()
     attempt = 0
+    sleep_s = 15.0
+    log_path = os.path.join(_HERE, "benchmarks", "bench_retry_log.txt")
+
+    def _log(line: str) -> None:
+        print(line, file=sys.stderr)
+        try:
+            with open(log_path, "a") as f:
+                f.write(f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+                        f" {line}\n")
+        except OSError:
+            pass
+
     while True:
         attempt += 1
         if _tpu_reachable():
             if attempt > 1:
-                print(f"# tpu reachable after {attempt} probes "
-                      f"({time.time() - t0:.0f}s)", file=sys.stderr)
+                _log(f"# tpu reachable after {attempt} probes "
+                     f"({time.time() - t0:.0f}s)")
             return True
         elapsed = time.time() - t0
         if elapsed >= deadline_s:
+            _log(f"# tpu wait EXHAUSTED: {attempt} probes over "
+                 f"{elapsed:.0f}s (window {deadline_s:.0f}s)")
             return False
-        print(f"# tpu probe {attempt} failed ({elapsed:.0f}s elapsed, "
-              f"retrying until {deadline_s:.0f}s)", file=sys.stderr)
-        time.sleep(min(30.0, max(0.0, deadline_s - elapsed)))
+        _log(f"# tpu probe {attempt} failed ({elapsed:.0f}s elapsed, "
+             f"retrying until {deadline_s:.0f}s)")
+        time.sleep(min(sleep_s, max(0.0, deadline_s - elapsed)))
+        sleep_s = min(sleep_s * 2.0, 120.0)
+
+
+def _record_latest(payload: dict) -> None:
+    """Atomically persist every successful bench result to
+    benchmarks/BENCH_latest.json (timestamp + git sha + device) so an
+    end-of-round tunnel outage can never again leave the round with zero
+    numeric artifact (r3 and r4 both hit this)."""
+    import subprocess
+    try:
+        sha = subprocess.run(["git", "rev-parse", "HEAD"], cwd=_HERE,
+                             capture_output=True, text=True, timeout=10,
+                             check=True).stdout.strip()
+    except Exception:
+        sha = "unknown"
+    rec = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": sha,
+        **payload,
+    }
+    path = os.path.join(_HERE, "benchmarks", "BENCH_latest.json")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"# could not write BENCH_latest.json: {e}", file=sys.stderr)
 
 
 def main() -> int:
-    on_tpu = _wait_for_tpu(deadline_s=900.0)
+    # 40 min of the ~45 min driver budget; the last 5 min are reserved for
+    # the bench itself after a late probe success (compile cache makes the
+    # run cheap, but a cold /tmp cache still needs minutes).
+    on_tpu = _wait_for_tpu(deadline_s=2400.0)
     if not on_tpu:
         if os.environ.get("BENCH_ALLOW_CPU") != "1":
-            print(json.dumps({
+            err = {
                 "metric": "llama_train_tokens_per_sec_per_chip",
                 "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
                 "error": "tpu unreachable — refusing to bench CPU "
                          "(set BENCH_ALLOW_CPU=1 for a local smoke run)",
-            }))
+            }
+            # surface the last committed success so an outage at bench time
+            # still points the reader at a real number
+            latest = os.path.join(_HERE, "benchmarks", "BENCH_latest.json")
+            try:
+                with open(latest) as f:
+                    err["last_success"] = json.load(f)
+            except (OSError, ValueError):
+                pass
+            print(json.dumps(err))
             return 1
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -173,7 +235,7 @@ def main() -> int:
     mfu = model_flops / peak if on_tpu else 0.0
     mfu_incl = fpt_incl_embed * tokens_per_sec / peak if on_tpu else 0.0
 
-    print(json.dumps({
+    result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
@@ -192,7 +254,10 @@ def main() -> int:
             "device": str(getattr(dev, "device_kind", dev)),
             "loss": float(jax.device_get(loss)),
         },
-    }))
+    }
+    if on_tpu:
+        _record_latest(result)
+    print(json.dumps(result))
     return 0
 
 
