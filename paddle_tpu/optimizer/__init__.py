@@ -1,7 +1,8 @@
 """paddle_tpu.optimizer (reference: /root/reference/python/paddle/optimizer/)."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
+from .lbfgs import LBFGS, minimize_lbfgs  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam, RAdam,
-    RMSProp,
+    ASGD, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
+    RAdam, RMSProp, Rprop,
 )

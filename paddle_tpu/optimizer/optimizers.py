@@ -9,7 +9,7 @@ import jax.numpy as jnp
 from .optimizer import Optimizer
 
 __all__ = ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad", "RMSProp",
-           "Adadelta", "Adamax", "NAdam", "RAdam"]
+           "Adadelta", "Adamax", "NAdam", "RAdam", "ASGD", "Rprop"]
 
 
 class SGD(Optimizer):
@@ -295,3 +295,69 @@ class RAdam(Adam):
         return p - (lr * upd).astype(p.dtype), \
             {"moment1": _sr_cast(m, md, step, 1),
              "moment2": _sr_cast(v, md, step, 2)}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference: optimizer/asgd.py:41):
+    keeps the gradient seen at each of the last `batch_num` batch slots
+    plus their running sum `d`; the update direction is the AVERAGE of the
+    stored gradients, so per-batch noise cancels as the epoch fills in.
+    State per param: d [*shape] and ys [batch_num, *shape] — the same
+    memory the reference's accumulators use."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if batch_num is None or batch_num <= 0:
+            raise ValueError("batch_num should be a positive int")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._batch_num = int(batch_num)
+
+    def _init_one(self, p):
+        return {"d": jnp.zeros_like(p, dtype=jnp.float32),
+                "ys": jnp.zeros((self._batch_num, *p.shape),
+                                dtype=jnp.float32)}
+
+    def _update_one(self, p, g, state, lr, step):
+        n = self._batch_num
+        g32 = g.astype(jnp.float32)
+        i = jnp.mod(jnp.asarray(step, jnp.int32) - 1, n)
+        y_i = jax.lax.dynamic_index_in_dim(state["ys"], i, axis=0,
+                                           keepdims=False)
+        d = state["d"] - y_i + g32
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], g32, i, axis=0)
+        denom = jnp.minimum(jnp.asarray(step, jnp.float32), float(n))
+        new_p = p - (lr * d / denom).astype(p.dtype)
+        return new_p, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: optimizer/rprop.py:40; update math
+    shared with the `rprop_` op in tensor/ops_ext4.py:121): per-weight
+    step sizes grown/shrunk by the sign agreement of consecutive
+    gradients; gradient magnitude is ignored entirely. Full-batch only —
+    sign flips from minibatch noise destroy the step-size adaptation
+    (the reference documents the same caveat)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_range = (float(learning_rate_range[0]),
+                          float(learning_rate_range[1]))
+        self._etas = (float(etas[0]), float(etas[1]))
+        self._initial_lr = float(learning_rate)
+
+    def _init_one(self, p):
+        return {"prev": jnp.zeros_like(p, dtype=jnp.float32),
+                "learning_rate": jnp.full(p.shape, self._initial_lr,
+                                          dtype=jnp.float32)}
+
+    def _update_one(self, p, g, state, lr, step):
+        from ..tensor.ops_ext4 import rprop_kernel
+        new_p, g_eff, sz = rprop_kernel(
+            p, g.astype(jnp.float32), state["prev"],
+            state["learning_rate"], self._etas, self._lr_range)
+        return new_p, {"prev": g_eff, "learning_rate": sz}

@@ -118,20 +118,28 @@ def radam_(param, grad, learning_rate, beta1_pow, beta2_pow, rho, moment1,
 
 
 @_export
+def rprop_kernel(p, g, prev, sz, etas=(0.5, 1.2),
+                 learning_rate_range=(1e-5, 50.0)):
+    """Pure Rprop update (the single source of the rule — both the
+    `rprop_` op and `optimizer.Rprop` call this): per-weight step sizes
+    grown/shrunk by the sign agreement of consecutive gradients, the
+    gradient zeroed on a sign flip. Returns (new_p, g_eff, new_sz)."""
+    eta_n, eta_p = etas
+    lo, hi = learning_rate_range
+    sign = jnp.sign(g * prev)
+    factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_n, 1.0))
+    sz_new = jnp.clip(sz * factor, lo, hi)
+    g_eff = jnp.where(sign < 0, 0.0, g)
+    p_new = p - (jnp.sign(g_eff) * sz_new).astype(p.dtype)
+    return p_new, g_eff, sz_new
+
+
 def rprop_(param, grad, prev, learning_rate, master_param=None,
            learning_rate_range=(1e-5, 50.0), etas=(0.5, 1.2),
            multi_precision=False, name=None):
     """Rprop (reference ops.yaml rprop_): sign-based per-weight step size."""
-    eta_n, eta_p = etas
-    lo, hi = learning_rate_range
-
     def f(p, g, pr, lr):
-        sign = jnp.sign(g * pr)
-        factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_n, 1.0))
-        lr_new = jnp.clip(lr * factor, lo, hi)
-        g_eff = jnp.where(sign < 0, 0.0, g)
-        p_new = p - jnp.sign(g_eff) * lr_new.astype(p.dtype)
-        return p_new, g_eff, lr_new
+        return rprop_kernel(p, g, pr, lr, etas, learning_rate_range)
     p2, pr2, lr2 = apply(f, param, grad, prev, learning_rate, name="rprop_")
     _set(param, p2); _set(prev, pr2); _set(learning_rate, lr2)
     return param, prev, learning_rate

@@ -1,0 +1,217 @@
+"""LBFGS / ASGD / Rprop optimizers (reference:
+/root/reference/python/paddle/optimizer/{lbfgs.py:342,asgd.py:41,rprop.py:40}).
+scipy is the numeric oracle for the L-BFGS core."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.optimizer import ASGD, LBFGS, Rprop, minimize_lbfgs
+
+
+def rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+
+
+class TestMinimizeLbfgs:
+    def test_rosenbrock_matches_scipy(self):
+        from scipy.optimize import minimize as sp_minimize
+        x0 = np.array([-1.2, 1.0, -0.5, 2.0], dtype=np.float32)
+
+        res = minimize_lbfgs(rosenbrock, x0, history_size=10, max_iters=200,
+                             tolerance_grad=1e-6)
+        sp = sp_minimize(lambda x: float(rosenbrock(jnp.asarray(x, jnp.float32))),
+                         x0, method="L-BFGS-B",
+                         jac=lambda x: np.asarray(
+                             jax.grad(rosenbrock)(jnp.asarray(x, jnp.float32)),
+                             dtype=np.float64))
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), sp.x, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(res.x), 1.0, atol=1e-3)
+
+    def test_jittable_single_program(self):
+        # the whole optimization must trace into ONE compiled program
+        jitted = jax.jit(lambda x0: minimize_lbfgs(
+            rosenbrock, x0, history_size=6, max_iters=100))
+        res = jitted(jnp.array([-1.2, 1.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(res.x), 1.0, atol=1e-3)
+        assert int(res.num_iters) <= 100
+
+    def test_quadratic_exact(self):
+        A = jnp.array([[3.0, 1.0], [1.0, 2.0]])
+        b = jnp.array([1.0, -1.0])
+        fun = lambda x: 0.5 * x @ A @ x - b @ x
+        res = minimize_lbfgs(fun, jnp.zeros(2), max_iters=50)
+        np.testing.assert_allclose(np.asarray(res.x),
+                                   np.linalg.solve(np.asarray(A),
+                                                   np.asarray(b)), atol=1e-4)
+
+    def test_no_line_search_mode(self):
+        fun = lambda x: jnp.sum((x - 2.0) ** 2)
+        res = minimize_lbfgs(fun, jnp.zeros(3), line_search_fn=None,
+                             learning_rate=0.3, max_iters=100)
+        np.testing.assert_allclose(np.asarray(res.x), 2.0, atol=1e-3)
+
+
+class TestLBFGSClass:
+    def _fit(self, line_search):
+        net = pt.nn.Linear(3, 1)
+        opt = LBFGS(parameters=net.parameters(), max_iter=10,
+                    line_search_fn=line_search, history_size=8)
+        rng = np.random.RandomState(0)
+        X = pt.to_tensor(rng.randn(32, 3).astype(np.float32))
+        w_true = np.array([[1.5], [-2.0], [0.5]], dtype=np.float32)
+        y = pt.to_tensor(rng.randn(32, 3).astype(np.float32) @ w_true * 0
+                         + np.asarray(X.numpy() @ w_true + 0.7))
+
+        def closure():
+            opt.clear_grad()
+            loss = ((net(X) - y) ** 2).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            loss = opt.step(closure)
+        return float(((net(X) - y) ** 2).mean().numpy()), loss
+
+    def test_small_net_fit_strong_wolfe(self):
+        final, loss = self._fit("strong_wolfe")
+        assert final < 1e-6, final
+
+    def test_small_net_fit_no_line_search(self):
+        final, _ = self._fit(None)
+        assert final < 1e-3, final
+
+    def test_state_dict_roundtrip(self):
+        net = pt.nn.Linear(2, 1)
+        opt = LBFGS(parameters=net.parameters(), max_iter=3,
+                    line_search_fn="strong_wolfe")
+        X = pt.to_tensor(np.eye(2, dtype=np.float32))
+        y = pt.to_tensor(np.array([[1.0], [2.0]], dtype=np.float32))
+
+        def closure():
+            opt.clear_grad()
+            loss = ((net(X) - y) ** 2).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        sd = opt.state_dict()
+        assert sd["n_iter"] >= 1 and sd["func_evals"] >= 1
+        assert len(sd["old_stps"]) == len(sd["ro"])
+        opt2 = LBFGS(parameters=net.parameters(), max_iter=3,
+                     line_search_fn="strong_wolfe")
+        opt2.set_state_dict(sd)
+        assert opt2._state["n_iter"] == sd["n_iter"]
+        opt2.step(closure)  # continues from restored curvature history
+
+    def test_rejects_unknown_line_search(self):
+        with pytest.raises(ValueError):
+            LBFGS(parameters=[], line_search_fn="backtracking")
+
+
+class TestASGD:
+    def test_averages_gradients(self):
+        # hand-computed SAG trajectory (asgd.py:41 math block):
+        #   i = m % n;  d += g - y_i;  y_i = g;  w -= lr * d / min(m+1, n)
+        lin = pt.nn.Linear(1, 1, bias_attr=False)
+        lin.weight.set_value(np.array([[0.0]], np.float32))
+        lr, n = 0.1, 2
+        opt = ASGD(learning_rate=lr, batch_num=n,
+                   parameters=lin.parameters())
+        X = pt.to_tensor(np.array([[1.0]], np.float32))
+        targets = [2.0, 6.0, 2.0, 6.0]        # dL/dw = 2*(w - target)
+
+        w_ref, d, ys = 0.0, 0.0, [0.0, 0.0]
+        for m, tgt in enumerate(targets):
+            opt.clear_grad()
+            loss = ((lin(X) - tgt) ** 2).sum()
+            loss.backward()
+            opt.step()
+            g = 2.0 * (w_ref - tgt)
+            i = m % n
+            d = d - ys[i] + g
+            ys[i] = g
+            w_ref -= lr * d / min(m + 1, n)
+            np.testing.assert_allclose(float(lin.weight.numpy()[0, 0]),
+                                       w_ref, rtol=1e-5,
+                                       err_msg=f"step {m}")
+
+    def test_convergence_quadratic(self):
+        lin = pt.nn.Linear(2, 1)
+        opt = ASGD(learning_rate=0.05, batch_num=4,
+                   parameters=lin.parameters())
+        rng = np.random.RandomState(1)
+        X = rng.randn(64, 2).astype(np.float32)
+        w = np.array([[2.0], [-1.0]], np.float32)
+        Y = X @ w + 0.3
+        for epoch in range(60):
+            for i in range(4):
+                xb = pt.to_tensor(X[i * 16:(i + 1) * 16])
+                yb = pt.to_tensor(Y[i * 16:(i + 1) * 16])
+                opt.clear_grad()
+                loss = ((lin(xb) - yb) ** 2).mean()
+                loss.backward()
+                opt.step()
+        assert float(loss.numpy()) < 1e-2
+
+    def test_rejects_bad_batch_num(self):
+        with pytest.raises(ValueError):
+            ASGD(batch_num=0)
+        with pytest.raises(ValueError):
+            ASGD(batch_num=None)
+
+
+class TestRprop:
+    def test_step_size_adaptation(self):
+        # constant-sign gradient → step size grows by eta_plus each step
+        lin = pt.nn.Linear(1, 1, bias_attr=False)
+        opt = Rprop(learning_rate=0.01, parameters=lin.parameters(),
+                    etas=(0.5, 1.2), learning_rate_range=(1e-5, 50.0))
+        X = pt.to_tensor(np.array([[1.0]], np.float32))
+        y = pt.to_tensor(np.array([[100.0]], np.float32))
+        deltas = []
+        prev = float(lin.weight.numpy()[0, 0])
+        for _ in range(4):
+            opt.clear_grad()
+            loss = ((lin(X) - y) ** 2).sum()
+            loss.backward()
+            opt.step()
+            cur = float(lin.weight.numpy()[0, 0])
+            deltas.append(cur - prev)
+            prev = cur
+        # steps all positive (toward y) and growing ×1.2 after the first
+        assert all(d > 0 for d in deltas)
+        np.testing.assert_allclose(deltas[2] / deltas[1], 1.2, rtol=1e-3)
+        np.testing.assert_allclose(deltas[3] / deltas[2], 1.2, rtol=1e-3)
+
+    def test_magnitude_invariance(self):
+        # Rprop uses only the SIGN of the gradient: scaling the loss by
+        # 1000 must produce the identical trajectory
+        traj = []
+        for scale in (1.0, 1000.0):
+            lin = pt.nn.Linear(1, 1, bias_attr=False)
+            lin.weight.set_value(np.array([[0.0]], np.float32))
+            opt = Rprop(learning_rate=0.01, parameters=lin.parameters())
+            X = pt.to_tensor(np.array([[1.0]], np.float32))
+            for _ in range(5):
+                opt.clear_grad()
+                loss = ((lin(X) - 3.0) ** 2).sum() * scale
+                loss.backward()
+                opt.step()
+            traj.append(float(lin.weight.numpy()[0, 0]))
+        np.testing.assert_allclose(traj[0], traj[1], rtol=1e-6)
+
+    def test_convergence(self):
+        lin = pt.nn.Linear(2, 1)
+        opt = Rprop(learning_rate=0.05, parameters=lin.parameters())
+        X = pt.to_tensor(np.random.RandomState(2).randn(32, 2)
+                         .astype(np.float32))
+        y = pt.to_tensor((X.numpy() @ np.array([[1.0], [2.0]], np.float32)))
+        for _ in range(80):
+            opt.clear_grad()
+            loss = ((lin(X) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+        assert float(loss.numpy()) < 1e-3
