@@ -62,6 +62,7 @@ from . import sysconfig  # noqa: F401
 from . import hub  # noqa: F401
 from .batch import batch  # noqa: F401
 from .hapi import Model  # noqa: F401
+from .hapi import callbacks  # noqa: F401  (reference: paddle.callbacks)
 from .framework import (  # noqa: F401
     save, load, set_device, get_device, device_count, is_compiled_with_cuda,
     is_compiled_with_xpu, is_compiled_with_rocm, in_dynamic_mode, CPUPlace,

@@ -555,6 +555,18 @@ def _vjp_cache_key(fn, static_kwargs, arrs):
     # constants ride by value, and anything else — notably callable
     # INSTANCES whose mutable state an identity key cannot see — demotes
     # to raw, mirroring the care taken above for closure cells.
+    #
+    # KNOWN LIMIT (advisor r4, one level deep by design): a global plain
+    # FUNCTION is keyed only by identity — the globals IT reads are not
+    # folded in. `def op(a): return helper(a)` with `def helper(a): return
+    # a * K` replays a stale forward after K is rebound in helper's module
+    # (pinned by tests/test_vjp_cache.py::TestGlobalsGuard::
+    # test_transitive_global_limit_pinned). Recursing over every reachable
+    # function's co_names would make
+    # key construction O(call-graph) on each eager op — the hot dispatch
+    # path — for a pattern that module-level jit caches (jax included)
+    # also don't track. Rebinding module state mid-training is the bug;
+    # use Tensor/array arguments for values that change.
     gvals = ()
     if co_names:
         gns = fn.__globals__

@@ -6,7 +6,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau"]
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "VisualDL",
+           "WandbCallback", "ScalarWriter"]
 
 
 class Callback:
@@ -154,3 +155,104 @@ class ReduceLROnPlateau(LRScheduler):
         s = self._sched()
         if s is not None and logs and self.monitor in logs:
             s.step(np.mean(logs[self.monitor]))
+
+
+class ScalarWriter:
+    """Append-only JSONL scalar sink shared by the monitoring callbacks:
+    one line per scalar — {"tag", "step", "value", "wall_time"} — a format
+    any dashboard (or pandas.read_json(lines=True)) ingests directly.
+    Chosen over TensorBoard event files deliberately: this environment has
+    zero egress and no dashboard service, so the artifact must be readable
+    with nothing but the standard library."""
+
+    def __init__(self, log_dir):
+        import os
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, "scalars.jsonl")
+        self._f = open(self._path, "a", buffering=1)  # line-buffered
+
+    def add_scalar(self, tag, value, step):
+        import json
+        self._f.write(json.dumps({
+            "tag": str(tag), "step": int(step), "value": float(value),
+            "wall_time": time.time()}) + "\n")
+
+    def close(self):
+        self._f.close()
+
+
+class _ScalarExportBase(Callback):
+    """Shared logic: pull numeric entries out of `logs` at batch/epoch
+    boundaries and forward them to a ScalarWriter."""
+
+    _writer = None
+    _log_every = 10
+
+    def _emit(self, prefix, logs, step):
+        if self._writer is None or not logs:
+            return
+        for k, v in logs.items():
+            v = np.asarray(v).reshape(-1)
+            if v.size and np.issubdtype(v.dtype, np.number):
+                self._writer.add_scalar(f"{prefix}/{k}", float(v[0]), step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step = step
+        if step % self._log_every == 0:
+            self._emit("train", logs, step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._emit("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._emit("eval", logs, getattr(self, "_step", 0))
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class VisualDL(_ScalarExportBase):
+    """Reference-parity monitoring callback (hapi/callbacks.py:977):
+    `VisualDL(log_dir)` exports train/eval scalars during fit(). The
+    backend is the local JSONL ScalarWriter (the visualdl package and its
+    web panel need network/service infrastructure this target lacks);
+    the callback surface — construction, hook points, per-tag scalars
+    with steps — matches the reference."""
+
+    def __init__(self, log_dir="vdl_log", log_every=10):
+        self._log_dir = log_dir
+        self._log_every = int(log_every)
+
+    def on_train_begin(self, logs=None):
+        self._writer = ScalarWriter(self._log_dir)
+
+
+class WandbCallback(_ScalarExportBase):
+    """Reference-parity W&B callback (hapi/callbacks.py:1097) running in
+    permanent OFFLINE mode: run metadata + scalars land under `dir` as
+    JSON/JSONL (a `wandb sync`-shaped layout: config.json + scalars.jsonl)
+    — no external service, matching this target's zero-egress contract.
+    Accepts the reference's kwargs; `mode` other than "offline"/"disabled"
+    downgrades to "offline"."""
+
+    def __init__(self, project=None, entity=None, name=None, dir="wandb",
+                 mode=None, job_type=None, log_every=10, **kwargs):
+        self._dir = dir
+        self._log_every = int(log_every)
+        self._disabled = mode == "disabled"
+        self._config = {"project": project or "uncategorized",
+                        "entity": entity, "name": name,
+                        "mode": "disabled" if self._disabled else "offline",
+                        "job_type": job_type, **kwargs}
+
+    def on_train_begin(self, logs=None):
+        if self._disabled:
+            return
+        import json
+        import os
+        os.makedirs(self._dir, exist_ok=True)
+        with open(os.path.join(self._dir, "config.json"), "w") as f:
+            json.dump(self._config, f, indent=1)
+        self._writer = ScalarWriter(self._dir)

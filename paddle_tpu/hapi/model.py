@@ -89,6 +89,7 @@ class Model:
         cbks.set_model(self)
         cbks.on_train_begin()
         it = 0
+        logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -106,11 +107,20 @@ class Model:
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
+            # snapshot TRAIN metrics before evaluate() resets and
+            # re-accumulates them over the eval set
+            ep_logs = {"loss": logs.get("loss")} if "loss" in logs else {}
+            for m in self._metrics:
+                names, vals = _to_list(m.name()), _to_list(m.accumulate())
+                ep_logs.update(zip(names, vals))
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+                cbks.on_eval_begin()
+                eval_res = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=0)
+                cbks.on_eval_end(eval_res)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
-            cbks.on_epoch_end(epoch, {})
+            cbks.on_epoch_end(epoch, ep_logs)
             if self.stop_training or (num_iters is not None and it >= num_iters):
                 break
         cbks.on_train_end()

@@ -39,8 +39,20 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     examples = []
     for s in input_spec:
         if isinstance(s, InputSpec):
-            shape = tuple(1 if d is None or d < 0 else int(d)
-                          for d in s.shape)
+            # FIXED-SHAPE contract (advisor r4): the jaxpr trace bakes
+            # every dim into value_infos and shape-carrying initializers
+            # (Reshape/Expand), so a dynamic dim silently exported as 1
+            # would produce a model that only accepts (or miscomputes at)
+            # that size. Reject loudly; export one model per shape, or use
+            # export_stablehlo whose jax.export path supports symbolic dims.
+            if any(d is None or d < 0 for d in s.shape):
+                raise UnsupportedOnnxExport(
+                    f"InputSpec {s.shape} has a dynamic dim: the ONNX "
+                    "emitter bakes concrete shapes (a dim traced as 1 "
+                    "would be wrong at any other size). Pass concrete "
+                    "dims — one export per shape — or use "
+                    "export_stablehlo for symbolic-shape deployment.")
+            shape = tuple(int(d) for d in s.shape)
             examples.append(np.zeros(shape, s.dtype or np.float32))
         elif isinstance(s, Tensor):
             examples.append(np.asarray(s.numpy()))

@@ -214,34 +214,54 @@ def fused_attention(query, key, value, sparse_mask, key_padding_mask=None,
     if key_padding_mask is None and attn_mask is None:
         from ..ops.block_sparse_attention import compile_pattern
         if block_size:
+            # user tiles must honor the same sublane alignment the auto
+            # search enforces — round up to a multiple of 8 (a 4-wide tile
+            # would hit the misaligned-Mosaic path the old dense fallback
+            # existed to dodge)
+            block_size = max(8, -(-int(block_size) // 8) * 8)
             bs = block_size if T % block_size == 0 else None
-        else:  # largest divisor of T up to 512 (tiles must cover T)
-            bs = next((b for b in range(min(512, T), 0, -1)
+        else:
+            # largest LANE-ALIGNED divisor of T up to 512: tiles must both
+            # cover T and be multiples of 8 (TPU sublane) — T=127's trivial
+            # divisor 127 would make one misaligned 127-wide tile
+            bs = next((b for b in range(min(512, T) & ~7, 7, -8)
                        if T % b == 0), None)
-        if bs is not None and bs >= 8:
-            # memoize the compiled closure ON the mask object: the pattern
-            # arrays are device-resident, and re-reading nnz entries to
-            # host + hashing them per training step would put an O(nnz)
-            # blocking transfer back into the hot path. Sparse tensors are
-            # rebuilt (not mutated) by every op, so object identity is a
-            # sound cache key.
-            memo = getattr(sparse_mask, "_bsa_fn_memo", None)
-            if memo is not None and memo[0] == (T, bs):
-                fn = memo[1]
-            else:
-                fn = compile_pattern(np.asarray(rows), np.asarray(cols), T,
-                                     block_q=bs, block_k=bs)
-                try:
-                    sparse_mask._bsa_fn_memo = ((T, bs), fn)
-                except AttributeError:
-                    pass  # non-Tensor pattern holder without a __dict__
-            out = fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                     jnp.swapaxes(v, 1, 2))
-            return Tensor(jnp.swapaxes(out, 1, 2))
-        import warnings
-        warnings.warn(
-            f"sparse.fused_attention: no usable tile size divides T={T}; "
-            "falling back to the DENSE lowering (O(T²) memory)")
+        if bs is not None:
+            T_eff, pad = T, 0
+        else:
+            # pad-to-tile (VERDICT r4 #8): no tile divides T — pad Q/K/V
+            # to the next multiple of a good MXU tile instead of
+            # densifying to O(T²). Pattern entries never touch padded
+            # rows/cols, so padded KEYS land in partial blocks whose
+            # elementwise masks zero them, and padded QUERY rows sit in
+            # empty blocks (skipped → output 0) and are sliced away:
+            # O(T·block) memory at ANY T.
+            bs = block_size if block_size else 128
+            T_eff = -(-T // bs) * bs
+            pad = T_eff - T
+        # memoize the compiled closure ON the mask object: the pattern
+        # arrays are device-resident, and re-reading nnz entries to
+        # host + hashing them per training step would put an O(nnz)
+        # blocking transfer back into the hot path. Sparse tensors are
+        # rebuilt (not mutated) by every op, so object identity is a
+        # sound cache key.
+        memo = getattr(sparse_mask, "_bsa_fn_memo", None)
+        if memo is not None and memo[0] == (T_eff, bs):
+            fn = memo[1]
+        else:
+            fn = compile_pattern(np.asarray(rows), np.asarray(cols), T_eff,
+                                 block_q=bs, block_k=bs)
+            try:
+                sparse_mask._bsa_fn_memo = ((T_eff, bs), fn)
+            except AttributeError:
+                pass  # non-Tensor pattern holder without a __dict__
+        if pad:
+            widths = ((0, 0), (0, 0), (0, pad), (0, 0))
+            q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
+        out = fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                 jnp.swapaxes(v, 1, 2))
+        out = jnp.swapaxes(out, 1, 2)
+        return Tensor(out[:, :, :T] if pad else out)
     pattern = jnp.zeros((T, T), bool).at[jnp.asarray(rows),
                                          jnp.asarray(cols)].set(True)
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))

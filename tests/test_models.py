@@ -71,6 +71,48 @@ class TestHapiModel:
         model.save(str(tmp_path / "ckpt"))
         model.load(str(tmp_path / "ckpt"))
 
+    def test_metrics_export_callbacks(self, tmp_path):
+        """VisualDL/WandbCallback (reference callbacks.py:977,1097) export
+        train/eval scalars as local JSONL during fit()."""
+        import json
+
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Flatten(), nn.Linear(16, 8), nn.ReLU(),
+                            nn.Linear(8, 4))
+        model = pt.Model(net)
+        model.prepare(pt.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), pt.metric.Accuracy())
+        x = pt.to_tensor(np.random.rand(64, 16).astype(np.float32))
+        y = pt.to_tensor(np.random.randint(0, 4, (64,)))
+        ds = TensorDataset([x, y])
+
+        vdl_dir = str(tmp_path / "vdl")
+        wb_dir = str(tmp_path / "wandb")
+        model.fit(ds, eval_data=ds, batch_size=16, epochs=2, verbose=0,
+                  callbacks=[
+                      pt.callbacks.VisualDL(log_dir=vdl_dir, log_every=1),
+                      pt.callbacks.WandbCallback(project="unit", dir=wb_dir,
+                                                 log_every=1)])
+
+        lines = [json.loads(l) for l in
+                 open(vdl_dir + "/scalars.jsonl")]
+        tags = {l["tag"] for l in lines}
+        assert any(t.startswith("train/loss") for t in tags), tags
+        assert any(t.startswith("train_epoch/") for t in tags), tags
+        assert any(t.startswith("eval/") for t in tags), tags
+        assert all(isinstance(l["value"], float) and "step" in l
+                   for l in lines)
+        cfg = json.load(open(wb_dir + "/config.json"))
+        assert cfg["project"] == "unit" and cfg["mode"] == "offline"
+        assert len(open(wb_dir + "/scalars.jsonl").readlines()) > 0
+        # disabled mode writes nothing
+        import os
+        model.fit(ds, batch_size=16, epochs=1, verbose=0, callbacks=[
+            pt.callbacks.WandbCallback(dir=str(tmp_path / "wb2"),
+                                       mode="disabled")])
+        assert not os.path.exists(str(tmp_path / "wb2"))
+
     def test_fit_learns(self):
         import paddle_tpu.nn as nn
         pt.seed(0)

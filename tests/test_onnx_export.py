@@ -267,3 +267,15 @@ def test_unsupported_opset_rejected():
     with pytest.raises(ValueError):
         ponnx.export(net, "/tmp/pt_onnx_opset", input_spec=[x],
                      opset_version=11)
+
+
+def test_dynamic_input_spec_rejected():
+    # advisor r4: the emitter bakes concrete shapes — a None/negative dim
+    # traced as 1 would silently produce a batch-1-only model
+    import paddle_tpu.onnx as ponnx
+    from paddle_tpu.static import InputSpec
+    net = nn.Linear(4, 4)
+    for shape in ((None, 4), (-1, 4)):
+        with pytest.raises(ponnx.UnsupportedOnnxExport, match="dynamic dim"):
+            ponnx.export(net, "/tmp/pt_onnx_dyn",
+                         input_spec=[InputSpec(shape, "float32")])

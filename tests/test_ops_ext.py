@@ -375,12 +375,22 @@ def test_c_ops_fallback_is_allowlisted():
     assert callable(C.masked_matmul)
     assert callable(C.barrier)
     import paddle_tpu.sparse as sp
-    assert C.fused_attention is sp.fused_attention  # sparse, not incubate
+
+    # advisor r4 medium: reference-parity sparse spellings carry the
+    # sparse_ prefix (sparse/nn/functional/transformer.py:103); the
+    # unprefixed `fused_attention` is the reference's DENSE fused MHA
+    # (fused_transformer.py:810) and must NOT resolve to the sparse op
+    assert C.sparse_fused_attention is sp.fused_attention
+    assert C.sparse_coalesce is sp.coalesce
+    assert C.sparse_sparse_coo_tensor is sp.sparse_coo_tensor  # yaml name
+    assert C.sparse_relu is sp.relu
+    import pytest
+    with pytest.raises(AttributeError):
+        C.fused_attention  # dense fused MHA op ABI: unimplemented → loud
 
     # names living in those namespaces but NOT allowlisted do not resolve
     # (paddle_tpu.sparse.values/indices would shadow a dense-table gap)
-    import pytest
     for bad in ("values", "indices", "batch_norm_", "get_rank",
-                "definitely_not_an_op"):
+                "sparse_values", "sparse_conv3d", "definitely_not_an_op"):
         with pytest.raises(AttributeError):
             getattr(C, bad)

@@ -195,6 +195,40 @@ class TestBlockSparseAttention:
                                    np.asarray(out_dense.numpy()),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_odd_T_pads_to_tile_not_dense(self, recwarn):
+        """VERDICT r4 #8: T=127 (prime — no tile divides it) must run the
+        pad-to-tile block-sparse path, numerically equal to the dense
+        lowering, with NO densify warning."""
+        from paddle_tpu.sparse.csr import fused_attention
+        T = 127
+        q, k, v = self._qkv(T=T)
+        rows, cols = self._band_pattern(T, w=7)
+        mask = self._csr_mask(rows, cols, T)
+        out = fused_attention(q, k, v, mask)
+        out_dense = fused_attention(q, k, v, mask,
+                                    attn_mask=jnp.zeros((T, T)))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(out_dense.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+        assert not [w for w in recwarn.list
+                    if "DENSE" in str(w.message)], "densify warning fired"
+        # compiled closure memoized at the padded geometry (128 tile)
+        assert mask._bsa_fn_memo[0] == (128, 128)
+
+    def test_odd_T_explicit_block_size_pads(self):
+        from paddle_tpu.sparse.csr import fused_attention
+        T = 70
+        q, k, v = self._qkv(T=T)
+        rows, cols = self._band_pattern(T, w=5)
+        mask = self._csr_mask(rows, cols, T)
+        out = fused_attention(q, k, v, mask, block_size=16)  # 70 % 16 != 0
+        out_dense = fused_attention(q, k, v, mask,
+                                    attn_mask=jnp.zeros((T, T)))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(out_dense.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+        assert mask._bsa_fn_memo[0] == (80, 16)  # padded to 5×16 tiles
+
     def test_grads_match_dense(self):
         from paddle_tpu.ops.block_sparse_attention import \
             block_sparse_attention

@@ -160,6 +160,17 @@ def _op_calls_helper(a):
     return HELPER_GLOBAL(a)
 
 
+K_TRANSITIVE = 2.0
+
+
+def _plain_fn_reads_global(a):
+    return a * K_TRANSITIVE
+
+
+def _op_calls_plain_fn(a):
+    return _plain_fn_reads_global(a)
+
+
 class TestGlobalsGuard:
     """advisor r3 medium #3: fn.__globals__ reads must be part of the key
     (or demote to raw) — a rebound module constant must never replay a
@@ -199,6 +210,26 @@ class TestGlobalsGuard:
         HELPER_GLOBAL.k = 2.0
         np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
         np.testing.assert_allclose(np.asarray(o2.numpy()), [9.0])
+
+    def test_transitive_global_limit_pinned(self):
+        """PINS the documented one-level limit (engine.py _vjp_cache_key
+        globals guard, advisor r4): a global plain FUNCTION rides in the
+        key by identity only — globals read by ITS body are invisible, so
+        rebinding them replays the stale compiled forward. If this test
+        starts failing with [9.0], the guard got deeper — update the
+        engine.py comment and flip the assertion."""
+        global K_TRANSITIVE
+        engine._VJP_JIT_CACHE.clear()
+        engine._VJP_CODE_STATS.clear()
+        K_TRANSITIVE = 2.0
+        x = _t([1.0], grad=True)
+        o1 = engine.apply(_op_calls_plain_fn, x, name="gt")
+        K_TRANSITIVE = 9.0
+        o2 = engine.apply(_op_calls_plain_fn, x, name="gt")
+        K_TRANSITIVE = 2.0
+        np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
+        # stale by design: identity key of _plain_fn_reads_global unchanged
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [2.0])
 
     def test_module_global_still_cached(self):
         engine._VJP_JIT_CACHE.clear()
