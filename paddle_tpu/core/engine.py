@@ -607,15 +607,26 @@ def _vjp_cache_key(fn, static_kwargs, arrs):
     return (key0, cells, sk, tuple(sig), defaults, gvals), static_argnums
 
 
-def _tape_vjp(f, fn, static_kwargs, arrs):
-    """(out, vjp_fn) — through the jit cache when the op signature allows."""
+def _bwd_vjp(f, fn, static_kwargs, arrs, cot_tree):
+    """Backward-time vjp through the jit cache: (cots, *arrs) → input
+    grads. The key is computed HERE (not at forward), so the compiled
+    trace and its key always see the same globals — a rebind between
+    forward and backward can never poison the cache (grads then follow
+    the backward-time globals; rebinding module state mid-step is the
+    same documented UB class as the one-level globals guard)."""
     keyinfo = _vjp_cache_key(fn, static_kwargs, arrs)
     if keyinfo is None:
-        return jax.vjp(f, *arrs)
+        # mark the code raw so SUBSEQUENT forwards of this op take the
+        # eager-vjp-at-forward path in apply() — otherwise a keyless hot
+        # op would recompute its forward at every backward (review r5)
+        plan = _fn_plan(fn)
+        if plan is not None:
+            _VJP_RAW_CODES.add(plan[0])
+        return jax.vjp(f, *arrs)[1](cot_tree)
     key, static_argnums = keyinfo
     entry = _VJP_JIT_CACHE.get(key)
     if entry is _VJP_RAW:
-        return jax.vjp(f, *arrs)
+        return jax.vjp(f, *arrs)[1](cot_tree)
     if entry is None:
         # churn guard: a code object that keeps producing fresh keys that
         # are never REUSED (identity-hashed closure contents) would compile
@@ -627,23 +638,69 @@ def _tape_vjp(f, fn, static_kwargs, arrs):
         st[0] += 1
         if st[0] > _VJP_CODE_MISS_CAP and st[0] > 4 * st[1]:
             _VJP_RAW_CODES.add(code)
-            return jax.vjp(f, *arrs)
+            return jax.vjp(f, *arrs)[1](cot_tree)
         if len(_VJP_JIT_CACHE) >= _VJP_CACHE_CAP:
             _VJP_JIT_CACHE.clear()
-        entry = jax.jit(lambda *a, _f=f: jax.vjp(_f, *a),
-                        static_argnums=static_argnums or None)
+        # XLA DCEs the recomputed forward out of this program whenever the
+        # op's backward doesn't need it (matmul, add, …), so deferring the
+        # vjp usually adds no backward flops
+        entry = jax.jit(lambda cots, *a, _f=f: jax.vjp(_f, *a)[1](cots),
+                        static_argnums=tuple(
+                            i + 1 for i in static_argnums) or None)
         _VJP_JIT_CACHE[key] = entry
     else:
         st = _VJP_CODE_STATS.get(key[0])
         if st is not None:
             st[1] += 1
     try:
-        return entry(*arrs)
+        return entry(cot_tree, *arrs)
     except Exception:
         # abstract tracing failed (value-dependent python control flow):
         # poison this key, run the concrete-trace path
         _VJP_JIT_CACHE[key] = _VJP_RAW
-        return jax.vjp(f, *arrs)
+        return jax.vjp(f, *arrs)[1](cot_tree)
+
+
+class _LazyVjp:
+    """Tape-node vjp evaluated at BACKWARD time (VERDICT r4 #6): forward
+    dispatch runs the primal only — no residual computation, no extra
+    output buffers to wrap — so grad-enabled dispatch costs what no_grad
+    costs plus node wiring. Holds the inputs (which the node's raw_args
+    pins anyway for create_graph) instead of vjp residuals: strictly less
+    memory than the eager-vjp design it replaces."""
+
+    __slots__ = ("f", "plain_fn", "static_kwargs", "arrs", "treedef")
+
+    def __init__(self, f, plain_fn, static_kwargs, arrs, treedef):
+        self.f = f
+        self.plain_fn = plain_fn
+        self.static_kwargs = static_kwargs
+        self.arrs = arrs
+        self.treedef = treedef
+
+    def __call__(self, flat_cots):
+        cot_tree = (flat_cots[0] if self.treedef is None
+                    else jax.tree.unflatten(self.treedef, list(flat_cots)))
+        return _bwd_vjp(self.f, self.plain_fn, self.static_kwargs,
+                        self.arrs, cot_tree)
+
+
+class _EagerVjp:
+    """vjp computed AT FORWARD (the pre-lazy design) — used for ops whose
+    key can never cache (bound methods, demoted/keyless codes): deriving
+    lazily would recompute their forward eagerly at every backward with
+    no XLA DCE to erase it."""
+
+    __slots__ = ("vjp_fn", "treedef")
+
+    def __init__(self, vjp_fn, treedef):
+        self.vjp_fn = vjp_fn
+        self.treedef = treedef
+
+    def __call__(self, flat_cots):
+        cot_tree = (flat_cots[0] if self.treedef is None
+                    else jax.tree.unflatten(self.treedef, list(flat_cots)))
+        return self.vjp_fn(cot_tree)
 
 
 def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **static_kwargs):
@@ -671,6 +728,7 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
     tensor_inputs = []  # parallel list: Tensor or None
     any_requires = False
     any_tracer = False
+    any_dist = False
     for a in args:
         if isinstance(a, Tensor):
             arrs.append(_reduced_if_partial(a))
@@ -679,6 +737,8 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
                 any_requires = True
             if _is_tracer(a._value):
                 any_tracer = True
+            if a._dist is not None:
+                any_dist = True
         else:
             arrs.append(a)
             tensor_inputs.append(None)
@@ -690,12 +750,10 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
     # per-op SPMD rule (general custom-rule surface; the reference's
     # InferSpmd→reshard→local-kernel contract, dist_api_gen.py:49-201)
     posthook = None
-    if name:
+    if name and any_dist:   # rule lookup skipped entirely off the dist path
         from ..distributed import spmd_rules as _spmd
         rule = _spmd.get_spmd_rule(name)
-        if rule is not None and any(
-                t is not None and getattr(t, "_dist", None) is not None
-                for t in tensor_inputs):
+        if rule is not None:
             arrs, posthook = _spmd.apply_rule(rule, tensor_inputs, arrs,
                                               static_kwargs)
 
@@ -713,17 +771,32 @@ def apply(fn: Callable, *args, n_outs: int | None = None, name: str = "", **stat
         wrapped = wrap_output(out, stop_gradient=not (any_requires and grad_enabled()))
         return _finish(wrapped)
 
-    out, vjp_fn = _tape_vjp(f, fn, static_kwargs, arrs)
+    plan = _fn_plan(fn)
+    if plan is None or plan[0] in _VJP_RAW_CODES:
+        # known-raw op (bound method / demoted / keyless): derive the vjp
+        # NOW from the single forward run — lazy derivation would pay the
+        # forward again, eagerly, at every backward
+        out, vjp_fn = jax.vjp(f, *arrs)
+        lazy = None
+    else:
+        out = f(*arrs)      # primal only; the vjp is derived at backward
+        lazy = True
     _check_nan_inf(name, out)
-    leaves, treedef = jax.tree.flatten(out)
+    if isinstance(out, jax.Array):  # the overwhelmingly common single-array
+        leaves, treedef = [out], None   # case skips pytree machinery
+    else:
+        leaves, treedef = jax.tree.flatten(out)
     node = GradNode(
-        _TreeVjp(vjp_fn, treedef),
+        (_LazyVjp(f, fn, static_kwargs, arrs, treedef) if lazy
+         else _EagerVjp(vjp_fn, treedef)),
         tensor_inputs,
         [(l.shape, l.dtype) for l in leaves],
         name=name,
         fn=f,
         raw_args=arrs,
     )
+    if treedef is None:
+        return _finish(Tensor(out, stop_gradient=False, _node=(node, 0)))
     out_tensors = [Tensor(l, stop_gradient=False, _node=(node, i)) for i, l in enumerate(leaves)]
     return _finish(jax.tree.unflatten(treedef, out_tensors))
 
@@ -775,19 +848,6 @@ def _propagate_dist(out_tree, tensor_inputs):
 
     jax.tree.map(setd, out_tree, is_leaf=lambda x: isinstance(x, Tensor))
     return out_tree
-
-
-class _TreeVjp:
-    """Adapts a pytree-output vjp_fn to flat-list cotangents."""
-
-    __slots__ = ("vjp_fn", "treedef")
-
-    def __init__(self, vjp_fn, treedef):
-        self.vjp_fn = vjp_fn
-        self.treedef = treedef
-
-    def __call__(self, flat_cots):
-        return self.vjp_fn(jax.tree.unflatten(self.treedef, list(flat_cots)))
 
 
 _flag_value = None

@@ -96,7 +96,9 @@ LOGICAL_RULES = {
     "fsdp": ("fsdp", "sharding", "dp"),
     "expert": ("ep", "dp"),
     "batch": ("dp", "fsdp"),
-    "seq": ("sp", "tp", "mp"),  # sequence (Megatron-SP / context parallel)
+    # sequence: a context-parallel "sep" axis wins (ring attention keeps
+    # seq sharded THROUGH attention); else Megatron-SP over tp
+    "seq": ("sep", "sp", "tp", "mp"),
 }
 
 # param name → logical axes per dim (leading 'stack' dim for layer-stacked
@@ -250,13 +252,19 @@ def _rmsnorm(x, w, eps):
     return (x32 * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(x.dtype)
 
 
-def _attention(q, k, v, config, use_flash=True):
-    """q:[B,T,H,hd] k,v:[B,T,KV,hd] causal."""
+def _expand_gqa(k, v, config):
+    """Repeat kv heads up to the query head count (GQA → MHA layout)."""
     H, KV = config.num_attention_heads, config.num_key_value_heads
     if KV != H:
         rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def _attention(q, k, v, config, use_flash=True):
+    """q:[B,T,H,hd] k,v:[B,T,KV,hd] causal."""
+    k, v = _expand_gqa(k, v, config)
     if use_flash:
         # Pallas kernel on TPU, XLA reference otherwise — the fallback
         # predicate lives in flash_attention_raw, not here
@@ -321,8 +329,18 @@ def _decoder_layer(x, lp, config, mesh, positions):
     k = (h @ lp["wk"]).reshape(B, T, c.num_key_value_heads, c.head_dim)
     v = (h @ lp["wv"]).reshape(B, T, c.num_key_value_heads, c.head_dim)
     q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
-    q = cst(q, "bthd")  # heads sharded on tp (attention region: seq gathered)
-    att = _attention(q, k, v, c)
+    if mesh is not None and "sep" in mesh_axes and mesh.shape["sep"] > 1:
+        # context parallelism: seq stays sharded on `sep` straight through
+        # attention via the ring kernel (ppermute over the sep axis, online
+        # softmax — ops/ring_attention.py). shard_map is manual ONLY over
+        # sep; dp/tp remain GSPMD-automatic, so this composes with the
+        # batch/heads shardings unchanged.
+        from ..ops.ring_attention import ring_attention_sharded
+        k, v = _expand_gqa(k, v, c)
+        att = ring_attention_sharded(q, k, v, mesh, "sep", causal=True)
+    else:
+        q = cst(q, "bthd")  # heads on tp (attention region: seq gathered)
+        att = _attention(q, k, v, c)
     # named residual hook for save_only_these_names remat experiments; the
     # default policy (dots_saveable, see remat_policy) does NOT save it —
     # saving measured slower on v5e than recomputing the flash kernel

@@ -95,6 +95,20 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
     return out.astype(q.dtype)
 
 
+def ring_attention_sharded(q, k, v, mesh, seq_axis: str = "sep",
+                           causal: bool = False):
+    """Raw-jax (no tape dispatch) ring attention over `mesh`'s seq axis:
+    shard_map manual ONLY over seq_axis — every other mesh axis stays
+    GSPMD-automatic, so this drops into any pjit program (the llama trunk
+    uses it directly). q/k/v: [B, T, H, D], equal head counts."""
+    spec = P(None, seq_axis)
+    return jax.shard_map(
+        functools.partial(ring_attention_local, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({seq_axis}), check_vma=False)(q, k, v)
+
+
 def ring_attention(query, key, value, mesh=None, seq_axis: str = "sep",
                    causal: bool = False):
     """Global [B, T, H, D] tensors (seq sharded or shardable on `seq_axis`) →
@@ -103,14 +117,9 @@ def ring_attention(query, key, value, mesh=None, seq_axis: str = "sep",
 
     mesh = mesh or get_mesh()
     jm = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
-    spec = P(None, seq_axis)
 
     def f(q, k, v):
-        local = jax.shard_map(
-            functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal),
-            mesh=jm, in_specs=(spec, spec, spec), out_specs=spec,
-            axis_names=frozenset({seq_axis}), check_vma=False)
-        return local(q, k, v)
+        return ring_attention_sharded(q, k, v, jm, seq_axis, causal)
 
     return apply(f, query, key, value, name="flash_attention")
 

@@ -1,7 +1,11 @@
-"""The eager tape's vjp jit-cache (core/engine.py _tape_vjp) — the
+"""The eager tape's vjp jit-cache (core/engine.py _bwd_vjp) — the
 dispatch-latency fix (benchmarks/eager_microbench.py: ~1 ms/op → ~100 µs)
 must never trade speed for wrong numerics. These tests pin the safety
-contract the r3 reviews established."""
+contract the r3 reviews established.
+
+r5 lazy-vjp redesign: FORWARD dispatch runs the primal only (never cached,
+never stale); the vjp is derived at BACKWARD through the jit cache, so
+cache-population assertions drive a backward() first."""
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,10 +33,12 @@ class TestCacheHits:
         x = _t([1.0, 2.0], grad=True)
         y = _t([3.0, 4.0])
         before = len(engine._VJP_JIT_CACHE)
-        engine.apply(op, x, y, name="op")
-        engine.apply(op, x, y, name="op")
+        engine.apply(op, x, y, name="op").sum().backward()
+        x.clear_grad()
+        engine.apply(op, x, y, name="op").sum().backward()
         after = len(engine._VJP_JIT_CACHE)
-        assert after == before + 1  # one entry, second call hit
+        # two entries: op's vjp + the sum() node's vjp; second backward hits
+        assert after == before + 2
 
     def test_values_flow_not_baked(self):
         def op(a):
@@ -106,8 +112,8 @@ class TestCacheSafety:
 
         x = _t([2.0], grad=True)
         before = len(engine._VJP_JIT_CACHE)
-        engine.apply(op, x, name="cc")
-        assert len(engine._VJP_JIT_CACHE) == before + 1
+        engine.apply(op, x, name="cc").sum().backward()
+        assert len(engine._VJP_JIT_CACHE) == before + 2  # op + sum nodes
 
     def test_grads_match_raw_path(self):
         # cached-path gradients == raw jax.vjp gradients
@@ -214,22 +220,32 @@ class TestGlobalsGuard:
     def test_transitive_global_limit_pinned(self):
         """PINS the documented one-level limit (engine.py _vjp_cache_key
         globals guard, advisor r4): a global plain FUNCTION rides in the
-        key by identity only — globals read by ITS body are invisible, so
-        rebinding them replays the stale compiled forward. If this test
-        starts failing with [9.0], the guard got deeper — update the
-        engine.py comment and flip the assertion."""
+        key by identity only — globals read by ITS body are invisible.
+        Since the r5 lazy-vjp redesign the FORWARD never caches (always
+        fresh); the stale replay now lives in the BACKWARD jit cache:
+        rebinding the transitive global between backwards replays the old
+        compiled vjp. If the grad assertion starts failing with 9.0, the
+        guard got deeper — update the engine.py comment and flip it."""
         global K_TRANSITIVE
         engine._VJP_JIT_CACHE.clear()
         engine._VJP_CODE_STATS.clear()
         K_TRANSITIVE = 2.0
         x = _t([1.0], grad=True)
         o1 = engine.apply(_op_calls_plain_fn, x, name="gt")
-        K_TRANSITIVE = 9.0
-        o2 = engine.apply(_op_calls_plain_fn, x, name="gt")
-        K_TRANSITIVE = 2.0
         np.testing.assert_allclose(np.asarray(o1.numpy()), [2.0])
-        # stale by design: identity key of _plain_fn_reads_global unchanged
-        np.testing.assert_allclose(np.asarray(o2.numpy()), [2.0])
+        o1.backward()
+        np.testing.assert_allclose(np.asarray(x._grad_value), [2.0])
+        K_TRANSITIVE = 9.0
+        x.clear_grad()
+        o2 = engine.apply(_op_calls_plain_fn, x, name="gt")
+        # forward is primal-only and never cached: always fresh
+        np.testing.assert_allclose(np.asarray(o2.numpy()), [9.0])
+        o2.backward()
+        grad2 = np.asarray(x._grad_value).copy()
+        K_TRANSITIVE = 2.0
+        # stale by design: identity key of _plain_fn_reads_global unchanged,
+        # so the backward jit compiled under K=2 replays for the K=9 step
+        np.testing.assert_allclose(grad2, [2.0])
 
     def test_module_global_still_cached(self):
         engine._VJP_JIT_CACHE.clear()
@@ -240,9 +256,10 @@ class TestGlobalsGuard:
 
         x = _t([0.5], grad=True)
         before = len(engine._VJP_JIT_CACHE)
-        engine.apply(op, x, name="gm")
+        engine.apply(op, x, name="gm").backward()
         assert len(engine._VJP_JIT_CACHE) == before + 1
-        engine.apply(op, x, name="gm")
+        x.clear_grad()
+        engine.apply(op, x, name="gm").backward()
         assert len(engine._VJP_JIT_CACHE) == before + 1  # hit, no new entry
 
 
