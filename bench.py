@@ -118,7 +118,7 @@ def _wait_for_tpu(deadline_s: float) -> bool:
         sleep_s = min(sleep_s * 2.0, 120.0)
 
 
-def _record_latest(payload: dict) -> None:
+def _record_latest(payload: dict, suffix: str = "") -> None:
     """Atomically persist every successful bench result to
     benchmarks/BENCH_latest.json (timestamp + git sha + device) so an
     end-of-round tunnel outage can never again leave the round with zero
@@ -135,7 +135,7 @@ def _record_latest(payload: dict) -> None:
         "git_sha": sha,
         **payload,
     }
-    path = os.path.join(_HERE, "benchmarks", "BENCH_latest.json")
+    path = os.path.join(_HERE, "benchmarks", f"BENCH_latest{suffix}.json")
     tmp = path + ".tmp"
     try:
         with open(tmp, "w") as f:
@@ -190,7 +190,19 @@ def main() -> int:
     dev = jax.devices()[0]
     on_tpu = jax.default_backend() == "tpu"
 
-    if on_tpu:
+    size = os.environ.get("BENCH_MODEL", "850m").lower()
+    if on_tpu and size == "2b":
+        # ~2.1B-param llama (BENCH_MODEL=2b): the scale-proof config
+        # (VERDICT r5 ask #3) — bf16 weights + SR-bf16 Adam moments keep
+        # states ~8.4 GB of 16 GB; B sized so activations (dots remat) fit.
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2560, intermediate_size=8192,
+            num_hidden_layers=22, num_attention_heads=20,
+            num_key_value_heads=20, max_position_embeddings=2048,
+            dtype=jnp.bfloat16)
+        B, T = int(os.environ.get("BENCH_BATCH", 3)), 2048
+        chain, samples = 8, 5
+    elif on_tpu:
         # ~850M-param llama on one 16GB v5e chip. bf16 Adam moments halve
         # optimizer HBM (f32 moments cap the batch at 4); B=6 +
         # dots_saveable remat measured best (benchmarks/ROUND3_PERF.md).
@@ -198,7 +210,7 @@ def main() -> int:
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=14, num_attention_heads=16, num_key_value_heads=16,
             max_position_embeddings=2048, dtype=jnp.bfloat16)
-        B, T = 6, 2048
+        B, T = int(os.environ.get("BENCH_BATCH", 6)), 2048
         chain, samples = 10, 6
     else:  # explicit CPU smoke sizing (BENCH_ALLOW_CPU=1)
         cfg = LlamaConfig.tiny()
@@ -252,11 +264,14 @@ def main() -> int:
             "step_ms_mean": round(dt_mean * 1e3, 2),
             "chain": chain, "samples": samples,
             "device": str(getattr(dev, "device_kind", dev)),
+            "model": size,
             "loss": float(jax.device_get(loss)),
         },
     }
     if on_tpu:
-        _record_latest(result)
+        # non-default sizes record to their own file: the canonical 850M
+        # BENCH_latest.json must not be clobbered by a 2b scale-proof run
+        _record_latest(result, suffix="" if size == "850m" else f"_{size}")
     print(json.dumps(result))
     return 0
 

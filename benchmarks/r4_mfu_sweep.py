@@ -6,8 +6,12 @@ through one 5-step chain (compile + median-ish signal; a winner gets
 promoted into bench.py and re-measured with the full protocol).
 
     python benchmarks/r4_mfu_sweep.py [config ...]
-    configs: blocks:BQxBK  (e.g. blocks:1024x512)
-             remat:off | remat:dots (default)
+    configs: comma-compound fields, e.g. blocks:1024x512,b:8,remat:off
+      blocks:BQxBK   flash tile sizes (e.g. blocks:1024x512)
+      remat:off|dots selective remat policy (default dots)
+      b:N            batch size (default 6)
+Results merge by config tag into benchmarks/MFU_SWEEP_r5.json (re-running
+one config updates its row without clobbering the rest).
 """
 from __future__ import annotations
 
@@ -55,28 +59,60 @@ def run_config(tag, block_q=0, block_k=0, remat=True, B=6):
         embed = 32000 * 2048
         fpt = 6.0 * (n_params - embed) + 6.0 * 14 * 16 * 128 * T
         mfu = fpt * (B * T / dt) / 197e12
-        print(json.dumps({"config": tag, "B": B, "step_ms": round(dt * 1e3, 1),
-                          "honest_mfu": round(mfu, 4)}))
+        rec = {"config": tag, "B": B, "step_ms": round(dt * 1e3, 1),
+               "honest_mfu": round(mfu, 4)}
     except Exception as e:  # OOM etc — record and continue
-        print(json.dumps({"config": tag, "B": B,
-                          "error": str(e).splitlines()[0][:120]}))
+        rec = {"config": tag, "B": B,
+               "error": str(e).splitlines()[0][:120]}
     finally:
         set_flags({"flash_block_q": 0, "flash_block_k": 0})
+    print(json.dumps(rec))
+    return rec
 
 
 def main():
-    specs = sys.argv[1:] or ["blocks:512x512", "blocks:1024x512",
-                             "blocks:512x1024", "blocks:1024x1024",
-                             "blocks:256x512", "remat:off"]
+    # compound specs: comma-joined fields, e.g. blocks:1024x512,b:8,remat:off
+    specs = sys.argv[1:] or [
+        "blocks:512x512", "blocks:1024x512", "blocks:512x1024",
+        "blocks:1024x1024", "blocks:256x512", "blocks:256x256",
+        "remat:off", "blocks:1024x512,b:5", "blocks:1024x512,b:8",
+        "b:5", "b:8",
+    ]
+    results = []
     for s in specs:
-        kind, _, val = s.partition(":")
-        if kind == "blocks":
-            bq, bk = (int(x) for x in val.split("x"))
-            run_config(s, block_q=bq, block_k=bk)
-        elif kind == "remat":
-            run_config(s, remat=(val != "off"))
-        else:
-            print(json.dumps({"config": s, "error": "unknown spec"}))
+        kw = {}
+        bad = None
+        for field in s.split(","):
+            kind, _, val = field.partition(":")
+            if kind == "blocks":
+                bq, bk = (int(x) for x in val.split("x"))
+                kw["block_q"], kw["block_k"] = bq, bk
+            elif kind == "remat":
+                kw["remat"] = val != "off"
+            elif kind == "b":
+                kw["B"] = int(val)
+            else:
+                bad = {"config": s, "error": f"unknown spec field {field!r}"}
+        if bad is not None:
+            print(json.dumps(bad))
+            results.append(bad)       # artifact keeps the same record the
+        else:                         # OOM error path keeps
+            results.append(run_config(s, **kw))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "MFU_SWEEP_r5.json")
+    # merge by config tag: re-measuring one config must not clobber the
+    # previously saved full-sweep table
+    merged = {}
+    try:
+        with open(out) as f:
+            merged = {r["config"]: r for r in json.load(f)}
+    except (OSError, ValueError):
+        pass
+    merged.update({r["config"]: r for r in results if r})
+    with open(out, "w") as f:
+        json.dump(list(merged.values()), f, indent=1)
+        f.write("\n")
+    print(f"# wrote {out}", file=sys.stderr)
 
 
 if __name__ == "__main__":
