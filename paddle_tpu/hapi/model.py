@@ -31,22 +31,65 @@ class Model:
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """amp_configs (reference hapi/model.py prepare): "O1"/"O2" or a
+        dict {"level", "init_loss_scaling", "use_dynamic_loss_scaling",
+        ...}. O1 = auto_cast bf16 compute; O2 = decorate (low-precision
+        weights + f32 masters in the optimizer). Both run fit/train_batch
+        under a GradScaler (a no-op for bf16's range, kept for the
+        reference's f16 contract)."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
+        self._amp_level = "O0"
+        self._scaler = None
+        if amp_configs:
+            cfgs = ({"level": amp_configs} if isinstance(amp_configs, str)
+                    else dict(amp_configs))
+            level = str(cfgs.pop("level", "O1")).upper()
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(f"amp level must be O0/O1/O2, got {level!r}")
+            self._amp_level = level
+            self._amp_dtype = cfgs.pop("dtype", "bfloat16")
+            if level != "O0":
+                from ..amp import GradScaler, decorate
+                self._scaler = GradScaler(
+                    enable=cfgs.pop("use_dynamic_loss_scaling", True),
+                    init_loss_scaling=cfgs.pop("init_loss_scaling", 2.0 ** 16))
+                if level == "O2":
+                    self.network, self._optimizer = decorate(
+                        models=self.network, optimizers=self._optimizer,
+                        level="O2", dtype=self._amp_dtype)
 
     # ---------------- core steps ----------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        outputs = self.network(*inputs)
-        losses = self._loss(*(_to_list(outputs) + labels)) if self._loss else outputs
-        total = losses if isinstance(losses, Tensor) else sum(_to_list(losses))
-        total.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        amp_on = getattr(self, "_amp_level", "O0") != "O0"
+        if amp_on:
+            from ..amp import auto_cast
+            with auto_cast(enable=True, level=self._amp_level,
+                           dtype=getattr(self, "_amp_dtype", "bfloat16")):
+                outputs = self.network(*inputs)
+                losses = self._loss(*(_to_list(outputs) + labels)) \
+                    if self._loss else outputs
+                total = losses if isinstance(losses, Tensor) \
+                    else sum(_to_list(losses))
+            self._scaler.scale(total).backward()
+            if update:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            outputs = self.network(*inputs)
+            losses = self._loss(*(_to_list(outputs) + labels)) \
+                if self._loss else outputs
+            total = losses if isinstance(losses, Tensor) \
+                else sum(_to_list(losses))
+            total.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
         return ([float(l.numpy()) for l in _to_list(losses)], metrics) if metrics \
             else [float(l.numpy()) for l in _to_list(losses)]

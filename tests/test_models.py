@@ -113,6 +113,34 @@ class TestHapiModel:
                                        mode="disabled")])
         assert not os.path.exists(str(tmp_path / "wb2"))
 
+    @pytest.mark.parametrize("level", ["O1", "O2"])
+    def test_fit_amp(self, level):
+        """prepare(amp_configs=...) runs fit under auto_cast (+decorate at
+        O2) with a GradScaler — reference hapi/model.py prepare contract."""
+        import paddle_tpu.nn as nn
+        pt.seed(0)
+        x = np.random.rand(128, 8).astype(np.float32)
+        y = (x @ np.random.rand(8, 1).astype(np.float32))
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        model = pt.Model(net)
+        model.prepare(pt.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+                      nn.MSELoss(), amp_configs=level)
+        assert model._amp_level == level and model._scaler is not None
+        ds = TensorDataset([pt.to_tensor(x), pt.to_tensor(y)])
+        model.fit(ds, batch_size=32, epochs=40, verbose=0)
+        res = model.evaluate(ds, batch_size=64, verbose=0)
+        assert res["loss"][0] < 0.03, res
+        if level == "O2":
+            # decorate cast the weights low-precision; masters live in opt
+            import jax.numpy as jnp
+            assert net[0].weight.dtype in ("bfloat16", jnp.bfloat16)
+
+    def test_prepare_rejects_bad_amp_level(self):
+        model = pt.Model(pt.nn.Linear(2, 2))
+        with pytest.raises(ValueError):
+            model.prepare(amp_configs="O3")
+
     def test_fit_learns(self):
         import paddle_tpu.nn as nn
         pt.seed(0)
