@@ -42,6 +42,7 @@ class Model:
         self._metrics = _to_list(metrics)
         self._amp_level = "O0"
         self._scaler = None
+        self._amp_lists = (None, None)
         if amp_configs:
             cfgs = ({"level": amp_configs} if isinstance(amp_configs, str)
                     else dict(amp_configs))
@@ -50,46 +51,58 @@ class Model:
                 raise ValueError(f"amp level must be O0/O1/O2, got {level!r}")
             self._amp_level = level
             self._amp_dtype = cfgs.pop("dtype", "bfloat16")
+            self._amp_lists = (cfgs.pop("custom_white_list", None),
+                               cfgs.pop("custom_black_list", None))
             if level != "O0":
                 from ..amp import GradScaler, decorate
-                self._scaler = GradScaler(
-                    enable=cfgs.pop("use_dynamic_loss_scaling", True),
-                    init_loss_scaling=cfgs.pop("init_loss_scaling", 2.0 ** 16))
+                scaler_kw = {k: cfgs.pop(k) for k in (
+                    "init_loss_scaling", "incr_ratio", "decr_ratio",
+                    "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                    "use_dynamic_loss_scaling") if k in cfgs}
+                self._scaler = GradScaler(enable=True, **scaler_kw)
                 if level == "O2":
-                    self.network, self._optimizer = decorate(
-                        models=self.network, optimizers=self._optimizer,
-                        level="O2", dtype=self._amp_dtype)
+                    if self._optimizer is not None:
+                        self.network, self._optimizer = decorate(
+                            models=self.network, optimizers=self._optimizer,
+                            level="O2", dtype=self._amp_dtype)
+                    else:  # inference-only prepare: cast the network alone
+                        self.network = decorate(
+                            models=self.network, level="O2",
+                            dtype=self._amp_dtype)
+            if cfgs:
+                raise ValueError(
+                    f"unknown amp_configs keys {sorted(cfgs)} — supported: "
+                    "level, dtype, custom_white_list, custom_black_list, "
+                    "init_loss_scaling, incr_ratio, decr_ratio, "
+                    "incr_every_n_steps, decr_every_n_nan_or_inf, "
+                    "use_dynamic_loss_scaling")
 
     # ---------------- core steps ----------------
     def train_batch(self, inputs, labels=None, update=True):
+        from ..amp import auto_cast
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
         amp_on = getattr(self, "_amp_level", "O0") != "O0"
-        if amp_on:
-            from ..amp import auto_cast
-            with auto_cast(enable=True, level=self._amp_level,
-                           dtype=getattr(self, "_amp_dtype", "bfloat16")):
-                outputs = self.network(*inputs)
-                losses = self._loss(*(_to_list(outputs) + labels)) \
-                    if self._loss else outputs
-                total = losses if isinstance(losses, Tensor) \
-                    else sum(_to_list(losses))
-            self._scaler.scale(total).backward()
-            if update:
-                self._scaler.step(self._optimizer)
-                self._scaler.update()
-                self._optimizer.clear_grad()
-        else:
+        white, black = getattr(self, "_amp_lists", (None, None))
+        with auto_cast(enable=amp_on,
+                       custom_white_list=white, custom_black_list=black,
+                       level=getattr(self, "_amp_level", "O1"),
+                       dtype=getattr(self, "_amp_dtype", "bfloat16")):
             outputs = self.network(*inputs)
             losses = self._loss(*(_to_list(outputs) + labels)) \
                 if self._loss else outputs
             total = losses if isinstance(losses, Tensor) \
                 else sum(_to_list(losses))
-            total.backward()
-            if update:
+        scaler = self._scaler if amp_on else None
+        (scaler.scale(total) if scaler else total).backward()
+        if update:
+            if scaler:
+                scaler.step(self._optimizer)
+                scaler.update()
+            else:
                 self._optimizer.step()
-                self._optimizer.clear_grad()
+            self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
         return ([float(l.numpy()) for l in _to_list(losses)], metrics) if metrics \
             else [float(l.numpy()) for l in _to_list(losses)]

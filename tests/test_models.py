@@ -141,6 +141,35 @@ class TestHapiModel:
         with pytest.raises(ValueError):
             model.prepare(amp_configs="O3")
 
+    def test_prepare_rejects_unknown_amp_key(self):
+        model = pt.Model(pt.nn.Linear(2, 2))
+        with pytest.raises(ValueError, match="unknown amp_configs"):
+            model.prepare(amp_configs={"level": "O1", "typo_key": 1})
+
+    def test_amp_o2_without_optimizer_casts_network(self):
+        # inference-only prepare: decorate() returns just the model
+        import jax.numpy as jnp
+        net = pt.nn.Sequential(pt.nn.Linear(2, 4), pt.nn.Linear(4, 2))
+        model = pt.Model(net)
+        model.prepare(amp_configs="O2")
+        assert model._optimizer is None
+        assert model.network is net  # not silently unpacked into sublayers
+        assert net[0].weight.dtype in ("bfloat16", jnp.bfloat16)
+
+    def test_amp_static_loss_scaling_still_scales(self):
+        # use_dynamic_loss_scaling=False must mean STATIC scaling, not a
+        # disabled scaler (review r5 finding)
+        net = pt.nn.Linear(2, 1)
+        model = pt.Model(net)
+        model.prepare(pt.optimizer.SGD(0.1, parameters=net.parameters()),
+                      pt.nn.MSELoss(),
+                      amp_configs={"level": "O1",
+                                   "use_dynamic_loss_scaling": False,
+                                   "init_loss_scaling": 1024.0})
+        sc = model._scaler
+        assert sc.is_enable() and not sc.is_use_dynamic_loss_scaling()
+        assert float(sc._scale) == 1024.0
+
     def test_fit_learns(self):
         import paddle_tpu.nn as nn
         pt.seed(0)
