@@ -25,6 +25,10 @@ def _to_list(x):
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = _to_list(inputs)  # InputSpecs: save(training=False)
+        self._labels = _to_list(labels)  # kept for reference API parity
+                                         # (static loss wiring is the
+                                         # Engine's job here, not Model's)
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -53,12 +57,17 @@ class Model:
             self._amp_dtype = cfgs.pop("dtype", "bfloat16")
             self._amp_lists = (cfgs.pop("custom_white_list", None),
                                cfgs.pop("custom_black_list", None))
+            # accepted for reference parity; varname-level lists have no
+            # analog in the op-level auto_cast and are ignored
+            cfgs.pop("custom_black_varnames", None)
+            # scaler keys pop unconditionally so {'level': 'O0', ...}
+            # stays accepted (reference _prepare_amp returns early at O0)
+            scaler_kw = {k: cfgs.pop(k) for k in (
+                "init_loss_scaling", "incr_ratio", "decr_ratio",
+                "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                "use_dynamic_loss_scaling") if k in cfgs}
             if level != "O0":
                 from ..amp import GradScaler, decorate
-                scaler_kw = {k: cfgs.pop(k) for k in (
-                    "init_loss_scaling", "incr_ratio", "decr_ratio",
-                    "incr_every_n_steps", "decr_every_n_nan_or_inf",
-                    "use_dynamic_loss_scaling") if k in cfgs}
                 self._scaler = GradScaler(enable=True, **scaler_kw)
                 if level == "O2":
                     if self._optimizer is not None:
@@ -213,9 +222,28 @@ class Model:
 
     # ---------------- persistence ----------------
     def save(self, path, training=True):
+        """training=True: params (+opt state). training=False: the
+        reference's inference-model export (hapi/model.py:1858
+        _save_inference_model) — traces the network over the InputSpecs
+        given at construction and writes the StableHLO artifact via
+        static.save_inference_model (the TPU-native deployment format)."""
+        if not training:
+            if not self._inputs:
+                raise ValueError(
+                    "save(training=False) exports an inference model and "
+                    "needs InputSpecs: Model(net, inputs=[InputSpec(...)])")
+            from ..static import Program, save_inference_model
+
+            def fn(*args):
+                self.network.eval()
+                return self.network(*args)
+
+            prog = Program(fn, list(self._inputs))
+            save_inference_model(path, self._inputs, None, program=prog)
+            return
         from ..framework import save
         save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

@@ -136,6 +136,26 @@ class TestHapiModel:
             import jax.numpy as jnp
             assert net[0].weight.dtype in ("bfloat16", jnp.bfloat16)
 
+    def test_save_inference_model(self, tmp_path):
+        """save(training=False) exports the InputSpec-traced StableHLO
+        inference model (reference hapi/model.py:1858)."""
+        from paddle_tpu.static import InputSpec, load_inference_model
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        # dynamic batch (None) exports SYMBOLICALLY: one artifact, any B
+        m = pt.Model(net, inputs=[InputSpec((None, 4), "float32", name="x")])
+        prefix = str(tmp_path / "infer")
+        m.save(prefix, training=False)
+        _, feeds, fn = load_inference_model(prefix)
+        assert feeds == ["x"]
+        for B in (1, 5):
+            x = np.random.rand(B, 4).astype(np.float32)
+            out = np.asarray(fn(x)).reshape(B, 2)
+            np.testing.assert_allclose(
+                out, np.asarray(net(pt.to_tensor(x)).numpy()), rtol=1e-6)
+        with pytest.raises(ValueError, match="InputSpec"):
+            pt.Model(net).save(str(tmp_path / "bad"), training=False)
+
     def test_prepare_rejects_bad_amp_level(self):
         model = pt.Model(pt.nn.Linear(2, 2))
         with pytest.raises(ValueError):
