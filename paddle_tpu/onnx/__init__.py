@@ -36,24 +36,23 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
             f"opset_version {opset_version} unsupported: the emitter "
             "produces opset 13-17 node forms")
 
+    from ..static import symbolic_abstracts
+
+    # dynamic InputSpec dims trace SYMBOLICALLY (advisor r4, shared
+    # helper): value_infos emit dim_param, Reshape targets use ONNX's -1,
+    # and an op that must bake the dim into a constant raises
+    # UnsupportedOnnxExport instead of freezing it at 1. ONE
+    # symbolic_abstracts call for all specs — symbolic dims in a single
+    # trace must share a scope.
+    spec_pos = [i for i, s in enumerate(input_spec)
+                if isinstance(s, InputSpec)]
+    abstracts = symbolic_abstracts([input_spec[i] for i in spec_pos]) \
+        if spec_pos else []
+    abstracts = list(abstracts)
     examples = []
     for s in input_spec:
         if isinstance(s, InputSpec):
-            # FIXED-SHAPE contract (advisor r4): the jaxpr trace bakes
-            # every dim into value_infos and shape-carrying initializers
-            # (Reshape/Expand), so a dynamic dim silently exported as 1
-            # would produce a model that only accepts (or miscomputes at)
-            # that size. Reject loudly; export one model per shape, or use
-            # export_stablehlo whose jax.export path supports symbolic dims.
-            if any(d is None or d < 0 for d in s.shape):
-                raise UnsupportedOnnxExport(
-                    f"InputSpec {s.shape} has a dynamic dim: the ONNX "
-                    "emitter bakes concrete shapes (a dim traced as 1 "
-                    "would be wrong at any other size). Pass concrete "
-                    "dims — one export per shape — or use "
-                    "export_stablehlo for symbolic-shape deployment.")
-            shape = tuple(int(d) for d in s.shape)
-            examples.append(np.zeros(shape, s.dtype or np.float32))
+            examples.append(abstracts.pop(0))
         elif isinstance(s, Tensor):
             examples.append(np.asarray(s.numpy()))
         else:

@@ -90,7 +90,10 @@ def _node(op_type, inputs, outputs, attrs=(), name=""):
 def _value_info(name: str, shape, dtype) -> Msg:
     shp = Msg()
     for d in shape:
-        shp.msg_field(1, Msg().int_field(1, int(d)))
+        if _is_dynamic(d):  # TensorShapeProto.Dimension.dim_param (field 2)
+            shp.msg_field(1, Msg().str_field(2, str(d)))
+        else:
+            shp.msg_field(1, Msg().int_field(1, int(d)))
     ttype = Msg().int_field(1, _dtype_code(dtype)).msg_field(2, shp)
     return Msg().str_field(1, name).msg_field(2, Msg().msg_field(1, ttype))
 
@@ -139,7 +142,51 @@ def _shape_of(var):
 
 
 def _np_i64(vals):
-    return np.asarray(list(vals), np.int64)
+    try:
+        return np.asarray([int(v) for v in vals], np.int64)
+    except Exception as e:  # symbolic dim baked into a non-shape constant
+        raise UnsupportedOnnxExport(
+            f"a dynamic (symbolic) dimension reaches a constant the ONNX "
+            f"graph must bake ({list(map(str, vals))}): {e}") from None
+
+
+def _is_dynamic(d) -> bool:
+    return not isinstance(d, (int, np.integer))
+
+
+def _np_i64_reshape(vals):
+    """Reshape target with at most ONE dynamic dim → ONNX's -1 (inferred);
+    more than one cannot be expressed in a static shape initializer."""
+    out, n_dyn = [], 0
+    for v in vals:
+        if _is_dynamic(v):
+            out.append(-1)
+            n_dyn += 1
+        else:
+            out.append(int(v))
+    if n_dyn > 1:
+        raise UnsupportedOnnxExport(
+            f"Reshape target {list(map(str, vals))} has {n_dyn} dynamic "
+            "dims; ONNX Reshape can infer only one (-1)")
+    return np.asarray(out, np.int64)
+
+
+def _np_i64_expand(tgt, interim):
+    """Expand target: a dynamic dim the input ALREADY has maps to 1 (ONNX
+    Expand keeps the input extent there); expanding a size-1 dim TO a
+    dynamic extent has no static encoding → raise."""
+    out = []
+    for t, i in zip(tgt, interim):
+        if _is_dynamic(t):
+            if _is_dynamic(i) and str(i) == str(t):
+                out.append(1)       # same symbol: broadcast is identity
+            else:
+                raise UnsupportedOnnxExport(
+                    f"Expand to dynamic extent {t} from {i} cannot be "
+                    "encoded as a static ONNX shape initializer")
+        else:
+            out.append(int(t))
+    return np.asarray(out, np.int64)
 
 
 # ---------------------------------------------------------------- emitters
@@ -205,17 +252,17 @@ def _broadcast_in_dim(g, eqn):
     for src_axis, out_axis in enumerate(bdims):
         interim[out_axis] = _shape_of(x)[src_axis]
     if tuple(interim) != _shape_of(x):
-        shp = g.add_const(_np_i64(interim), "shape")
+        shp = g.add_const(_np_i64_reshape(interim), "shape")
         xn = g.emit("Reshape", [xn, shp], None)[0]
     if tuple(interim) != tuple(tgt):
-        shp = g.add_const(_np_i64(tgt), "shape")
+        shp = g.add_const(_np_i64_expand(tgt, interim), "shape")
         g.emit("Expand", [xn, shp], eqn.outvars)
     else:
         g.names[eqn.outvars[0]] = xn
 
 
 def _reshape(g, eqn):
-    shp = g.add_const(_np_i64(eqn.params["new_sizes"]), "shape")
+    shp = g.add_const(_np_i64_reshape(eqn.params["new_sizes"]), "shape")
     g.emit("Reshape", [g.name_of(eqn.invars[0]), shp], eqn.outvars)
 
 
@@ -344,7 +391,18 @@ def to_onnx_bytes(fn, example_args, graph_name="paddle_tpu",
     """Trace fn(*example_args) and serialize an ONNX ModelProto."""
     import jax
 
-    closed = jax.make_jaxpr(fn)(*example_args)
+    try:
+        closed = jax.make_jaxpr(fn)(*example_args)
+    except Exception as e:
+        # symbolic-dim trace failures (value-dependent control flow /
+        # constants baked from a dynamic dim) surface as jax's
+        # InconclusiveDimensionOperation — no public import path, so match
+        # by name; everything else re-raises untouched
+        if type(e).__name__ != "InconclusiveDimensionOperation":
+            raise
+        raise UnsupportedOnnxExport(
+            "an op's python control flow or a baked constant depends on a "
+            f"dynamic (symbolic) dimension: {e}") from None
     jaxpr = closed.jaxpr
     g = _Graph()
     for cv, c in zip(jaxpr.constvars, closed.consts):

@@ -137,6 +137,29 @@ class Executor:
         return [Tensor(o) for o in outs]
 
 
+def symbolic_abstracts(specs):
+    """InputSpecs → abstract avals, lowering -1/None dims as jax.export
+    SYMBOLIC shapes — the traced artifact then accepts any size at those
+    dims (the reference's -1-batch idiom). One shared scope; a distinct
+    symbol per dynamic dim so unrelated dims never pick up accidental
+    equality constraints. Shared by save_inference_model and onnx.export."""
+    if not any(-1 in s.shape for s in specs):
+        return [s.to_abstract() for s in specs]
+    scope = jax.export.SymbolicScope()
+    abstract, n_sym = [], 0
+    for s in specs:
+        dims = []
+        for d in s.shape:
+            if d == -1:
+                dims.append(jax.export.symbolic_shape(
+                    f"dyn{n_sym}", scope=scope)[0])
+                n_sym += 1
+            else:
+                dims.append(d)
+        abstract.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+    return abstract
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
     """Serialize a compiled inference function: StableHLO via jax.export +
@@ -146,26 +169,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         raise ValueError("no program callable to export")
     specs = feed_vars if feed_vars and isinstance(feed_vars[0], InputSpec) \
         else program.input_specs
-    if any(-1 in s.shape for s in specs):
-        # dynamic dims export SYMBOLICALLY (jax.export symbolic shapes) —
-        # the artifact then accepts any size at those dims, matching the
-        # reference's -1-batch inference models. One shared scope; a
-        # distinct symbol per dynamic dim (no accidental equality
-        # constraints between unrelated dims).
-        scope = jax.export.SymbolicScope()
-        abstract, n_sym = [], 0
-        for s in specs:
-            dims = []
-            for d in s.shape:
-                if d == -1:
-                    dims.append(jax.export.symbolic_shape(
-                        f"_dyn{n_sym}", scope=scope)[0])
-                    n_sym += 1
-                else:
-                    dims.append(d)
-            abstract.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
-    else:
-        abstract = [s.to_abstract() for s in specs]
+    abstract = symbolic_abstracts(specs)
     exported = jax.export.export(jax.jit(program.fn))(*abstract)
     blob = exported.serialize()
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)

@@ -14,12 +14,25 @@ from ..core.tensor import Tensor
 py_slice = slice  # saved before the paddle-style `slice` op shadows the builtin
 
 
+def _int_or_symbolic(x):
+    # symbolic dims (jax.export shape polymorphism — x.shape[0] under a
+    # dynamic-dim trace) pass through: jnp.reshape & friends accept them,
+    # and int() on one raises InconclusiveDimensionOperation
+    try:
+        return int(x)
+    except TypeError:
+        return x
+    except Exception:
+        return x
+
+
 def _ilist(v):
     if isinstance(v, Tensor):
         return tuple(int(x) for x in v.tolist())
     if isinstance(v, (int, np.integer)):
         return (int(v),)
-    return tuple(int(x._value) if isinstance(x, Tensor) else int(x) for x in v)
+    return tuple(_int_or_symbolic(x._value if isinstance(x, Tensor) else x)
+                 for x in v)
 
 
 def reshape(x, shape, name=None):

@@ -269,13 +269,99 @@ def test_unsupported_opset_rejected():
                      opset_version=11)
 
 
-def test_dynamic_input_spec_rejected():
-    # advisor r4: the emitter bakes concrete shapes — a None/negative dim
-    # traced as 1 would silently produce a batch-1-only model
+def test_dynamic_input_spec_exports_dim_param(tmp_path):
+    """advisor r4 (remedy a): dynamic InputSpec dims trace symbolically —
+    value_infos carry dim_param, not a silently-baked batch=1; the model
+    RE-EXECUTES correctly at batches != the traced extent."""
+    import shutil
+    import subprocess
+
     import paddle_tpu.onnx as ponnx
     from paddle_tpu.static import InputSpec
-    net = nn.Linear(4, 4)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
     for shape in ((None, 4), (-1, 4)):
-        with pytest.raises(ponnx.UnsupportedOnnxExport, match="dynamic dim"):
-            ponnx.export(net, "/tmp/pt_onnx_dyn",
-                         input_spec=[InputSpec(shape, "float32")])
+        path = ponnx.export(net, str(tmp_path / "dyn"),
+                            input_spec=[InputSpec(shape, "float32")])
+        data = open(path, "rb").read()
+        assert len(data) > 100
+    # numeric re-execution at B=3 — a regression baking batch=1 into any
+    # shape initializer miscomputes or crashes here
+    x = np.random.rand(3, 4).astype(np.float32)
+    out = _run_onnx(data, {"input_0": x})
+    ref = net(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(np.asarray(out).reshape(3, 2),
+                               np.asarray(ref), rtol=1e-5)
+    if shutil.which("protoc"):
+        proto = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "paddle_tpu", "onnx",
+            "onnx_subset.proto")
+        r = subprocess.run(
+            ["protoc", f"--proto_path={os.path.dirname(proto)}",
+             "--decode=onnx.ModelProto", os.path.basename(proto)],
+            input=data, capture_output=True)
+        assert r.returncode == 0, r.stderr.decode()
+        assert 'dim_param: "dyn0"' in r.stdout.decode()
+
+
+def test_dynamic_flatten_head_exports(tmp_path):
+    # the canonical conv-style head: reshape((batch, -1)) over a dynamic
+    # batch must lower to ONNX Reshape [-1, k], not crash or bake
+    import paddle_tpu.onnx as ponnx
+    from paddle_tpu.static import InputSpec
+
+    class FlattenHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(12, 3)
+
+        def forward(self, x):
+            return self.fc(x.reshape((x.shape[0], -1)))
+
+    net = FlattenHead()
+    path = ponnx.export(net, str(tmp_path / "flat"),
+                        input_spec=[InputSpec((None, 3, 4), "float32")])
+    data = open(path, "rb").read()
+    for B in (1, 5):
+        x = np.random.rand(B, 3, 4).astype(np.float32)
+        out = _run_onnx(data, {"input_0": x})
+        ref = net(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out).reshape(B, 3),
+                                   np.asarray(ref), rtol=1e-5)
+
+
+def test_dynamic_dim_baked_into_const_raises(tmp_path):
+    # an op whose trace bakes/branches on the symbolic dim raises loudly
+    import paddle_tpu.onnx as ponnx
+    from paddle_tpu.static import InputSpec
+
+    class BakesShape(nn.Layer):
+        def forward(self, x):
+            # iota of length batch: the constant depends on the dyn dim
+            return x + pt.arange(x.shape[0]).astype("float32").unsqueeze(-1)
+
+    with pytest.raises(ponnx.UnsupportedOnnxExport):
+        ponnx.export(BakesShape(), str(tmp_path / "bake"),
+                     input_spec=[InputSpec((None, 4), "float32")])
+
+
+def test_symbolic_shape_const_guards():
+    # the new raise paths in export.py, exercised DIRECTLY with symbolic
+    # dims (review r5: they had no coverage via the high-level API)
+    import jax
+    from paddle_tpu.onnx.export import (UnsupportedOnnxExport, _np_i64,
+                                        _np_i64_expand, _np_i64_reshape)
+    d0, d1 = (jax.export.symbolic_shape("a, b", scope=jax.export.SymbolicScope()))
+
+    # reshape: one dynamic dim → -1; two → raise
+    np.testing.assert_array_equal(_np_i64_reshape((d0, 4)), [-1, 4])
+    with pytest.raises(UnsupportedOnnxExport, match="only one"):
+        _np_i64_reshape((d0, d1))
+
+    # expand: same symbol kept (→1); expanding TO a dynamic extent raises
+    np.testing.assert_array_equal(_np_i64_expand((d0, 3), (d0, 1)), [1, 3])
+    with pytest.raises(UnsupportedOnnxExport, match="dynamic extent"):
+        _np_i64_expand((d0, 3), (1, 1))
+
+    # generic constant: symbolic dim cannot bake
+    with pytest.raises(UnsupportedOnnxExport, match="constant"):
+        _np_i64((d0, 2))
