@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate.optimizer (reference: incubate/optimizer/)."""
+from . import functional  # noqa: F401

@@ -1,0 +1,77 @@
+"""Functional optimizers (reference: incubate/optimizer/functional/
+{bfgs,lbfgs}.py — minimize_bfgs/minimize_lbfgs with the 6-/5-element
+result tuples). Thin reference-shaped wrappers over the jittable cores
+in paddle_tpu.optimizer.lbfgs — there the whole optimization (outer
+iteration, strong-Wolfe bracket+zoom, curvature updates) compiles to ONE
+XLA program."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....optimizer.lbfgs import minimize_bfgs as _bfgs_core
+from ....optimizer.lbfgs import minimize_lbfgs as _lbfgs_core
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _unwrap(fn):
+    # the objective receives the RAW jax array (paddle_tpu ops accept
+    # arrays and Tensors alike, jnp ops require arrays); only the output
+    # is unwrapped
+    def f(x):
+        out = fn(x)
+        return out._value if isinstance(out, Tensor) else out
+    return f
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate) — reference bfgs.py:36."""
+    x0 = initial_position._value if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+    H0 = initial_inverse_hessian_estimate
+    if isinstance(H0, Tensor):
+        H0 = H0._value
+    if dtype not in ("float32", "float64"):
+        raise ValueError(f"dtype must be float32/float64, got {dtype!r}")
+    r = _bfgs_core(_unwrap(objective_func), x0, max_iters=max_iters,
+                   tolerance_grad=tolerance_grad,
+                   tolerance_change=tolerance_change,
+                   initial_inverse_hessian=H0,
+                   line_search_fn=line_search_fn,
+                   max_ls=max_line_search_iters,
+                   learning_rate=initial_step_length, dtype=dtype)
+    return (Tensor(r.converged), Tensor(r.num_func_calls), Tensor(r.x),
+            Tensor(r.fun), Tensor(r.grad), Tensor(r.inverse_hessian))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient) — reference lbfgs.py:36.
+    initial_inverse_hessian_estimate is applied as r = H0 @ q in the
+    two-loop recursion, exactly the reference's lbfgs.py:235."""
+    if dtype not in ("float32", "float64"):
+        raise ValueError(f"dtype must be float32/float64, got {dtype!r}")
+    x0 = initial_position._value if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+    H0 = initial_inverse_hessian_estimate
+    if isinstance(H0, Tensor):
+        H0 = H0._value
+    r = _lbfgs_core(_unwrap(objective_func), x0, history_size=history_size,
+                    max_iters=max_iters, tolerance_grad=tolerance_grad,
+                    tolerance_change=tolerance_change,
+                    initial_inverse_hessian=H0,
+                    line_search_fn=line_search_fn,
+                    max_ls=max_line_search_iters,
+                    learning_rate=initial_step_length, dtype=dtype)
+    return (Tensor(r.converged), Tensor(r.num_func_calls), Tensor(r.x),
+            Tensor(r.fun), Tensor(r.grad))

@@ -1,7 +1,7 @@
 """paddle_tpu.optimizer (reference: /root/reference/python/paddle/optimizer/)."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
-from .lbfgs import LBFGS, minimize_lbfgs  # noqa: F401
+from .lbfgs import LBFGS, minimize_bfgs, minimize_lbfgs  # noqa: F401
 from .optimizers import (  # noqa: F401
     ASGD, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
     RAdam, RMSProp, Rprop,

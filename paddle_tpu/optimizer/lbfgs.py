@@ -34,7 +34,7 @@ from jax import lax
 from ..core.tensor import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["LBFGS", "minimize_lbfgs"]
+__all__ = ["LBFGS", "minimize_lbfgs", "minimize_bfgs"]
 
 
 # --------------------------------------------------------------------------
@@ -58,10 +58,57 @@ def _cubic_interpolate(x1, f1, g1, x2, f2, g2, lo, hi):
     return jnp.clip(t, lo, hi)
 
 
-def _direction(g, s_hist, y_hist, rho, k, m):
+def _pinned_vg(fun):
+    """value_and_grad with outputs pinned to the input dtype: with
+    jax_enable_x64 on (package default) a user fun built from float
+    literals returns f64, which would flip the while_loop carry dtypes
+    mid-trace. Shared by minimize_lbfgs and minimize_bfgs."""
+    _vg = jax.value_and_grad(fun)
+
+    def vg(x):
+        f, g = _vg(x)
+        return f.astype(x.dtype), g.astype(x.dtype)
+    return vg
+
+
+def _phi_factory(vg):
+    def phi_at(x, d):
+        def phi(t):
+            f, g = vg(x + t * d)
+            return f, g, jnp.dot(g, d)
+        return phi
+    return phi_at
+
+
+def _descent_guard(g, d, gtd):
+    """Fall back to steepest descent when the (quasi-)Newton direction is
+    not a descent direction (history/estimate gone bad)."""
+    bad = gtd > -1e-12 * jnp.maximum(jnp.dot(g, g), 1e-38)
+    return (jnp.where(bad, -g, d),
+            jnp.where(bad, -jnp.dot(g, g), gtd))
+
+
+def _initial_step(k, g, dtype, learning_rate):
+    """First iteration: scale by 1/|g|_1 (torch's rule); later: lr."""
+    return jnp.where(k == 0,
+                     jnp.minimum(1.0, 1.0 / jnp.maximum(
+                         jnp.sum(jnp.abs(g)), 1e-38)) * learning_rate,
+                     jnp.asarray(learning_rate, dtype))
+
+
+def _stop_pred(g_new, s, f_new, f, tolerance_grad, tolerance_change):
+    return (jnp.max(jnp.abs(g_new)) <= tolerance_grad) | \
+           (jnp.max(jnp.abs(s)) <= tolerance_change) | \
+           (jnp.abs(f_new - f) <= tolerance_change) | \
+           ~jnp.isfinite(f_new)
+
+
+def _direction(g, s_hist, y_hist, rho, k, m, H0=None):
     """Two-loop recursion over a circular history of m slots (slot j%m holds
     iteration j's pair); entries outside [k-m, k) are masked via rho=0.
-    Returns the descent direction -H_k @ g."""
+    Returns the descent direction -H_k @ g. H0: optional initial inverse
+    Hessian — applied as the reference does (r = H0 @ q, no gamma); when
+    None the standard gamma*I scaling is used."""
     q = g
     alphas = jnp.zeros((m,), dtype=g.dtype)
 
@@ -77,11 +124,15 @@ def _direction(g, s_hist, y_hist, rho, k, m):
 
     q, alphas = lax.fori_loop(0, m, loop1, (q, alphas))
 
-    slot_last = jnp.mod(k - 1, m)
-    ys = jnp.dot(s_hist[slot_last], y_hist[slot_last])
-    yy = jnp.dot(y_hist[slot_last], y_hist[slot_last])
-    gamma = jnp.where((k > 0) & (yy > 0), ys / jnp.maximum(yy, 1e-38), 1.0)
-    r_vec = gamma * q
+    if H0 is not None:
+        r_vec = H0 @ q
+    else:
+        slot_last = jnp.mod(k - 1, m)
+        ys = jnp.dot(s_hist[slot_last], y_hist[slot_last])
+        yy = jnp.dot(y_hist[slot_last], y_hist[slot_last])
+        gamma = jnp.where((k > 0) & (yy > 0), ys / jnp.maximum(yy, 1e-38),
+                          1.0)
+        r_vec = gamma * q
 
     def loop2(t, r_vec):
         j = k - m + t                      # oldest first
@@ -218,66 +269,56 @@ class LbfgsResult(NamedTuple):
     grad: jnp.ndarray
     num_iters: jnp.ndarray
     converged: jnp.ndarray
+    num_func_calls: jnp.ndarray = jnp.int32(0)
 
 
 def minimize_lbfgs(fun, x0, *, history_size: int = 10, max_iters: int = 50,
                    tolerance_grad: float = 1e-7,
                    tolerance_change: float = 1e-9,
                    line_search_fn: str = "strong_wolfe",
+                   initial_inverse_hessian=None,
                    initial_step: float = 1.0, max_ls: int = 25,
-                   learning_rate: float = 1.0) -> LbfgsResult:
-    """Jittable L-BFGS: ``fun`` maps a flat f32 vector to a scalar loss.
+                   learning_rate: float = 1.0,
+                   dtype="float32") -> LbfgsResult:
+    """Jittable L-BFGS: ``fun`` maps a flat vector to a scalar loss.
     The entire optimization — outer iteration, two-loop recursion over
     fixed-size circular history buffers, strong-Wolfe bracketing/zoom —
     is compiler-visible control flow, so under ``jax.jit`` it runs as one
-    XLA program with zero host syncs."""
+    XLA program with zero host syncs. initial_inverse_hessian: applied as
+    ``r = H0 @ q`` in the two-loop recursion (reference semantics); when
+    None the standard gamma*I scaling is used. dtype: float32 (default)
+    or float64 (x64 is enabled package-wide)."""
     if line_search_fn not in ("strong_wolfe", None):
         raise ValueError(f"unsupported line_search_fn {line_search_fn!r}")
 
-    x0 = jnp.asarray(x0, dtype=jnp.float32).reshape(-1)
+    x0 = jnp.asarray(x0, dtype=jnp.dtype(dtype)).reshape(-1)
     n, m = x0.shape[0], int(history_size)
-    _vg = jax.value_and_grad(fun)
-
-    def vg(x):
-        # pin the working dtype: with jax_enable_x64 on (package default) a
-        # user fun built from float literals returns f64, which would flip
-        # the while_loop carry dtypes mid-trace
-        f, g = _vg(x)
-        return f.astype(x.dtype), g.astype(x.dtype)
-
+    H0 = (None if initial_inverse_hessian is None
+          else jnp.asarray(initial_inverse_hessian, x0.dtype))
+    vg = _pinned_vg(fun)
+    phi_at = _phi_factory(vg)
     f0, g0 = vg(x0)
-
-    def phi_at(x, d):
-        def phi(t):
-            f, g = vg(x + t * d)
-            return f, g, jnp.dot(g, d)
-        return phi
 
     def cond(st):
         (k, x, f, g, *_h, stop) = st
         return (~stop) & (k < max_iters)
 
     def body(st):
-        (k, x, f, g, s_hist, y_hist, rho, stop) = st
-        d = _direction(g, s_hist, y_hist, rho, k, m)
-        gtd = jnp.dot(g, d)
-        # non-descent direction (history gone bad) → steepest descent
-        bad = gtd > -1e-12 * jnp.maximum(jnp.dot(g, g), 1e-38)
-        d = jnp.where(bad, -g, d)
-        gtd = jnp.where(bad, -jnp.dot(g, g), gtd)
+        (k, x, f, g, s_hist, y_hist, rho, calls, stop) = st
+        d = _direction(g, s_hist, y_hist, rho, k, m, H0)
+        d, gtd = _descent_guard(g, d, jnp.dot(g, d))
 
-        t0 = jnp.where(k == 0,
-                       jnp.minimum(1.0, 1.0 / jnp.maximum(
-                           jnp.sum(jnp.abs(g)), 1e-38)) * learning_rate,
-                       jnp.asarray(learning_rate, x.dtype))
+        t0 = _initial_step(k, g, x.dtype, learning_rate)
         if line_search_fn == "strong_wolfe":
             res = _strong_wolfe_jit(phi_at(x, d), t0, f, g, gtd,
                                     max_ls=max_ls,
                                     tol_change=tolerance_change)
             t, f_new, g_new = res.t, res.f, res.g
+            calls = calls + res.n_evals
         else:
             t = t0
             f_new, g_new = vg(x + t * d)
+            calls = calls + 1
 
         s = t * d
         x_new = x + s
@@ -295,11 +336,10 @@ def minimize_lbfgs(fun, x0, *, history_size: int = 10, max_iters: int = 50,
         # counts iterations; mask instead by zeroing rho for that slot
         rho = jnp.where(keep, rho, rho.at[slot].set(0.0))
 
-        stop_new = (jnp.max(jnp.abs(g_new)) <= tolerance_grad) | \
-                   (jnp.max(jnp.abs(s)) <= tolerance_change) | \
-                   (jnp.abs(f_new - f) <= tolerance_change) | \
-                   ~jnp.isfinite(f_new)
-        return (k + 1, x_new, f_new, g_new, s_hist, y_hist, rho, stop_new)
+        stop_new = _stop_pred(g_new, s, f_new, f, tolerance_grad,
+                              tolerance_change)
+        return (k + 1, x_new, f_new, g_new, s_hist, y_hist, rho, calls,
+                stop_new)
 
     # converged = stopped by a tolerance (grad/step/fchange) with a finite
     # objective — NOT by exhausting max_iters. At f32 the gradient floor of
@@ -308,11 +348,11 @@ def minimize_lbfgs(fun, x0, *, history_size: int = 10, max_iters: int = 50,
 
     st0 = (jnp.int32(0), x0, f0, g0,
            jnp.zeros((m, n), x0.dtype), jnp.zeros((m, n), x0.dtype),
-           jnp.zeros((m,), x0.dtype),
+           jnp.zeros((m,), x0.dtype), jnp.int32(1),
            jnp.max(jnp.abs(g0)) <= tolerance_grad)
-    k, x, f, g, *_h, stop = lax.while_loop(cond, body, st0)
+    k, x, f, g, _s, _y, _r, calls, stop = lax.while_loop(cond, body, st0)
     converged = stop & jnp.isfinite(f)
-    return LbfgsResult(x, f, g, k, converged)
+    return LbfgsResult(x, f, g, k, converged, calls)
 
 
 # --------------------------------------------------------------------------
@@ -527,3 +567,79 @@ class LBFGS(Optimizer):
                               maxlen=self.history_size),
             "ro": deque(sd.get("ro", []), maxlen=self.history_size),
         }
+
+
+class BfgsResult(NamedTuple):
+    x: jnp.ndarray
+    fun: jnp.ndarray
+    grad: jnp.ndarray
+    num_iters: jnp.ndarray
+    num_func_calls: jnp.ndarray
+    converged: jnp.ndarray
+    inverse_hessian: jnp.ndarray
+
+
+def minimize_bfgs(fun, x0, *, max_iters: int = 50,
+                  tolerance_grad: float = 1e-7,
+                  tolerance_change: float = 1e-9,
+                  initial_inverse_hessian=None,
+                  line_search_fn: str = "strong_wolfe",
+                  max_ls: int = 50, learning_rate: float = 1.0,
+                  dtype="float32") -> BfgsResult:
+    """Jittable dense BFGS (Nocedal & Wright Alg. 6.1): the full N×N
+    inverse-Hessian estimate is carried and updated each step —
+    TPU-native answer to the reference's
+    incubate/optimizer/functional/bfgs.py:36 (which builds the same loop
+    out of static-graph while ops). Shares the strong-Wolfe line search
+    with minimize_lbfgs."""
+    if line_search_fn not in ("strong_wolfe", None):
+        raise ValueError(f"unsupported line_search_fn {line_search_fn!r}")
+    x0 = jnp.asarray(x0, dtype=jnp.dtype(dtype)).reshape(-1)
+    n = x0.shape[0]
+    vg = _pinned_vg(fun)
+    phi_at = _phi_factory(vg)
+
+    H0 = (jnp.eye(n, dtype=x0.dtype) if initial_inverse_hessian is None
+          else jnp.asarray(initial_inverse_hessian, x0.dtype))
+    f0, g0 = vg(x0)
+
+    def cond(st):
+        (k, *_rest, stop) = st
+        return (~stop) & (k < max_iters)
+
+    def body(st):
+        (k, x, f, g, H, calls, stop) = st
+        d, gtd = _descent_guard(g, -(H @ g), jnp.dot(g, -(H @ g)))
+        t0 = _initial_step(k, g, x.dtype, learning_rate)
+        if line_search_fn == "strong_wolfe":
+            res = _strong_wolfe_jit(phi_at(x, d), t0, f, g, gtd,
+                                    max_ls=max_ls,
+                                    tol_change=tolerance_change)
+            t, f_new, g_new = res.t, res.f, res.g
+            calls = calls + res.n_evals
+        else:
+            t = t0
+            f_new, g_new = vg(x + t * d)
+            calls = calls + 1
+
+        s = t * d
+        y = g_new - g
+        ys = jnp.dot(y, s)
+        keep = ys > 1e-10
+        rho = 1.0 / jnp.maximum(ys, 1e-38)
+        Hy = H @ y
+        # H' = H + (s.y + y.Hy) ρ² ssᵀ − ρ (Hy sᵀ + s Hyᵀ)   (N&W 6.17)
+        H_new = H \
+            + (ys + jnp.dot(y, Hy)) * (rho * rho) * jnp.outer(s, s) \
+            - rho * (jnp.outer(Hy, s) + jnp.outer(s, Hy))
+        H = jnp.where(keep, H_new, H)
+
+        x_new = x + s
+        stop_new = _stop_pred(g_new, s, f_new, f, tolerance_grad,
+                              tolerance_change)
+        return (k + 1, x_new, f_new, g_new, H, calls, stop_new)
+
+    st0 = (jnp.int32(0), x0, f0, g0, H0, jnp.int32(1),
+           jnp.max(jnp.abs(g0)) <= tolerance_grad)
+    k, x, f, g, H, calls, stop = lax.while_loop(cond, body, st0)
+    return BfgsResult(x, f, g, k, calls, stop & jnp.isfinite(f), H)

@@ -215,3 +215,50 @@ class TestRprop:
             loss.backward()
             opt.step()
         assert float(loss.numpy()) < 1e-3
+
+
+class TestIncubateFunctional:
+    """reference incubate/optimizer/functional/{bfgs,lbfgs}.py:36 —
+    result-tuple parity, jittable cores, scipy-BFGS oracle."""
+
+    def test_minimize_bfgs_rosenbrock(self):
+        from scipy.optimize import minimize as spmin
+
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+        x0 = pt.to_tensor(np.array([-1.2, 1.0], np.float32))
+        conv, calls, pos, val, grad, H = minimize_bfgs(rosenbrock, x0)
+        assert bool(conv.numpy()) and int(calls.numpy()) > 0
+        np.testing.assert_allclose(np.asarray(pos.numpy()), 1.0, atol=1e-3)
+        sp = spmin(lambda x: float(rosenbrock(jnp.asarray(x, jnp.float32))),
+                   [-1.2, 1.0], method="BFGS",
+                   jac=lambda x: np.asarray(
+                       jax.grad(rosenbrock)(jnp.asarray(x, jnp.float32)),
+                       np.float64))
+        np.testing.assert_allclose(np.asarray(pos.numpy()), sp.x, atol=1e-3)
+        # inverse-Hessian estimate is symmetric PSD-ish at the optimum
+        Hn = np.asarray(H.numpy())
+        np.testing.assert_allclose(Hn, Hn.T, atol=1e-5)
+
+    def test_minimize_bfgs_initial_hessian(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+        x0 = pt.to_tensor(np.array([2.0, -3.0], np.float32))
+        fun = lambda x: jnp.sum((x - 1.0) ** 2)
+        conv, _, pos, *_ = minimize_bfgs(
+            fun, x0, initial_inverse_hessian_estimate=0.5 * np.eye(2, dtype=np.float32))
+        assert bool(conv.numpy())
+        np.testing.assert_allclose(np.asarray(pos.numpy()), 1.0, atol=1e-4)
+
+    def test_minimize_lbfgs_tuple(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+        x0 = pt.to_tensor(np.array([-1.2, 1.0, 0.5], np.float32))
+        out = minimize_lbfgs(rosenbrock, x0, history_size=8, max_iters=200)
+        assert len(out) == 5  # reference 5-tuple
+        conv, iters, pos, val, grad = out
+        assert bool(conv.numpy())
+        np.testing.assert_allclose(np.asarray(pos.numpy()), 1.0, atol=1e-3)
+
+    def test_bfgs_jittable(self):
+        from paddle_tpu.optimizer import minimize_bfgs as core
+        jitted = jax.jit(lambda x0: core(rosenbrock, x0, max_iters=100))
+        res = jitted(jnp.array([-1.2, 1.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(res.x), 1.0, atol=1e-3)
