@@ -269,7 +269,10 @@ class LbfgsResult(NamedTuple):
     grad: jnp.ndarray
     num_iters: jnp.ndarray
     converged: jnp.ndarray
-    num_func_calls: jnp.ndarray = jnp.int32(0)
+    num_func_calls: int = 0  # plain int default: a jnp default would
+                             # create a device array AT IMPORT and
+                             # initialize the XLA backend before
+                             # jax.distributed.initialize can run
 
 
 def minimize_lbfgs(fun, x0, *, history_size: int = 10, max_iters: int = 50,

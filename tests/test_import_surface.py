@@ -44,3 +44,24 @@ def test_reference_namespace_spotchecks():
     assert callable(MoELayer)
     from paddle_tpu.device.custom import load_custom_device
     assert callable(load_custom_device)
+
+
+def test_import_does_not_initialize_backend():
+    """`import paddle_tpu` must not create ANY jax array / touch the XLA
+    backend: every multiprocess runner calls jax.distributed.initialize()
+    AFTER importing the package, which jax requires to happen before
+    backend init. (r5 regression: a NamedTuple field default of
+    jnp.int32(0) in optimizer/lbfgs.py initialized the backend at import
+    and broke all mp tests.) Runs in a subprocess — the current process
+    already has a backend."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import paddle_tpu\n"
+         "import jax._src.xla_bridge as xb\n"
+         "import sys\n"
+         "sys.exit(1 if xb._backends else 0)"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (
+        f"importing paddle_tpu initialized an XLA backend\n{r.stderr}")
