@@ -269,10 +269,10 @@ class LbfgsResult(NamedTuple):
     grad: jnp.ndarray
     num_iters: jnp.ndarray
     converged: jnp.ndarray
-    num_func_calls: int = 0  # plain int default: a jnp default would
-                             # create a device array AT IMPORT and
-                             # initialize the XLA backend before
-                             # jax.distributed.initialize can run
+    # real results carry a jnp.int32; the DEFAULT must stay a plain int —
+    # a jnp default would create a device array AT IMPORT and initialize
+    # the XLA backend before jax.distributed.initialize can run
+    num_func_calls: int | jnp.ndarray = 0
 
 
 def minimize_lbfgs(fun, x0, *, history_size: int = 10, max_iters: int = 50,
