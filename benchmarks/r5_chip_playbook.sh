@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-5 chip playbook: run the full chip-side backlog the moment the
+# tunnel answers, committing each artifact IMMEDIATELY so a re-outage
+# can't erase results. Priority order = verdict order: headline bench
+# (BENCH_latest.json) -> MFU sweep -> serving -> 2B scale proof.
+#
+#   bash benchmarks/r5_chip_playbook.sh
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+log() { echo "[playbook $(date -u +%H:%M:%S)] $*"; }
+commit() {  # commit whatever artifacts a stage produced
+    git add benchmarks/ 2>/dev/null
+    git diff --cached --quiet || git commit -q -m "$1"
+}
+
+log "stage 1: headline bench (850M)"
+BENCH_TPU_WAIT_S=600 python bench.py | tee /tmp/bench_850m.json
+commit "bench: r5 headline 850M run (BENCH_latest.json)"
+
+log "stage 2: MFU sweep"
+timeout 3600 python benchmarks/r4_mfu_sweep.py
+commit "bench: r5 MFU sweep table (MFU_SWEEP_r5.json)"
+
+log "stage 3: serving bench (trained-weights parity gate)"
+timeout 2400 python benchmarks/serving_bench.py 16 8 16 \
+    | tee /tmp/serving.json
+commit "bench: r5 serving continuous-batching run"
+
+log "stage 4: 2B scale proof"
+BENCH_TPU_WAIT_S=600 BENCH_MODEL=2b python bench.py \
+    | tee /tmp/bench_2b.json
+commit "bench: r5 2B scale-proof run (BENCH_latest_2b.json)"
+
+log "stage 5: decode bench"
+timeout 1200 python benchmarks/decode_bench.py | tail -1
+commit "bench: r5 decode bench"
+
+log "playbook complete"
