@@ -11,10 +11,16 @@ def batch(reader, batch_size: int, drop_last: bool = False):
         raise ValueError(f"batch_size should be a positive value, but got {batch_size}")
 
     def batch_reader():
+        import os
         buf = []
         for sample in reader():
             buf.append(sample)
             if len(buf) == batch_size:
+                # mirrors resilience.chaos.active(); inline so chaos-free
+                # runs never import the distributed package from here
+                if os.environ.get("PADDLE_CHAOS"):
+                    from .distributed.resilience import chaos
+                    chaos.hit("data.next")
                 yield buf
                 buf = []
         if buf and not drop_last:

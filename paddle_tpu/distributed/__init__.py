@@ -33,6 +33,8 @@ from .engine import Engine, PipelinePlan, Strategy as EngineStrategy  # noqa: F4
 from . import fleet  # noqa: F401
 from . import metric  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import ResilientLoop  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401

@@ -34,34 +34,83 @@ def _np_dtype(name):
 from .metadata import VIEW_DTYPES as _VIEW_OF
 
 
-def _latest_metadata(path, unique_id):
+def _candidate_metadatas(path, unique_id):
+    """Metadata paths to try, newest generation first. A pinned unique_id
+    yields exactly one candidate (no silent fallback past an explicit pin)."""
     if unique_id is not None:
-        return os.path.join(path, f"{int(unique_id)}_metadata.json")
-    best, best_fn = -1, None
+        return [os.path.join(path, f"{int(unique_id)}_metadata.json")]
+    uids = []
     for fn in os.listdir(path):
         if fn.endswith("_metadata.json"):
             try:
-                uid = int(fn.split("_")[0])
+                uids.append(int(fn.split("_")[0]))
             except ValueError:
                 continue
-            if uid > best:
-                best, best_fn = uid, fn
-    if best_fn is None:
-        # pre-generation layout
-        legacy = os.path.join(path, "metadata.json")
-        if os.path.exists(legacy):
-            return legacy
+    out = [os.path.join(path, f"{u}_metadata.json")
+           for u in sorted(uids, reverse=True)]
+    legacy = os.path.join(path, "metadata.json")  # pre-generation layout
+    if os.path.exists(legacy):
+        out.append(legacy)
+    if not out:
         raise FileNotFoundError(f"no checkpoint metadata in {path}")
-    return os.path.join(path, best_fn)
+    return out
+
+
+def verify_generation(path, meta: Metadata):
+    """Reject a torn/partial generation BEFORE any value is assigned:
+    every storage file must exist and match its crc32 manifest entry
+    (generations saved before the manifest existed skip the crc check).
+    Raises ValueError naming exactly what is torn."""
+    from .metadata import crc32_file
+    for key, fn in meta.storage_metadata.items():
+        fp = os.path.join(path, fn)
+        if not os.path.exists(fp):
+            raise ValueError(
+                f"torn checkpoint: storage file {fn!r} (for {key!r}) is "
+                "missing — the save died between write and publish")
+    for fn, want in meta.file_checksums.items():
+        crc = crc32_file(os.path.join(path, fn))
+        if crc != int(want):
+            raise ValueError(
+                f"torn checkpoint: {fn!r} crc32 {crc:#x} != "
+                f"manifest {int(want):#x} — file corrupted after save")
 
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, offload=False):
     """Fills `state_dict`'s tensors in place from the checkpoint at `path`
-    (latest generation unless unique_id pins one)."""
-    with open(_latest_metadata(path, unique_id)) as f:
-        meta = Metadata.from_dict(json.load(f))
+    (latest generation unless unique_id pins one).
 
+    Torn/partial generations (missing shard file, crc-manifest mismatch,
+    unreadable metadata json) are REJECTED up front and the loader falls
+    back to the previous valid generation, with a loud stderr warning. A
+    pinned unique_id never falls back — it raises. FileNotFoundError only
+    when the directory holds no loadable generation at all. Only the
+    VERIFICATION stage decides fallback: errors while filling values (shape
+    mismatch, bad holder type, incomplete shard coverage in otherwise-valid
+    metadata) propagate unchanged — they are caller bugs or semantic
+    corruption, and silently sliding to an older generation would mask
+    them."""
+    import sys
+    errors = []
+    for meta_path in _candidate_metadatas(path, unique_id):
+        try:
+            with open(meta_path) as f:
+                meta = Metadata.from_dict(json.load(f))
+            verify_generation(path, meta)
+        except (OSError, ValueError, KeyError) as e:
+            errors.append((os.path.basename(meta_path), e))
+            print(f"[checkpoint] generation {os.path.basename(meta_path)} "
+                  f"rejected ({type(e).__name__}: {e}); falling back to the "
+                  f"previous generation", file=sys.stderr)
+            continue
+        return _load_generation(state_dict, path, meta)
+    detail = "; ".join(f"{n}: {e}" for n, e in errors)
+    raise FileNotFoundError(
+        f"no valid checkpoint generation in {path} ({detail})")
+
+
+def _load_generation(state_dict, path, meta: Metadata):
     files: dict[str, np.lib.npyio.NpzFile] = {}
 
     def get_file(fn):
@@ -69,6 +118,14 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             files[fn] = np.load(os.path.join(path, fn))
         return files[fn]
 
+    try:
+        return _fill_from(state_dict, meta, get_file)
+    finally:
+        for f in files.values():
+            f.close()
+
+
+def _fill_from(state_dict, meta: Metadata, get_file):
     flat = _flatten_refs(state_dict)
     for name, holder in flat.items():
         shards = meta.state_dict_metadata.get(name)
@@ -122,8 +179,6 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 f"state_dict[{name!r}] holder of type {type(holder).__name__} "
                 "cannot receive a loaded value in place: pass Tensors or "
                 "numpy arrays (bare jax.Array holders are immutable)")
-    for f in files.values():
-        f.close()
     return state_dict
 
 

@@ -17,6 +17,18 @@ VIEW_DTYPES = {"bfloat16": np.uint16, "float8_e4m3fn": np.uint8,
                "float8_e5m2": np.uint8}
 
 
+def crc32_file(path) -> int:
+    """Chunked crc32 of a file's bytes. ONE implementation shared by the
+    saver (manifest write) and loader (torn-generation verify) — two copies
+    drifting would disagree on what a valid checkpoint is."""
+    import zlib
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
 @dataclasses.dataclass
 class LocalTensorMetadata:
     global_offset: tuple
@@ -30,10 +42,16 @@ class LocalTensorMetadata:
 
 @dataclasses.dataclass
 class Metadata:
-    """name → list of (file, LocalTensorMetadata) describing all stored shards."""
+    """name → list of (file, LocalTensorMetadata) describing all stored shards.
+
+    file_checksums: storage file → crc32 of the file bytes at save time —
+    the manifest that lets load reject torn/partial generations (a file the
+    rename never landed, a truncated write) and fall back to the previous
+    valid one instead of deserializing garbage."""
     state_dict_metadata: dict = dataclasses.field(default_factory=dict)
     storage_metadata: dict = dataclasses.field(default_factory=dict)
     flat_mapping: dict = dataclasses.field(default_factory=dict)
+    file_checksums: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self):
         return {
@@ -43,6 +61,7 @@ class Metadata:
             },
             "storage_metadata": self.storage_metadata,
             "flat_mapping": self.flat_mapping,
+            "file_checksums": self.file_checksums,
         }
 
     @classmethod
@@ -59,4 +78,5 @@ class Metadata:
             },
             storage_metadata=d.get("storage_metadata", {}),
             flat_mapping=d.get("flat_mapping", {}),
+            file_checksums=d.get("file_checksums", {}),
         )

@@ -22,9 +22,10 @@ import jax
 import numpy as np
 
 from ...core.tensor import Tensor
-from .metadata import LocalTensorMetadata, Metadata
+from .metadata import LocalTensorMetadata, Metadata, crc32_file
 
 _async_queue: "queue.Queue" = queue.Queue()
+_async_errors: list = []  # failures from the background writer, drained by wait_async_save
 _worker: list = [None]
 
 from .metadata import VIEW_DTYPES as _VIEW_DTYPES
@@ -38,26 +39,55 @@ def _world_size():
 
 
 def _wait_for_files(paths, what, timeout_s=None):
-    """Poll until every path exists — the metadata-merge barrier (the
-    reference barriers before its coordinator gather; a polling wait is the
-    filesystem analog). Raises a NAMED TimeoutError listing what is missing.
-    timeout<=0 (watchdog disabled) waits without deadline."""
-    import time
+    """Backoff-poll until every path exists — the metadata-merge barrier
+    (the reference barriers before its coordinator gather; a polling wait is
+    the filesystem analog). Routed through resilience.retry.wait_for: on
+    expiry a NAMED DeadlineExceeded (a TimeoutError) lists exactly which
+    peers' files never appeared. timeout<=0 (watchdog disabled) waits
+    without deadline."""
     from ..comm_watchdog import default_timeout
+    from ..resilience.retry import wait_for
     t = default_timeout() if timeout_s is None else timeout_s
-    start = time.monotonic()
-    deadline = start + t if t > 0 else None
     missing = list(paths)
-    while missing:
-        missing = [p for p in missing if not os.path.exists(p)]
-        if not missing:
-            return
-        if deadline is not None and time.monotonic() > deadline:
-            waited = time.monotonic() - start
-            raise TimeoutError(
-                f"checkpoint {what}: peers never produced "
-                f"{[os.path.basename(m) for m in missing]} within {waited:.0f}s")
-        time.sleep(0.05)
+
+    def check():
+        missing[:] = [p for p in missing if not os.path.exists(p)]
+        return not missing
+
+    wait_for(check, f"checkpoint {what}", timeout=t if t > 0 else None,
+             describe=lambda: "peers never produced "
+                              f"{[os.path.basename(m) for m in missing]}")
+
+
+def _keep_last_k(keep_last_k=None) -> int:
+    """0 disables GC. Param wins over the PADDLE_CKPT_KEEP env default."""
+    if keep_last_k is not None:
+        return int(keep_last_k)
+    return int(os.environ.get("PADDLE_CKPT_KEEP", "0"))
+
+
+def _gc_generations(path, keep: int):
+    """Keep the newest `keep` PUBLISHED generations; delete every file of
+    older ones (shards, meta pieces, stray .tmp leftovers). Only complete
+    (metadata-published) generations count toward the keep budget, so a
+    torn generation never displaces a valid restore target."""
+    if keep <= 0:
+        return
+    published = sorted(
+        int(fn.split("_")[0]) for fn in os.listdir(path)
+        if fn.endswith("_metadata.json") and fn.split("_")[0].isdigit())
+    if len(published) <= keep:
+        return
+    floor = published[-keep]  # oldest generation that survives
+    for fn in os.listdir(path):
+        head = fn.split("_", 1)[0]
+        # torn generations below the floor go too; anything >= floor (incl.
+        # a concurrent not-yet-published save) is untouchable
+        if "_" in fn and head.isdigit() and int(head) < floor:
+            try:
+                os.remove(os.path.join(path, fn))
+            except OSError:
+                pass
 
 
 def _ensure_worker():
@@ -70,6 +100,8 @@ def _ensure_worker():
                 fn = item
                 try:
                     fn()
+                except BaseException as e:  # surface via wait_async_save
+                    _async_errors.append(e)
                 finally:
                     _async_queue.task_done()
 
@@ -104,7 +136,7 @@ def _next_unique_id(path) -> int:
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, async_save=False):
+                    unique_id=None, async_save=False, keep_last_k=None):
     """state_dict: {name: Tensor | jax.Array | np.ndarray}.
 
     EVERY rank of `process_group` (default: all processes) must call this —
@@ -112,6 +144,14 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     gather. unique_id: save generation; auto-assigned (max existing + 1) when
     None. Reusing a generation that already has merged metadata raises —
     stale rank pieces would otherwise satisfy the merge barrier.
+
+    Robustness contract: every file lands via tmp-write + atomic rename; the
+    merged metadata carries a crc32 manifest of every shard file (load
+    verifies and falls back past torn generations); the shard write is
+    retried on transient IO errors; keep_last_k (or PADDLE_CKPT_KEEP, 0 =
+    off) garbage-collects generations older than the newest K published
+    ones after a successful publish. Chaos sites: `ckpt.write` (before the
+    shard write), `ckpt.rename` (between write and rename).
 
     async_save=True returns immediately; the data write AND the metadata
     publish happen on the background thread (call wait_async_save() before
@@ -170,18 +210,34 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             "fresh unique_id (or None for auto) — reusing one would merge "
             "stale rank metadata")
 
+    checksums: dict[str, int] = {}
+
     def write_data():
         # atomic: a crash mid-write can't leave a truncated npz behind the
-        # published metadata
+        # published metadata. The write itself is retried on transient IO
+        # errors; chaos faults pass through retry untouched (they exercise
+        # the caller's recovery path, see resilience.chaos).
+        from ..resilience import chaos
+        from ..resilience.retry import RetryPolicy, retry_call
         tmp = os.path.join(path, shard_file + ".tmp.npz")
-        np.savez(tmp, **arrays)
-        os.replace(tmp, os.path.join(path, shard_file))
+
+        def write_once():
+            chaos.hit("ckpt.write")
+            np.savez(tmp, **arrays)
+            crc = crc32_file(tmp)
+            chaos.hit("ckpt.rename")  # "crash between write and rename"
+            os.replace(tmp, os.path.join(path, shard_file))
+            checksums[shard_file] = crc
+
+        retry_call(write_once, op=f"ckpt.write {shard_file}",
+                   policy=RetryPolicy(max_attempts=3, base_delay=0.05))
 
     def publish_metadata():
         # every rank writes its piece atomically; the coordinator waits for
         # ALL group pieces before merging; non-coordinators wait for the
         # merged file — completion on any rank means the checkpoint is
         # loadable (VERDICT r1 weak #4: no barrier before merge)
+        meta.file_checksums = dict(checksums)  # the torn-file manifest
         meta_piece = os.path.join(path, f"{uid}_meta_rank{rank}.json")
         tmp = meta_piece + ".tmp"
         with open(tmp, "w") as f:
@@ -200,10 +256,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 for k, v in other["state_dict_metadata"].items():
                     merged["state_dict_metadata"].setdefault(k, []).extend(v)
                 merged["storage_metadata"].update(other["storage_metadata"])
+                merged["file_checksums"].update(
+                    other.get("file_checksums", {}))
             tmp = final_meta + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(merged, f)
             os.replace(tmp, final_meta)
+            _gc_generations(path, _keep_last_k(keep_last_k))
         else:
             _wait_for_files([final_meta], "coordinator merge")
 
@@ -217,7 +276,16 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
 
 def wait_async_save():
+    """Block until queued async saves finish; re-raise the first failure.
+
+    An async save that died (IO error past its retry budget, injected
+    chaos fault) must not look like a published checkpoint — the caller
+    holds a uid that no metadata ever backed."""
     _async_queue.join()
+    if _async_errors:
+        errs = _async_errors[:]
+        _async_errors.clear()  # stale failures must not damn a LATER save
+        raise errs[0]
 
 
 def _flatten(state_dict, prefix=""):

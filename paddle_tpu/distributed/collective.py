@@ -401,7 +401,9 @@ def barrier(group=None):
     Watched: a peer that never arrives produces a named timeout error
     (comm_watchdog), not an eternal hang."""
     from .comm_watchdog import watch
+    from .resilience import chaos
     g = _group(group)
+    chaos.hit("collective.wait")
     with watch("barrier", group=g):
         t = Tensor(jnp.zeros((), jnp.float32))
         all_reduce(t, group=g)
@@ -411,6 +413,8 @@ def barrier(group=None):
 
 def wait(tensor, group=None, use_calc_stream=True):
     from .comm_watchdog import watch
+    from .resilience import chaos
+    chaos.hit("collective.wait")
     with watch("wait", group=group):
         jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
 

@@ -744,3 +744,21 @@ class Engine:
     def state_dict(self):
         """Checkpoint-friendly params in the model's own key layout."""
         return self._external_params()
+
+    # ---- resilience protocol (distributed.resilience.ResilientLoop) ----
+    def resilience_state(self):
+        """Training-layout state for bitwise-exact restore: params (pp:
+        stacked blocks), optimizer accumulators, stateful buffers, and the
+        step counter."""
+        return {"params": self._params, "opt_state": self._opt_state,
+                "buffers": self._buffers,
+                "step": np.asarray(self._step_i, np.int64)}
+
+    def load_resilience_state(self, state):
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]
+        self._buffers = state["buffers"]
+        self._step_i = int(np.asarray(state["step"]))
+
+    def train_step(self, inputs, labels=()):
+        return self.step(inputs, labels)

@@ -172,26 +172,56 @@ class KVServer:
 
 
 class KVRegistry:
-    """Client of a KVServer: heartbeat + membership over HTTP."""
+    """Client of a KVServer: heartbeat + membership over HTTP.
 
-    def __init__(self, endpoint: str, ttl: float = 10.0, timeout: float = 3.0):
+    Every PUT/GET routes through resilience.retry — one dropped HTTP
+    request (tunnel flap, master GC pause) retries with jittered backoff
+    instead of surfacing as a dead node / empty membership."""
+
+    def __init__(self, endpoint: str, ttl: float = 10.0, timeout: float = 3.0,
+                 retry_policy=None):
+        from ..resilience.retry import RetryPolicy
         self.base = endpoint if endpoint.startswith("http") else f"http://{endpoint}"
         self.ttl = ttl
         self.timeout = timeout
+        # budget stays well under the TTL: a heartbeat that retries past
+        # its own expiry is worse than a miss. deadline is only checked
+        # BETWEEN attempts and each attempt can block `timeout` seconds,
+        # so half the ttl leaves the other half for the in-flight request
+        # plus the beat interval before the entry lapses
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay=0.1, max_delay=0.5,
+            deadline=max(1.0, ttl * 0.5))
 
     def heartbeat(self, node_id: str, info=None):
-        req = urllib.request.Request(
-            f"{self.base}/hb/{node_id}", method="PUT",
-            data=json.dumps(info or {}).encode(),
-            headers={"X-Paddle-Job-Token": _kv_token()})
-        urllib.request.urlopen(req, timeout=self.timeout).read()
+        from ..resilience import chaos
+        from ..resilience.retry import retry_call
+
+        def put():
+            chaos.hit("kv.heartbeat")
+            req = urllib.request.Request(
+                f"{self.base}/hb/{node_id}", method="PUT",
+                data=json.dumps(info or {}).encode(),
+                headers={"X-Paddle-Job-Token": _kv_token()})
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+
+        retry_call(put, op=f"kv.heartbeat {node_id}",
+                   policy=self.retry_policy)
 
     def alive_nodes(self):
-        try:
+        from ..resilience.retry import retry_call
+
+        def get():
             with urllib.request.urlopen(f"{self.base}/nodes",
                                         timeout=self.timeout) as r:
                 return json.loads(r.read())
+
+        try:
+            return retry_call(get, op="kv.alive_nodes",
+                              policy=self.retry_policy)
         except Exception:
+            # exhausted budget: report empty so the manager's own-heartbeat
+            # guard (watch() HOLD) treats it as an unreliable read
             return []
 
     def leave(self, node_id: str):
@@ -237,16 +267,18 @@ class ElasticManager:
     # ---- lifecycle ----
     def start(self):
         # the first heartbeat may race a KV master that is still coming up
-        # on node 0 — retry for up to elastic_timeout before giving up
-        deadline = time.time() + self.elastic_timeout
-        while True:
-            try:
-                self.registry.heartbeat(self.node_id)
-                break
-            except Exception:
-                if time.time() >= deadline:
-                    raise
-                time.sleep(self.interval)
+        # on node 0 — retry under a deadline budget before giving up
+        from ..resilience.retry import RetryPolicy, retry_call
+        # should_retry overrides classify: the registry's OWN small retry
+        # budget raises DeadlineExceeded (normally fatal) well inside
+        # elastic_timeout, and this outer loop must keep trying anyway
+        retry_call(self.registry.heartbeat, self.node_id,
+                   op=f"elastic.first-heartbeat {self.node_id}",
+                   policy=RetryPolicy(max_attempts=0,
+                                      base_delay=min(self.interval, 0.5),
+                                      max_delay=self.interval,
+                                      deadline=self.elastic_timeout),
+                   should_retry=lambda e: True)
 
         def beat():
             while not self._stop.wait(self.interval):

@@ -46,6 +46,8 @@ def init_parallel_env():
         port = os.environ.get("MASTER_PORT")
         addr = master if ":" in master or not port else f"{master}:{port}"
         from .comm_watchdog import watch
+        from .resilience import chaos
+        chaos.hit("rendezvous")
         with watch("init_parallel_env/rendezvous"):
             jax.distributed.initialize(
                 coordinator_address=addr,

@@ -32,8 +32,8 @@ __all__ = ["reshard_value", "partial_axes", "shard_map_compat"]
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs, check=False):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=check)
+    from ..utils.jax_compat import shard_map
+    return shard_map(fn, mesh, in_specs, out_specs, check=check)
 
 
 def partial_axes(mesh, placements):

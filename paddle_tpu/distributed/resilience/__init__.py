@@ -1,0 +1,23 @@
+"""paddle_tpu.distributed.resilience — the fault-tolerance layer.
+
+One subsystem that the trainer, engine, checkpoint, and launch layers all
+route through:
+
+  retry    — jittered exponential backoff + deadline budgets + transient-vs-
+             fatal classification for every blocking wait in the runtime
+  chaos    — deterministic env-driven fault injection at named sites
+             (PADDLE_CHAOS="ckpt.rename:1"), so robustness paths run as
+             tier-1 CPU tests
+  preempt  — SIGTERM/SIGINT latch + emergency-checkpoint marker files
+  loop     — ResilientLoop: catch classified-transient failures, restore
+             the last valid checkpoint, resume bitwise-exact
+"""
+from . import chaos  # noqa: F401
+from . import preempt  # noqa: F401
+from .loop import ResilientLoop, RunResult  # noqa: F401
+from .preempt import PreemptionHandler  # noqa: F401
+from .retry import (  # noqa: F401
+    DeadlineExceeded, FatalError, RetryPolicy, TransientError, classify,
+    retry_call, wait_for,
+)
+from .chaos import ChaosError  # noqa: F401

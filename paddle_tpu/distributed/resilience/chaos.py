@@ -1,0 +1,160 @@
+"""Deterministic fault injection at named sites — robustness paths on CPU CI.
+
+The reference Paddle can only exercise its fault machinery (CommTaskManager
+aborts, elastic relaunch) on a live multi-node pod. Here every
+failure-prone boundary in the runtime declares a NAMED chaos site and calls
+``chaos.hit(site)``; the ``PADDLE_CHAOS`` env var (or the ``inject()``
+context manager in tests) decides deterministically which hits fail. That
+makes checkpoint-torn / rendezvous-lost / heartbeat-dropped paths ordinary
+tier-1 CPU tests.
+
+Spec grammar (comma-separated):  ``PADDLE_CHAOS="site:sel[,site:sel...]"``
+  * ``site:3``    fail exactly the 3rd hit at `site` (1-based)
+  * ``site:3+``   fail every hit from the 3rd on
+  * ``site:p0.1`` fail each hit with probability 0.1, seeded by
+                  ``PADDLE_CHAOS_SEED`` + the site name (deterministic
+                  per (seed, site, hit-index) — reruns reproduce exactly)
+
+Known sites (grep `chaos.hit` for ground truth):
+  ckpt.write       before a checkpoint shard file is written
+  ckpt.rename      between the shard tmp-write and its atomic rename
+  collective.wait  before a blocking collective wait/barrier
+  rendezvous       before distributed rendezvous / parallel-env init
+  data.next        before a data-loader batch is handed to the trainer
+  kv.heartbeat     before an elastic KV heartbeat PUT
+
+``ChaosError`` subclasses ``retry.TransientError`` so recovery layers
+(ResilientLoop, checkpoint fallback) treat it like a real transient fault —
+but ``retry_call`` deliberately re-raises it unretried, so an injected
+fault always reaches the outermost recovery boundary instead of being
+absorbed three frames deep (see retry.py docstring).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+from .retry import TransientError
+
+__all__ = ["ChaosError", "hit", "active", "reset", "inject", "hit_counts"]
+
+ENV_VAR = "PADDLE_CHAOS"
+SEED_VAR = "PADDLE_CHAOS_SEED"
+
+
+class ChaosError(TransientError):
+    """The injected fault. Carries the site and the 1-based hit index."""
+
+    def __init__(self, site: str, hit_index: int):
+        self.site, self.hit_index = site, hit_index
+        super().__init__(f"chaos-injected fault at site {site!r} "
+                         f"(hit #{hit_index}, spec {os.environ.get(ENV_VAR)!r})")
+
+
+_lock = threading.Lock()
+_counters: dict[str, int] = {}
+_parsed: tuple[str, dict] | None = None  # (raw env string, parsed plan)
+
+
+def _parse(raw: str) -> dict:
+    plan: dict[str, dict] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"{ENV_VAR} entry {part!r}: expected 'site:selector'")
+        site, sel = part.rsplit(":", 1)
+        site, sel = site.strip(), sel.strip()
+        if sel.startswith("p"):
+            plan[site] = {"kind": "prob", "p": float(sel[1:])}
+        elif sel.endswith("+"):
+            plan[site] = {"kind": "from", "n": int(sel[:-1])}
+        else:
+            plan[site] = {"kind": "exact", "n": int(sel)}
+    return plan
+
+
+def _plan() -> dict:
+    global _parsed
+    raw = os.environ.get(ENV_VAR, "")
+    if _parsed is None or _parsed[0] != raw:
+        _parsed = (raw, _parse(raw) if raw else {})
+    return _parsed[1]
+
+
+def active() -> bool:
+    """Cheap guard for hot paths (data.next): is any injection configured?"""
+    return bool(os.environ.get(ENV_VAR))
+
+
+def hit(site: str) -> int:
+    """Register one arrival at `site`; raise ChaosError when the configured
+    selector matches. Returns the 1-based hit index otherwise. When
+    PADDLE_CHAOS is unset this is a true no-op (no lock, no counting) — the
+    sites live on hot paths (collective waits, data loading)."""
+    if not os.environ.get(ENV_VAR):
+        return 0
+    with _lock:
+        n = _counters.get(site, 0) + 1
+        _counters[site] = n
+    sel = _plan().get(site)
+    if sel is None:
+        return n
+    if sel["kind"] == "exact":
+        fail = n == sel["n"]
+    elif sel["kind"] == "from":
+        fail = n >= sel["n"]
+    else:  # prob: deterministic per (seed, site, hit index)
+        seed = os.environ.get(SEED_VAR, "0")
+        fail = random.Random(f"{seed}:{site}:{n}").random() < sel["p"]
+    if fail:
+        raise ChaosError(site, n)
+    return n
+
+
+def hit_counts() -> dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset():
+    """Clear hit counters (tests)."""
+    global _parsed
+    with _lock:
+        _counters.clear()
+    _parsed = None
+
+
+class inject:
+    """Context manager scoping a chaos spec (and fresh counters) to a test:
+
+        with chaos.inject("ckpt.rename:1"):
+            ...
+    """
+
+    def __init__(self, spec: str, seed: int | None = None):
+        self.spec, self.seed = spec, seed
+        self._saved: dict[str, str | None] = {}
+
+    def __enter__(self):
+        for var, val in ((ENV_VAR, self.spec),
+                        (SEED_VAR, None if self.seed is None else str(self.seed))):
+            self._saved[var] = os.environ.get(var)
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+        reset()
+        return self
+
+    def __exit__(self, *exc):
+        for var, old in self._saved.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+        reset()
+        return False

@@ -1,0 +1,256 @@
+"""ResilientLoop — restartable training with checkpoint-exact recovery.
+
+Wraps any step-able trainable (``models.trainer.LlamaTrainStep``,
+``distributed.engine.Engine``, or anything implementing the small protocol
+below) with the full robustness contract:
+
+  * periodic + final checkpoints through ``distributed.checkpoint`` (atomic,
+    checksummed, keep-last-K);
+  * classified-transient failures (chaos faults, wire/IO blips, watchdog
+    timeouts) restore the last VALID checkpoint and replay — because the
+    step program is deterministic given (state, batch), the recovered
+    trajectory is bitwise identical to a fault-free run (the contract
+    MULTICHIP_r05.json proved: resume_max_rel == 0.0);
+  * SIGTERM/SIGINT latches an emergency save + ``PREEMPTED.json`` marker at
+    the next step boundary, and a relaunch resumes step-exact.
+
+Trainable protocol (duck-typed; adapters exist on LlamaTrainStep/Engine):
+  resilience_state() -> pytree containing a scalar ``step`` leaf
+  load_resilience_state(tree) -> None   (restore, same structure)
+  train_step(*batch) -> loss            (or __call__ / .step fallback)
+
+Data replay: ``run(batch_fn, num_steps)`` pulls ``batch_fn(step)`` — the
+batch for a given global step must be a pure function of the step index so
+a restored run replays the identical batches. (This is the same determinism
+checkpointed data loaders provide; a stateful iterator cannot resume-exact.)
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from . import chaos, preempt
+from .retry import DeadlineExceeded, RetryPolicy, classify
+
+__all__ = ["ResilientLoop", "RunResult"]
+
+
+@dataclasses.dataclass
+class RunResult:
+    steps: int              # global step reached (== num_steps when done)
+    last_loss: float | None
+    restores: int           # transient recoveries performed
+    preempted: bool         # True: stopped on a preemption signal
+    resumed_from: int | None = None  # step a pre-existing checkpoint supplied
+
+
+def _leaf_key(i: int) -> str:
+    return f"leaf{i:05d}"
+
+
+class ResilientLoop:
+    """loop = ResilientLoop(trainable, ckpt_dir); loop.run(batch_fn, steps)"""
+
+    def __init__(self, trainable, ckpt_dir: str, save_every: int = 0,
+                 keep_last_k: int = 3, max_restores: int = 8,
+                 policy: RetryPolicy | None = None, handle_signals: bool = True,
+                 process_group=None):
+        self.trainable = trainable
+        self.ckpt_dir = ckpt_dir
+        self.save_every = int(save_every)
+        self.keep_last_k = keep_last_k
+        self.max_restores = int(max_restores)
+        self.policy = policy or RetryPolicy(max_attempts=0, base_delay=0.05,
+                                            max_delay=1.0)
+        self.process_group = process_group
+        self.preemption = preempt.PreemptionHandler()
+        self._handle_signals = handle_signals
+        self.restores = 0        # lifetime total (reported in RunResult)
+        self._consec = 0         # consecutive failures; reset on progress
+        self._last_good_uid: int | None = None
+
+        if not (hasattr(trainable, "resilience_state")
+                and hasattr(trainable, "load_resilience_state")):
+            raise TypeError(
+                f"{type(trainable).__name__} does not implement the "
+                "resilience protocol (resilience_state/load_resilience_state)")
+        if hasattr(trainable, "train_step"):
+            self._step_fn = trainable.train_step
+        elif hasattr(trainable, "step") and callable(trainable.step):
+            self._step_fn = trainable.step
+        elif callable(trainable):
+            self._step_fn = trainable
+        else:
+            raise TypeError(f"{type(trainable).__name__} is not step-able")
+
+    # ---------------- state <-> checkpoint ----------------
+    def _get_step(self) -> int:
+        tree = self.trainable.resilience_state()
+        return int(np.asarray(tree["step"]))
+
+    def save_checkpoint(self) -> int:
+        """Write one atomic checkpoint generation; returns its unique_id."""
+        from ..checkpoint import save_state_dict
+        tree = self.trainable.resilience_state()
+        leaves, _ = jax.tree.flatten(tree)
+        flat = {_leaf_key(i): v for i, v in enumerate(leaves)}
+        uid = save_state_dict(flat, self.ckpt_dir,
+                              process_group=self.process_group,
+                              keep_last_k=self.keep_last_k)
+        self._last_good_uid = uid
+        return uid
+
+    def restore_checkpoint(self, unique_id=None) -> int | None:
+        """Restore the newest VALID generation (torn ones are skipped by the
+        loader). Returns the restored global step, or None when the
+        directory holds no loadable checkpoint."""
+        from ..checkpoint import load_state_dict
+        tree = self.trainable.resilience_state()
+        leaves, treedef = jax.tree.flatten(tree)
+        holders = {}
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.Array):
+                holders[_leaf_key(i)] = Tensor(leaf)
+            else:
+                holders[_leaf_key(i)] = np.array(leaf)
+        try:
+            load_state_dict(holders, self.ckpt_dir, unique_id=unique_id,
+                            process_group=self.process_group)
+        except FileNotFoundError:
+            return None
+        new_leaves = [h._value if isinstance(h, Tensor) else h
+                      for h in (holders[_leaf_key(i)]
+                                for i in range(len(leaves)))]
+        self.trainable.load_resilience_state(jax.tree.unflatten(treedef,
+                                                                new_leaves))
+        return self._get_step()
+
+    # ---------------- recovery ----------------
+    def _recover(self, exc: Exception, delays):
+        """Transient failure: back off, then restore the last valid
+        checkpoint (or continue from current state when none exists yet —
+        the failure was in saving, nothing has diverged).
+
+        max_restores bounds CONSECUTIVE failures — a long run that
+        recovers, makes progress, and blips again hours later must not
+        die on a lifetime quota (the counter resets on every completed
+        step)."""
+        self.restores += 1
+        self._consec += 1
+        if self._consec > self.max_restores:
+            raise DeadlineExceeded("resilient-loop.recover", self._consec,
+                                   0.0, last=exc) from exc
+        print(f"[resilience] transient failure "
+              f"({type(exc).__name__}: {exc}); recovery "
+              f"{self._consec}/{self.max_restores}", file=sys.stderr)
+        time.sleep(next(delays))
+        restored = self.restore_checkpoint()
+        if restored is not None:
+            print(f"[resilience] restored checkpoint at step {restored}",
+                  file=sys.stderr)
+
+    def _emergency_save(self) -> None:
+        uid = None
+        try:
+            uid = self.save_checkpoint()
+        except Exception as e:  # keep the marker even when the save dies
+            print(f"[resilience] emergency save failed ({e}); marker will "
+                  f"point at the last good generation", file=sys.stderr)
+            uid = self._last_good_uid
+        preempt.write_marker(self.ckpt_dir, self._get_step(), unique_id=uid,
+                             signum=self.preemption.signum)
+        print(f"[resilience] preempted: emergency checkpoint uid={uid} "
+              f"step={self._get_step()} marker written", file=sys.stderr)
+
+    # ---------------- the loop ----------------
+    def run(self, batch_fn, num_steps: int, on_step=None) -> RunResult:
+        """Train to ``num_steps`` global steps, recovering along the way.
+
+        batch_fn(step) -> batch (tuple/list of step-fn args, or a single
+        array). on_step(step, loss) observes completed steps.
+        """
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        if self._handle_signals:
+            self.preemption.install()
+        try:
+            return self._run(batch_fn, num_steps, on_step)
+        finally:
+            if self._handle_signals:
+                self.preemption.uninstall()
+
+    def _run(self, batch_fn, num_steps, on_step) -> RunResult:
+        delays = self.policy.delays()
+        last_loss = None
+
+        # resume: a prior run's checkpoint (possibly with a preemption
+        # marker) restores step-exact; otherwise anchor generation 0 so
+        # recovery always has a restore target.
+        resumed_from = self.restore_checkpoint()
+        if resumed_from is not None:
+            print(f"[resilience] resuming from step {resumed_from}"
+                  f"{' (preemption marker)' if preempt.read_marker(self.ckpt_dir) else ''}",
+                  file=sys.stderr)
+            preempt.clear_marker(self.ckpt_dir)
+        else:
+            while True:
+                try:
+                    self.save_checkpoint()
+                    break
+                except Exception as e:
+                    if not classify(e):
+                        raise
+                    self._recover(e, delays)
+
+        step = self._get_step()
+        while step < num_steps:
+            if self.preemption.requested:
+                self._emergency_save()
+                return RunResult(step, _loss_float(last_loss), self.restores,
+                                 True, resumed_from)
+            try:
+                batch = batch_fn(step)
+                if not isinstance(batch, (tuple, list)):
+                    batch = (batch,)
+                loss = self._step_fn(*batch)
+                step = self._get_step()
+                last_loss = loss
+                if self._consec:  # progress: reset failure budget + backoff
+                    self._consec = 0
+                    delays = self.policy.delays()
+                if on_step is not None:
+                    on_step(step, loss)
+                if self.save_every and step < num_steps \
+                        and step % self.save_every == 0:
+                    self.save_checkpoint()
+            except Exception as e:
+                if not classify(e):
+                    raise
+                self._recover(e, delays)
+                step = self._get_step()
+
+        # completion checkpoint: a restart after the run re-loads the final
+        # state instead of retraining
+        while True:
+            try:
+                self.save_checkpoint()
+                break
+            except Exception as e:
+                if not classify(e):
+                    raise
+                self._recover(e, delays)
+        preempt.clear_marker(self.ckpt_dir)
+        return RunResult(step, _loss_float(last_loss), self.restores, False,
+                         resumed_from)
+
+
+def _loss_float(loss):
+    if loss is None:
+        return None
+    return float(jax.device_get(
+        loss._value if isinstance(loss, Tensor) else loss))

@@ -1,0 +1,175 @@
+"""Unified retry/backoff discipline for every blocking wait in the runtime.
+
+Reference capability: the reference Paddle scatters retry behavior across
+gloo store waits, etcd lease refreshes, and ad-hoc `time.sleep` loops
+(fleet/elastic/manager.py, launch/utils/kv_client.py). Here ONE policy
+object owns attempts, jittered exponential backoff, and a total deadline
+budget, and every blocking wait in paddle_tpu (checkpoint file barriers,
+rendezvous, KV heartbeats) routes through it — so a transient blip retries
+with bounded, jittered pacing and a real outage dies with a NAMED error
+instead of a silent hang or an instant false failure.
+
+Error discipline:
+  * ``TransientError`` — marker base class: safe to retry.
+  * ``FatalError`` — marker base class: never retried.
+  * ``classify(exc)`` — transient-vs-fatal for foreign exceptions
+    (ConnectionError / TimeoutError / OSError are transient wire+IO noise;
+    Value/Type/Key errors are bugs and always fatal).
+  * ``DeadlineExceeded`` — raised when the retry budget expires; subclasses
+    TimeoutError and names the op, attempts, and elapsed time.
+  * ``chaos.ChaosError`` is deliberately NEVER absorbed by ``retry_call``:
+    injected faults exist to exercise the *outer* recovery boundary
+    (ResilientLoop restore, checkpoint fallback), so low-level retries must
+    stay transparent to them.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable
+
+__all__ = [
+    "TransientError", "FatalError", "DeadlineExceeded", "RetryPolicy",
+    "classify", "retry_call", "wait_for",
+]
+
+
+class TransientError(Exception):
+    """A failure that is expected to clear on retry (wire/IO blip)."""
+
+
+class FatalError(Exception):
+    """A failure that retrying cannot fix (bad input, corrupt state)."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """Retry/wait budget expired. Carries op name, attempts, elapsed."""
+
+    def __init__(self, op: str, attempts: int, elapsed: float, last=None):
+        self.op, self.attempts, self.elapsed, self.last = \
+            op, attempts, elapsed, last
+        tail = f": last error {type(last).__name__}: {last}" if last else ""
+        super().__init__(
+            f"{op}: retry budget exhausted after {attempts} attempt(s) over "
+            f"{elapsed:.1f}s{tail}")
+
+
+def classify(exc: BaseException) -> bool:
+    """True when `exc` is safe to retry. DeadlineExceeded is the *product*
+    of an exhausted budget, never an input to another retry round."""
+    if isinstance(exc, (DeadlineExceeded, FatalError)):
+        return False
+    if isinstance(exc, TransientError):
+        return True
+    # permanent misconfiguration dressed as IO: retrying a missing path or
+    # a read-only filesystem buries the real error under backoff cycles
+    if isinstance(exc, (FileNotFoundError, PermissionError,
+                        NotADirectoryError, IsADirectoryError)):
+        return False
+    # wire + IO noise (urllib.error.URLError ⊂ OSError; socket.timeout ⊂
+    # TimeoutError ⊂ OSError on 3.10+)
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return True
+    return False
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Jittered exponential backoff with an attempt cap and a deadline budget.
+
+    delay(k) = min(max_delay, base_delay * 2**k), then jittered into
+    [delay*(1-jitter), delay]. `seed` pins the jitter stream (tests,
+    bitwise-reproducible schedules); None uses process entropy.
+    deadline: total wall budget in seconds across all attempts+sleeps
+    (None = attempts-only). max_attempts <= 0 means unlimited attempts
+    (deadline-bounded).
+    """
+    max_attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    deadline: float | None = None
+    jitter: float = 0.5
+    seed: int | None = None
+
+    def delays(self):
+        """Infinite generator of jittered backoff delays."""
+        rng = random.Random(self.seed)
+        k = 0
+        while True:
+            d = min(self.max_delay, self.base_delay * (2.0 ** k))
+            if self.jitter > 0:
+                d *= (1.0 - self.jitter) + self.jitter * rng.random()
+            yield d
+            k += 1
+
+
+# pacing-only defaults for pollers that manage their own deadline
+_POLL = RetryPolicy(max_attempts=0, base_delay=0.02, max_delay=0.5,
+                    deadline=None, jitter=0.25)
+
+
+def retry_call(fn: Callable[..., Any], *args, policy: RetryPolicy | None = None,
+               op: str = "call", should_retry: Callable = classify,
+               on_retry: Callable | None = None, sleep=time.sleep, **kwargs):
+    """Call fn(*args, **kwargs), retrying transient failures under `policy`.
+
+    on_retry(attempt, exc, delay) observes each retry (logging hooks).
+    Raises DeadlineExceeded when the budget expires, or the last error
+    unchanged when it classifies fatal. Chaos-injected errors pass through
+    untouched (see module docstring).
+    """
+    from .chaos import ChaosError
+    pol = policy or RetryPolicy()
+    start = time.monotonic()
+    delays = pol.delays()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except ChaosError:
+            raise  # injected faults target the outer recovery boundary
+        except Exception as e:
+            elapsed = time.monotonic() - start
+            if not should_retry(e):
+                raise
+            out_of_attempts = pol.max_attempts > 0 and attempt >= pol.max_attempts
+            d = next(delays)
+            out_of_time = pol.deadline is not None and \
+                elapsed + d >= pol.deadline
+            if out_of_attempts or out_of_time:
+                raise DeadlineExceeded(op, attempt, elapsed, last=e) from e
+            if on_retry is not None:
+                on_retry(attempt, e, d)
+            sleep(d)
+
+
+def wait_for(predicate: Callable[[], Any], op: str,
+             timeout: float | None = None, policy: RetryPolicy | None = None,
+             describe: Callable[[], str] | None = None, sleep=time.sleep):
+    """Backoff-poll `predicate` until it returns truthy; return its value.
+
+    The replacement for bare `while not done: time.sleep(...)` loops.
+    timeout <= 0 or None means no deadline (poll forever — callers that
+    want that must say so explicitly). On expiry raises DeadlineExceeded,
+    appending `describe()` (e.g. the still-missing files) to the message.
+    A predicate that RAISES is a bug, not a wait — exceptions propagate.
+    """
+    pol = policy or _POLL
+    start = time.monotonic()
+    delays = pol.delays()
+    attempt = 0
+    while True:
+        attempt += 1
+        v = predicate()
+        if v:
+            return v
+        elapsed = time.monotonic() - start
+        if timeout is not None and timeout > 0 and elapsed >= timeout:
+            extra = f" ({describe()})" if describe is not None else ""
+            raise DeadlineExceeded(op + extra, attempt, elapsed)
+        d = next(delays)
+        if timeout is not None and timeout > 0:
+            d = min(d, max(0.0, timeout - elapsed))
+        sleep(d)

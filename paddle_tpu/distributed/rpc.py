@@ -356,16 +356,13 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
         socket.gethostbyname(socket.gethostname())
     _state["scoped_name"] = scoped(name)
-    deadline = time.time() + 60
-    while True:
-        try:
-            reg.heartbeat(scoped(name),
-                          {"rank": rank, "ip": my_ip, "port": port})
-            break
-        except Exception:
-            if time.time() > deadline:
-                raise
-            time.sleep(0.2)
+    from .resilience.retry import RetryPolicy, retry_call
+    retry_call(reg.heartbeat, scoped(name),
+               {"rank": rank, "ip": my_ip, "port": port},
+               op=f"rpc.register {name}",
+               policy=RetryPolicy(max_attempts=0, base_delay=0.2,
+                                  max_delay=2.0, deadline=60.0),
+               should_retry=lambda e: True)
 
     # Wait for the full world. Workers are ACCUMULATED as they appear — a
     # peer that registers, finishes fast, and deregisters (or whose entry
@@ -379,6 +376,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     deadline = time.time() + float(os.environ.get("PADDLE_RPC_TIMEOUT", 300))
     last_beat = 0.0
     t_start = time.time()
+    # discovery pacing: start tight (a freshly-registered peer that finishes
+    # fast deregisters within ~100ms — a flat 0.2s poll can miss it forever),
+    # back off once the world is clearly still assembling
+    from .resilience.retry import RetryPolicy
+    _delays = RetryPolicy(max_attempts=0, base_delay=0.02, max_delay=0.5,
+                          jitter=0.25).delays()
     while len(agent.workers) < world_size:
         now = time.time()
         if now - last_beat > 5:  # keep our own entry fresh past the ttl
@@ -411,7 +414,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         if time.time() > deadline:
             raise TimeoutError(
                 f"rpc rendezvous: {len(agent.workers)}/{world_size} workers")
-        time.sleep(0.2)
+        time.sleep(next(_delays))  # resilience: ok (accumulating poll; deadline + named TimeoutError above)
     return agent
 
 

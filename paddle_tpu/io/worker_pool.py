@@ -103,6 +103,13 @@ def _worker_loop(dataset, task_q, result_q, collate_fn, worker_id,
         return
 
 
+def _chaos_active():
+    # mirrors resilience.chaos.active(); checked inline so chaos-free runs
+    # never import the distributed package from the data path
+    import os
+    return bool(os.environ.get("PADDLE_CHAOS"))
+
+
 class WorkerPool:
     """Spawned worker pool usable across epochs (persistent_workers)."""
 
@@ -113,6 +120,8 @@ class WorkerPool:
         self._result_q = ctx.Queue()
         self.num_workers = num_workers
         self._workers = []
+        self._epoch = 0  # generation token: stale results from an abandoned
+        #                  epoch (chaos fault, consumer bailed) are dropped
         collate = collate_fn or numpy_collate
         for w in range(num_workers):
             p = ctx.Process(
@@ -134,26 +143,37 @@ class WorkerPool:
         batches = list(index_batches)
         n = len(batches)
         window = max(prefetch, 1) * max(self.num_workers, 1)
+        self._epoch += 1
+        epoch = self._epoch
         submitted = 0
         pending: dict = {}
         nxt = 0
         while submitted < min(window, n):
-            self._task_q.put((submitted, list(batches[submitted])))
+            self._task_q.put(((epoch, submitted), list(batches[submitted])))
             submitted += 1
         poll = timeout if timeout and timeout > 0 else 60
         hard = timeout if timeout and timeout > 0 else None
         while nxt < n:
             if nxt in pending:
+                # fault BEFORE consuming: an injected data.next error must
+                # not eat a batch a replayed epoch still needs
+                if _chaos_active():
+                    from ..distributed.resilience import chaos
+                    chaos.hit("data.next")
                 data = pending.pop(nxt)
                 nxt += 1
                 # consumed one -> admit one (backpressure window slides)
                 if submitted < n:
-                    self._task_q.put((submitted, list(batches[submitted])))
+                    self._task_q.put(((epoch, submitted),
+                                      list(batches[submitted])))
                     submitted += 1
                 yield data
                 continue
             try:
-                bi, data, err = self._result_q.get(timeout=poll)
+                key, data, err = self._result_q.get(timeout=poll)
+                ep, bi = key
+                if ep != epoch:
+                    continue  # leftover from an abandoned earlier epoch
             except pyqueue.Empty:
                 dead = [w.pid for w in self._workers if not w.is_alive()]
                 if dead:

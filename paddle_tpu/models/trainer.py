@@ -202,3 +202,18 @@ class LlamaTrainStep:
     @property
     def params(self):
         return self._params
+
+    # ---- resilience protocol (distributed.resilience.ResilientLoop) ----
+    def resilience_state(self):
+        """Everything a bitwise-exact resume needs: params, optimizer
+        moments, and the step counter (bias correction depends on it)."""
+        return {"params": self._params, "opt_state": self._opt_state,
+                "step": np.asarray(self._step_i, np.int64)}
+
+    def load_resilience_state(self, state):
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]
+        self._step_i = int(np.asarray(state["step"]))
+
+    def train_step(self, tokens, labels):
+        return self(tokens, labels)

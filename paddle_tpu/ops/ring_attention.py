@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.engine import apply
 from ..core.tensor import Tensor
+from ..utils.jax_compat import axis_size as _axis_size, shard_map as _shard_map
 
 __all__ = ["ring_attention", "ulysses_attention", "ring_attention_local"]
 
@@ -57,7 +58,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
                          remat: bool = True):
     """The shard_map-local body: q/k/v are LOCAL seq blocks [B, Tl, H, D];
     runs the ring over `axis_name`. Returns local output block."""
-    S = jax.lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     Tl = q.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -102,11 +103,11 @@ def ring_attention_sharded(q, k, v, mesh, seq_axis: str = "sep",
     GSPMD-automatic, so this drops into any pjit program (the llama trunk
     uses it directly). q/k/v: [B, T, H, D], equal head counts."""
     spec = P(None, seq_axis)
-    return jax.shard_map(
+    return _shard_map(
         functools.partial(ring_attention_local, axis_name=seq_axis,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names=frozenset({seq_axis}), check_vma=False)(q, k, v)
+        mesh, (spec, spec, spec), spec,
+        axis_names={seq_axis}, check=False)(q, k, v)
 
 
 def ring_attention(query, key, value, mesh=None, seq_axis: str = "sep",
@@ -157,8 +158,7 @@ def ulysses_attention(query, key, value, mesh=None, seq_axis: str = "sep",
         return gather_seq(out.astype(q.dtype))
 
     def f(q, k, v):
-        return jax.shard_map(local_fn, mesh=jm, in_specs=(spec, spec, spec),
-                             out_specs=spec, axis_names=frozenset({seq_axis}),
-                             check_vma=False)(q, k, v)
+        return _shard_map(local_fn, jm, (spec, spec, spec), spec,
+                          axis_names={seq_axis}, check=False)(q, k, v)
 
     return apply(f, query, key, value, name="flash_attention")

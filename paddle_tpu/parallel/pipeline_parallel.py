@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..distributed.process_mesh import ProcessMesh, get_mesh
+from ..utils.jax_compat import shard_map as _shard_map
 from ..nn.layer.layers import Layer
 
 __all__ = ["pipeline_apply", "pipeline_train_1f1b", "pipeline_apply_interleaved",
@@ -110,8 +111,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh: Proce
     if keyed:
         in_specs = in_specs + (P(),)
         operands = operands + (key,)
-    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
-                             axis_names=frozenset({pp_axis}), check_vma=False)
+    shmapped = _shard_map(local_fn, jm, in_specs, P(),
+                          axis_names={pp_axis}, check=False)
     return shmapped(*operands)
 
 
@@ -284,9 +285,8 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable, stacked_params,
     if keyed:
         in_specs = in_specs + (P(),)
         operands = operands + (key,)
-    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs,
-                             out_specs=out_specs,
-                             axis_names=frozenset({pp_axis}), check_vma=False)
+    shmapped = _shard_map(local_fn, jm, in_specs, out_specs,
+                          axis_names={pp_axis}, check=False)
     return shmapped(*operands)
 
 
@@ -381,8 +381,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, microbatches,
     if keyed:
         in_specs = in_specs + (P(),)
         operands = operands + (key,)
-    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs, out_specs=P(),
-                             axis_names=frozenset({pp_axis}), check_vma=False)
+    shmapped = _shard_map(local_fn, jm, in_specs, P(),
+                          axis_names={pp_axis}, check=False)
     return shmapped(*operands)
 
 
@@ -553,9 +553,8 @@ def pipeline_train_vpp(stage_fn: Callable, loss_fn: Callable, stacked_params,
     if keyed:
         in_specs = in_specs + (P(),)
         operands = operands + (key,)
-    shmapped = jax.shard_map(local_fn, mesh=jm, in_specs=in_specs,
-                             out_specs=out_specs,
-                             axis_names=frozenset({pp_axis}), check_vma=False)
+    shmapped = _shard_map(local_fn, jm, in_specs, out_specs,
+                          axis_names={pp_axis}, check=False)
     return shmapped(*operands)
 
 

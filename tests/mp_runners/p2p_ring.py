@@ -27,8 +27,8 @@ def main():
         # send to (i+1) % world: every rank receives its LEFT neighbor's value
         return jax.lax.ppermute(x, ax, [(i, (i + 1) % world) for i in range(world)])
 
-    out = jax.shard_map(shift, mesh=jm, in_specs=P(), out_specs=P(),
-                        check_vma=False)(glob)
+    from paddle_tpu.utils.jax_compat import shard_map
+    out = shard_map(shift, jm, P(), P(), check=False)(glob)
     got = float(np.asarray(out.addressable_shards[0].data)[0])
     expect = float((rank - 1) % world)
     assert got == expect, f"rank {rank}: got {got} expect {expect}"

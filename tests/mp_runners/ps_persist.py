@@ -96,8 +96,15 @@ def main():
         assert not np.allclose(expected, lost)
         np.savez(os.path.join(state_dir, "expected.npz"),
                  expected=expected, lost=lost, st1=np.asarray(st1))
-        # REAL kill: SIGKILL the serving process mid-train
-        with open(os.path.join(state_dir, "server_a.pid")) as f:
+        # REAL kill: SIGKILL the serving process mid-train. The server
+        # writes its pid right after ITS rendezvous returns — which can be
+        # a beat after ours (the worker side races through its rpcs in
+        # ~15ms), so wait for the file instead of assuming the order.
+        from paddle_tpu.distributed.resilience.retry import wait_for
+        pid_f = os.path.join(state_dir, "server_a.pid")
+        wait_for(lambda: os.path.exists(pid_f), "ps_persist.server_pid",
+                 timeout=60)
+        with open(pid_f) as f:
             spid = int(f.read())
         os.kill(spid, signal.SIGKILL)
         with open(os.path.join(state_dir, "done_a.txt"), "w") as f:

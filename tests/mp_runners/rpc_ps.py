@@ -23,6 +23,7 @@ import paddle_tpu.distributed.ps as ps
 import paddle_tpu.distributed.rpc as rpc
 
 _DONE = set()
+_SERVER_READY = []
 
 
 def double(x):
@@ -38,12 +39,23 @@ def done_count():
     return len(_DONE)
 
 
+def server_ready():
+    """True once the server's OWN rendezvous returned. Workers must not
+    deregister before then: rpc handler threads serve as soon as the TCP
+    server binds, so a 1-worker job can finish and leave() while the
+    server is still polling the KV for its membership — after which the
+    server can never discover it and hangs to the rendezvous timeout."""
+    return bool(_SERVER_READY)
+
+
 def main():
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     world = int(os.environ["PADDLE_TRAINERS_NUM"])
     n_workers = world - 1
     name = f"server{rank}" if rank == 0 else f"worker{rank}"
     rt = ps.TheOnePSRuntime(name=name, rank=rank, world_size=world)
+    if rt.server is not None:
+        _SERVER_READY.append(True)
 
     if rt.worker is not None:
         # plain rpc: call a function on the server
@@ -77,10 +89,13 @@ def main():
         empty = rt.worker.pull("emb", np.zeros((0,), np.int64))
         assert empty.shape == (0, 8), empty.shape
 
-        # finish barrier: report done, wait until every worker is done
+        # finish barrier: report done, wait until every worker is done AND
+        # the server's rendezvous completed (see server_ready) — only then
+        # is it safe to deregister
         rpc.rpc_sync("server0", mark_done, (name,))
         deadline = time.time() + 300
-        while rpc.rpc_sync("server0", done_count, ()) < n_workers:
+        while (rpc.rpc_sync("server0", done_count, ()) < n_workers
+               or not rpc.rpc_sync("server0", server_ready, ())):
             if time.time() > deadline:
                 raise TimeoutError("finish barrier")
             time.sleep(0.3)

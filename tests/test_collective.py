@@ -19,8 +19,8 @@ def world():
 
 
 def _shard_map(mesh, fn, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh.jax_mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    from paddle_tpu.utils.jax_compat import shard_map
+    return shard_map(fn, mesh.jax_mesh, in_specs, out_specs, check=False)
 
 
 class TestCollectivesInSPMD:
