@@ -56,11 +56,41 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _metrics_payload() -> dict | None:
+    """The observability snapshot embedded in the bench JSON line: step-time
+    p50/p95, retry/chaos/restore counters — the perf-trajectory dimension of
+    BENCH_*.json. Never raises (the bench may die before paddle_tpu ever
+    imported; the JSON contract survives regardless)."""
+    try:
+        if "paddle_tpu" in sys.modules:
+            from paddle_tpu.observability import metrics
+        else:
+            # error paths that never imported paddle_tpu (tpu unreachable,
+            # SIGTERM in the probe window) must not pay the full jax import
+            # just to report an empty registry: load the stdlib-only metrics
+            # module standalone
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "_bench_obs_metrics",
+                os.path.join(_HERE, "paddle_tpu", "observability",
+                             "metrics.py"))
+            metrics = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(metrics)
+        snap = metrics.snapshot()
+        return {
+            "counters": snap["counters"],
+            "step_time_s": snap["histograms"].get("train.step_time_s"),
+        }
+    except Exception:
+        return None
+
+
 def _error_payload(msg: str) -> dict:
     err = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
         "error": msg,
+        "metrics": _metrics_payload(),
     }
     # surface the last committed success so an outage at bench time still
     # points the reader at a real number
@@ -332,6 +362,7 @@ def main() -> int:
             "model": size,
             "loss": float(jax.device_get(loss)),
         },
+        "metrics": _metrics_payload(),
     }
     if on_tpu:
         # non-default sizes record to their own file: the canonical 850M

@@ -49,6 +49,7 @@ from . import incubate  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from . import audio  # noqa: F401

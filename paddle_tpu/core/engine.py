@@ -873,7 +873,9 @@ def _check_nan_inf(op_name: str, out):
                 msg = f"[check_nan_inf] op={op_name or '?'}: {bad} non-finite values"
                 if level == 0:
                     raise FloatingPointError(msg)
-                print(msg)
+                from ..observability import recorder as _recorder
+                _recorder.record("check_nan_inf", message=msg, echo=True,
+                                 op=op_name or "?", bad=bad)
 
 
 def apply_nondiff(fn: Callable, *args, name: str = "", **static_kwargs):

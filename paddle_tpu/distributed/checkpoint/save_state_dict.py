@@ -22,6 +22,8 @@ import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...observability import metrics as _obs_metrics, \
+    recorder as _obs_recorder, spans as _obs_spans
 from .metadata import LocalTensorMetadata, Metadata, crc32_file
 
 _async_queue: "queue.Queue" = queue.Queue()
@@ -225,12 +227,19 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             chaos.hit("ckpt.write")
             np.savez(tmp, **arrays)
             crc = crc32_file(tmp)
+            nbytes = os.path.getsize(tmp)
             chaos.hit("ckpt.rename")  # "crash between write and rename"
             os.replace(tmp, os.path.join(path, shard_file))
             checksums[shard_file] = crc
+            _obs_metrics.counter("checkpoint.save_bytes").inc(nbytes)
 
-        retry_call(write_once, op=f"ckpt.write {shard_file}",
-                   policy=RetryPolicy(max_attempts=3, base_delay=0.05))
+        with _obs_spans.span("checkpoint.save", cat="checkpoint", uid=uid,
+                             shard=shard_file), \
+                _obs_metrics.timer("checkpoint.save_time_s"):
+            retry_call(write_once, op=f"ckpt.write {shard_file}",
+                       policy=RetryPolicy(max_attempts=3, base_delay=0.05))
+        _obs_recorder.record("ckpt.save", uid=uid, shard=shard_file,
+                             dir=path)
 
     def publish_metadata():
         # every rank writes its piece atomically; the coordinator waits for
@@ -238,6 +247,15 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         # merged file — completion on any rank means the checkpoint is
         # loadable (VERDICT r1 weak #4: no barrier before merge)
         meta.file_checksums = dict(checksums)  # the torn-file manifest
+        _publish_span = _obs_spans.span("checkpoint.publish", cat="checkpoint",
+                                        uid=uid).begin()
+        try:
+            _publish_metadata_inner()
+        finally:
+            _publish_span.end()  # a failed publish is the span worth having
+        _obs_recorder.record("ckpt.published", uid=uid, dir=path)
+
+    def _publish_metadata_inner():
         meta_piece = os.path.join(path, f"{uid}_meta_rank{rank}.json")
         tmp = meta_piece + ".tmp"
         with open(tmp, "w") as f:

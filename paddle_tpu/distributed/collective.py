@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _metrics
 from .placement import Partial, Replicate, Shard, placements_to_spec
 from .process_mesh import ProcessMesh, get_mesh
 from .reshard import shard_map_compat
@@ -404,10 +405,11 @@ def barrier(group=None):
     from .resilience import chaos
     g = _group(group)
     chaos.hit("collective.wait")
-    with watch("barrier", group=g):
+    with _metrics.timer("collective.wait_s"), watch("barrier", group=g):
         t = Tensor(jnp.zeros((), jnp.float32))
         all_reduce(t, group=g)
         jax.block_until_ready(t._value)
+    _metrics.counter("collective.barriers").inc()
     return _Task()
 
 
@@ -415,7 +417,7 @@ def wait(tensor, group=None, use_calc_stream=True):
     from .comm_watchdog import watch
     from .resilience import chaos
     chaos.hit("collective.wait")
-    with watch("wait", group=group):
+    with _metrics.timer("collective.wait_s"), watch("wait", group=group):
         jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
 
 

@@ -29,6 +29,8 @@ import os
 import sys
 import threading
 
+from ..observability import metrics as _metrics, recorder as _recorder, \
+    spans as _spans
 from ..utils.flags import define_flag, flag_value
 
 define_flag("comm_timeout_s", 600.0,
@@ -76,12 +78,19 @@ def watch(op_name: str, group=None, timeout: float | None = None,
                f"group=({_describe_group(group)}) rank={rank} — the peer "
                f"never arrived; dumping stacks and "
                f"{'aborting' if action == 'abort' else 'reporting'}")
-        print(msg, file=sys.stderr, flush=True)
+        # stall telemetry: counter + structured flight event carrying the
+        # full message text (echo keeps the loud stderr line), and a flight
+        # dump BEFORE the abort — exit 124 must leave the postmortem behind
+        _metrics.counter("watchdog.stall").inc()
+        _recorder.record("watchdog.stall", message=msg, echo=True,
+                         op=op_name, group=_describe_group(group),
+                         rank=rank, timeout_s=t, action=action)
         try:
             faulthandler.dump_traceback(file=sys.stderr)
         except Exception:
             pass
         if action == "abort":
+            _recorder.dump_flight(reason=f"watchdog stall: {op_name}")
             sys.stderr.flush()
             os._exit(124)
 
@@ -89,6 +98,7 @@ def watch(op_name: str, group=None, timeout: float | None = None,
     timer.daemon = True
     timer.start()
     try:
-        yield
+        with _spans.span("comm." + op_name, cat="collective"):
+            yield
     finally:
         timer.cancel()

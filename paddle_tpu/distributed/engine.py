@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import random as _rng
 from ..core.tensor import Parameter, Tensor
+from ..observability import metrics as _metrics, spans as _spans
 from .process_mesh import ProcessMesh
 
 __all__ = ["Engine", "PipelinePlan", "Strategy"]
@@ -626,10 +627,14 @@ class Engine:
         labels = tuple(self._put_data(x) for x in labels)
         self._step_i += 1
         key = _rng.split_key()
-        loss, self._params, self._opt_state, self._buffers = self._jitted(
-            self._params, self._opt_state, self._buffers, key,
-            jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step_i),
-            inputs, labels)
+        with _spans.span("engine.step", cat="step", step=self._step_i), \
+                _metrics.timer("train.step_time_s"):
+            loss, self._params, self._opt_state, self._buffers = self._jitted(
+                self._params, self._opt_state, self._buffers, key,
+                jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step_i),
+                inputs, labels)
+        _metrics.counter("train.steps").inc()
+        _metrics.maybe_emit_step(self._step_i)
         return Tensor(loss)
 
     def _put_data(self, x):
@@ -644,13 +649,14 @@ class Engine:
         """Reference engine.py:1547 fit — loop the donated step over a loader
         yielding (inputs, labels) pairs."""
         last = None
-        for _ in range(epochs):
-            for batch in data_loader:
-                if isinstance(batch, (tuple, list)) and len(batch) == 2:
-                    inputs, labels = batch
-                else:
-                    inputs, labels = batch, ()
-                last = self.step(inputs, labels)
+        for epoch in range(epochs):
+            with _spans.span("engine.epoch", cat="step", epoch=epoch):
+                for batch in data_loader:
+                    if isinstance(batch, (tuple, list)) and len(batch) == 2:
+                        inputs, labels = batch
+                    else:
+                        inputs, labels = batch, ()
+                    last = self.step(inputs, labels)
         return last
 
     @contextlib.contextmanager

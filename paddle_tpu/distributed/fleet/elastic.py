@@ -146,7 +146,7 @@ class KVServer:
                     with lock:
                         rec = store.get(node)
                     # same TTL contract as /nodes: stale entries are gone
-                    if rec is None or time.time() - rec[0] > ttl_ref.ttl:
+                    if rec is None or time.time() - rec[0] > ttl_ref.ttl:  # observability: ok (wall-clock liveness TTL, not perf timing)
                         return self._send(404)
                     return self._send(200, rec[1].encode())
                 if self.path != "/nodes":

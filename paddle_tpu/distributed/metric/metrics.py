@@ -174,6 +174,12 @@ class MetricRunner:
 
     def get_metric_msg(self, name: str):
         m = self._metrics[name].all_reduce().compute()
+        # bridge into the process-wide observability registry: AUC values
+        # show up in metrics.snapshot() / the per-step sink next to the
+        # runtime numbers instead of living on their own island
+        from ...observability import metrics as _obs
+        _obs.gauge(f"metric.{name}.auc").set(m["auc"])
+        _obs.gauge(f"metric.{name}.ins_count").set(float(m["ins_count"]))
         return [m["auc"], m["bucket_error"], m["mae"], m["rmse"],
                 m["actual_ctr"], m["predicted_ctr"], m["copc"],
                 float(m["ins_count"])]

@@ -35,6 +35,7 @@ import os
 import random
 import threading
 
+from ...observability import metrics as _metrics, recorder as _recorder
 from .retry import TransientError
 
 __all__ = ["ChaosError", "hit", "active", "reset", "inject", "hit_counts"]
@@ -111,6 +112,11 @@ def hit(site: str) -> int:
         seed = os.environ.get(SEED_VAR, "0")
         fail = random.Random(f"{seed}:{site}:{n}").random() < sel["p"]
     if fail:
+        # telemetry BEFORE the raise: the flight recorder's last events must
+        # explain the fault even when the raise kills the process
+        _metrics.counter("chaos.faults").inc()
+        _recorder.record("chaos.fault", site=site, hit=n,
+                         spec=os.environ.get(ENV_VAR))
         raise ChaosError(site, n)
     return n
 

@@ -28,13 +28,14 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import sys
 import time
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...observability import metrics as _metrics, recorder as _recorder, \
+    spans as _spans
 from . import chaos, preempt
 from .retry import DeadlineExceeded, RetryPolicy, classify
 
@@ -74,6 +75,7 @@ class ResilientLoop:
         self.restores = 0        # lifetime total (reported in RunResult)
         self._consec = 0         # consecutive failures; reset on progress
         self._last_good_uid: int | None = None
+        _recorder.install_crash_hook()  # an uncaught death leaves FLIGHT.json
 
         if not (hasattr(trainable, "resilience_state")
                 and hasattr(trainable, "load_resilience_state")):
@@ -143,30 +145,52 @@ class ResilientLoop:
         step)."""
         self.restores += 1
         self._consec += 1
+        _metrics.counter("resilience.restores").inc()
         if self._consec > self.max_restores:
+            _recorder.record(
+                "resilience.give_up", echo=True,
+                message=f"[resilience] {self._consec} consecutive failures "
+                        f"exceed max_restores={self.max_restores}; dying",
+                error=f"{type(exc).__name__}: {exc}")
+            _recorder.dump_flight(self.ckpt_dir, reason="recovery exhausted")
             raise DeadlineExceeded("resilient-loop.recover", self._consec,
                                    0.0, last=exc) from exc
-        print(f"[resilience] transient failure "
-              f"({type(exc).__name__}: {exc}); recovery "
-              f"{self._consec}/{self.max_restores}", file=sys.stderr)
+        _recorder.record(
+            "resilience.recover", echo=True,
+            message=f"[resilience] transient failure "
+                    f"({type(exc).__name__}: {exc}); recovery "
+                    f"{self._consec}/{self.max_restores}",
+            error=f"{type(exc).__name__}: {exc}", consec=self._consec)
         time.sleep(next(delays))
         restored = self.restore_checkpoint()
         if restored is not None:
-            print(f"[resilience] restored checkpoint at step {restored}",
-                  file=sys.stderr)
+            _recorder.record(
+                "resilience.restored", echo=True,
+                message=f"[resilience] restored checkpoint at step {restored}",
+                step=restored)
+        # the run survived a fault — dump the story while it is fresh, so a
+        # later hard death (or a postmortem without re-run) still has it
+        _recorder.dump_flight(self.ckpt_dir, reason="resilient-loop restore")
 
     def _emergency_save(self) -> None:
         uid = None
         try:
             uid = self.save_checkpoint()
         except Exception as e:  # keep the marker even when the save dies
-            print(f"[resilience] emergency save failed ({e}); marker will "
-                  f"point at the last good generation", file=sys.stderr)
+            _recorder.record(
+                "resilience.emergency_save_failed", echo=True,
+                message=f"[resilience] emergency save failed ({e}); marker "
+                        f"will point at the last good generation",
+                error=f"{type(e).__name__}: {e}")
             uid = self._last_good_uid
         preempt.write_marker(self.ckpt_dir, self._get_step(), unique_id=uid,
                              signum=self.preemption.signum)
-        print(f"[resilience] preempted: emergency checkpoint uid={uid} "
-              f"step={self._get_step()} marker written", file=sys.stderr)
+        _recorder.record(
+            "resilience.preempted", echo=True,
+            message=f"[resilience] preempted: emergency checkpoint uid={uid} "
+                    f"step={self._get_step()} marker written",
+            uid=uid, step=self._get_step(), signum=self.preemption.signum)
+        _recorder.dump_flight(self.ckpt_dir, reason="preemption save")
 
     # ---------------- the loop ----------------
     def run(self, batch_fn, num_steps: int, on_step=None) -> RunResult:
@@ -193,9 +217,12 @@ class ResilientLoop:
         # recovery always has a restore target.
         resumed_from = self.restore_checkpoint()
         if resumed_from is not None:
-            print(f"[resilience] resuming from step {resumed_from}"
-                  f"{' (preemption marker)' if preempt.read_marker(self.ckpt_dir) else ''}",
-                  file=sys.stderr)
+            marker = preempt.read_marker(self.ckpt_dir)
+            _recorder.record(
+                "resilience.resume", echo=True,
+                message=f"[resilience] resuming from step {resumed_from}"
+                        f"{' (preemption marker)' if marker else ''}",
+                step=resumed_from, preemption_marker=bool(marker))
             preempt.clear_marker(self.ckpt_dir)
         else:
             while True:
@@ -214,10 +241,11 @@ class ResilientLoop:
                 return RunResult(step, _loss_float(last_loss), self.restores,
                                  True, resumed_from)
             try:
-                batch = batch_fn(step)
-                if not isinstance(batch, (tuple, list)):
-                    batch = (batch,)
-                loss = self._step_fn(*batch)
+                with _spans.span("loop.step", cat="step", step=step):
+                    batch = batch_fn(step)
+                    if not isinstance(batch, (tuple, list)):
+                        batch = (batch,)
+                    loss = self._step_fn(*batch)
                 step = self._get_step()
                 last_loss = loss
                 if self._consec:  # progress: reset failure budget + backoff

@@ -78,11 +78,13 @@ class PreemptionHandler:
             return
         self.signum = signum
         self._event.set()
+        _notify_flight(signum)
 
     def request(self, signum: int | None = None):
         """Programmatic preemption (tests, SDK shutdown hooks)."""
         self.signum = signum
         self._event.set()
+        _notify_flight(signum, programmatic=True)
 
     @property
     def requested(self) -> bool:
@@ -91,6 +93,22 @@ class PreemptionHandler:
     def clear(self):
         self._event.clear()
         self.signum = None
+
+
+def _notify_flight(signum, programmatic=False):
+    """Latch telemetry: record the preemption and dump FLIGHT.json NOW —
+    the grace window after SIGTERM may be too short for anything later.
+    Best-effort and exception-free (this runs inside a signal handler)."""
+    try:
+        from ...observability import recorder
+        recorder.record("preempt.latch", signum=signum,
+                        programmatic=programmatic)
+        # dump at the latch only when the operator named a telemetry dir —
+        # ResilientLoop's emergency save dumps into the ckpt dir regardless
+        if os.environ.get("PADDLE_TRACE_DIR"):
+            recorder.dump_flight(reason=f"preemption (signum={signum})")
+    except Exception:
+        pass
 
 
 # ---- marker file: which emergency save to resume from ----

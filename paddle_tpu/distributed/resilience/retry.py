@@ -29,6 +29,8 @@ import random
 import time
 from typing import Any, Callable
 
+from ...observability import metrics as _metrics, recorder as _recorder
+
 __all__ = [
     "TransientError", "FatalError", "DeadlineExceeded", "RetryPolicy",
     "classify", "retry_call", "wait_for",
@@ -139,7 +141,14 @@ def retry_call(fn: Callable[..., Any], *args, policy: RetryPolicy | None = None,
             out_of_time = pol.deadline is not None and \
                 elapsed + d >= pol.deadline
             if out_of_attempts or out_of_time:
+                _recorder.record("retry.exhausted", op=op, attempts=attempt,
+                                 elapsed_s=round(elapsed, 3),
+                                 error=f"{type(e).__name__}: {e}")
                 raise DeadlineExceeded(op, attempt, elapsed, last=e) from e
+            _metrics.counter("resilience.retries").inc()
+            _recorder.record("retry", op=op, attempt=attempt,
+                             delay_s=round(d, 4),
+                             error=f"{type(e).__name__}: {e}")
             if on_retry is not None:
                 on_retry(attempt, e, d)
             sleep(d)
@@ -168,6 +177,8 @@ def wait_for(predicate: Callable[[], Any], op: str,
         elapsed = time.monotonic() - start
         if timeout is not None and timeout > 0 and elapsed >= timeout:
             extra = f" ({describe()})" if describe is not None else ""
+            _recorder.record("wait.timeout", op=op + extra, attempts=attempt,
+                             elapsed_s=round(elapsed, 3))
             raise DeadlineExceeded(op + extra, attempt, elapsed)
         d = next(delays)
         if timeout is not None and timeout > 0:

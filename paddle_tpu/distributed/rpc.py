@@ -375,7 +375,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     # stall minutes before it registers (PADDLE_RPC_TIMEOUT overrides)
     deadline = time.time() + float(os.environ.get("PADDLE_RPC_TIMEOUT", 300))
     last_beat = 0.0
-    t_start = time.time()
+    t_start = time.perf_counter()
     # discovery pacing: start tight (a freshly-registered peer that finishes
     # fast deregisters within ~100ms — a flat 0.2s poll can miss it forever),
     # back off once the world is clearly still assembling
@@ -392,9 +392,13 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
             except Exception:
                 pass
         if debug:
-            print(f"[rpc {name}] t={time.time()-t_start:.1f} "
-                  f"alive={reg.alive_nodes()} have={sorted(agent.workers)}",
-                  flush=True)
+            from ..observability import recorder as _recorder
+            _recorder.record(
+                "rpc.rendezvous", echo=True,
+                message=f"[rpc {name}] t={time.perf_counter()-t_start:.1f} "
+                        f"alive={reg.alive_nodes()} "
+                        f"have={sorted(agent.workers)}",
+                have=len(agent.workers), want=world_size)
         for sn in reg.alive_nodes():
             if not sn.startswith(job + "::"):
                 continue  # another job's orphan on a recycled master port

@@ -68,7 +68,7 @@ class ProgBarLogger(Callback):
         self.epoch = epoch
         self.steps = 0
         self.losses = []
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
 
     def on_train_batch_end(self, step, logs=None):
         self.steps += 1
@@ -81,7 +81,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._t0
+            dt = time.perf_counter() - self._t0
             avg = np.mean(self.losses) if self.losses else float("nan")
             print(f"Epoch {epoch} done in {dt:.1f}s, avg loss {avg:.4f}")
 

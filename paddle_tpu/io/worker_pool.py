@@ -26,6 +26,9 @@ import queue as pyqueue
 
 import numpy as np
 
+from ..observability import metrics as _metrics, recorder as _recorder, \
+    spans as _spans
+
 
 class WorkerInfo:
     def __init__(self, id, num_workers, dataset=None, seed=None):
@@ -145,6 +148,10 @@ class WorkerPool:
         window = max(prefetch, 1) * max(self.num_workers, 1)
         self._epoch += 1
         epoch = self._epoch
+        _recorder.record("io.epoch", epoch=epoch, batches=n,
+                         workers=self.num_workers)
+        epoch_span = _spans.span("io.epoch", cat="data", epoch=epoch,
+                                 batches=n).begin()
         submitted = 0
         pending: dict = {}
         nxt = 0
@@ -153,40 +160,49 @@ class WorkerPool:
             submitted += 1
         poll = timeout if timeout and timeout > 0 else 60
         hard = timeout if timeout and timeout > 0 else None
-        while nxt < n:
-            if nxt in pending:
-                # fault BEFORE consuming: an injected data.next error must
-                # not eat a batch a replayed epoch still needs
-                if _chaos_active():
-                    from ..distributed.resilience import chaos
-                    chaos.hit("data.next")
-                data = pending.pop(nxt)
-                nxt += 1
-                # consumed one -> admit one (backpressure window slides)
-                if submitted < n:
-                    self._task_q.put(((epoch, submitted),
-                                      list(batches[submitted])))
-                    submitted += 1
-                yield data
-                continue
-            try:
-                key, data, err = self._result_q.get(timeout=poll)
-                ep, bi = key
-                if ep != epoch:
-                    continue  # leftover from an abandoned earlier epoch
-            except pyqueue.Empty:
-                dead = [w.pid for w in self._workers if not w.is_alive()]
-                if dead:
-                    raise RuntimeError(
-                        f"DataLoader worker(s) died: pids {dead}")
-                if hard is not None:
-                    raise RuntimeError(
-                        f"DataLoader worker timeout after {hard}s")
-                continue  # no timeout requested: keep waiting
-            if err is not None:
-                raise RuntimeError(f"DataLoader worker failed on batch "
-                                   f"{bi}:\n{err}")
-            pending[bi] = data
+        try:
+            while nxt < n:
+                if nxt in pending:
+                    # fault BEFORE consuming: an injected data.next error must
+                    # not eat a batch a replayed epoch still needs
+                    if _chaos_active():
+                        from ..distributed.resilience import chaos
+                        chaos.hit("data.next")
+                    data = pending.pop(nxt)
+                    nxt += 1
+                    # consumed one -> admit one (backpressure window slides)
+                    if submitted < n:
+                        self._task_q.put(((epoch, submitted),
+                                          list(batches[submitted])))
+                        submitted += 1
+                    _metrics.counter("io.batches").inc()
+                    yield data
+                    continue
+                try:
+                    key, data, err = self._result_q.get(timeout=poll)
+                    ep, bi = key
+                    if ep != epoch:
+                        continue  # leftover from an abandoned earlier epoch
+                except pyqueue.Empty:
+                    dead = [w.pid for w in self._workers if not w.is_alive()]
+                    if dead:
+                        _recorder.record("io.worker_dead", pids=dead,
+                                         epoch=epoch)
+                        raise RuntimeError(
+                            f"DataLoader worker(s) died: pids {dead}")
+                    if hard is not None:
+                        _recorder.record("io.worker_timeout", timeout_s=hard,
+                                         epoch=epoch)
+                        raise RuntimeError(
+                            f"DataLoader worker timeout after {hard}s")
+                    continue  # no timeout requested: keep waiting
+                if err is not None:
+                    _recorder.record("io.batch_failed", batch=bi, epoch=epoch)
+                    raise RuntimeError(f"DataLoader worker failed on batch "
+                                       f"{bi}:\n{err}")
+                pending[bi] = data
+        finally:
+            epoch_span.end()
 
     def shutdown(self):
         for w in self._workers:

@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..distributed.process_mesh import ProcessMesh
+from ..observability import metrics as _metrics, spans as _spans
 from ..optimizer import AdamW, Optimizer
 from . import llama as L
 
@@ -194,9 +195,17 @@ class LlamaTrainStep:
             tokens = jax.device_put(tokens, sh)
             labels = jax.device_put(labels, sh)
         self._step_i += 1
-        loss, self._params, self._opt_state = self._jitted(
-            self._params, self._opt_state, tokens, labels,
-            jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step_i))
+        # host-side dispatch time; the async device step is NOT synced here
+        # (bench/tests own their sync points — per-step host syncs would
+        # serialize the chip)
+        with _spans.span("train.step", cat="step", step=self._step_i), \
+                _metrics.timer("train.step_time_s"):
+            loss, self._params, self._opt_state = self._jitted(
+                self._params, self._opt_state, tokens, labels,
+                jnp.float32(self.optimizer.get_lr()), jnp.int32(self._step_i))
+        _metrics.counter("train.steps").inc()
+        _metrics.counter("train.tokens").inc(int(tokens.size))
+        _metrics.maybe_emit_step(self._step_i)
         return loss
 
     @property

@@ -1,0 +1,56 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+One substrate that every layer of the runtime reports through, replacing the
+pre-PR-2 archipelago (comm_watchdog prints, resilience stderr lines, ad-hoc
+``time.time()`` deltas, the distributed/metric island):
+
+  spans    — thread-safe span/trace API (``span("train.step")`` context
+             manager + decorator) with a near-zero-cost disabled path and
+             chrome-trace (Perfetto-compatible) JSON export that merges the
+             profiler's host events and scheduler windows.
+  metrics  — process-wide registry of counters / gauges / histograms
+             (step time, tokens/sec, retry counts, checkpoint bytes,
+             collective latency) with a ``snapshot()`` dict and an optional
+             per-step CSV/JSONL sink (``PADDLE_METRICS_SINK``).
+  recorder — bounded flight-recorder ring buffer of structured events that
+             auto-dumps ``FLIGHT.json`` on crash, SIGTERM/preemption (via
+             the resilience preempt latch) and on every ResilientLoop
+             restore — postmortems of chaos/preemption runs need no re-run.
+
+Env vars:
+  PADDLE_TRACE_DIR        enable span tracing; chrome trace + FLIGHT.json
+                          land here (trace exported at process exit too)
+  PADDLE_METRICS_SINK     path ending .jsonl or .csv: per-step metric rows
+  PADDLE_FLIGHT_RECORDER  ring capacity (default 512; 0/off disables)
+
+The package imports only the stdlib — any module in paddle_tpu (including
+the earliest-imported resilience layer) can depend on it without cycles.
+"""
+from __future__ import annotations
+
+from . import metrics  # noqa: F401
+from . import recorder  # noqa: F401
+from . import spans  # noqa: F401
+from .metrics import counter, gauge, histogram, snapshot, timer  # noqa: F401
+from .recorder import dump_flight, record  # noqa: F401
+from .spans import (  # noqa: F401
+    disable_tracing, enable_tracing, export_chrome_trace, span, traced,
+    tracing_enabled,
+)
+
+__all__ = [
+    "spans", "metrics", "recorder",
+    "span", "traced", "tracing_enabled", "enable_tracing", "disable_tracing",
+    "export_chrome_trace",
+    "counter", "gauge", "histogram", "snapshot", "timer",
+    "record", "dump_flight",
+]
+
+
+def reset():
+    """Clear all telemetry state (tests). Metrics counters are normally
+    NEVER reset in a live process — monotonicity across ResilientLoop
+    restores is part of the contract."""
+    spans.reset()
+    metrics.reset()
+    recorder.reset()

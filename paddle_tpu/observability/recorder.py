@@ -1,0 +1,181 @@
+"""Flight recorder: a bounded ring of structured events, dumped on disaster.
+
+The postmortem problem PR 1 left open: a chaos/preemption run prints its
+story to stderr as it happens, and when the process dies the story dies with
+it — debugging a failed run means re-running it. Here every layer records
+structured events (retries, chaos faults, checkpoint saves/restores,
+watchdog stalls, preemption latches) into ONE process-wide ring buffer
+(bounded: old events fall off), and the ring auto-dumps ``FLIGHT.json``:
+
+  * on crash (a chained ``sys.excepthook``, installed by ResilientLoop or
+    explicitly via ``install_crash_hook()``),
+  * on SIGTERM/SIGINT preemption (the resilience preempt latch calls
+    ``dump_flight(reason="preemption")``),
+  * on every ResilientLoop restore (the run survived — the dump explains
+    what it survived),
+  * on a comm-watchdog stall right before the abort.
+
+``record(..., echo=True)`` also writes the line to stderr — the operator
+still sees events live; the recorder owns the print so the rest of the tree
+doesn't (tools/lint_observability.py enforces this).
+
+Env: PADDLE_FLIGHT_RECORDER = ring capacity (default 512; "0"/"off"
+disables recording AND dumping). Dumps land in the explicit path argument,
+else $PADDLE_TRACE_DIR, else the cwd.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+__all__ = ["record", "dump_flight", "events", "reset", "enabled",
+           "install_crash_hook", "FLIGHT_NAME"]
+
+ENV_CAP = "PADDLE_FLIGHT_RECORDER"
+FLIGHT_NAME = "FLIGHT.json"
+_DEFAULT_CAP = 512
+
+# SIGNAL-SAFETY: record() runs inside the preemption signal handler, which
+# executes on the main thread BETWEEN bytecodes — if it blocked on a lock the
+# interrupted frame already holds, the process would deadlock at the worst
+# possible moment. So the append path is lock-free: deque.append with maxlen
+# and itertools.count.__next__ are both GIL-atomic. The lock below guards
+# only the rare resize path (and uses a timeout, never a blocking acquire).
+_resize_lock = threading.Lock()
+_ring: deque = deque(maxlen=_DEFAULT_CAP)
+_seq = itertools.count(1)
+_prev_excepthook = [None]
+
+
+def _capacity() -> int:
+    raw = os.environ.get(ENV_CAP, "")
+    if not raw:
+        return _DEFAULT_CAP
+    if raw.lower() in ("off", "false", "no"):
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _DEFAULT_CAP
+
+
+def enabled() -> bool:
+    return _capacity() > 0
+
+
+def _resize_if_needed():
+    global _ring
+    cap = _capacity()
+    if _ring.maxlen == cap:
+        return
+    if _resize_lock.acquire(timeout=0.2):  # never block a signal handler
+        try:
+            if _ring.maxlen != cap:
+                _ring = deque(_ring, maxlen=cap)
+        finally:
+            _resize_lock.release()
+
+
+def record(kind: str, message: str | None = None, echo: bool = False,
+           **fields):
+    """Append one structured event to the ring. `kind` is the dotted event
+    type ("chaos.fault", "ckpt.save", "watchdog.stall"); `message` is the
+    human line (with echo=True it is also written to stderr, preserving the
+    live-operator view the old prints gave). Safe to call from a signal
+    handler (lock-free append path)."""
+    if echo and message is not None:
+        print(message, file=sys.stderr, flush=True)
+    if not enabled():
+        return
+    _resize_if_needed()
+    ev = {"seq": next(_seq), "t": time.time(), "kind": kind}
+    if message is not None:
+        ev["message"] = message
+    if fields:
+        ev.update(fields)
+    _ring.append(ev)  # GIL-atomic; maxlen evicts the oldest
+
+
+def events() -> list[dict]:
+    ring = _ring
+    for _ in range(5):  # a concurrent append can invalidate the iterator
+        try:
+            return list(ring)
+        except RuntimeError:
+            continue
+    return list(ring)
+
+
+def reset():
+    global _ring, _seq
+    _ring = deque(maxlen=_capacity())
+    _seq = itertools.count(1)
+
+
+def _default_dir() -> str:
+    return os.environ.get("PADDLE_TRACE_DIR") or "."
+
+
+def dump_flight(path: str | None = None, reason: str = "manual") -> str | None:
+    """Write the ring to FLIGHT.json (atomically) and return the path.
+    `path` may be a directory (FLIGHT.json lands inside) or a full file
+    path. Returns None when the recorder is disabled. Never raises — a
+    failing dump must not mask the disaster being dumped."""
+    if not enabled():
+        return None
+    try:
+        if path is None:
+            path = _default_dir()
+        if os.path.isdir(path) or not path.endswith(".json"):
+            os.makedirs(path, exist_ok=True)
+            path = os.path.join(path, FLIGHT_NAME)
+        doc = {
+            "reason": reason,
+            "dumped_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "pid": os.getpid(),
+            "capacity": _capacity(),
+            "events": events(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+def install_crash_hook():
+    """Chain sys.excepthook: an uncaught exception records a terminal
+    "crash" event and dumps FLIGHT.json before the interpreter dies.
+    Idempotent; the previous hook still runs (traceback printing included)."""
+    if _prev_excepthook[0] is not None:
+        return
+
+    prev = sys.excepthook
+    _prev_excepthook[0] = prev
+
+    def hook(exc_type, exc, tb):
+        try:
+            tail = traceback.format_exception(exc_type, exc, tb)[-3:]
+            record("crash", message=f"{exc_type.__name__}: {exc}",
+                   traceback="".join(tail))
+            dump_flight(reason=f"crash: {exc_type.__name__}: {exc}")
+        except Exception:
+            pass
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+
+
+def uninstall_crash_hook():
+    """Restore the pre-install excepthook (tests)."""
+    if _prev_excepthook[0] is not None:
+        sys.excepthook = _prev_excepthook[0]
+        _prev_excepthook[0] = None

@@ -1,0 +1,239 @@
+"""Span tracing with chrome-trace (Perfetto-compatible) export.
+
+Reference capability: the reference profiler's host event tree + chrome-trace
+export (SURVEY §5.1). Here the host side is a flat, thread-safe list of
+completed spans on the ``time.perf_counter`` clock — the SAME clock the
+profiler's ``RecordEvent`` tree uses, so one exported trace file carries
+trainer steps, checkpoint IO, collective waits, profiler windows, and
+RecordEvent scopes on a single timeline.
+
+Cost discipline: ``span(...)`` on the disabled path returns ONE module-level
+no-op singleton — no allocation, no lock, no clock read; the only work is a
+module-global flag check. Tracing is enabled explicitly (``enable_tracing``)
+or by setting ``PADDLE_TRACE_DIR``, which also registers an atexit export so
+a traced run always leaves a loadable trace file behind.
+
+Usage:
+    with spans.span("train.step", cat="step", step=i): ...
+    @spans.span("load_batch", cat="data")
+    def load_batch(...): ...
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+
+__all__ = ["span", "traced", "tracing_enabled", "enable_tracing",
+           "disable_tracing", "export_chrome_trace", "reset", "events",
+           "dropped"]
+
+ENV_DIR = "PADDLE_TRACE_DIR"
+ENV_MAX = "PADDLE_TRACE_MAX_EVENTS"
+
+_enabled = False
+_trace_dir: str | None = None
+_lock = threading.Lock()
+_events: list[dict] = []
+_dropped = [0]  # spans discarded past the ring bound (bounded memory)
+_atexit_registered = [False]
+
+
+def _read_max_events() -> int:
+    try:
+        return int(os.environ.get(ENV_MAX, "100000"))
+    except ValueError:
+        return 100000
+
+
+# cached: read at enable/reset time, not per span-end under the lock
+_max_events = _read_max_events()
+
+
+class _NoopSpan:
+    """The disabled-path singleton: enter/exit do nothing. As a decorator it
+    late-binds under the function's qualname (span() already dropped the
+    name by the time __call__ runs — use ``traced(name, cat)`` to decorate
+    with an explicit name that survives later ``enable_tracing()``)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def begin(self):
+        return self
+
+    def end(self):
+        return None
+
+    def __call__(self, fn):
+        return traced(fn.__qualname__)(fn)
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """An open span. Context manager, decorator, or manual begin()/end()."""
+
+    __slots__ = ("name", "cat", "args", "_t0")
+
+    def __init__(self, name, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def end(self):
+        if self._t0 is None or not _enabled:
+            return
+        now = time.perf_counter()
+        ev = {
+            "name": self.name, "cat": self.cat, "ph": "X",
+            "ts": self._t0 * 1e6, "dur": (now - self._t0) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+        }
+        if self.args:
+            ev["args"] = self.args
+        self._t0 = None
+        with _lock:
+            if len(_events) < _max_events:
+                _events.append(ev)
+            else:
+                _dropped[0] += 1
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        name, cat, args = self.name, self.cat, self.args
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            if not _enabled:
+                return fn(*a, **k)
+            with _Span(name, cat, args):
+                return fn(*a, **k)
+        return wrapped
+
+
+def span(name: str, cat: str = "user", **args):
+    """Open a span named `name` under category `cat` (the chrome-trace
+    category lane: step / checkpoint / collective / data / resilience /
+    profiler / user). Extra kwargs become trace-event args. Disabled path:
+    returns the no-op singleton — a flag check, nothing else. To DECORATE a
+    function while tracing may still be off, use ``traced`` (it keeps the
+    explicit name; a disabled ``span`` has already dropped it)."""
+    if not _enabled:
+        return _NOOP
+    return _Span(name, cat, args or None)
+
+
+def traced(name: str, cat: str = "user", **args):
+    """Decorator factory: ``@traced("load_batch", cat="data")``. Unlike
+    decorating with ``span(...)`` under disabled tracing, the explicit
+    name/cat/args are captured at decoration time and apply whenever
+    tracing is (later) enabled; per call the disabled cost is one flag
+    check."""
+    span_args = args or None
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            if not _enabled:
+                return fn(*a, **k)
+            with _Span(name, cat, span_args):
+                return fn(*a, **k)
+        return wrapped
+    return deco
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def enable_tracing(trace_dir: str | None = None):
+    """Turn span collection on. `trace_dir` (or $PADDLE_TRACE_DIR) is where
+    export_chrome_trace lands by default; the first enable registers an
+    atexit export so a traced process always leaves a trace file."""
+    global _enabled, _trace_dir, _max_events
+    _trace_dir = trace_dir or os.environ.get(ENV_DIR) or _trace_dir
+    _max_events = _read_max_events()
+    _enabled = True
+    if not _atexit_registered[0]:
+        _atexit_registered[0] = True
+        atexit.register(_export_at_exit)
+
+
+def disable_tracing():
+    global _enabled
+    _enabled = False
+
+
+def reset():
+    """Drop collected spans (tests); tracing stays in its current state."""
+    global _max_events
+    with _lock:
+        _events.clear()
+        _dropped[0] = 0
+    _max_events = _read_max_events()
+
+
+def events() -> list[dict]:
+    with _lock:
+        return list(_events)
+
+
+def dropped() -> int:
+    return _dropped[0]
+
+
+def export_chrome_trace(path: str | None = None) -> str:
+    """Write the collected spans as a chrome://tracing / Perfetto JSON file
+    and return its path. Default location: $PADDLE_TRACE_DIR (or the
+    enable_tracing dir) /trace_<pid>.json. The file is written atomically
+    and is always valid JSON, even with zero spans."""
+    if path is None:
+        base = _trace_dir or os.environ.get(ENV_DIR) or "."
+        os.makedirs(base, exist_ok=True)
+        path = os.path.join(base, f"trace_{os.getpid()}.json")
+    with _lock:
+        evs = list(_events)
+        n_dropped = _dropped[0]
+    meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+             "args": {"name": "paddle_tpu"}}]
+    doc = {"traceEvents": meta + evs, "displayTimeUnit": "ms",
+           "otherData": {"clock": "perf_counter", "dropped_events": n_dropped}}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, default=str)  # numpy scalars etc. in span args
+    os.replace(tmp, path)
+    return path
+
+
+def _export_at_exit():
+    if _enabled and (_trace_dir or os.environ.get(ENV_DIR)):
+        try:
+            export_chrome_trace()
+        except OSError:
+            pass
+
+
+# a run launched with PADDLE_TRACE_DIR set traces from the first import
+if os.environ.get(ENV_DIR):
+    enable_tracing()

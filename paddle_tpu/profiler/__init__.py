@@ -20,6 +20,8 @@ from collections import defaultdict
 
 import jax
 
+from ..observability import spans as _spans
+
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
 
@@ -113,11 +115,16 @@ class RecordEvent:
             tls.first_start = now
         # frame: [name, type, start, child_time_accumulator]
         tls.stack.append([self.name, self.event_type, now, 0.0])
+        # mirror into the observability span stream (same perf_counter
+        # clock), so ONE exported chrome trace carries RecordEvent scopes
+        # next to train-step / checkpoint / collective spans
+        self._span = _spans.span(self.name, cat="profiler").begin()
         self._ann.__enter__()
 
     def end(self):
         from .statistics import EventRecord
         self._ann.__exit__(None, None, None)
+        self._span.end()
         tls = _tree()
         name, etype, t0, child = tls.stack.pop()
         now = time.perf_counter()
@@ -149,6 +156,7 @@ class Profiler:
         self._trace_dir = None
         self._step_times = []
         self._t_last = None
+        self._win_span = None  # open "profiler.window" span while recording
 
     def start(self):
         reset_host_events()  # each profiling window reports its own events
@@ -192,6 +200,11 @@ class Profiler:
             self._stop_trace()
 
     def _start_trace(self):
+        if self._win_span is None:
+            # the scheduler WINDOW itself is a span: the merged chrome trace
+            # shows exactly which steps each profiling window covered
+            self._win_span = _spans.span("profiler.window", cat="profiler",
+                                         step=self._step).begin()
         if not self._tracing:
             self._trace_dir = self._export_dir or os.environ.get(
                 "PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_trace")
@@ -202,6 +215,9 @@ class Profiler:
                 self._tracing = False
 
     def _stop_trace(self):
+        if self._win_span is not None:
+            self._win_span.end()
+            self._win_span = None
         if self._tracing:
             try:
                 jax.profiler.stop_trace()

@@ -544,7 +544,7 @@ class TestElasticRetry:
 # ------------------------------------------------------------ comm watchdog
 
 class TestCommWatchdog:
-    def test_watch_exit_124_names_op_and_group(self):
+    def test_watch_exit_124_names_op_and_group(self, tmp_path):
         code = (
             "import time\n"
             "from paddle_tpu.distributed.comm_watchdog import watch\n"
@@ -556,11 +556,15 @@ class TestCommWatchdog:
         r = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
                            capture_output=True, text=True, timeout=120,
                            env={**os.environ, "JAX_PLATFORMS": "cpu",
-                                "PADDLE_TRAINER_ID": "3"})
+                                "PADDLE_TRAINER_ID": "3",
+                                # the abort path dumps FLIGHT.json (PR 2):
+                                # keep the postmortem out of the repo root
+                                "PADDLE_TRACE_DIR": str(tmp_path)})
         assert r.returncode == 124, (r.returncode, r.stderr[-500:])
         assert "op=allreduce-under-test" in r.stderr
         assert "gid=7" in r.stderr and "ranks=[0, 1]" in r.stderr
         assert "rank=3" in r.stderr
+        assert (tmp_path / "FLIGHT.json").exists()  # abort left the story
 
     def test_watch_no_timeout_is_transparent(self):
         from paddle_tpu.distributed.comm_watchdog import watch

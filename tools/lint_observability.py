@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Static check: runtime telemetry goes through paddle_tpu.observability.
+
+PR 2 unified telemetry into one layer (spans / metrics / flight recorder).
+This lint keeps the tree from regrowing the pre-PR-2 archipelago of stderr
+prints and ad-hoc ``time.time()`` deltas — the pattern that made chaos and
+preemption runs un-postmortem-able.
+
+Flagged (AST-based):
+  O1 bare-print      : a ``print(...)`` call in paddle_tpu/. Runtime events
+     belong in ``observability.recorder.record(..., echo=True)`` (the
+     recorder still writes the stderr line AND keeps it for FLIGHT.json).
+  O2 raw-wall-timing : a ``time.time() - x`` / ``x - time.time()``
+     subtraction — ad-hoc duration math on the WALL clock. Durations belong
+     in ``metrics.timer(name)`` / ``spans.span(name)``; wall-clock reads
+     without subtraction (timestamps, deadlines via addition/comparison)
+     are fine.
+
+Exemptions:
+  * paddle_tpu/observability/ and paddle_tpu/profiler/ (they ARE the layer)
+  * files in ALLOWLIST — interactive/user-facing printers whose stdout IS
+    the product (model summaries, CLI launchers, build tools), each with a
+    recorded reason
+  * a line carrying ``# observability: ok (<why>)`` — an audited use (e.g.
+    a wall-clock liveness TTL that looks like timing math). The why is
+    mandatory: a bare marker is itself a finding.
+
+Run: python tools/lint_observability.py [root]   (exit 1 on findings)
+Wired into tier-1 via tests/test_observability.py::TestLint.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+EXEMPT_DIRS = (
+    os.path.join("paddle_tpu", "observability"),
+    os.path.join("paddle_tpu", "profiler"),
+)
+
+# user-facing printers: stdout is their product, not runtime telemetry
+ALLOWLIST = {
+    "paddle_tpu/hapi/callbacks.py":        "ProgBarLogger: the training progress bar",
+    "paddle_tpu/hapi/summary.py":          "model summary tables (paddle.summary parity)",
+    "paddle_tpu/amp/debugging.py":         "user-invoked op-list debug printer",
+    "paddle_tpu/optimizer/lr.py":          "LRScheduler(verbose=True) reference parity",
+    "paddle_tpu/distributed/auto_tuner/__init__.py": "interactive tuning progress report",
+    "paddle_tpu/utils/cpp_extension.py":   "build-tool output",
+    "paddle_tpu/distributed/launch/main.py": "CLI launcher stdout",
+}
+
+MARKER = "# observability: ok ("
+
+
+def _is_print(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print")
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def lint_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        yield ("SYNTAX", e.lineno or 0, f"unparseable: {e.msg}")
+        return
+    lines = src.splitlines()
+
+    def marked(lineno: int) -> bool:
+        return lineno - 1 < len(lines) and MARKER in lines[lineno - 1]
+
+    for node in ast.walk(tree):
+        if _is_print(node) and not marked(node.lineno):
+            yield ("O1", node.lineno,
+                   "bare print(): route runtime events through "
+                   "observability.recorder.record(..., echo=True), or mark "
+                   "the line '# observability: ok (<why>)' if stdout is the "
+                   "product")
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            if (_is_time_time(node.left) or _is_time_time(node.right)) \
+                    and not marked(node.lineno):
+                yield ("O2", node.lineno,
+                       "raw time.time() duration math: use "
+                       "observability.metrics.timer(name) / spans.span(name) "
+                       "(or time.perf_counter for a monotonic clock), or "
+                       "mark '# observability: ok (<why>)'")
+
+
+def iter_py_files(root: str):
+    pkg = os.path.join(root, "paddle_tpu")
+    for base, dirs, files in os.walk(pkg):
+        rel_base = os.path.relpath(base, root)
+        if any(rel_base == d or rel_base.startswith(d + os.sep)
+               for d in EXEMPT_DIRS):
+            continue
+        for fn in files:
+            if fn.endswith(".py"):
+                p = os.path.join(base, fn)
+                if os.path.relpath(p, root).replace(os.sep, "/") in ALLOWLIST:
+                    continue
+                yield p
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = []
+    for path in sorted(iter_py_files(root)):
+        for rule, lineno, msg in lint_file(path):
+            findings.append((os.path.relpath(path, root), lineno, rule, msg))
+    for path, lineno, rule, msg in findings:
+        print(f"{path}:{lineno}: [{rule}] {msg}")
+    if findings:
+        print(f"\n{len(findings)} observability-lint finding(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
