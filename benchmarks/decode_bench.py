@@ -1,35 +1,66 @@
-"""KV-cache decode throughput on the TPU chip (VERDICT r2 next #2).
+"""KV-cache decode throughput (VERDICT r2 next #2; paged mode PR 3).
+
+Dense mode (default) times the compiled prefill+scan generate
+(models/llama_decode.py) and prints one JSON line with decode tokens/s.
+The whole generate is ONE executable; sync via np.asarray of the result
+(tunnel: block_until_ready lies — ROUND2_PERF.md).
 
     python benchmarks/decode_bench.py [B] [PROMPT] [NEW]
 
-Times the compiled prefill+scan generate (models/llama_decode.py) on the
-850M flagship config and prints one JSON line with decode tokens/s.
-The whole generate is ONE executable; sync via np.asarray of the result
-(tunnel: block_until_ready lies — ROUND2_PERF.md).
+Paged mode serves a mixed-length workload through the paged
+ContinuousBatcher (inference/serving.py + models/llama_paged.py) and emits
+the two numbers the paged design is FOR:
+
+  * kv_read_bytes_per_token — the per-token K/V bytes the decode attention
+    actually gathers (page bucket × page size), next to the dense
+    worst-case (max_len) it replaces;
+  * executables — compiled-program inventory (one burst per page bucket +
+    one prefill per prompt bucket), read straight off the jit caches, so
+    the O(buckets) bound is a measured fact, not a claim.
+
+    python benchmarks/decode_bench.py --paged [N_REQ] [MAX_BATCH] [BURST]
+
+On CPU both modes drop to the tiny config automatically (the 850M flagship
+sizing stays TPU-only) — that is what the tier-1 smoke
+(tests/test_serving_paged.py) runs to pin the compile-count bound.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main():
-    B = int(sys.argv[1]) if len(sys.argv) > 1 else 1
-    prompt = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    new = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+
+def _flagship_or_tiny(on_tpu, jnp):
+    from paddle_tpu.models.llama import LlamaConfig
+    if on_tpu:
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=14, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype=jnp.bfloat16), 850
+    return LlamaConfig.tiny(num_hidden_layers=2), 0
+
+
+def _dense_main(args) -> dict:
+    B = int(args[0]) if len(args) > 0 else 1
+    prompt = int(args[1]) if len(args) > 1 else 128
+    new = int(args[2]) if len(args) > 2 else 128
 
     import jax
     import jax.numpy as jnp
-    from paddle_tpu.models.llama import LlamaConfig, llama_init_params
+    from paddle_tpu.models.llama import llama_init_params
     from paddle_tpu.models.llama_decode import llama_generate
 
-    cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_hidden_layers=14, num_attention_heads=16, num_key_value_heads=16,
-        max_position_embeddings=2048, dtype=jnp.bfloat16)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg, params_m = _flagship_or_tiny(on_tpu, jnp)
+    if not on_tpu:
+        prompt, new = min(prompt, 32), min(new, 16)
     params = llama_init_params(cfg, jax.random.PRNGKey(0))
     toks = jnp.asarray(np.random.RandomState(0).randint(
         0, cfg.vocab_size, (B, prompt)).astype(np.int32))
@@ -47,15 +78,99 @@ def main():
         times.append(time.perf_counter() - t0)
 
     dt = float(np.median(times))
-    print(json.dumps({
+    return {
         "metric": "llama_decode_tokens_per_sec",
         "config": {"B": B, "prompt": prompt, "new_tokens": new,
-                   "params_m": 850},
+                   "params_m": params_m},
         "total_ms_median": round(dt * 1e3, 1),
         "decode_tokens_per_sec": round(B * new / dt, 1),
         "ms_per_token": round(dt * 1e3 / new, 2),
         "compile_s": round(compile_s, 1),
-    }))
+        "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+    }
+
+
+def _paged_main(args) -> dict:
+    n_req = int(args[0]) if len(args) > 0 else 16
+    max_batch = int(args[1]) if len(args) > 1 else 8
+    burst = int(args[2]) if len(args) > 2 else 16
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.models.llama import llama_init_params
+    from paddle_tpu.models.llama_paged import (
+        llama_paged_decode_burst, llama_paged_prefill_slot,
+        paged_kv_bytes_per_token)
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg, params_m = _flagship_or_tiny(on_tpu, jnp)
+    if on_tpu:
+        max_len, buckets, page_size = 512, (64, 128, 256), 64
+        lens, budgets = [24, 57, 100, 190], [32, 64, 96]
+    else:
+        max_len, buckets, page_size = 96, (16, 32), 8
+        lens, budgets = [5, 11, 23, 30], [4, 8, 12]
+        n_req = min(n_req, 8)
+        max_batch = min(max_batch, 4)
+    params = llama_init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(1, cfg.vocab_size, int(n)).tolist(), int(m))
+            for n, m in zip(rng.choice(lens, n_req),
+                            rng.choice(budgets, n_req))]
+    total_new = sum(m for _, m in reqs)
+
+    def serve():
+        eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
+                                max_len=max_len, prompt_buckets=buckets,
+                                burst=burst, kv_layout="paged",
+                                page_size=page_size)
+        for p, m in reqs:
+            eng.add_request(p, max_new_tokens=m)
+        eng.run()
+        return eng
+
+    serve()  # compile pass
+    t0 = time.perf_counter()
+    eng = serve()
+    dt = time.perf_counter() - t0
+
+    buckets_used = eng.stats["page_buckets_used"]
+    worst_bucket = max(buckets_used) if buckets_used else 0
+    dense_pages = (max_len - 1) // page_size + 1
+    return {
+        "metric": "llama_paged_decode_tokens_per_sec",
+        "value": round(total_new / dt, 1),
+        "unit": "tokens/s",
+        "config": {"requests": n_req, "max_batch": max_batch,
+                   "burst": burst, "max_len": max_len,
+                   "page_size": page_size, "params_m": params_m,
+                   "prompt_buckets": list(buckets),
+                   "page_buckets": list(eng._page_buckets)},
+        "page_buckets_used": buckets_used,
+        "bursts_run": eng.stats["bursts"],
+        # per-token K/V bytes the attention gathers at the widest bucket
+        # this workload hit, vs the dense layout's always-max_len read
+        "kv_read_bytes_per_token": paged_kv_bytes_per_token(
+            cfg, worst_bucket, page_size),
+        "kv_read_bytes_per_token_dense": paged_kv_bytes_per_token(
+            cfg, dense_pages, page_size),
+        # measured executable inventory: the O(buckets) bound as a fact
+        "executables": {
+            "paged_burst": llama_paged_decode_burst._cache_size(),
+            "paged_prefill": llama_paged_prefill_slot._cache_size(),
+        },
+        "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+    }
+
+
+def main(argv=None) -> dict:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    paged = "--paged" in argv
+    args = [a for a in argv if not a.startswith("--")]
+    payload = _paged_main(args) if paged else _dense_main(args)
+    print(json.dumps(payload))
+    return payload
 
 
 if __name__ == "__main__":

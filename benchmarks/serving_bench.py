@@ -118,18 +118,25 @@ def main():
     seq_s = time.perf_counter() - t0
 
     # ---- continuous batching (includes its compiles on first run; measure
-    # a second pass for steady-state, same as sequential)
-    def serve():
+    # a second pass for steady-state, same as sequential). Both KV layouts
+    # are timed: paged (block-table pool, the default) and dense slots.
+    def serve(kv_layout):
         eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
                                 max_len=max_len, prompt_buckets=buckets,
-                                burst=burst)
+                                burst=burst, kv_layout=kv_layout,
+                                page_size=64 if on_tpu else 8)
         rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
         return eng, rids, eng.run()
 
-    serve()  # compile pass
+    serve("paged")  # compile pass
     t0 = time.perf_counter()
-    eng, rids, out = serve()
+    eng, rids, out = serve("paged")
     cont_s = time.perf_counter() - t0
+
+    serve("dense")  # compile pass
+    t0 = time.perf_counter()
+    _, dense_rids, dense_out = serve("dense")
+    dense_s = time.perf_counter() - t0
 
     # With trained weights greedy equality is a HARD assertion (logits
     # peaked, no load-bearing argmax ties); with random weights
@@ -137,29 +144,37 @@ def main():
     # bf16 ties differently and the count is informational only. The f32
     # CPU suite (tests/test_serving.py) pins exact equality either way.
     mismatch = sum(out[r] != s for r, s in zip(rids, seq_out))
+    paged_vs_dense = sum(out[r] != dense_out[d]
+                         for r, d in zip(rids, dense_rids))
 
     print(json.dumps({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": round(total_new / cont_s, 1),
         "unit": "tokens/s",
+        "kv_layout": "paged",
         "vs_sequential_b1": round(seq_s / cont_s, 2),
+        "vs_dense_slots": round(dense_s / cont_s, 2),
         "config": {"requests": n_req, "max_batch": max_batch,
                    "burst": burst, "prompt_lens": lens.tolist(),
                    "budgets": budgets.tolist(),
-                   "bursts_run": eng.stats["bursts"]},
+                   "bursts_run": eng.stats["bursts"],
+                   "page_buckets_used": eng.stats["page_buckets_used"]},
         "sequential_tokens_per_sec": round(total_new / seq_s, 1),
+        "dense_tokens_per_sec": round(total_new / dense_s, 1),
         "trained_weights": bool(train_steps),
         "greedy_divergent_requests": mismatch,
+        "paged_vs_dense_divergent_requests": paged_vs_dense,
         "device": str(getattr(jax.devices()[0], "device_kind", "?")),
     }))
 
     # hard parity gate AFTER the JSON line: the measured throughputs must
     # never be discarded by the failure they diagnose (cf. bench.py
     # _record_latest rationale). Plain `if` — `assert` dies under -O.
-    if train_steps and mismatch:
-        print(f"# FAIL: {mismatch}/{n_req} requests diverged between "
-              f"continuous and sequential serving WITH TRAINED WEIGHTS — "
-              f"a real numerics bug, not a bf16 tiebreak", file=sys.stderr)
+    if train_steps and (mismatch or paged_vs_dense):
+        print(f"# FAIL: {mismatch}/{n_req} paged-vs-sequential and "
+              f"{paged_vs_dense}/{n_req} paged-vs-dense requests diverged "
+              f"WITH TRAINED WEIGHTS — a real numerics bug, not a bf16 "
+              f"tiebreak", file=sys.stderr)
         return 1
     return 0
 

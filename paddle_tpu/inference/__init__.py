@@ -32,7 +32,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
            "PrecisionType", "LLMPredictor", "ContinuousBatcher",
-           "PredictorPool"]
+           "PredictorPool", "PageAllocator"]
 
 
 class PrecisionType:
@@ -313,4 +313,5 @@ class LLMPredictor:
                 "avg_ms": 1e3 * sum(ts) / len(ts)}
 
 
+from .paging import PageAllocator  # noqa: E402
 from .serving import ContinuousBatcher, PredictorPool  # noqa: E402

@@ -1,0 +1,87 @@
+"""Host-side page accounting for the paged KV cache.
+
+The device side (models/llama_paged.py) sees only a page pool and block
+tables; WHICH physical page holds which request's tokens is pure host
+metadata, managed here. Pages are interchangeable (no fragmentation — any
+free page serves any slot), so the allocator is a plain LIFO free list.
+
+Physical page 0 is reserved as the SCRATCH page: retired/idle slots point
+their whole block-table row at it so their frozen in-flight writes land
+somewhere no live request reads. ``PageAllocator`` therefore never hands
+out page 0; ``usable`` is ``num_pages - 1``.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+__all__ = ["PageAllocator", "SCRATCH_PAGE", "default_page_buckets",
+           "pages_for"]
+
+SCRATCH_PAGE = 0
+
+
+def pages_for(n_positions: int, page_size: int) -> int:
+    """Pages needed to hold positions [0, n_positions)."""
+    if n_positions <= 0:
+        return 0
+    return (int(n_positions) - 1) // int(page_size) + 1
+
+
+def default_page_buckets(max_pages: int) -> tuple:
+    """Powers-of-two page counts up to (and always including) max_pages —
+    the same executable-inventory/bandwidth trade as prompt buckets: a
+    burst compiles per bucket, and reads scale with the bucket instead of
+    the worst case."""
+    max_pages = int(max_pages)
+    out, b = [], 1
+    while b < max_pages:
+        out.append(b)
+        b *= 2
+    out.append(max_pages)
+    return tuple(sorted(set(out)))
+
+
+class PageAllocator:
+    """LIFO free list over ``num_pages`` physical pages (page 0 reserved).
+
+    ``alloc`` is all-or-nothing: a partially satisfiable request returns
+    None and leaves the free list untouched, so callers can treat "not
+    enough pages" as one atomic admission/growth decision.
+    """
+
+    def __init__(self, num_pages: int):
+        num_pages = int(num_pages)
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is scratch)")
+        self.num_pages = num_pages
+        # low page ids first: keeps early traffic in a compact prefix,
+        # which makes pool dumps human-readable
+        self._free = list(range(num_pages - 1, SCRATCH_PAGE, -1))
+
+    @property
+    def usable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.usable - len(self._free)
+
+    def alloc(self, n: int) -> list | None:
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, page_ids: Sequence[int]) -> None:
+        for p in page_ids:
+            p = int(p)
+            if p == SCRATCH_PAGE or p >= self.num_pages:
+                raise ValueError(f"freeing invalid page {p}")
+            self._free.append(p)
+        if len(self._free) > self.usable:
+            raise RuntimeError("double free: free list exceeds pool")

@@ -1,4 +1,4 @@
-"""Continuous-batching LLM serving (VERDICT r3 next #8).
+"""Continuous-batching LLM serving (VERDICT r3 next #8; paged KV PR 3).
 
 Reference bar: ``PredictorPool`` (/root/reference/paddle/fluid/inference/
 api/paddle_inference_api.h:253) — the reference serves concurrency by
@@ -6,33 +6,67 @@ pooling whole predictors, one request per predictor at a time. The
 TPU-native design does better: ONE compiled decode whose batch dimension
 is a pool of slots with independent per-slot positions, so requests of
 different prompt lengths and generation budgets share every MXU step
-(iteration-level scheduling, the vLLM/Orca idea, expressed as two XLA
-executables):
+(iteration-level scheduling, the vLLM/Orca idea):
 
-  * admit — a queued request prefills into any free slot
-    (``llama_prefill_slot``: prompt bucketed to a few static lengths, one
-    executable per bucket; the cache row-range of just that slot is
-    overwritten);
-  * decode — ``llama_decode_burst`` scans N single-token steps over ALL
-    active slots; a slot retires on EOS or its length budget and emits
-    padding until the host swaps a new request in between bursts.
+  * admit — a queued request prefills into any free slot (prompt bucketed
+    to a few static lengths, one executable per bucket);
+  * decode — a burst scans N single-token steps over ALL active slots; a
+    slot retires on EOS or its length budget and emits padding until the
+    host swaps a new request in between bursts.
 
-The scheduler below is plain host Python between device calls: it owns the
-request queue, slot table, and per-request output buffers. burst=1 gives
-token-level admission latency; larger bursts amortize dispatch.
+Two KV layouts share that scheduler:
 
-``PredictorPool`` (API parity with the reference) is also provided as a
-thin pool of independent predictors for the thread-per-request style.
+  * ``kv_layout="paged"`` (default) — a shared ``[num_pages, page_size,
+    KV, hd]`` pool per layer with per-slot block tables
+    (models/llama_paged.py, the Ragged-Paged-Attention idea at the XLA
+    level). Cache HBM scales with LIVE tokens (pages alloc on admit, free
+    on retire) and decode attention gathers only ``page_bucket ×
+    page_size`` rows — bandwidth follows actual context length. Admission
+    is gated by free pages, not by ``max_batch × max_len`` worst case;
+    when the pool runs dry mid-flight the youngest slot is preempted back
+    to the queue (its tokens regenerate exactly at temperature=0). The
+    scheduler is OVERLAPPED: each step dispatches the burst first, then
+    does all host work (queue pop, bucketing, page alloc/free, prefill
+    dispatch, output drain) while the device runs, and blocks exactly once
+    on the EOS/pos readback.
+  * ``kv_layout="dense"`` — the PR-before layout: per-slot
+    ``[max_batch, max_len]`` rows, full-``max_len`` masked reads. Kept as
+    the equivalence baseline (paged output is token-identical at
+    temperature=0, pinned by tests/test_serving_paged.py) and for tiny
+    models where paging overhead isn't worth it.
+
+Chaos sites (PADDLE_CHAOS, ROADMAP PR 1 follow-up): ``serve.admit`` fails
+one admission (that request retires with partial output), ``serve.burst``
+fails one burst (every active request retires with what it has) — the
+scheduler keeps serving the queue either way, never wedges.
+
+Metrics published (observability.metrics): ``serve.pages_in_use`` gauge,
+``serve.tokens`` / ``serve.requests`` / ``serve.admission_stalls`` /
+``serve.preemptions`` / ``serve.chaos_retired`` counters,
+``serve.tokens_per_s`` and ``serve.kv_read_mb_per_tok`` gauges,
+``serve.burst_time_s`` histogram.
+
+The host scheduler is plain Python between device calls: it owns the
+request queue, slot table, block tables, and per-request output buffers.
+burst=1 gives token-level admission latency; larger bursts amortize
+dispatch. ``PredictorPool`` (API parity with the reference) is also
+provided as a thin pool of independent predictors.
 """
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..distributed.resilience import chaos
+from ..observability import metrics
+from .paging import (PageAllocator, SCRATCH_PAGE, default_page_buckets,
+                     pages_for)
 
 __all__ = ["ContinuousBatcher", "PredictorPool", "ServedRequest"]
 
@@ -53,9 +87,10 @@ class ContinuousBatcher:
     rid = engine.add_request([1, 2, 3], max_new_tokens=64)
     results = engine.run()          # {rid: [generated token ids]}
 
-    Executable inventory (all compiled once, reused forever):
-    one prefill per prompt bucket + one burst — independent of request
-    count, prompt mix, and admission order.
+    Executable inventory (all compiled once, reused forever): one prefill
+    per prompt bucket + one burst per page-count bucket (dense: exactly
+    one burst) — O(prompt buckets + page buckets), independent of request
+    count, prompt mix, context lengths, and admission order.
     """
 
     def __init__(self, model_config, params, max_batch: int = 4,
@@ -63,8 +98,9 @@ class ContinuousBatcher:
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256),
                  burst: int = 8, eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 precision: str | None = None):
-        from ..models.llama_decode import init_kv_cache
+                 precision: str | None = None, kv_layout: str = "paged",
+                 page_size: int = 16, num_pages: int | None = None,
+                 page_buckets: Sequence[int] | None = None):
         self._dequant = None
         if precision in ("int8", "weight_only_int8"):
             # int8 weight-only serving: weights live quantized in HBM and
@@ -97,49 +133,141 @@ class ContinuousBatcher:
         self._temp, self._top_k = float(temperature), int(top_k)
         self._key = jax.random.PRNGKey(seed)
 
-        self._cache = init_kv_cache(model_config, self.B, self.S)
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        self._layout = kv_layout
         # Slot state lives HOST-side as numpy and is uploaded per burst
-        # call (four tiny [B] arrays). The alternative — device arrays
-        # updated with .at[].set per admission and read back per decision —
-        # costs one device→host sync per touch, and on a tunneled TPU a
-        # sync is ~60 ms of RTT: the r4 serving bench measured 200 ms per
-        # ADMISSION before this batching (one int(first) sync each).
+        # call (four tiny [B] arrays + the block table). The alternative —
+        # device arrays updated with .at[].set per admission and read back
+        # per decision — costs one device→host sync per touch, and on a
+        # tunneled TPU a sync is ~60 ms of RTT: the r4 serving bench
+        # measured 200 ms per ADMISSION before this batching.
         self._pos = np.zeros(self.B, np.int32)
         self._tok = np.zeros(self.B, np.int32)
         self._done = np.ones(self.B, bool)         # done == slot free
         self._limit = np.zeros(self.B, np.int32)
         self._slot_req: list[ServedRequest | None] = [None] * self.B
 
+        if self._layout == "paged":
+            from ..models.llama_paged import init_paged_kv_cache
+            self._ps = int(page_size)
+            if self._ps < 1:
+                raise ValueError("page_size must be >= 1")
+            slot_max_pages = pages_for(self.S, self._ps)
+            if num_pages is None:
+                # capacity parity with the dense layout (+1 scratch); size
+                # DOWN for real memory savings — admission degrades to
+                # queueing, never to a crash
+                num_pages = self.B * slot_max_pages + 1
+            self._alloc = PageAllocator(num_pages)
+            pb = (default_page_buckets(slot_max_pages) if page_buckets is None
+                  else tuple(sorted({min(int(p), slot_max_pages)
+                                     for p in page_buckets if int(p) >= 1})))
+            if not pb or pb[-1] < slot_max_pages:
+                pb = tuple(sorted(set(pb) | {slot_max_pages}))
+            self._page_buckets = pb
+            self._cache = init_paged_kv_cache(model_config, num_pages,
+                                              self._ps)
+            # per-slot block tables (host truth); device table is built per
+            # burst. _admit_seq orders slots by admission for preemption.
+            self._page_tbl: list[list[int]] = [[] for _ in range(self.B)]
+            self._admit_seq = [0] * self.B
+            self._seq = 0
+        else:
+            from ..models.llama_decode import init_kv_cache
+            self._cache = init_kv_cache(model_config, self.B, self.S)
+
         self._queue: deque[ServedRequest] = deque()
         self._finished: dict[int, ServedRequest] = {}
         self._next_rid = 0
-        self.stats = {"bursts": 0, "decode_steps": 0, "prefills": 0}
+        self.stats = {"bursts": 0, "decode_steps": 0, "prefills": 0,
+                      "admission_stalls": 0, "preemptions": 0,
+                      "chaos_retired": 0, "max_concurrent": 0,
+                      "page_buckets_used": []}
 
     # ------------------------------------------------------------- intake
     def add_request(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        """Enqueue one request. Budget violations are rejected HERE, at
+        enqueue time — an over-budget request must never be admitted and
+        then silently truncated (or, paged, wedge the queue forever waiting
+        for pages that cannot exist)."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens} "
+                "(0 would still emit the prefill token — reject, don't "
+                "silently over-deliver)")
         if len(prompt) > self._buckets[-1]:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds the largest bucket "
                 f"{self._buckets[-1]}")
         if len(prompt) + max_new_tokens > self.S:
-            raise ValueError("prompt + max_new_tokens exceeds max_len")
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self.S}")
+        if self._layout == "paged":
+            worst = max(pages_for(len(prompt) + max_new_tokens, self._ps),
+                        pages_for(self._bucket_len(len(prompt)), self._ps))
+            if worst > self._alloc.usable:
+                raise ValueError(
+                    f"request needs {worst} pages but the pool only has "
+                    f"{self._alloc.usable} usable — it could never be "
+                    "admitted (grow num_pages or shrink the request)")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(ServedRequest(rid, prompt, int(max_new_tokens)))
+        self._queue.append(ServedRequest(rid, prompt, max_new_tokens))
+        metrics.counter("serve.requests").inc()
         return rid
 
     def _bucket_len(self, n: int) -> int:
         return next(b for b in self._buckets if b >= n)
 
+    # ----------------------------------------------------------- shared
+    def _finish(self, req: ServedRequest) -> None:
+        req.done = True
+        self._finished[req.rid] = req
+
+    def _retire_slot(self, slot: int) -> None:
+        """Free a slot (and, paged, its pages) after its request finished
+        or was chaos-retired. The slot's frozen writes are redirected to
+        row 0 / the scratch page by zeroing its host state."""
+        self._slot_req[slot] = None
+        self._pos[slot] = 0
+        self._tok[slot] = self.pad_id
+        self._done[slot] = True
+        self._limit[slot] = 0
+        if self._layout == "paged":
+            self._alloc.free(self._page_tbl[slot])
+            self._page_tbl[slot] = []
+            metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+
+    def _retire_all_active(self, why: str) -> None:
+        """A faulted burst retires every active request with the output it
+        has so far — degraded service, never a wedged scheduler."""
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self.stats["chaos_retired"] += 1
+            metrics.counter("serve.chaos_retired").inc()
+            self._finish(req)
+            self._retire_slot(slot)
+
     # ------------------------------------------------------------- admit
-    def _admit(self):
+    def _admit_dense(self):
         from ..models.llama_decode import llama_prefill_slot
         staged = []  # (req, slot, tlen, first_device_scalar)
         while self._queue and None in self._slot_req:
             req = self._queue.popleft()
+            try:
+                chaos.hit("serve.admit")
+            except chaos.ChaosError:
+                self.stats["chaos_retired"] += 1
+                metrics.counter("serve.chaos_retired").inc()
+                self._finish(req)  # partial (empty) output, queue moves on
+                continue
             slot = self._slot_req.index(None)
             tlen = len(req.prompt)
             tb = self._bucket_len(tlen)
@@ -163,8 +291,7 @@ class ContinuousBatcher:
         for (req, slot, tlen, _), first in zip(staged, firsts):
             req.out.append(first)
             if req.max_new_tokens <= 1 or first == self.eos_id:
-                req.done = True
-                self._finished[req.rid] = req
+                self._finish(req)
                 self._slot_req[slot] = None
                 continue
             self._pos[slot] = tlen
@@ -173,14 +300,223 @@ class ContinuousBatcher:
             self._limit[slot] = min(tlen + req.max_new_tokens - 1,
                                     self.S - 1)
 
+    # ------------------------------------------------- paged scheduling
+    def _preempt(self, slot: int) -> None:
+        """Pool ran dry mid-flight: push the youngest slot's request back
+        to the FRONT of the queue and restart it later from scratch. At
+        temperature=0 the regenerated tokens are identical, so preemption
+        is invisible in the output (sampling runs get a fresh trajectory —
+        documented degraded mode, not corruption)."""
+        req = self._slot_req[slot]
+        # serve.tokens already counted these emissions and counters are
+        # monotonic by contract: record the discard so delivered tokens =
+        # serve.tokens - serve.tokens_discarded stays derivable
+        metrics.counter("serve.tokens_discarded").inc(len(req.out))
+        req.out = []
+        self._queue.appendleft(req)
+        self._retire_slot(slot)
+        self.stats["preemptions"] += 1
+        metrics.counter("serve.preemptions").inc()
+
+    def _dispatch_burst_paged(self):
+        """Grow block tables to cover this burst's writes, then dispatch
+        the paged burst ASYNCHRONOUSLY. Returns (old_pos, device futures)
+        or None when nothing is active. No host sync here."""
+        from ..models.llama_paged import (llama_paged_decode_burst,
+                                          paged_kv_bytes_per_token)
+        active = [b for b, r in enumerate(self._slot_req) if r is not None]
+        if not active:
+            return None
+        try:
+            chaos.hit("serve.burst")
+        except chaos.ChaosError:
+            self._retire_all_active("chaos serve.burst")
+            return None
+        # page growth, preempting youngest-first when the pool is dry (a
+        # lone slot always fits: add_request rejected anything that can't)
+        while True:
+            grown = True
+            for b in list(active):
+                last_pos = min(int(self._pos[b]) + self.burst - 1,
+                               int(self._limit[b]))
+                deficit = pages_for(last_pos + 1, self._ps) \
+                    - len(self._page_tbl[b])
+                if deficit <= 0:
+                    continue
+                got = self._alloc.alloc(deficit)
+                if got is not None:
+                    self._page_tbl[b].extend(got)
+                    continue
+                victim = max(active, key=lambda s: self._admit_seq[s])
+                self._preempt(victim)
+                active.remove(victim)
+                grown = False
+                break
+            if grown:
+                break
+            if not active:
+                return None
+        metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+
+        width = max(len(self._page_tbl[b]) for b in active)
+        P = next(p for p in self._page_buckets if p >= width)
+        if P not in self.stats["page_buckets_used"]:
+            self.stats["page_buckets_used"] = sorted(
+                self.stats["page_buckets_used"] + [P])
+        metrics.gauge("serve.kv_read_mb_per_tok").set(
+            paged_kv_bytes_per_token(self._cfg, P, self._ps) / 1e6)
+        bt = np.full((self.B, P), SCRATCH_PAGE, np.int32)
+        for b in active:
+            ids = self._page_tbl[b]
+            bt[b, :len(ids)] = ids
+
+        old_pos = self._pos.copy()
+        self._key, sub = jax.random.split(self._key)
+        (self._cache, pos_d, tok_d, done_d, emitted_d) = \
+            llama_paged_decode_burst(
+                self._params, self._cache, jnp.asarray(bt),
+                jnp.asarray(self._pos), jnp.asarray(self._tok),
+                jnp.asarray(self._done), jnp.asarray(self._limit),
+                jnp.int32(self.eos_id), sub, config=self._cfg, n=self.burst,
+                temperature=self._temp, top_k=self._top_k,
+                pad_id=self.pad_id, dequant=self._dequant)
+        self.stats["bursts"] += 1
+        self.stats["decode_steps"] += self.burst
+        return old_pos, pos_d, tok_d, done_d, emitted_d
+
+    def _admit_paged(self):
+        """Pop + bucket + allocate + dispatch prefills — all host work that
+        OVERLAPS the in-flight burst. Admission is gated by free pages (and
+        a free slot), never by a worst-case length reservation. Returns the
+        staged list; nothing blocks here."""
+        from ..models.llama_paged import llama_paged_prefill_slot
+        staged = []  # (req, slot, tlen, first_device_scalar)
+        stalled = False
+        while self._queue and None in self._slot_req:
+            req = self._queue[0]
+            tlen = len(req.prompt)
+            tb = self._bucket_len(tlen)
+            bucket_pages = pages_for(tb, self._ps)
+            if self._alloc.free_pages < bucket_pages:
+                stalled = True  # stays queued; pages free as slots retire
+                break
+            self._queue.popleft()
+            try:
+                chaos.hit("serve.admit")
+            except chaos.ChaosError:
+                self.stats["chaos_retired"] += 1
+                metrics.counter("serve.chaos_retired").inc()
+                self._finish(req)  # partial (empty) output, queue moves on
+                continue
+            pages = self._alloc.alloc(bucket_pages)
+            slot = self._slot_req.index(None)
+            toks = np.full(tb, self.pad_id, np.int32)
+            toks[:tlen] = req.prompt
+            self._key, sub = jax.random.split(self._key)
+            first, self._cache = llama_paged_prefill_slot(
+                self._params, self._cache, jnp.asarray(toks),
+                jnp.asarray(np.asarray(pages, np.int32)), jnp.int32(tlen),
+                sub, config=self._cfg, temperature=self._temp,
+                top_k=self._top_k, dequant=self._dequant)
+            # pages past the real prompt hold only bucket-pad garbage the
+            # mask never exposes — return them right away; the pre-burst
+            # growth path re-allocates the decode page when it's needed
+            keep = pages_for(tlen, self._ps)
+            self._alloc.free(pages[keep:])
+            self._page_tbl[slot] = pages[:keep]
+            self._slot_req[slot] = req  # reserved; state lands at the sync
+            self._admit_seq[slot] = self._seq = self._seq + 1
+            self.stats["prefills"] += 1
+            staged.append((req, slot, tlen, first))
+        if stalled:
+            self.stats["admission_stalls"] += 1
+            metrics.counter("serve.admission_stalls").inc()
+        metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+        return staged
+
+    def _sync_merge_paged(self, inflight, staged) -> int:
+        """THE one blocking point per step: a single device_get covering
+        the burst readback and every staged first token, then pure host
+        bookkeeping (drain outputs, retire, install admissions)."""
+        if inflight is None and not staged:
+            return 0
+        burst_vals, firsts = jax.device_get(
+            (inflight[1:] if inflight else (),
+             [f for *_, f in staged]))
+        emitted_total = 0
+        staged_slots = {s for _, s, _, _ in staged}
+        if inflight:
+            old_pos = inflight[0]
+            pos, tok, done, emitted = burst_vals
+            self._pos = np.array(pos)    # device_get views are read-only;
+            self._tok = np.array(tok)    # admissions write these in place
+            self._done = np.array(done)
+            emitted = np.asarray(emitted)
+            for slot, req in enumerate(self._slot_req):
+                # slots staged THIS step were frozen (done) for the burst:
+                # their n_new is 0 and their done flag is stale — skip
+                if req is None or slot in staged_slots:
+                    continue
+                n_new = int(self._pos[slot] - old_pos[slot])
+                req.out.extend(int(t) for t in emitted[:n_new, slot])
+                emitted_total += n_new
+                if done[slot]:
+                    self._finish(req)
+                    self._retire_slot(slot)
+        for (req, slot, tlen, _), first in zip(staged, firsts):
+            first = int(first)
+            req.out.append(first)
+            emitted_total += 1
+            if req.max_new_tokens <= 1 or first == self.eos_id:
+                self._finish(req)
+                self._retire_slot(slot)
+                continue
+            self._pos[slot] = tlen
+            self._tok[slot] = first
+            self._done[slot] = False
+            self._limit[slot] = min(tlen + req.max_new_tokens - 1,
+                                    self.S - 1)
+        metrics.counter("serve.tokens").inc(emitted_total)
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"],
+            sum(r is not None for r in self._slot_req))
+        return emitted_total
+
     # ------------------------------------------------------------- decode
     def step(self):
-        """One scheduling iteration: admit, then one decode burst."""
+        """One scheduling iteration.
+
+        Paged (overlap-scheduled): dispatch the burst async → do ALL host
+        scheduling while the device runs → block once on the combined
+        readback. Dense (legacy order): admit synchronously, then burst.
+        """
+        if self._layout == "paged":
+            t0 = time.perf_counter()
+            inflight = self._dispatch_burst_paged()
+            staged = self._admit_paged()
+            emitted = self._sync_merge_paged(inflight, staged)
+            dt = time.perf_counter() - t0
+            metrics.histogram("serve.burst_time_s").observe(dt)
+            if emitted and dt > 0:
+                metrics.gauge("serve.tokens_per_s").set(emitted / dt)
+            return
+        self._step_dense()
+
+    def _step_dense(self):
         from ..models.llama_decode import llama_decode_burst
-        self._admit()
+        self._admit_dense()
         if all(r is None for r in self._slot_req):
             return
+        try:
+            chaos.hit("serve.burst")
+        except chaos.ChaosError:
+            self._retire_all_active("chaos serve.burst")
+            return
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"],
+            sum(r is not None for r in self._slot_req))
         old_pos = self._pos.copy()
+        t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         (self._cache, pos_d, tok_d, done_d, emitted) = llama_decode_burst(
             self._params, self._cache, jnp.asarray(self._pos),
@@ -196,19 +532,29 @@ class ContinuousBatcher:
         self._pos = np.array(pos)    # device_get views are read-only;
         self._tok = np.array(tok)    # admissions write these in place
         self._done = np.array(done)
+        emitted_total = 0
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
             n_new = int(self._pos[slot] - old_pos[slot])
             req.out.extend(int(t) for t in np.asarray(emitted)[:n_new, slot])
+            emitted_total += n_new
             if done[slot]:
-                req.done = True
-                self._finished[req.rid] = req
-                self._slot_req[slot] = None
+                self._finish(req)
+                self._retire_slot(slot)
+        dt = time.perf_counter() - t0
+        metrics.histogram("serve.burst_time_s").observe(dt)
+        metrics.counter("serve.tokens").inc(emitted_total)
+        if emitted_total and dt > 0:
+            metrics.gauge("serve.tokens_per_s").set(emitted_total / dt)
 
     @property
     def pending(self) -> int:
         return len(self._queue) + sum(r is not None for r in self._slot_req)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self._alloc.pages_in_use if self._layout == "paged" else 0
 
     def run(self) -> dict:
         """Drain the queue; returns {rid: [generated token ids]}."""

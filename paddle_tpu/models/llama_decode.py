@@ -23,6 +23,13 @@ independent of sequence length:
 The decode attention is intentionally NOT the Pallas flash kernel: with
 q_len=1 there is no softmax tiling to win; a masked dense [B,H,1,S] product
 is a clean MXU/VPU op and XLA fuses the mask+softmax+pv chain.
+
+Serving note: the slot-form entry points here keep the DENSE [B, S_max]
+cache, whose decode read is always S_max rows per token. The serving
+default is the paged layout (models/llama_paged.py): same attention math
+over pages gathered through a block table, so reads scale with live
+context length instead — this module remains the single-stream generate
+path and the paged path's equivalence baseline.
 """
 from __future__ import annotations
 

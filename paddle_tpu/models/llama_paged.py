@@ -1,0 +1,232 @@
+"""Paged KV-cache decode for the llama family (Ragged Paged Attention,
+PAPERS.md arxiv 2604.15464, expressed at the XLA level).
+
+The dense slot cache (llama_decode.init_kv_cache) sizes HBM at
+``max_batch × max_len`` and every decode step streams ALL ``max_len`` rows
+of every slot through the attention einsum under a validity mask — both
+footprint and bandwidth are paid at worst case. Here the cache is a shared
+POOL of fixed-size pages with per-slot block tables:
+
+  * pool      — per-layer ``[num_pages, page_size, KV, hd]`` buffers (one
+    buffer per layer, same in-place-update discipline as the dense cache:
+    see init_kv_cache's measured rationale);
+  * block table — ``[B, P]`` int32, logical page j of slot b lives in
+    physical page ``block_table[b, j]``. The host allocates pages on admit
+    and frees them on retire, so HBM scales with LIVE tokens and the pool,
+    not ``max_batch``, bounds admission.
+  * decode attention gathers K/V through the block table and computes over
+    ``P × page_size`` rows, where P is the page-count BUCKET of the longest
+    active context — bandwidth scales with actual context length, which is
+    the decode budget (the GQA-einsum note in llama_decode applies: at
+    decode the cache read IS the bandwidth). P is static per executable;
+    bucketing P (same trick as prompt buckets) keeps the inventory at
+    O(prompt buckets + page buckets), independent of request mix.
+
+Physical page 0 is a SCRATCH page by convention (the serving allocator
+never hands it out): freed/idle slots point every block-table entry at it,
+so their frozen in-flight writes land in scratch instead of a page another
+request owns. Scratch rows are never read unmasked.
+
+Numerics match the dense path exactly: gathered rows sit at the same
+logical positions, the validity mask keeps the same prefix, and masked
+lanes underflow to exact zeros — so greedy outputs are token-identical to
+the dense slot cache (pinned by tests/test_serving_paged.py).
+
+Sharding note (GSPMD, arxiv 2105.04663): the pool keeps KV-heads as a
+leading-free trailing axis exactly like the dense cache, so a
+``NamedSharding(mesh, P(None, None, "model", None))`` shards pages across
+model-parallel chips unchanged; the block table is replicated host
+metadata.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, _rmsnorm, _rope, lm_head_logits, \
+    split_layer_params
+from .llama_decode import _cached_attention_slots, _mlp, _qkv, _sample
+
+__all__ = ["init_paged_kv_cache", "llama_paged_prefill_slot",
+           "llama_paged_decode_burst", "paged_kv_bytes_per_token"]
+
+
+def init_paged_kv_cache(config: LlamaConfig, num_pages: int, page_size: int):
+    """Shared page pool: PER-LAYER tuples of [num_pages, page_size, KV, hd].
+
+    Per-layer buffers for the same reason as the dense cache
+    (llama_decode.init_kv_cache): XLA only updates a carried/donated leaf
+    in place when it is a whole buffer. Page 0 is scratch (see module
+    docstring) — the usable pool is ``num_pages - 1`` pages.
+    """
+    c = config
+    shape = (int(num_pages), int(page_size), c.num_key_value_heads,
+             c.head_dim)
+    return {
+        "k": tuple(jnp.zeros(shape, c.dtype)
+                   for _ in range(c.num_hidden_layers)),
+        "v": tuple(jnp.zeros(shape, c.dtype)
+                   for _ in range(c.num_hidden_layers)),
+    }
+
+
+def paged_kv_bytes_per_token(config: LlamaConfig, pages: int,
+                             page_size: int) -> int:
+    """Decode-attention K+V bytes gathered per emitted token per slot when
+    the block table is `pages` wide — the bandwidth the page buckets are
+    sized against (dense reads the same expression with
+    pages*page_size == max_len, always)."""
+    c = config
+    return int(2 * c.num_hidden_layers * pages * page_size
+               * c.num_key_value_heads * c.head_dim
+               * jnp.dtype(c.dtype).itemsize)
+
+
+def _paged_decode_step_slots(params, cache, block_table, pos, tok,
+                             config: LlamaConfig):
+    """One single-token step over all slots, K/V through the block table.
+
+    block_table [B, P] int32; pos/tok [B]. Slot b writes this token's K/V
+    into physical page ``block_table[b, pos[b] // page_size]`` at row
+    ``pos[b] % page_size`` and attends the gathered [P*page_size] rows
+    under the same ``row <= pos`` mask as the dense path. Layers unrolled,
+    per-layer pool buffers, per-lane dynamic_update_slice — the measured
+    in-place discipline of llama_decode_step_slots carries over verbatim.
+    """
+    c = config
+    layer_p, other = split_layer_params(params)
+    B = tok.shape[0]
+    ps = cache["k"][0].shape[1]
+    x = jnp.take(other["embed_tokens"], tok[:, None], axis=0).astype(c.dtype)
+    positions = pos[:, None].astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    page_of = pos32 // ps            # [B] logical page of the write
+    row_of = pos32 % ps              # [B] row within that page
+    z = jnp.int32(0)
+
+    ks, vs = list(cache["k"]), list(cache["v"])
+    for l in range(c.num_hidden_layers):
+        lp = jax.tree.map(lambda a: a[l], layer_p)
+        h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv(h, lp, c)
+        q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+        kp, vp = ks[l], vs[l]
+        ku, vu = k[:, 0], v[:, 0]
+        for b in range(B):
+            at = (block_table[b, page_of[b]], row_of[b], z, z)
+            kp = jax.lax.dynamic_update_slice(kp, ku[b][None, None], at)
+            vp = jax.lax.dynamic_update_slice(vp, vu[b][None, None], at)
+        ks[l], vs[l] = kp, vp
+        # gather the slot's pages into a [B, P*ps, KV, hd] view — THIS is
+        # the read whose bytes scale with the page bucket instead of S_max
+        kc = jnp.take(kp, block_table, axis=0).reshape(
+            B, -1, c.num_key_value_heads, c.head_dim)
+        vc = jnp.take(vp, block_table, axis=0).reshape(
+            B, -1, c.num_key_value_heads, c.head_dim)
+        att = _cached_attention_slots(q, kc, vc, pos, c)
+        y = x + (att.reshape(B, 1, -1) @ lp["wo"])
+        x = _mlp(y, lp, c)
+
+    return lm_head_logits(x[:, 0, :], other, c), \
+        {"k": tuple(ks), "v": tuple(vs)}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "temperature", "top_k", "dequant"),
+    donate_argnums=(1,))
+def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
+                             config: LlamaConfig,
+                             temperature: float = 0.0, top_k: int = 0,
+                             dequant=None):
+    """Prefill ONE request's prompt into its allocated pages.
+
+    tokens [Tb] int32 padded to a bucket length; page_ids [ceil(Tb/ps)]
+    int32 physical pages (logical order); tlen = real prompt length
+    (traced). Writes all ceil(Tb/ps) pages — rows past tlen hold pad
+    garbage that the validity mask hides until decode overwrites them, so
+    the host may free pages past ``tlen // ps`` right after dispatch (any
+    later owner rewrites before its mask ever exposes them). Samples the
+    first generated token at tlen-1 and returns (first_token, cache).
+    One executable per prompt bucket, like llama_prefill_slot.
+    """
+    c = config
+    if dequant is not None:
+        params = dequant(params)
+    layer_p, other = split_layer_params(params)
+    T = tokens.shape[0]
+    ps = cache["k"][0].shape[1]
+    n_pages = page_ids.shape[0]
+    pad = n_pages * ps - T
+    x = jnp.take(other["embed_tokens"], tokens[None, :],
+                 axis=0).astype(c.dtype)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    from .llama import _attention
+
+    def body(carry, lp):
+        h = _rmsnorm(carry, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv(h, lp, c)
+        q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+        att = _attention(q, k, v, c)
+        y = carry + (att.reshape(1, T, -1) @ lp["wo"])
+        y = _mlp(y, lp, c)
+        return y, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, layer_p)  # ks [L, 1, T, KV, hd]
+
+    z = jnp.int32(0)
+    kl, vl = list(cache["k"]), list(cache["v"])
+    for l in range(c.num_hidden_layers):
+        krows = jnp.pad(ks[l][0], ((0, pad), (0, 0), (0, 0)))
+        vrows = jnp.pad(vs[l][0], ((0, pad), (0, 0), (0, 0)))
+        kp, vp = kl[l], vl[l]
+        for j in range(n_pages):
+            at = (page_ids[j], z, z, z)
+            kp = jax.lax.dynamic_update_slice(
+                kp, krows[j * ps:(j + 1) * ps][None], at)
+            vp = jax.lax.dynamic_update_slice(
+                vp, vrows[j * ps:(j + 1) * ps][None], at)
+        kl[l], vl[l] = kp, vp
+    cache = {"k": tuple(kl), "v": tuple(vl)}
+
+    last = jax.lax.dynamic_slice_in_dim(x[0], tlen - 1, 1, axis=0)  # [1, D]
+    logits = lm_head_logits(last, other, c)
+    first = _sample(logits, temperature, top_k, key)
+    return first[0], cache
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "n", "temperature", "top_k", "pad_id", "dequant"),
+    donate_argnums=(1,))
+def llama_paged_decode_burst(params, cache, block_table, pos, tok, done,
+                             limit, eos_id, key, config: LlamaConfig,
+                             n: int, temperature: float = 0.0,
+                             top_k: int = 0, pad_id: int = 0, dequant=None):
+    """n scanned paged-decode steps — the paged serving hot loop.
+
+    Same contract as llama_decode_burst plus block_table [B, P]: a slot
+    stops on eos_id or `limit`, finished slots emit pad_id and freeze
+    (their frozen write lands in their own page while active, in scratch
+    page 0 once the host retires them and zeroes their table row).
+    Returns (cache, pos, tok, done, emitted [n, B]). One executable per
+    (B, P, n) — P is the page-count bucket, so the inventory is
+    O(page buckets), not O(contexts).
+    """
+    def step(carry, _):
+        cache, pos, tok, done, key = carry
+        p = dequant(params) if dequant is not None else params
+        logits, cache = _paged_decode_step_slots(p, cache, block_table,
+                                                 pos, tok, config)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, temperature, top_k, sub)
+        emit = jnp.where(done, jnp.int32(pad_id), nxt)
+        new_pos = jnp.where(done, pos, pos + 1)
+        new_tok = jnp.where(done, tok, nxt)
+        new_done = done | (nxt == eos_id) | (new_pos >= limit)
+        return (cache, new_pos, new_tok, new_done, key), emit
+
+    (cache, pos, tok, done, _), emitted = jax.lax.scan(
+        step, (cache, pos, tok, done, key), None, length=n)
+    return cache, pos, tok, done, emitted

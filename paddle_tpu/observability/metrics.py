@@ -218,10 +218,15 @@ _STANDARD_COUNTERS = (
     "train.steps", "train.tokens", "resilience.retries",
     "resilience.restores", "chaos.faults", "watchdog.stall", "io.batches",
     "checkpoint.save_bytes", "checkpoint.load_bytes", "collective.barriers",
+    "serve.requests", "serve.tokens", "serve.tokens_discarded",
+    "serve.admission_stalls", "serve.preemptions", "serve.chaos_retired",
+)
+_STANDARD_GAUGES = (
+    "serve.pages_in_use", "serve.tokens_per_s", "serve.kv_read_mb_per_tok",
 )
 _STANDARD_HISTOGRAMS = (
     "train.step_time_s", "collective.wait_s", "checkpoint.save_time_s",
-    "checkpoint.load_time_s", "checkpoint.crc_time_s",
+    "checkpoint.load_time_s", "checkpoint.crc_time_s", "serve.burst_time_s",
 )
 
 
@@ -239,6 +244,8 @@ def set_sink(path: str | None):
         _sink[0] = {"path": path, "kind": kind, "columns": None}
     for n in _STANDARD_COUNTERS:
         counter(n)
+    for n in _STANDARD_GAUGES:
+        gauge(n)
     for n in _STANDARD_HISTOGRAMS:
         histogram(n)
 

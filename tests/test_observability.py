@@ -523,6 +523,7 @@ class TestBenchMetricsEmbed:
             capture_output=True, text=True, timeout=300,
             env={**os.environ, "JAX_PLATFORMS": "cpu",
                  "BENCH_TPU_WAIT_S": "0",
+                 "BENCH_REQUIRE_TPU": "1",  # force the strict error path
                  "BENCH_RETRY_LOG": "/dev/null"})  # keep evidence log clean
         assert r.returncode != 0
         lines = [l for l in r.stdout.splitlines() if l.startswith("{")]

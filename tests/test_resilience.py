@@ -607,13 +607,29 @@ class TestBenchNeverJsonless:
                 out, err = p.communicate()
         return p.returncode, out, err
 
-    def test_unreachable_tpu_exits_nonzero_with_one_json_line(self):
+    def test_unreachable_tpu_falls_back_to_cpu_json(self):
+        """PR 3 contract: probe exhaustion falls back to the CPU smoke so
+        a real (rc=0) JSON line always lands, tagged device=cpu."""
         rc, out, err = self._run_bench(
             {"JAX_PLATFORMS": "cpu", "BENCH_TPU_WAIT_S": "0"})
+        assert rc == 0
+        parsed = self._json_lines(out)
+        assert len(parsed) == 1, out
+        assert parsed[0]["device"] == "cpu"
+        assert "error" not in parsed[0]
+        assert parsed[0]["vs_baseline"] == 0.0   # CPU numbers never score
+
+    def test_require_tpu_restores_strict_error_exit(self):
+        """BENCH_REQUIRE_TPU=1 keeps the old behavior: error JSON line +
+        nonzero rc, no silent CPU benching."""
+        rc, out, err = self._run_bench(
+            {"JAX_PLATFORMS": "cpu", "BENCH_TPU_WAIT_S": "0",
+             "BENCH_REQUIRE_TPU": "1"})
         assert rc != 0
         parsed = self._json_lines(out)
         assert len(parsed) == 1, out
         assert "error" in parsed[0] and "unreachable" in parsed[0]["error"]
+        assert parsed[0]["device"] == "none"
 
     def test_kill_timer_still_yields_one_json_line(self):
         """Run with a 5 s kill timer while the bench is deep in its TPU
@@ -631,14 +647,17 @@ class TestBenchNeverJsonless:
     def test_retry_window_capped_below_driver_budget(self):
         """Even an absurd BENCH_TPU_WAIT_S is clamped to (budget - 300 s):
         with a 300 s driver budget the wait window collapses to a single
-        probe and the bench exits (JSON + nonzero) almost immediately."""
+        probe and the bench proceeds to the CPU fallback (one JSON line)
+        almost immediately instead of retrying into the driver's kill."""
         import time
         t0 = time.time()
         rc, out, err = self._run_bench(
             {"JAX_PLATFORMS": "cpu", "BENCH_TPU_WAIT_S": "99999",
              "BENCH_DRIVER_BUDGET_S": "300"})
-        assert rc != 0
-        assert len(self._json_lines(out)) == 1, out
+        assert rc == 0
+        parsed = self._json_lines(out)
+        assert len(parsed) == 1, out
+        assert parsed[0]["device"] == "cpu"
         assert time.time() - t0 < 90, "wait window was not capped"
 
 
