@@ -28,6 +28,8 @@ from .metadata import LocalTensorMetadata, Metadata, crc32_file
 
 _async_queue: "queue.Queue" = queue.Queue()
 _async_errors: list = []  # failures from the background writer, drained by wait_async_save
+_async_cv = threading.Condition()
+_async_pending = [0]  # queued-but-unfinished async saves (guarded by _async_cv)
 _worker: list = [None]
 
 from .metadata import VIEW_DTYPES as _VIEW_DTYPES
@@ -105,6 +107,9 @@ def _ensure_worker():
                 except BaseException as e:  # surface via wait_async_save
                     _async_errors.append(e)
                 finally:
+                    with _async_cv:
+                        _async_pending[0] -= 1
+                        _async_cv.notify_all()
                     _async_queue.task_done()
 
         t = threading.Thread(target=run, daemon=True)
@@ -149,11 +154,14 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     Robustness contract: every file lands via tmp-write + atomic rename; the
     merged metadata carries a crc32 manifest of every shard file (load
-    verifies and falls back past torn generations); the shard write is
-    retried on transient IO errors; keep_last_k (or PADDLE_CKPT_KEEP, 0 =
-    off) garbage-collects generations older than the newest K published
-    ones after a successful publish. Chaos sites: `ckpt.write` (before the
-    shard write), `ckpt.rename` (between write and rename).
+    verifies and falls back past torn generations); each renamed shard is
+    read back and crc-verified on the SAVE side (silently-failing
+    filesystems rewrite while the arrays still exist; PADDLE_CKPT_VERIFY=0
+    disables); the shard write is retried on transient IO errors;
+    keep_last_k (or PADDLE_CKPT_KEEP, 0 = off) garbage-collects generations
+    older than the newest K published ones after a successful publish.
+    Chaos sites: `ckpt.write` (before the shard write), `ckpt.rename`
+    (between write and rename).
 
     async_save=True returns immediately; the data write AND the metadata
     publish happen on the background thread (call wait_async_save() before
@@ -224,12 +232,31 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         tmp = os.path.join(path, shard_file + ".tmp.npz")
 
         def write_once():
+            from ..resilience.retry import TransientError
             chaos.hit("ckpt.write")
             np.savez(tmp, **arrays)
             crc = crc32_file(tmp)
             nbytes = os.path.getsize(tmp)
             chaos.hit("ckpt.rename")  # "crash between write and rename"
-            os.replace(tmp, os.path.join(path, shard_file))
+            final = os.path.join(path, shard_file)
+            os.replace(tmp, final)
+            if os.environ.get("PADDLE_CKPT_VERIFY", "1") != "0":
+                # save-side read-back: a silently-failing filesystem (bit
+                # flips, short writes absorbed by a cache) is caught NOW,
+                # while the in-memory arrays still exist to rewrite — not at
+                # load time when the job that could have re-saved is gone
+                back = crc32_file(final)
+                if back != crc:
+                    _obs_metrics.counter("checkpoint.verify_failures").inc()
+                    _obs_recorder.record(
+                        "ckpt.verify_fail", echo=True,
+                        message=f"[checkpoint] save read-back crc mismatch "
+                                f"on {shard_file} (wrote {crc:#x}, read "
+                                f"{back:#x}); rewriting",
+                        shard=shard_file, wrote=crc, read=back)
+                    raise TransientError(
+                        f"ckpt save verify: {shard_file} read-back crc "
+                        f"{back:#x} != written {crc:#x}")
             checksums[shard_file] = crc
             _obs_metrics.counter("checkpoint.save_bytes").inc(nbytes)
 
@@ -286,6 +313,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     if async_save:
         _ensure_worker()
+        with _async_cv:
+            _async_pending[0] += 1
         _async_queue.put(lambda: (write_data(), publish_metadata()))
     else:
         write_data()
@@ -293,13 +322,22 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     return uid
 
 
-def wait_async_save():
+def wait_async_save(timeout: float | None = None):
     """Block until queued async saves finish; re-raise the first failure.
 
     An async save that died (IO error past its retry budget, injected
     chaos fault) must not look like a published checkpoint — the caller
-    holds a uid that no metadata ever backed."""
-    _async_queue.join()
+    holds a uid that no metadata ever backed.
+
+    timeout: seconds to wait (None = forever). On expiry raises a NAMED
+    DeadlineExceeded — the emergency-save path bounds this wait by the
+    remaining SIGTERM grace window so a slow filesystem can't eat the whole
+    window and lose the preemption marker too."""
+    with _async_cv:
+        done = _async_cv.wait_for(lambda: _async_pending[0] == 0, timeout)
+    if not done:
+        from ..resilience.retry import DeadlineExceeded
+        raise DeadlineExceeded("ckpt.wait_async_save", 1, float(timeout or 0))
     if _async_errors:
         errs = _async_errors[:]
         _async_errors.clear()  # stale failures must not damn a LATER save

@@ -397,18 +397,57 @@ def batch_isend_irecv(p2p_op_list):
     return [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
 
 
+def _leaf_ready(v) -> bool:
+    ready = getattr(v, "is_ready", None)
+    return bool(ready()) if callable(ready) else True
+
+
+def _finish_wait(value, op: str, timeout: float | None = None):
+    """Complete a blocking device wait on a collective result.
+
+    Elastic-active fleets poll readiness (``jax.Array.is_ready``) under the
+    comm deadline instead of blocking in C: a peer that died mid-collective
+    surfaces as a NAMED ``DeadlineExceeded`` that the resilience layer turns
+    into abort-and-reform (re-rendezvous + checkpoint resume) — not a wedge
+    the watchdog can only kill with exit 124."""
+    from .comm_watchdog import default_timeout
+    from .fleet.elastic import elastic_active
+    if elastic_active():
+        from .resilience.retry import CommLostError, DeadlineExceeded, \
+            wait_for
+        t = default_timeout() if timeout is None else timeout
+        try:
+            wait_for(lambda: all(_leaf_ready(v)
+                                 for v in jax.tree.leaves(value)),
+                     f"collective.{op}", timeout=t if t > 0 else None)
+        except DeadlineExceeded as e:
+            # a collective that never completes means a peer died — retype
+            # so the resilience layer re-forms the fleet for THIS, while
+            # ordinary IO deadlines keep the plain retry/fatal discipline
+            raise CommLostError(e.op, e.attempts, e.elapsed) from e
+        return
+    jax.block_until_ready(value)  # resilience: ok (watched by comm_watchdog at every call site; the elastic path above is the deadline-bounded variant)
+
+
 def barrier(group=None):
     """Device-level barrier: a tiny psum forces a synchronization point.
     Watched: a peer that never arrives produces a named timeout error
-    (comm_watchdog), not an eternal hang."""
+    (comm_watchdog) — or, under elastic supervision, a DeadlineExceeded the
+    fleet recovers from by re-rendezvous — not an eternal hang."""
     from .comm_watchdog import watch
     from .resilience import chaos
     g = _group(group)
     chaos.hit("collective.wait")
-    with _metrics.timer("collective.wait_s"), watch("barrier", group=g):
-        t = Tensor(jnp.zeros((), jnp.float32))
-        all_reduce(t, group=g)
-        jax.block_until_ready(t._value)
+    with _metrics.timer("collective.wait_s"):
+        # dispatch keeps the exit-124 backstop even under elastic: until
+        # the result exists there is nothing to poll, so a wedge in here
+        # (cross-host compile/coordination blocking in C) has no
+        # deadline-bounded raise path — only the readiness wait defers
+        with watch("barrier.dispatch", group=g):
+            t = Tensor(jnp.zeros((), jnp.float32))
+            all_reduce(t, group=g)
+        with watch("barrier", group=g, deadline_bounded=True):
+            _finish_wait(t._value, "barrier")
     _metrics.counter("collective.barriers").inc()
     return _Task()
 
@@ -417,8 +456,10 @@ def wait(tensor, group=None, use_calc_stream=True):
     from .comm_watchdog import watch
     from .resilience import chaos
     chaos.hit("collective.wait")
-    with _metrics.timer("collective.wait_s"), watch("wait", group=group):
-        jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
+    with _metrics.timer("collective.wait_s"), \
+            watch("wait", group=group, deadline_bounded=True):
+        _finish_wait(tensor._value if isinstance(tensor, Tensor) else tensor,
+                     "wait")
 
 
 # stream.* namespace (reference communication/stream/*) — same ops; the

@@ -20,6 +20,12 @@ hanging forever silently (VERDICT r1 missing #3).
 Timeout default: FLAGS_comm_timeout_s (env FLAGS_comm_timeout_s=...), 0
 disables. Reference analog: FLAGS_nccl_blocking_wait + the 30-min
 ProcessGroupNCCL default.
+
+Elastic fleets (fleet.elastic.elastic_active()): the abort is DEFERRED —
+the collective layer's deadline-bounded readiness poll raises a named
+DeadlineExceeded at the same budget, and the resilience layer answers with
+re-rendezvous + checkpoint resume (abort-and-reform). Killing the process
+with exit 124 would turn one lost peer into a second lost node.
 """
 from __future__ import annotations
 
@@ -60,12 +66,19 @@ def _describe_group(group) -> str:
 
 @contextlib.contextmanager
 def watch(op_name: str, group=None, timeout: float | None = None,
-          action: str = "abort"):
+          action: str = "abort", deadline_bounded: bool = False):
     """Arm a hang timer around a blocking communication wait.
 
     action: 'abort' (default) — log + os._exit(124), the analog of
     AbortComm; 'report' — log the named error but let the wait continue
     (debugging / tests that manage their own teardown).
+
+    deadline_bounded: the watched wait ITSELF raises a named deadline at
+    this budget (collective._finish_wait's readiness poll). Only such
+    waits may defer the abort under elastic supervision — a wait that
+    blocks in C with no raise path (jax.distributed.initialize) keeps the
+    exit-124 backstop even when elastic is active, else one lost peer
+    becomes an unbounded wedge.
     """
     t = default_timeout() if timeout is None else float(timeout)
     if t <= 0:
@@ -74,6 +87,26 @@ def watch(op_name: str, group=None, timeout: float | None = None,
 
     def fire():
         rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+        if action == "abort" and deadline_bounded:
+            # abort-and-reform: under elastic supervision the wait itself
+            # is deadline-bounded (collective._finish_wait) and raises into
+            # the re-rendezvous path — exiting here would turn one lost
+            # peer into a second lost node. Checked FIRST so an intended
+            # reform is never misreported as a stall/abort (no stall
+            # counter, no stack spew).
+            try:
+                from .fleet.elastic import elastic_active
+                defer = elastic_active()
+            except Exception:
+                defer = False
+            if defer:
+                _recorder.record(
+                    "watchdog.reform", echo=True,
+                    message=f"[comm-watchdog] elastic active: deferring "
+                            f"abort for op={op_name} — the deadline-bounded "
+                            f"wait raises and the fleet re-forms",
+                    op=op_name, timeout_s=t)
+                return
         msg = (f"[comm-watchdog] TIMEOUT after {t:.0f}s: op={op_name} "
                f"group=({_describe_group(group)}) rank={rank} — the peer "
                f"never arrived; dumping stacks and "

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import re
 from typing import Any, Callable
 
@@ -645,9 +646,35 @@ class Engine:
             v = jax.device_put(v, sh)
         return v
 
-    def fit(self, data_loader, epochs: int = 1, log_freq: int = 0, verbose=0):
+    def fit(self, data_loader, epochs: int = 1, log_freq: int = 0, verbose=0,
+            ckpt_dir: str | None = None, save_every: int = 0):
         """Reference engine.py:1547 fit — loop the donated step over a loader
-        yielding (inputs, labels) pairs."""
+        yielding (inputs, labels) pairs.
+
+        Resilience is the DEFAULT on the launch path: when a checkpoint
+        directory is configured (``ckpt_dir=`` or ``PADDLE_CKPT_DIR``, which
+        the elastic launcher forwards) and ``PADDLE_RESILIENT`` != "0", the
+        epoch loop runs under ``ResilientLoop`` — periodic + emergency
+        checkpoints, transient-failure replay, preemption markers, and
+        elastic abort-and-reform all apply without the caller writing any
+        of it. Without a checkpoint directory the plain loop runs as before.
+        """
+        ckpt_dir = ckpt_dir if ckpt_dir is not None \
+            else os.environ.get("PADDLE_CKPT_DIR")
+        if ckpt_dir and os.environ.get("PADDLE_RESILIENT", "1") != "0":
+            if hasattr(data_loader, "__getitem__") \
+                    and hasattr(data_loader, "__len__"):
+                return self._fit_resilient(data_loader, epochs, ckpt_dir,
+                                           save_every, log_freq)
+            # a pure iterator cannot resume-exact (batch_fn must be a pure
+            # function of the global step) and materializing it could eat
+            # host memory — stay on the plain loop, but say so once
+            from ..observability import recorder as _rec
+            _rec.record(
+                "resilience.fit_unreplayable", echo=True,
+                message="[engine] fit: data_loader is not indexable — "
+                        "running WITHOUT the resilience protocol (pass a "
+                        "Sequence of batches for step-exact resume)")
         last = None
         for epoch in range(epochs):
             with _spans.span("engine.epoch", cat="step", epoch=epoch):
@@ -658,6 +685,51 @@ class Engine:
                         inputs, labels = batch, ()
                     last = self.step(inputs, labels)
         return last
+
+    def _fit_resilient(self, data_loader, epochs, ckpt_dir, save_every,
+                       log_freq=0):
+        """fit under the resilience protocol. Requires an INDEXABLE loader
+        (``__getitem__``/``__len__``) so ``batch_fn(step)`` is a pure
+        function of the global step — the property that makes a restored
+        run replay bitwise-identically (resilience.loop docstring); fit()
+        falls back to the plain loop for pure iterators."""
+        from .resilience.loop import ResilientLoop
+        batches = data_loader
+        n = len(batches)
+        if n == 0:
+            return None
+        def batch_fn(step):
+            b = batches[step % n]
+            if isinstance(b, (tuple, list)) and len(b) == 2:
+                return (b[0], b[1])
+            return (b, ())
+        on_step = None
+        if log_freq:
+            from ..observability import recorder as _rec
+
+            def on_step(step, loss):
+                if step % log_freq == 0:
+                    _rec.record("engine.fit_step", echo=True,
+                                message=f"[engine] step {step}/{epochs * n} "
+                                        f"loss={float(loss):.6f}",
+                                step=step, loss=float(loss))
+        loop = ResilientLoop(self, ckpt_dir, save_every=save_every,
+                             keep_last_k=3)
+        res = loop.run(batch_fn, epochs * n, on_step=on_step)
+        if res.resumed_from is not None and res.last_loss is None:
+            # the checkpoint dir already held a COMPLETED run: nothing was
+            # trained this call — say so loudly instead of returning a None
+            # that looks like a quiet success
+            from ..observability import recorder as _rec
+            _rec.record(
+                "resilience.fit_already_complete", echo=True,
+                message=f"[engine] fit: {ckpt_dir} holds a completed run at "
+                        f"step {res.resumed_from} — restored it, ran 0 "
+                        f"steps; use a fresh ckpt_dir (or clear it) to "
+                        f"retrain")
+        if res.last_loss is None:
+            return None
+        return Tensor(jnp.asarray(res.last_loss, jnp.float32))
 
     @contextlib.contextmanager
     def _eval_mode(self):

@@ -17,9 +17,29 @@ TPU-native: etcd isn't vendored, so membership is pluggable transport:
 ``ElasticManager`` owns the decision loop (HOLD / RESTART / ERROR /
 COMPLETED); the launcher (``distributed/launch/main.py``) owns process
 supervision and acts on the decisions.
+
+Self-healing (re-rendezvous): both registries additionally expose a small
+DURABLE key/value space (``kv_put/kv_get/kv_max/kv_list/kv_del`` — no TTL)
+that backs the generation-numbered re-rendezvous barrier:
+
+  * the fleet generation lives under key ``gen`` and only ever grows
+    (``kv_max`` is a max-CAS, so concurrent survivors proposing the next
+    generation converge on one number);
+  * survivors re-enroll under ``enroll.<gen>.<node>``;
+  * the deterministic leader (lowest enrolled node id) waits for the
+    enrollment set to hold still for a join window, then publishes
+    ``assign.<gen>`` — contiguous ranks over the sorted survivors and the
+    new world size;
+  * anything tagged with an older generation is fenced (rpc messages carry
+    the generation; a superseded barrier is abandoned mid-flight and the
+    new one chased).
+
+``ElasticManager.re_rendezvous()`` drives one pass of that barrier and
+returns the node's new (generation, rank, world).
 """
 from __future__ import annotations
 
+import dataclasses
 import enum
 import json
 import os
@@ -28,8 +48,28 @@ import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ...observability import metrics as _metrics, recorder as _recorder, \
+    spans as _spans
+
 __all__ = ["ElasticLevel", "ElasticStatus", "FileRegistry", "KVServer",
-           "KVRegistry", "ElasticManager"]
+           "KVRegistry", "ElasticManager", "RendezvousResult",
+           "elastic_active", "set_elastic_active"]
+
+
+_active = [False]
+
+
+def set_elastic_active(on: bool):
+    """In-process switch consulted by the collective/watchdog layers (the
+    launcher exports PADDLE_ELASTIC_ACTIVE=1 to its children instead)."""
+    _active[0] = bool(on)
+
+
+def elastic_active() -> bool:
+    """True when this process runs under elastic supervision: blocking
+    collective waits become deadline-bounded (abort-and-reform) and the
+    comm watchdog defers its exit-124 abort to the reform path."""
+    return _active[0] or os.environ.get("PADDLE_ELASTIC_ACTIVE", "") == "1"
 
 
 def _kv_token() -> str:
@@ -89,19 +129,104 @@ class FileRegistry:
         except OSError:
             pass
 
+    # ---- durable KV (re-rendezvous barrier state; no TTL) ----
+    def _kv_path(self, key: str) -> str:
+        return os.path.join(self.dir, "kv__" + key.replace(os.sep, "_"))
+
+    def kv_put(self, key: str, value: str):
+        path = self._kv_path(key)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def kv_get(self, key: str) -> str | None:
+        try:
+            with open(self._kv_path(key)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def kv_del(self, key: str):
+        try:
+            os.remove(self._kv_path(key))
+        except OSError:
+            pass
+
+    def kv_list(self, prefix: str) -> dict:
+        pfx = "kv__" + prefix.replace(os.sep, "_")
+        out = {}
+        for fn in os.listdir(self.dir):
+            if not fn.startswith(pfx) or ".tmp" in fn or fn.endswith(".lock"):
+                continue
+            try:
+                with open(os.path.join(self.dir, fn)) as f:
+                    out[fn[4:]] = f.read()
+            except OSError:
+                continue  # racing a concurrent replace/delete
+        return out
+
+    def kv_max(self, key: str, value: int) -> int:
+        """Max-CAS: the counter becomes max(current, value); returns the
+        winner. Monotone WITHOUT locks: each proposed value is its own
+        `<key>.v<value>` marker file (O_CREAT is atomic and idempotent) and
+        the counter's value is the max over markers — concurrent proposals
+        can only ADD markers, so there is no read-modify-write window in
+        which a racer with a stale read could regress the generation."""
+        try:
+            os.close(os.open(f"{self._kv_path(key)}.v{int(value)}",
+                             os.O_CREAT | os.O_WRONLY))
+        except OSError:
+            pass  # an existing marker is the same proposal already counted
+        return max(int(value), self.kv_counter(key))
+
+    def kv_counter(self, key: str) -> int:
+        """Current value of a kv_max counter (0 when never proposed)."""
+        pfx = os.path.basename(self._kv_path(key)) + ".v"
+        best = 0
+        try:
+            for fn in os.listdir(self.dir):
+                if fn.startswith(pfx):
+                    tail = fn[len(pfx):]
+                    if tail.isdigit():
+                        best = max(best, int(tail))
+        except FileNotFoundError:
+            pass
+        return best
+
+    def kv_max_gc(self, key: str, floor: int):
+        """Drop counter markers below `floor`. The counter's value (the max
+        over markers) is preserved as long as callers pass floor <= the
+        current value — keeps listdir scans bounded on long-lived fleets."""
+        pfx = os.path.basename(self._kv_path(key)) + ".v"
+        try:
+            for fn in os.listdir(self.dir):
+                if fn.startswith(pfx):
+                    tail = fn[len(pfx):]
+                    if tail.isdigit() and int(tail) < floor:
+                        try:
+                            os.remove(os.path.join(self.dir, fn))
+                        except OSError:
+                            pass
+        except FileNotFoundError:
+            pass
+
 
 class KVServer:
     """TTL'd KV over HTTP — the master side of KVRegistry.
 
     Reference: launch/utils/kv_server.py (the launcher master's KV store).
     Endpoints: PUT /hb/<node> (body = info json), GET /nodes (alive list),
-    DELETE /hb/<node>.
+    DELETE /hb/<node>; durable (no-TTL) re-rendezvous state under
+    PUT/GET/DELETE /kv/<key>, PUT /kvmax/<key> (atomic max-CAS, body = int,
+    response = winning value) and GET /kvlist/<prefix> (JSON dict).
     """
 
     def __init__(self, port: int = 0, ttl: float = 10.0):
         store: dict = {}
+        kv: dict = {}  # durable: generation counter, enrollments, assignments
         lock = threading.Lock()
-        self._store, self._lock, self.ttl = store, lock, ttl
+        self._store, self._kv, self._lock, self.ttl = store, kv, lock, ttl
         ttl_ref = self
 
         class H(BaseHTTPRequestHandler):
@@ -119,28 +244,65 @@ class KVServer:
                 tok = self.headers.get("X-Paddle-Job-Token", "")
                 return _hmac.compare_digest(tok, _kv_token())
 
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
             def do_PUT(self):
-                if not self.path.startswith("/hb/"):
-                    return self._send(404)
                 if not self._authed():
                     return self._send(403)
-                node = self.path[4:]
-                n = int(self.headers.get("Content-Length", 0))
-                info = self.rfile.read(n) if n else b"{}"
-                with lock:
-                    store[node] = (time.time(), info.decode() or "{}")
-                self._send(200)
+                if self.path.startswith("/hb/"):
+                    node = self.path[4:]
+                    info = self._body() or b"{}"
+                    with lock:
+                        store[node] = (time.time(), info.decode() or "{}")
+                    return self._send(200)
+                if self.path.startswith("/kv/"):
+                    with lock:
+                        kv[self.path[4:]] = self._body().decode()
+                    return self._send(200)
+                if self.path.startswith("/kvmax/"):
+                    key = self.path[7:]
+                    try:
+                        val = int(self._body().decode() or "0")
+                    except ValueError:
+                        return self._send(400)
+                    with lock:  # the lock IS the CAS: read-max-write is atomic
+                        try:
+                            cur = int(kv.get(key) or 0)
+                        except ValueError:
+                            cur = 0
+                        new = max(cur, val)
+                        kv[key] = str(new)
+                    return self._send(200, str(new).encode())
+                self._send(404)
 
             def do_DELETE(self):
-                if not self.path.startswith("/hb/"):
-                    return self._send(404)
                 if not self._authed():
                     return self._send(403)
-                with lock:
-                    store.pop(self.path[4:], None)
-                self._send(200)
+                if self.path.startswith("/hb/"):
+                    with lock:
+                        store.pop(self.path[4:], None)
+                    return self._send(200)
+                if self.path.startswith("/kv/"):
+                    with lock:
+                        kv.pop(self.path[4:], None)
+                    return self._send(200)
+                self._send(404)
 
             def do_GET(self):
+                if self.path.startswith("/kv/"):
+                    with lock:
+                        v = kv.get(self.path[4:])
+                    if v is None:
+                        return self._send(404)
+                    return self._send(200, v.encode())
+                if self.path.startswith("/kvlist/"):
+                    pfx = self.path[8:]
+                    with lock:
+                        out = {k: v for k, v in kv.items()
+                               if k.startswith(pfx)}
+                    return self._send(200, json.dumps(out).encode())
                 if self.path.startswith("/info/"):
                     node = self.path[6:]
                     with lock:
@@ -233,6 +395,65 @@ class KVRegistry:
         except Exception:
             pass
 
+    # ---- durable KV (re-rendezvous barrier state) ----
+    def _kv_req(self, path: str, method: str = "GET", data: bytes | None = None,
+                op: str = "kv"):
+        from ..resilience.retry import retry_call
+        import urllib.error
+
+        def go():
+            req = urllib.request.Request(
+                f"{self.base}{path}", method=method, data=data,
+                headers={"X-Paddle-Job-Token": _kv_token()})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None  # a missing key is an answer, not a blip
+                raise
+
+        return retry_call(go, op=op, policy=self.retry_policy)
+
+    def kv_put(self, key: str, value: str):
+        self._kv_req(f"/kv/{key}", "PUT", value.encode(), op=f"kv.put {key}")
+
+    def kv_get(self, key: str) -> str | None:
+        out = self._kv_req(f"/kv/{key}", op=f"kv.get {key}")
+        return None if out is None else out.decode()
+
+    def kv_del(self, key: str):
+        try:
+            self._kv_req(f"/kv/{key}", "DELETE", op=f"kv.del {key}")
+        except Exception:
+            pass
+
+    def kv_list(self, prefix: str) -> dict:
+        out = self._kv_req(f"/kvlist/{prefix}", op=f"kv.list {prefix}")
+        return {} if out is None else json.loads(out)
+
+    def kv_max(self, key: str, value: int) -> int:
+        # the server applies max(current, value) under ITS lock — one
+        # process owns the counter, so this transport cannot regress it
+        out = self._kv_req(f"/kvmax/{key}", "PUT", str(int(value)).encode(),
+                           op=f"kv.max {key}")
+        return int(out)
+
+    def kv_counter(self, key: str) -> int:
+        try:
+            return int(self.kv_get(key) or 0)
+        except ValueError:
+            return 0
+
+
+@dataclasses.dataclass
+class RendezvousResult:
+    """Outcome of one re-rendezvous barrier pass for this node."""
+    generation: int
+    rank: int          # contiguous node rank in the new world; -1 = spare
+    world: int         # new node count
+    hosts: list        # sorted surviving node ids, rank order
+
 
 class ElasticManager:
     """Membership watcher + scale decisions (reference manager.py:125).
@@ -263,6 +484,7 @@ class ElasticManager:
         self._thread = None
         self._last_membership: tuple | None = None  # None = never observed
         self._below_min_since: float | None = None
+        self.generation = 0  # fleet generation; bumped by re_rendezvous
 
     # ---- lifecycle ----
     def start(self):
@@ -279,6 +501,13 @@ class ElasticManager:
                                       max_delay=self.interval,
                                       deadline=self.elastic_timeout),
                    should_retry=lambda e: True)
+
+        # adopt the fleet's current generation (a node joining after a
+        # reform must not speak with generation 0 — it would be fenced)
+        try:
+            self.generation = max(self.generation, self._gen())
+        except Exception:
+            pass
 
         def beat():
             while not self._stop.wait(self.interval):
@@ -341,3 +570,168 @@ class ElasticManager:
         hosts = self.world_hosts()
         nid = node_id or self.node_id
         return hosts.index(nid) if nid in hosts else -1
+
+    # ---- self-healing: the generation-numbered re-rendezvous barrier ----
+    def behind_generation(self) -> bool:
+        """True when the fleet's generation counter has advanced past ours —
+        someone re-formed without us (we enrolled too late, or our published
+        assignment was superseded). The launcher treats this as a reform
+        trigger so every node converges on the newest barrier."""
+        try:
+            return self._gen() > self.generation
+        except Exception:
+            return False
+
+    def _gen(self) -> int:
+        """The fleet generation counter (kv_max-backed; monotone)."""
+        reg = self.registry
+        try:
+            if hasattr(reg, "kv_counter"):
+                return int(reg.kv_counter("gen"))
+            return int(reg.kv_get("gen") or 0)
+        except (ValueError, TypeError):
+            return 0
+
+    def _enrolled(self, gen: int) -> list:
+        pfx = f"enroll.{gen}."
+        return [k[len(pfx):] for k in self.registry.kv_list(pfx)]
+
+    def _enroll(self, gen: int, t0: float, budget: float):
+        """Re-enroll this node in generation `gen`. Chaos site
+        ``elastic.enroll``: the barrier itself is the recovery boundary for
+        a faulted enroll — pace and retry under the rendezvous budget."""
+        from ..resilience import chaos
+        from ..resilience.retry import DeadlineExceeded
+        while True:
+            try:
+                chaos.hit("elastic.enroll")
+                self.registry.kv_put(f"enroll.{gen}.{self.node_id}",
+                                     json.dumps({"t": time.time()}))
+                return
+            except Exception as e:
+                if time.monotonic() - t0 > budget:
+                    raise DeadlineExceeded(f"elastic.enroll gen={gen}", 0,
+                                           time.monotonic() - t0, last=e)
+                _recorder.record("elastic.enroll_retry", gen=gen,
+                                 error=f"{type(e).__name__}: {e}")
+                time.sleep(min(self.interval, 0.2))  # resilience: ok (budget-bounded above; ChaosError must reach THIS boundary, so retry_call cannot own it)
+
+    def _gc_generations(self, gen: int):
+        """Best-effort cleanup of barrier state two generations behind —
+        anything that old can never satisfy a live barrier (fenced)."""
+        try:
+            for prefix in ("enroll.", "assign."):
+                for key in self.registry.kv_list(prefix):
+                    head = key[len(prefix):].split(".", 1)[0]
+                    if head.isdigit() and int(head) <= gen - 2:
+                        self.registry.kv_del(key)
+            if hasattr(self.registry, "kv_max_gc"):
+                # drop stale generation markers too (floor <= current gen
+                # keeps the counter's max intact)
+                self.registry.kv_max_gc("gen", gen - 1)
+        except Exception:
+            pass
+
+    def re_rendezvous(self, reason: str = "membership-change",
+                      join_window: float | None = None,
+                      budget: float | None = None) -> RendezvousResult:
+        """One pass of the survivor barrier: propose/join the next fleet
+        generation, re-enroll, and adopt the leader's rank assignment.
+
+        Every survivor (and every restarted node) calls this concurrently.
+        The generation is a max-CAS counter, so concurrent proposals
+        converge; a barrier superseded mid-flight (another failure bumped
+        the generation again) is abandoned and the new one chased — the
+        stale generation's state can never produce an assignment anyone
+        adopts. The deterministic leader is the lowest enrolled node id; it
+        publishes once the enrollment set has held still for `join_window`
+        seconds and covers at least min_np nodes. Raises DeadlineExceeded
+        when the fleet cannot re-form within `budget` (default
+        elastic_timeout) — the min_np floor held too long.
+        """
+        from ..resilience.retry import DeadlineExceeded
+        t0 = time.monotonic()
+        budget = self.elastic_timeout if budget is None else float(budget)
+        join = max(self.interval, 0.5) if join_window is None \
+            else float(join_window)
+        pace = min(max(self.interval / 4.0, 0.02), 0.25)
+        result = None
+        with _spans.span("elastic.rendezvous", cat="elastic", reason=reason,
+                         node=self.node_id):
+            # join an in-flight reform if one is newer than us; otherwise
+            # propose the next generation (max-CAS: survivors converge)
+            cur = self._gen()
+            if cur > self.generation:
+                gen = cur
+            else:
+                gen = self.registry.kv_max("gen", cur + 1)
+            self._enroll(gen, t0, budget)
+            last_seen: tuple | None = None
+            stable_since = time.monotonic()
+            while result is None:
+                if time.monotonic() - t0 > budget:
+                    raise DeadlineExceeded(
+                        f"elastic.re_rendezvous gen={gen} "
+                        f"(survivors below min_np={self.min_np}?)", 0,
+                        time.monotonic() - t0)
+                cur = self._gen()
+                if cur > gen:
+                    # superseded: a newer failure started a newer barrier —
+                    # fence this one and chase the current generation
+                    gen = cur
+                    self._enroll(gen, t0, budget)
+                    last_seen, stable_since = None, time.monotonic()
+                    continue
+                raw = self.registry.kv_get(f"assign.{gen}")
+                if raw:
+                    rec = json.loads(raw)
+                    if self.node_id in rec["hosts"]:
+                        result = rec
+                        continue
+                    if int(rec["world"]) >= self.max_np:
+                        # the published world is already at max_np: we were
+                        # capped out, not missed — adopt it in standby
+                        # (rank -1) instead of forcing a new barrier the cap
+                        # would exclude us from again (livelock)
+                        result = rec
+                        continue
+                    # published without us while below the cap — the leader
+                    # missed our enrollment; force the next generation so
+                    # the fleet re-forms around us too
+                    self.registry.kv_max("gen", gen + 1)
+                    continue
+                enrolled = tuple(sorted(self._enrolled(gen)))
+                if enrolled != last_seen:
+                    last_seen, stable_since = enrolled, time.monotonic()
+                if enrolled and enrolled[0] == self.node_id \
+                        and time.monotonic() - stable_since >= join \
+                        and len(enrolled) >= self.min_np:
+                    hosts = list(enrolled[: self.max_np])
+                    self.registry.kv_put(f"assign.{gen}", json.dumps({
+                        "gen": gen, "hosts": hosts, "world": len(hosts),
+                        "leader": self.node_id, "reason": reason,
+                        "t": time.time()}))
+                    continue  # adopt through the same read path as followers
+                time.sleep(pace)
+
+        gen = int(result["gen"])
+        hosts = list(result["hosts"])
+        rank = hosts.index(self.node_id) if self.node_id in hosts else -1
+        self.generation = gen
+        self.np = len(hosts)
+        # re-baseline membership: the next watch() observation starts fresh
+        # instead of re-firing RESTART on the world we just formed
+        self._last_membership = None
+        self._below_min_since = None
+        elapsed = time.monotonic() - t0
+        _metrics.gauge("elastic.regen").set(gen)
+        _metrics.histogram("elastic.rejoin_s").observe(elapsed)
+        _recorder.record(
+            "elastic.regen", echo=True,
+            message=f"[elastic] re-rendezvous complete: gen={gen} "
+                    f"world={len(hosts)} rank={rank} ({elapsed:.2f}s, "
+                    f"reason: {reason})",
+            gen=gen, world=len(hosts), rank=rank, reason=reason,
+            rejoin_s=round(elapsed, 3))
+        self._gc_generations(gen)
+        return RendezvousResult(gen, rank, len(hosts), hosts)

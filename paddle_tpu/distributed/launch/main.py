@@ -15,8 +15,21 @@ spawns N local ranks for the multi-node-shaped tests (SURVEY.md §4).
 Elastic: `--nnodes MIN:MAX` (reference syntax) turns on membership watching
 via fleet.elastic — heartbeats over a shared dir (`--elastic_root`) or the
 HTTP KV master (`--elastic_server host:port`; node 0 with `--elastic_server
-auto` serves it in-process). On membership change inside [MIN, MAX] the pod
-is relaunched with the new world size; the per-rank env is recomputed.
+auto` serves it in-process).
+
+Self-healing: node death (heartbeat lapse) or a worker's REFORM_EXIT (75 —
+"I hit a communication deadline, checkpointed, re-rendezvous me") triggers
+the generation-numbered re-rendezvous barrier (fleet.elastic): survivors
+re-enroll, the deterministic leader re-assigns contiguous ranks and the new
+world size, and the pod relaunches under the new generation — workers
+resume through the preemption-marker path, step-exact. A dead LOCAL worker
+(non-zero exit that isn't a reform request) is restarted in place under the
+--max_restarts budget instead of tearing the pod down. Consecutive reforms
+widen the leader's join window exponentially (--join_window base), so a
+flapping node can't make the fleet thrash. Workers inherit
+PADDLE_ELASTIC_GEN / PADDLE_ELASTIC_ACTIVE / PADDLE_RESILIENT, and when
+PADDLE_TRACE_DIR is set each rank gets its own subdirectory for
+FLIGHT.json postmortems.
 """
 from __future__ import annotations
 
@@ -28,6 +41,18 @@ import sys
 import time
 
 __all__ = ["main", "launch"]
+
+# resilience.loop.REFORM_EXIT without importing the heavy jax-backed module
+# into the supervisor process
+REFORM_RC = 75
+
+# consecutive re-rendezvous passes (none separated by a stable stretch of
+# running) before the launcher gives up named. Bounds the RUNNING→reform
+# spin of a fleet that re-forms successfully but can never complete a step
+# (relaunched workers reset their own in-process reform budgets, so the
+# launcher must hold the line) — distinct from --max_restarts, which
+# budgets worker FAILURES.
+MAX_CONSEC_REFORMS = 8
 
 
 def _parse(argv):
@@ -51,6 +76,10 @@ def _parse(argv):
                    help="HTTP KV master host:port, or 'auto' (node 0 serves)")
     p.add_argument("--elastic_timeout", type=float, default=120.0)
     p.add_argument("--heartbeat_interval", type=float, default=2.0)
+    p.add_argument("--join_window", type=float, default=1.0,
+                   help="base leader stability window for re-rendezvous; "
+                        "doubles per consecutive reform (exponential "
+                        "node-join window)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -66,7 +95,8 @@ def _parse(argv):
     return args
 
 
-def _spawn(args, local_rank: int, world: int, base_rank: int, nnodes: int):
+def _spawn(args, local_rank: int, world: int, base_rank: int, nnodes: int,
+           node_id: str = "node", gen: int = 0, elastic_on: bool = False):
     env = dict(os.environ)
     rank = base_rank + local_rank
     env.update({
@@ -75,7 +105,25 @@ def _spawn(args, local_rank: int, world: int, base_rank: int, nnodes: int):
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_NNODES": str(nnodes),
         "PADDLE_JOB_ID": args.job_id,
+        # fleet generation: rpc messages are stamped with it (stale-world
+        # fencing) and per-generation barriers key on it
+        "PADDLE_ELASTIC_GEN": str(gen),
+        # stable node identity (ranks are reassigned across generations)
+        "PADDLE_NODE_ID": node_id,
     })
+    # trainers wrap their step loops in the resilience protocol by default
+    # (Engine.fit / ResilientLoop honor PADDLE_RESILIENT=0 to opt out)
+    env.setdefault("PADDLE_RESILIENT", "1")
+    if elastic_on:
+        # blocking collective waits become deadline-bounded and a comm loss
+        # exits REFORM_RC instead of wedging (resilience.loop)
+        env["PADDLE_ELASTIC_ACTIVE"] = "1"
+    trace = os.environ.get("PADDLE_TRACE_DIR")
+    if trace:
+        # one trace dir per (node, local rank), stable across generations —
+        # every rank leaves its own FLIGHT.json for the postmortem
+        env["PADDLE_TRACE_DIR"] = os.path.join(
+            trace, f"{node_id}.{local_rank}")
     if args.master:
         env["PADDLE_MASTER"] = args.master
         host, _, port = args.master.partition(":")
@@ -168,7 +216,8 @@ def launch(argv=None):
 
     nnodes = args.nnodes
     restarts = 0
-    rc = 0
+    reform_streak = 0  # consecutive reforms; widens the join window
+    have_assignment = False  # re_rendezvous already fixed (rank, world)
     procs: list = []
     stop_sig = {"sig": None}
 
@@ -178,24 +227,45 @@ def launch(argv=None):
         # when children swallow SIGTERM; dying instantly skips _stop_procs)
         stop_sig["sig"] = sig
 
+    def _dump_launcher_flight(reason):
+        if not os.environ.get("PADDLE_TRACE_DIR"):
+            return
+        try:
+            from ...observability import recorder
+            recorder.dump_flight(
+                os.path.join(os.environ["PADDLE_TRACE_DIR"],
+                             f"{node_id}.launcher"), reason=reason)
+        except Exception:
+            pass
+
     signal.signal(signal.SIGTERM, on_term)
     try:
         while True:
             if stop_sig["sig"] is not None:  # SIGTERM during a restart path
                 return 128 + int(stop_sig["sig"])
-            if mgr is not None:
+            if mgr is not None and not have_assignment:
                 # wait until ≥ min_nodes members are up AND our own heartbeat
                 # is visible with an in-range rank; a node beyond max_np is a
-                # spare and stays in standby until membership changes
+                # spare and stays in standby until membership changes. Hold
+                # one extra join window once quorum is met so a whole fleet
+                # booting together starts at full strength instead of
+                # spawning at min_np and immediately reforming.
                 deadline = time.time() + args.elastic_timeout
+                stable_since = time.time()
+                prev_hosts = None
                 while True:
                     if stop_sig["sig"] is not None:
                         return 128 + int(stop_sig["sig"])
                     mgr.watch()
                     nnodes = max(args.min_nodes, min(mgr.np, args.max_nodes))
                     rank = mgr.rank_of(node_id)
-                    if len(mgr.world_hosts()) >= args.min_nodes \
-                            and 0 <= rank < nnodes:
+                    hosts = tuple(mgr.world_hosts())
+                    if hosts != prev_hosts:
+                        prev_hosts, stable_since = hosts, time.time()
+                    if len(hosts) >= args.min_nodes and 0 <= rank < nnodes \
+                            and (len(hosts) >= args.max_nodes
+                                 or time.time() - stable_since
+                                 >= args.join_window):
                         break
                     if rank >= nnodes:
                         deadline = time.time() + args.elastic_timeout  # spare
@@ -205,29 +275,75 @@ def launch(argv=None):
                         return 1
                     time.sleep(args.heartbeat_interval)
                 node_rank = rank
+            have_assignment = False
             world = nnodes * args.nproc_per_node
             base = node_rank * args.nproc_per_node
+            gen = mgr.generation if mgr is not None else 0
             # append as we spawn: if _spawn rank k raises, ranks 0..k-1 are
             # already in `procs` and the finally's _stop_procs reaps them
             # (a discarded list-comprehension would orphan them)
             procs.clear()
             for i in range(args.nproc_per_node):
-                procs.append(_spawn(args, i, world, base, nnodes))
+                procs.append(_spawn(args, i, world, base, nnodes,
+                                    node_id=node_id, gen=gen,
+                                    elastic_on=elastic_on))
+            spawned_at = time.monotonic()
 
             # supervision loop (reference controller.py:87 watch)
             failed = None
-            decision = None
+            reform_reason = None
             while True:
                 if stop_sig["sig"] is not None:
                     _stop_procs(procs)
                     return 128 + int(stop_sig["sig"])
                 alive = 0
-                for p in procs:
+                for i, p in enumerate(procs):
                     prc = p.poll()
                     if prc is None:
                         alive += 1
+                    elif prc == REFORM_RC and mgr is not None:
+                        # worker hit a communication deadline, checkpointed,
+                        # and asks for a fleet re-rendezvous — not a failure
+                        if reform_reason is None:
+                            reform_reason = (f"worker {base + i} requested "
+                                             f"reform (rc={REFORM_RC})")
                     elif prc != 0 and failed is None:
-                        failed = prc
+                        # (a REFORM_RC without an elastic manager is a plain
+                        # failure — nobody can re-rendezvous it)
+                        if mgr is not None and restarts < args.max_restarts \
+                                and args.nproc_per_node == 1:
+                            # self-heal locally: restart JUST the dead
+                            # worker instead of tearing the job down. Only
+                            # coherent for single-worker pods — a lone
+                            # respawn into a half-live multi-rank pod would
+                            # face peers blocked mid-collective on the dead
+                            # incarnation.
+                            restarts += 1
+                            print(f"[launch] elastic: local worker "
+                                  f"{base + i} died (exit {prc}); restart "
+                                  f"in place {restarts}/{args.max_restarts}",
+                                  file=sys.stderr)
+                            procs[i] = _spawn(args, i, world, base, nnodes,
+                                              node_id=node_id, gen=gen,
+                                              elastic_on=elastic_on)
+                            alive += 1
+                        elif mgr is not None \
+                                and restarts < args.max_restarts:
+                            # multi-rank pod: re-form it whole (checkpoint
+                            # resume keeps this cheap) under the same
+                            # budget. ONE charge per reform event — all
+                            # ranks of one crash die in the same poll pass
+                            # and must not each burn a restart unit.
+                            if reform_reason is None:
+                                restarts += 1
+                                reform_reason = (
+                                    f"local worker {base + i} died (exit "
+                                    f"{prc}); pod reform "
+                                    f"{restarts}/{args.max_restarts}")
+                        else:
+                            failed = prc
+                if reform_reason is not None:
+                    break
                 if failed is not None:
                     _stop_procs(procs)
                     break
@@ -236,21 +352,62 @@ def launch(argv=None):
                 if mgr is not None:
                     st = mgr.watch()
                     if st is not None and st.value == "restart":
-                        decision = st
-                        print(f"[launch] elastic: membership changed → "
-                              f"relaunch at np={mgr.np}", file=sys.stderr)
-                        _stop_procs(procs)
+                        reform_reason = "membership changed"
+                        break
+                    if mgr.behind_generation():
+                        # the fleet re-formed without us (we published or
+                        # adopted an assignment a slower peer superseded) —
+                        # chase the newest generation
+                        reform_reason = "fleet generation advanced"
                         break
                     if st is not None and st.value == "error":
                         print("[launch] elastic: below min_np past timeout",
                               file=sys.stderr)
                         _stop_procs(procs)
+                        _dump_launcher_flight("below min_np past timeout")
                         return 1
-                time.sleep(0.5)
-            if decision is not None:
-                nnodes = mgr.np
+                time.sleep(0.5)  # resilience: ok (supervision poll; every exit is a named decision — reform, error, budget-exhausted failure, or clean completion)
+            if reform_reason is not None:
+                _stop_procs(procs)
+                # exponential node-join window: a stretch of stable running
+                # resets the streak; consecutive reforms double the leader's
+                # stability wait so a flapping node can't thrash the fleet
+                if time.monotonic() - spawned_at \
+                        > 20 * args.heartbeat_interval:
+                    reform_streak = 0
+                join = args.join_window * (2 ** min(reform_streak, 4))
+                reform_streak += 1
+                if reform_streak > MAX_CONSEC_REFORMS:
+                    print(f"[launch] elastic: {reform_streak} consecutive "
+                          f"reforms without a stable run — the fleet "
+                          f"re-forms but never makes progress; giving up",
+                          file=sys.stderr)
+                    _dump_launcher_flight("reform streak exhausted")
+                    return 1
+                print(f"[launch] elastic: {reform_reason} → re-rendezvous "
+                      f"(gen {mgr.generation} → ?, join window {join:.1f}s)",
+                      file=sys.stderr)
+                try:
+                    res = mgr.re_rendezvous(reason=reform_reason,
+                                            join_window=join)
+                except Exception as e:
+                    print(f"[launch] elastic: re-rendezvous failed ({e})",
+                          file=sys.stderr)
+                    _dump_launcher_flight(f"re-rendezvous failed: {e}")
+                    return 1
+                _dump_launcher_flight(
+                    f"re-rendezvous: gen={res.generation} rank={res.rank}")
+                if res.rank < 0:
+                    print("[launch] elastic: standby (spare beyond max_np)",
+                          file=sys.stderr)
+                    continue  # back to the quorum wait
+                node_rank, nnodes = res.rank, res.world
+                have_assignment = True
+                print(f"[launch] elastic: membership changed → relaunch at "
+                      f"np={res.world} gen={res.generation} rank={res.rank}",
+                      file=sys.stderr)
                 continue
-            if restarts < args.max_restarts:
+            if mgr is None and restarts < args.max_restarts:
                 restarts += 1
                 print(f"[launch] rank failed (exit {failed}); restart "
                       f"{restarts}/{args.max_restarts}", file=sys.stderr)

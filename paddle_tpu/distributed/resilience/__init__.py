@@ -17,7 +17,7 @@ from . import preempt  # noqa: F401
 from .loop import ResilientLoop, RunResult  # noqa: F401
 from .preempt import PreemptionHandler  # noqa: F401
 from .retry import (  # noqa: F401
-    DeadlineExceeded, FatalError, RetryPolicy, TransientError, classify,
-    retry_call, wait_for,
+    CommLostError, DeadlineExceeded, FatalError, RetryPolicy, TransientError,
+    classify, retry_call, wait_for,
 )
 from .chaos import ChaosError  # noqa: F401

@@ -22,6 +22,11 @@ Known sites (grep `chaos.hit` for ground truth):
   rendezvous       before distributed rendezvous / parallel-env init
   data.next        before a data-loader batch is handed to the trainer
   kv.heartbeat     before an elastic KV heartbeat PUT
+  rpc.send         before any wire IO of an rpc call (retry-safe fault)
+  rpc.rendezvous   one discovery poll of init_rpc's accumulating loop
+  elastic.enroll   before a re-rendezvous enrollment write
+  serve.admit      before a serving request is admitted to a slot
+  serve.burst      before a serving decode burst is dispatched
 
 ``ChaosError`` subclasses ``retry.TransientError`` so recovery layers
 (ResilientLoop, checkpoint fallback) treat it like a real transient fault —

@@ -12,7 +12,21 @@ below) with the full robustness contract:
     trajectory is bitwise identical to a fault-free run (the contract
     MULTICHIP_r05.json proved: resume_max_rel == 0.0);
   * SIGTERM/SIGINT latches an emergency save + ``PREEMPTED.json`` marker at
-    the next step boundary, and a relaunch resumes step-exact.
+    the next step boundary, and a relaunch resumes step-exact. The
+    emergency save is ASYNC: the marker (naming the last known-good
+    generation) lands first, serialization overlaps the telemetry flush on
+    the background writer, and the wait is bounded by the remaining
+    SIGTERM grace window (``PADDLE_PREEMPT_GRACE_S``) — a slow filesystem
+    can cost the freshest step, never the marker;
+  * communication loss (``CommLostError`` — the typed deadline raised by
+    collective readiness polls and fleet barriers when a peer is gone)
+    under elastic supervision becomes
+    abort-and-reform instead of death: with an in-process coordinator
+    (``elastic=`` an ``ElasticManager``) the loop re-rendezvouses with the
+    survivors, restores the checkpoint, and replays under the new world;
+    under a launcher-coordinated fleet (``PADDLE_ELASTIC_ACTIVE=1``) it
+    checkpoints, writes the marker, and exits with ``REFORM_EXIT`` (75) so
+    the launcher re-rendezvouses and relaunches it step-exact.
 
 Trainable protocol (duck-typed; adapters exist on LlamaTrainStep/Engine):
   resilience_state() -> pytree containing a scalar ``step`` leaf
@@ -39,7 +53,12 @@ from ...observability import metrics as _metrics, recorder as _recorder, \
 from . import chaos, preempt
 from .retry import DeadlineExceeded, RetryPolicy, classify
 
-__all__ = ["ResilientLoop", "RunResult"]
+__all__ = ["ResilientLoop", "RunResult", "REFORM_EXIT"]
+
+# exit code a worker uses to hand control back to the launcher after a
+# communication loss: "I checkpointed; re-rendezvous the fleet and relaunch
+# me" — distinct from failure (any other non-zero) and success (0)
+REFORM_EXIT = 75
 
 
 @dataclasses.dataclass
@@ -61,7 +80,7 @@ class ResilientLoop:
     def __init__(self, trainable, ckpt_dir: str, save_every: int = 0,
                  keep_last_k: int = 3, max_restores: int = 8,
                  policy: RetryPolicy | None = None, handle_signals: bool = True,
-                 process_group=None):
+                 process_group=None, elastic=None, on_world_change=None):
         self.trainable = trainable
         self.ckpt_dir = ckpt_dir
         self.save_every = int(save_every)
@@ -72,8 +91,15 @@ class ResilientLoop:
         self.process_group = process_group
         self.preemption = preempt.PreemptionHandler()
         self._handle_signals = handle_signals
+        # in-process elastic coordinator: anything with re_rendezvous()
+        # (fleet.elastic.ElasticManager); on_world_change(result) lets the
+        # caller rebuild meshes/groups for the new world before replay
+        self.elastic = elastic
+        self.on_world_change = on_world_change
         self.restores = 0        # lifetime total (reported in RunResult)
+        self.reforms = 0         # lifetime fleet re-formations survived
         self._consec = 0         # consecutive failures; reset on progress
+        self._consec_reforms = 0  # consecutive reforms; reset on progress
         self._last_good_uid: int | None = None
         _recorder.install_crash_hook()  # an uncaught death leaves FLIGHT.json
 
@@ -96,16 +122,21 @@ class ResilientLoop:
         tree = self.trainable.resilience_state()
         return int(np.asarray(tree["step"]))
 
-    def save_checkpoint(self) -> int:
-        """Write one atomic checkpoint generation; returns its unique_id."""
+    def save_checkpoint(self, async_save: bool = False) -> int:
+        """Write one atomic checkpoint generation; returns its unique_id.
+        async_save=True enqueues the write on the background writer (call
+        ``checkpoint.wait_async_save`` before trusting the uid) — the
+        generation only becomes "last good" once that wait succeeds."""
         from ..checkpoint import save_state_dict
         tree = self.trainable.resilience_state()
         leaves, _ = jax.tree.flatten(tree)
         flat = {_leaf_key(i): v for i, v in enumerate(leaves)}
         uid = save_state_dict(flat, self.ckpt_dir,
                               process_group=self.process_group,
-                              keep_last_k=self.keep_last_k)
-        self._last_good_uid = uid
+                              keep_last_k=self.keep_last_k,
+                              async_save=async_save)
+        if not async_save:
+            self._last_good_uid = uid
         return uid
 
     def restore_checkpoint(self, unique_id=None) -> int | None:
@@ -172,25 +203,114 @@ class ResilientLoop:
         # later hard death (or a postmortem without re-run) still has it
         _recorder.dump_flight(self.ckpt_dir, reason="resilient-loop restore")
 
-    def _emergency_save(self) -> None:
+    def _emergency_save(self, reason: str = "preemption") -> None:
+        """Emergency checkpoint overlapping the kill grace window.
+
+        Ordering is the contract: (1) the marker lands FIRST, naming the
+        last known-good generation — if the grace window expires mid-save
+        the relaunch still resumes from a valid save; (2) the fresh
+        generation serializes on the background writer while this thread
+        flushes telemetry; (3) the async wait is bounded by the remaining
+        grace (PADDLE_PREEMPT_GRACE_S) and, on success, the marker is
+        re-pointed at the fresh generation."""
+        from ..checkpoint import wait_async_save
+        step = self._get_step()
+        signum = self.preemption.signum
+        preempt.write_marker(self.ckpt_dir, step, unique_id=self._last_good_uid,
+                             signum=signum,
+                             extra={"provisional": True, "reason": reason})
         uid = None
         try:
-            uid = self.save_checkpoint()
-        except Exception as e:  # keep the marker even when the save dies
+            uid = self.save_checkpoint(async_save=True)
+            # overlap: the shard write runs on the background writer while
+            # this thread leaves the postmortem behind
+            _recorder.dump_flight(self.ckpt_dir,
+                                  reason=f"{reason} save (in flight)")
+            wait_async_save(timeout=self.preemption.grace_remaining())
+            self._last_good_uid = uid
+            preempt.write_marker(self.ckpt_dir, step, unique_id=uid,
+                                 signum=signum, extra={"reason": reason})
+        except Exception as e:  # keep the provisional marker
             _recorder.record(
                 "resilience.emergency_save_failed", echo=True,
                 message=f"[resilience] emergency save failed ({e}); marker "
-                        f"will point at the last good generation",
+                        f"points at the last good generation",
                 error=f"{type(e).__name__}: {e}")
             uid = self._last_good_uid
-        preempt.write_marker(self.ckpt_dir, self._get_step(), unique_id=uid,
-                             signum=self.preemption.signum)
         _recorder.record(
             "resilience.preempted", echo=True,
-            message=f"[resilience] preempted: emergency checkpoint uid={uid} "
-                    f"step={self._get_step()} marker written",
-            uid=uid, step=self._get_step(), signum=self.preemption.signum)
-        _recorder.dump_flight(self.ckpt_dir, reason="preemption save")
+            message=f"[resilience] {reason}: emergency checkpoint uid={uid} "
+                    f"step={step} marker written",
+            uid=uid, step=step, signum=signum)
+        _recorder.dump_flight(self.ckpt_dir, reason=f"{reason} save")
+
+    # ---------------- elastic: abort-and-reform ----------------
+    def _elastic_enabled(self) -> bool:
+        if self.elastic is not None:
+            return True
+        from ..fleet.elastic import elastic_active
+        return elastic_active()
+
+    def _comm_loss(self, exc: Exception) -> bool:
+        """A failure that means 'a peer is gone', answerable by re-forming
+        the fleet. Only CommLostError qualifies — the typed deadline the
+        collective/rendezvous waits raise (collective._finish_wait, fleet
+        barriers). A transient wire/IO error (ConnectionError, a checkpoint
+        deadline) keeps the plain retry/restore discipline: re-forming the
+        fleet cannot fix a dead disk, and a save-blip must not cost a
+        whole-fleet reform. Only meaningful under elastic supervision."""
+        from .retry import CommLostError
+        return isinstance(exc, CommLostError) and self._elastic_enabled()
+
+    def _reform(self, exc: Exception) -> None:
+        """Answer a communication loss: re-rendezvous in-process when a
+        coordinator is attached, else checkpoint + exit REFORM_EXIT for the
+        launcher to re-form the fleet and relaunch us."""
+        self.reforms += 1
+        self._consec_reforms += 1
+        _metrics.counter("elastic.comm_loss").inc()
+        if self._consec_reforms > self.max_restores:
+            _recorder.record(
+                "elastic.give_up", echo=True,
+                message=f"[resilience] {self._consec_reforms} consecutive "
+                        f"fleet re-formations exceed "
+                        f"max_restores={self.max_restores}; dying",
+                error=f"{type(exc).__name__}: {exc}")
+            raise DeadlineExceeded("resilient-loop.reform",
+                                   self._consec_reforms, 0.0,
+                                   last=exc) from exc
+        if self.elastic is not None:
+            _recorder.record(
+                "elastic.reform", echo=True,
+                message=f"[resilience] communication lost "
+                        f"({type(exc).__name__}: {exc}); re-rendezvousing "
+                        f"with survivors",
+                error=f"{type(exc).__name__}: {exc}")
+            res = self.elastic.re_rendezvous(
+                reason=f"{type(exc).__name__}: {exc}")
+            if self.on_world_change is not None:
+                self.on_world_change(res)
+            restored = self.restore_checkpoint()
+            _recorder.record(
+                "elastic.resumed", echo=True,
+                message=f"[resilience] fleet re-formed: gen={res.generation} "
+                        f"world={res.world} rank={res.rank}; resuming from "
+                        f"step {restored if restored is not None else self._get_step()}",
+                gen=res.generation, world=res.world, rank=res.rank,
+                step=restored)
+            _recorder.dump_flight(self.ckpt_dir, reason="elastic reform")
+            return
+        # launcher-coordinated: save + marker now, then hand control back
+        # with the reform exit code — the relaunched world resumes step-exact
+        self._emergency_save(reason="elastic-reform")
+        _recorder.record(
+            "elastic.reform_exit", echo=True,
+            message=f"[resilience] communication lost ({type(exc).__name__}: "
+                    f"{exc}); exiting rc={REFORM_EXIT} for launcher "
+                    f"re-rendezvous",
+            error=f"{type(exc).__name__}: {exc}")
+        _recorder.dump_flight(reason="elastic reform exit")
+        raise SystemExit(REFORM_EXIT)
 
     # ---------------- the loop ----------------
     def run(self, batch_fn, num_steps: int, on_step=None) -> RunResult:
@@ -202,9 +322,20 @@ class ResilientLoop:
         os.makedirs(self.ckpt_dir, exist_ok=True)
         if self._handle_signals:
             self.preemption.install()
+        prev_active = None
+        if self.elastic is not None:
+            # an attached in-process coordinator IS elastic supervision:
+            # flip the switch so collective waits become deadline-bounded
+            # (CommLostError) — otherwise a real peer loss would block in C
+            # and the watchdog would exit 124, never reaching _reform
+            from ..fleet import elastic as _el
+            prev_active = _el._active[0]
+            _el.set_elastic_active(True)
         try:
             return self._run(batch_fn, num_steps, on_step)
         finally:
+            if prev_active is not None:
+                _el.set_elastic_active(prev_active)
             if self._handle_signals:
                 self.preemption.uninstall()
 
@@ -248,8 +379,10 @@ class ResilientLoop:
                     loss = self._step_fn(*batch)
                 step = self._get_step()
                 last_loss = loss
-                if self._consec:  # progress: reset failure budget + backoff
+                if self._consec or self._consec_reforms:
+                    # progress: reset failure budgets + backoff
                     self._consec = 0
+                    self._consec_reforms = 0
                     delays = self.policy.delays()
                 if on_step is not None:
                     on_step(step, loss)
@@ -257,6 +390,13 @@ class ResilientLoop:
                         and step % self.save_every == 0:
                     self.save_checkpoint()
             except Exception as e:
+                if self._comm_loss(e):
+                    # a dead peer, not a transient blip: re-form the fleet
+                    # (in-process or via the launcher) and replay from the
+                    # checkpoint under the new world
+                    self._reform(e)
+                    step = self._get_step()
+                    continue
                 if not classify(e):
                     raise
                 self._recover(e, delays)

@@ -39,6 +39,7 @@ class PreemptionHandler:
         self.signum: int | None = None
         self._prev: dict[int, object] = {}
         self._installed = False
+        self._latched_at: float | None = None  # monotonic; grace accounting
 
     # ---- lifecycle ----
     def install(self):
@@ -77,12 +78,14 @@ class PreemptionHandler:
             os.kill(os.getpid(), signum)
             return
         self.signum = signum
+        self._latched_at = time.monotonic()
         self._event.set()
         _notify_flight(signum)
 
     def request(self, signum: int | None = None):
         """Programmatic preemption (tests, SDK shutdown hooks)."""
         self.signum = signum
+        self._latched_at = time.monotonic()
         self._event.set()
         _notify_flight(signum, programmatic=True)
 
@@ -93,6 +96,21 @@ class PreemptionHandler:
     def clear(self):
         self._event.clear()
         self.signum = None
+        self._latched_at = None
+
+    def grace_remaining(self) -> float | None:
+        """Seconds left of the scheduler's kill grace window
+        (``PADDLE_PREEMPT_GRACE_S``) since the signal latched. None when no
+        window is declared (or nothing latched): wait as long as needed.
+        Never returns less than 0.5s — an emergency save gets at least one
+        real chance before the async wait gives up on it."""
+        try:
+            grace = float(os.environ.get("PADDLE_PREEMPT_GRACE_S", "0") or 0)
+        except ValueError:
+            grace = 0.0
+        if grace <= 0 or self._latched_at is None:
+            return None
+        return max(0.5, grace - (time.monotonic() - self._latched_at))
 
 
 def _notify_flight(signum, programmatic=False):

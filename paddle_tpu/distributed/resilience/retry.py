@@ -32,8 +32,8 @@ from typing import Any, Callable
 from ...observability import metrics as _metrics, recorder as _recorder
 
 __all__ = [
-    "TransientError", "FatalError", "DeadlineExceeded", "RetryPolicy",
-    "classify", "retry_call", "wait_for",
+    "TransientError", "FatalError", "DeadlineExceeded", "CommLostError",
+    "RetryPolicy", "classify", "retry_call", "wait_for",
 ]
 
 
@@ -55,6 +55,15 @@ class DeadlineExceeded(TimeoutError):
         super().__init__(
             f"{op}: retry budget exhausted after {attempts} attempt(s) over "
             f"{elapsed:.1f}s{tail}")
+
+
+class CommLostError(DeadlineExceeded):
+    """A deadline that means a PEER IS GONE — raised only by waits whose
+    expiry implicates the fleet, not the local process: collective
+    readiness polls, rendezvous barriers. The elastic layer answers THIS
+    with re-rendezvous (abort-and-reform); an ordinary DeadlineExceeded
+    (checkpoint IO, a slow filesystem) keeps the plain retry/fatal
+    discipline — re-forming the fleet cannot fix a dead disk."""
 
 
 def classify(exc: BaseException) -> bool:

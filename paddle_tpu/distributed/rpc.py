@@ -12,6 +12,16 @@ name plus pickled args (same trust model as the reference: RPC peers are
 within one training job).
 
 Used by the parameter-server stack (distributed/ps.py) for pull/push.
+
+Generation fencing (elastic fleets): every call message carries the sender's
+fleet generation (``PADDLE_ELASTIC_GEN``, or ``set_generation()`` after an
+in-process re-rendezvous). A receiver whose generation differs answers
+``fenced`` and the caller raises ``StaleGenerationError`` (fatal, never
+retried) — a worker from a pre-failure world can neither execute against
+nor poison the re-formed fleet. Chaos sites: ``rpc.send`` (before any wire
+IO of a call — faulted sends never half-execute, so the caller may simply
+retry) and ``rpc.rendezvous`` (one discovery poll of init_rpc — the
+accumulating discovery loop is the recovery boundary and retries it).
 """
 from __future__ import annotations
 
@@ -25,8 +35,26 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..observability import recorder as _recorder, spans as _spans
+from .resilience.retry import FatalError, TransientError
+
 __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
-           "get_all_worker_infos", "WorkerInfo"]
+           "get_all_worker_infos", "WorkerInfo", "StaleGenerationError",
+           "StalePeerError", "current_generation", "set_generation"]
+
+
+class StaleGenerationError(FatalError):
+    """WE are behind the fleet: the receiver answered from a NEWER
+    generation. Never retried — this process's fix is re-rendezvous +
+    checkpoint resume, not another attempt."""
+
+
+class StalePeerError(TransientError):
+    """The PEER is behind the fleet: it answered from an OLDER generation
+    (its launcher hasn't chased the new barrier yet — teardown/poll skew
+    during an ordinary reform window). Transient: the healthy caller may
+    retry once the peer re-forms; dying here would charge a restart-budget
+    unit to the wrong side."""
 
 
 @dataclass
@@ -37,7 +65,24 @@ class WorkerInfo:
     port: int
 
 
-_state: dict = {"agent": None}
+_state: dict = {"agent": None, "gen": None}
+
+
+def current_generation() -> int:
+    """This process's fleet generation: ``set_generation()`` override first,
+    else PADDLE_ELASTIC_GEN (exported by the elastic launcher), else 0."""
+    if _state.get("gen") is not None:
+        return int(_state["gen"])
+    try:
+        return int(os.environ.get("PADDLE_ELASTIC_GEN", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def set_generation(gen: int | None):
+    """Adopt a new fleet generation after an in-process re-rendezvous
+    (None = fall back to the environment)."""
+    _state["gen"] = None if gen is None else int(gen)
 
 
 def _job_token() -> bytes:
@@ -224,16 +269,22 @@ class _Agent:
             return ("call_pickled", _serialize_fn(fn))
         return ("call", f"{fn.__module__}:{qual}")
 
-    def call(self, to, fn, args=(), kwargs=None, timeout=None):
+    def call(self, to, fn, args=(), kwargs=None, timeout=None, gen=None):
+        from .resilience import chaos
+        # before ANY wire IO: a chaos-faulted send never half-executes, so
+        # the caller's boundary (ResilientLoop, a ps pull/push retry) can
+        # simply re-issue the call and land a result identical to fault-free
+        chaos.hit("rpc.send")
         w = self.info_by(to)
         kind, wire = self._wire_fn(fn)
+        g = current_generation() if gen is None else int(gen)
         for attempt in (0, 1):
             cache = getattr(self._conns, "cache", {})
             was_cached = (w.ip, w.port) in cache
             s = self._connection(w, timeout)
             sent = False
             try:
-                _send_msg(s, (kind, wire, args, kwargs or {}))
+                _send_msg(s, (kind, wire, args, kwargs or {}, g))
                 sent = True
                 status, payload = _recv_msg(s)
                 break
@@ -251,6 +302,16 @@ class _Agent:
                     raise
         if status == "ok":
             return payload
+        if status == "fenced":
+            info = payload if isinstance(payload, dict) else {}
+            recv_gen = int(info.get("receiver_gen", g + 1))
+            detail = (f"rpc to {w.name} fenced: message generation {g} vs "
+                      f"receiver generation {recv_gen}")
+            if g > recv_gen:
+                # the PEER lags the fleet — transient: it will be reformed
+                # or torn down shortly; the healthy caller may retry
+                raise StalePeerError(detail + " (peer is behind the fleet)")
+            raise StaleGenerationError(detail + " (we are behind the fleet)")
         raise RuntimeError(f"rpc to {w.name} failed: {payload}")
 
 
@@ -276,12 +337,28 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             kind = msg[0]
             try:
+                # generation fence: a call stamped with another fleet
+                # generation comes from a stale (pre-reform) or not-yet-
+                # reformed world — refuse to execute it (messages without a
+                # stamp predate fencing and pass, single-job compatibility)
+                if kind in ("call", "call_pickled") and len(msg) >= 5 \
+                        and msg[4] is not None:
+                    local = current_generation()
+                    if int(msg[4]) != local:
+                        _recorder.record(
+                            "rpc.fenced", peer_gen=int(msg[4]), gen=local)
+                        # structured payload: the caller decides which side
+                        # is the stale one (direction matters for recovery)
+                        _send_msg(self.request, (
+                            "fenced", {"sender_gen": int(msg[4]),
+                                       "receiver_gen": local}))
+                        continue
                 if kind == "call":
-                    _, wire_fn, args, kwargs = msg
+                    wire_fn, args, kwargs = msg[1], msg[2], msg[3]
                     fn = _resolve(wire_fn)
                     out = fn(*args, **kwargs)
                 elif kind == "call_pickled":
-                    _, blob, args, kwargs = msg
+                    blob, args, kwargs = msg[1], msg[2], msg[3]
                     out = _deserialize_fn(blob)(*args, **kwargs)
                 elif kind == "ping":
                     out = "pong"
@@ -368,21 +445,52 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     # peer that registers, finishes fast, and deregisters (or whose entry
     # expires) still counts once its endpoint was fetched; requiring one
     # simultaneous full-membership snapshot deadlocks under start skew.
-    import json
-    import urllib.request
     debug = os.environ.get("PADDLE_RPC_DEBUG") == "1"
     # generous default: under heavy CI load a peer's interpreter start can
     # stall minutes before it registers (PADDLE_RPC_TIMEOUT overrides)
     deadline = time.time() + float(os.environ.get("PADDLE_RPC_TIMEOUT", 300))
-    last_beat = 0.0
     t_start = time.perf_counter()
     # discovery pacing: start tight (a freshly-registered peer that finishes
     # fast deregisters within ~100ms — a flat 0.2s poll can miss it forever),
     # back off once the world is clearly still assembling
+    from .resilience import chaos as _chaos
     from .resilience.retry import RetryPolicy
     _delays = RetryPolicy(max_attempts=0, base_delay=0.02, max_delay=0.5,
                           jitter=0.25).delays()
+    _rdv_span = _spans.span("rpc.rendezvous", cat="elastic", worker=name,
+                            rank=rank, world=world_size).begin()
+    try:
+        _rendezvous_loop(agent, reg, scoped, name, rank, my_ip, port, job,
+                         world_size, deadline, debug, t_start, _chaos,
+                         _delays)
+    finally:
+        _rdv_span.end()
+    return agent
+
+
+def _rendezvous_loop(agent, reg, scoped, name, rank, my_ip, port, job,
+                     world_size, deadline, debug, t_start, _chaos, _delays):
+    """init_rpc's accumulating discovery loop (factored out so the
+    rpc.rendezvous span wraps it in one try/finally — no span leak on any
+    exit path). Mutates agent.workers; raises TimeoutError past deadline."""
+    import json
+    import urllib.request
+    last_beat = 0.0
     while len(agent.workers) < world_size:
+        try:
+            # chaos site: ONE faulted discovery poll — the accumulating
+            # loop is the recovery boundary (workers found so far are kept,
+            # the next poll re-reads the registry), so an injected fault
+            # leaves the rendezvous result identical to a fault-free run
+            _chaos.hit("rpc.rendezvous")
+        except _chaos.ChaosError as e:
+            _recorder.record("rpc.rendezvous_fault", error=str(e))
+            if time.time() > deadline:  # a 100%-faulted rendezvous still dies named
+                raise TimeoutError(
+                    f"rpc rendezvous: {len(agent.workers)}/{world_size} "
+                    f"workers (chaos-faulted)") from e
+            time.sleep(next(_delays))  # resilience: ok (deadline + named TimeoutError above bound the loop)
+            continue
         now = time.time()
         if now - last_beat > 5:  # keep our own entry fresh past the ttl
             try:
@@ -392,7 +500,6 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
             except Exception:
                 pass
         if debug:
-            from ..observability import recorder as _recorder
             _recorder.record(
                 "rpc.rendezvous", echo=True,
                 message=f"[rpc {name}] t={time.perf_counter()-t_start:.1f} "
@@ -419,7 +526,6 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
             raise TimeoutError(
                 f"rpc rendezvous: {len(agent.workers)}/{world_size} workers")
         time.sleep(next(_delays))  # resilience: ok (accumulating poll; deadline + named TimeoutError above)
-    return agent
 
 
 def shutdown():

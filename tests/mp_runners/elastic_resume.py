@@ -1,0 +1,125 @@
+"""Self-healing fleet drill trainer (ISSUE 4 acceptance).
+
+Runs under ``paddle_tpu.distributed.launch`` with an elastic node range.
+Each "node" trains a deterministic numpy toy under ``ResilientLoop``
+(save_every=1) and, at the top of every step, crosses a fleet-wide step
+barrier keyed by (generation, node) in the shared FileRegistry KV — the
+CPU-testable stand-in for a device collective: when a peer dies, the
+barrier wait raises a named DeadlineExceeded exactly like an elastic
+collective wait does.
+
+The self-healing path this exercises end to end:
+  peer SIGKILLed → barrier DeadlineExceeded → ResilientLoop elastic path
+  (emergency checkpoint + marker + exit 75) → launcher re-rendezvous
+  (new generation, contiguous ranks over survivors) → relaunch → restore →
+  bitwise-exact replay under the new world.
+
+The loss trajectory is a pure function of the global step (the toy never
+reads rank or world size), so the post-resume trajectory of a killed fleet
+must be bitwise-identical to a fault-free run — asserted by the test.
+
+env: DRILL_DIR (shared scratch), DRILL_STEPS, DRILL_STEP_S (per-step
+sleep so the kill lands mid-run), DRILL_BAR_TIMEOUT (barrier deadline).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed.fleet.elastic import FileRegistry
+from paddle_tpu.distributed.resilience.loop import ResilientLoop
+from paddle_tpu.distributed.resilience.retry import (CommLostError,
+                                                     DeadlineExceeded,
+                                                     wait_for)
+
+RANK = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+GEN = int(os.environ.get("PADDLE_ELASTIC_GEN", "0"))
+NODE = os.environ.get("PADDLE_NODE_ID") or f"anon-{RANK}"
+DRILL = os.environ["DRILL_DIR"]
+STEPS = int(os.environ.get("DRILL_STEPS", "12"))
+STEP_S = float(os.environ.get("DRILL_STEP_S", "0.3"))
+BAR_TIMEOUT = float(os.environ.get("DRILL_BAR_TIMEOUT", "5"))
+
+_reg = FileRegistry(DRILL, "bar")
+
+
+def _barrier(step: int, preemption):
+    """Every live node must reach `step` (entries are per-node latest-step
+    watermarks, keyed by generation so a stale world can never satisfy a
+    re-formed one). A dead peer surfaces as DeadlineExceeded — the same
+    shape an elastic collective wait raises."""
+    _reg.kv_put(f"bar.{GEN}.{NODE}", str(step))
+
+    def ready():
+        if preemption.requested:
+            return True  # shutting down: don't wait out the deadline
+        rows = _reg.kv_list(f"bar.{GEN}.")
+        at_step = sum(1 for v in rows.values()
+                      if v.strip().isdigit() and int(v) >= step)
+        return at_step >= WORLD
+
+    try:
+        wait_for(ready, f"drill.barrier step={step} gen={GEN} world={WORLD}",
+                 timeout=BAR_TIMEOUT)
+    except DeadlineExceeded as e:
+        # a peer never arrived: the typed comm loss the elastic layer
+        # answers with re-rendezvous
+        raise CommLostError(e.op, e.attempts, e.elapsed) from e
+
+
+class Toy:
+    """Deterministic trainable: state is (w, step); the update is a pure
+    float32 function of (state, batch) — bitwise-replayable."""
+
+    def __init__(self, preemption_ref):
+        self.w = np.zeros(4, np.float32)
+        self.step_i = 0
+        self._preemption_ref = preemption_ref
+
+    def resilience_state(self):
+        return {"w": self.w, "step": np.asarray(self.step_i, np.int64)}
+
+    def load_resilience_state(self, tree):
+        self.w = np.asarray(tree["w"], np.float32)
+        self.step_i = int(np.asarray(tree["step"]))
+
+    def train_step(self, x):
+        _barrier(self.step_i, self._preemption_ref[0])
+        time.sleep(STEP_S)  # pace the drill so the kill lands mid-run
+        self.w = (self.w * np.float32(1.01) + x).astype(np.float32)
+        self.step_i += 1
+        return float(self.w.sum())
+
+
+def batch_fn(step):
+    # pure function of the global step — the replay-exactness contract
+    return np.full(4, np.float32((step % 7) * 0.125), np.float32)
+
+
+def main():
+    pre_ref = [None]
+    toy = Toy(pre_ref)
+    loop = ResilientLoop(toy, os.path.join(DRILL, "ckpt", NODE),
+                         save_every=1, keep_last_k=4)
+    pre_ref[0] = loop.preemption
+    losses_path = os.path.join(DRILL, f"losses.{NODE}.jsonl")
+
+    def on_step(step, loss):
+        with open(losses_path, "a") as f:
+            f.write(json.dumps({"step": step, "loss": loss,
+                                "gen": GEN, "rank": RANK}) + "\n")
+
+    res = loop.run(batch_fn, STEPS, on_step=on_step)
+    if res.preempted:
+        print(f"DRILL_PREEMPTED node={NODE} step={res.steps}", flush=True)
+        return 0
+    print(f"DRILL_DONE node={NODE} rank={RANK} gen={GEN} "
+          f"steps={res.steps} world={WORLD}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,760 @@
+"""Elastic self-healing fleet (ISSUE 4): re-rendezvous barrier, rank
+re-assignment, generation fencing, abort-and-reform, emergency-save
+hardening, and the chaos-equality contract on the new rpc/elastic sites.
+
+The multi-process end-to-end drill lives in
+tests/test_multinode_launch.py::TestSelfHealingFleetDrill; these tests
+exercise each layer in-process.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import elastic as el
+from paddle_tpu.distributed.resilience import chaos, preempt
+from paddle_tpu.distributed.resilience.retry import (CommLostError,
+                                                     DeadlineExceeded,
+                                                     TransientError)
+from paddle_tpu.observability import metrics, recorder
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _mgr(node, root, min_np=1, max_np=3, interval=0.05, timeout=10):
+    return el.ElasticManager(node, np=max_np, min_np=min_np, max_np=max_np,
+                             registry=el.FileRegistry(str(root), "job"),
+                             heartbeat_interval=interval,
+                             elastic_timeout=timeout)
+
+
+# ---------------------------------------------------------------- registry KV
+
+class TestRegistryDurableKV:
+    def test_file_registry_roundtrip(self, tmp_path):
+        r = el.FileRegistry(str(tmp_path), "j")
+        assert r.kv_get("gen") is None
+        r.kv_put("gen", "3")
+        assert r.kv_get("gen") == "3"
+        r.kv_put("enroll.3.a", "x")
+        r.kv_put("enroll.3.b", "y")
+        assert r.kv_list("enroll.3.") == {"enroll.3.a": "x",
+                                          "enroll.3.b": "y"}
+        r.kv_del("enroll.3.a")
+        assert list(r.kv_list("enroll.3.")) == ["enroll.3.b"]
+
+    def test_file_registry_max_cas_is_monotonic(self, tmp_path):
+        r = el.FileRegistry(str(tmp_path), "j")
+        assert r.kv_max("gen", 2) == 2
+        assert r.kv_max("gen", 1) == 2  # a lower proposal never wins
+        assert r.kv_max("gen", 5) == 5
+
+    def test_file_registry_max_cas_under_contention(self, tmp_path):
+        r = el.FileRegistry(str(tmp_path), "j")
+        results = []
+
+        def bump(v):
+            results.append(r.kv_max("gen", v))
+
+        threads = [threading.Thread(target=bump, args=(v,))
+                   for v in range(1, 9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert r.kv_counter("gen") == 8
+        # marker-based counter: a later lower proposal can never regress it
+        assert r.kv_max("gen", 3) == 8
+        assert r.kv_counter("gen") == 8
+
+    def test_kv_max_gc_preserves_counter(self, tmp_path):
+        r = el.FileRegistry(str(tmp_path), "j")
+        for v in (1, 2, 3, 7):
+            r.kv_max("gen", v)
+        r.kv_max_gc("gen", 6)
+        assert r.kv_counter("gen") == 7  # the max survives the sweep
+        marks = [f for f in os.listdir(r.dir) if ".v" in f]
+        assert marks == ["kv__gen.v7"]
+
+    def test_kv_server_durable_endpoints(self):
+        server = el.KVServer(ttl=5).start()
+        try:
+            r = el.KVRegistry(f"127.0.0.1:{server.port}", ttl=5)
+            assert r.kv_get("gen") is None
+            r.kv_put("gen", "1")
+            assert r.kv_get("gen") == "1"
+            assert r.kv_max("gen", 4) == 4
+            assert r.kv_max("gen", 2) == 4
+            r.kv_put("enroll.4.n0", "{}")
+            r.kv_put("enroll.4.n1", "{}")
+            assert sorted(r.kv_list("enroll.4.")) == ["enroll.4.n0",
+                                                      "enroll.4.n1"]
+            r.kv_del("enroll.4.n0")
+            assert sorted(r.kv_list("enroll.4.")) == ["enroll.4.n1"]
+        finally:
+            server.stop()
+
+    def test_kv_server_rejects_unauthenticated_writes(self):
+        import urllib.error
+        import urllib.request
+        server = el.KVServer(ttl=5).start()
+        try:
+            r = el.KVRegistry(f"127.0.0.1:{server.port}", ttl=5)
+            r.kv_put("gen", "7")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/kv/gen", method="PUT",
+                data=b"99")  # no job token
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=3)
+            assert ei.value.code == 403
+            assert r.kv_get("gen") == "7"  # a forger cannot move the fleet
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------- re-rendezvous
+
+class TestReRendezvous:
+    def test_survivors_reassign_contiguous_ranks(self, tmp_path):
+        """3-node fleet, node-c dies: both survivors re-rendezvous
+        concurrently and adopt ONE new generation with contiguous ranks."""
+        a = _mgr("node-a", tmp_path)
+        b = _mgr("node-b", tmp_path)
+        out = {}
+        ta = threading.Thread(
+            target=lambda: out.__setitem__(
+                "a", a.re_rendezvous(join_window=0.3)))
+        tb = threading.Thread(
+            target=lambda: out.__setitem__(
+                "b", b.re_rendezvous(join_window=0.3)))
+        ta.start(), tb.start()
+        ta.join(10), tb.join(10)
+        ra, rb = out["a"], out["b"]
+        assert ra.generation == rb.generation == 1
+        assert ra.hosts == rb.hosts == ["node-a", "node-b"]
+        assert (ra.rank, rb.rank) == (0, 1)
+        assert ra.world == 2
+        assert a.generation == b.generation == 1
+        assert metrics.gauge("elastic.regen").value == 1
+        assert metrics.histogram("elastic.rejoin_s").count >= 2
+
+    def test_below_min_np_raises_named_deadline(self, tmp_path):
+        lonely = _mgr("node-a", tmp_path, min_np=2)
+        with pytest.raises(DeadlineExceeded) as ei:
+            lonely.re_rendezvous(join_window=0.1, budget=1.0)
+        assert "elastic.re_rendezvous" in str(ei.value)
+
+    def test_superseded_barrier_is_chased(self, tmp_path):
+        """A second failure mid-rendezvous bumps the generation again; the
+        in-flight node abandons the stale barrier and converges on the new
+        one (stale-generation fencing)."""
+        a = _mgr("node-a", tmp_path)
+        b = _mgr("node-b", tmp_path)
+        out = {}
+        ta = threading.Thread(
+            target=lambda: out.__setitem__(
+                "a", a.re_rendezvous(join_window=0.6)))
+        ta.start()
+        time.sleep(0.15)  # a is now waiting out its join window at gen 1
+        a.registry.kv_max("gen", 2)  # a newer failure supersedes it
+        rb = b.re_rendezvous(join_window=0.4)
+        ta.join(10)
+        ra = out["a"]
+        assert ra.generation == rb.generation == 2
+        assert ra.hosts == rb.hosts == ["node-a", "node-b"]
+
+    def test_late_enrollee_forces_next_generation(self, tmp_path):
+        """A node that misses the barrier (assignment published without it)
+        bumps the generation; the published node detects it is behind and
+        both converge."""
+        a = _mgr("node-a", tmp_path)
+        b = _mgr("node-b", tmp_path)
+        ra = a.re_rendezvous(join_window=0.05)  # publishes [node-a] alone
+        assert ra.hosts == ["node-a"] and ra.generation == 1
+        out = {}
+        tb = threading.Thread(
+            target=lambda: out.__setitem__(
+                "b", b.re_rendezvous(join_window=0.5)))
+        tb.start()
+        # the launcher notices behind_generation() and re-enters the barrier
+        deadline = time.time() + 5
+        while not a.behind_generation() and time.time() < deadline:
+            time.sleep(0.02)
+        assert a.behind_generation()
+        ra2 = a.re_rendezvous(join_window=0.5)
+        tb.join(10)
+        rb = out["b"]
+        assert ra2.generation == rb.generation == 2
+        assert ra2.hosts == rb.hosts == ["node-a", "node-b"]
+
+    def test_max_np_caps_world_and_marks_spares(self, tmp_path):
+        a = _mgr("node-a", tmp_path, max_np=1)
+        b = _mgr("node-b", tmp_path, max_np=1)
+        out = {}
+        tb = threading.Thread(
+            target=lambda: out.__setitem__(
+                "b", b.re_rendezvous(join_window=0.4)))
+        tb.start()
+        ra = a.re_rendezvous(join_window=0.4)
+        tb.join(10)
+        assert ra.hosts == ["node-a"] and ra.rank == 0 and ra.world == 1
+        assert out["b"].rank == -1  # spare beyond max_np
+
+    def test_watch_does_not_refire_after_reform(self, tmp_path):
+        """The membership baseline re-anchors post-reform: the very world we
+        just formed must not read as another membership change."""
+        reg = el.FileRegistry(str(tmp_path), "job")
+        a = _mgr("node-a", tmp_path, max_np=2)
+        reg.heartbeat("node-a")
+        a.re_rendezvous(join_window=0.05)
+        assert a.watch() == el.ElasticStatus.HOLD  # first obs: baseline
+        assert a.watch() == el.ElasticStatus.HOLD
+
+    def test_elastic_enroll_chaos_equality(self, tmp_path):
+        """Chaos acceptance on the new site: a faulted enroll is retried by
+        the barrier itself and the assignment comes out EXACTLY equal to the
+        fault-free run's."""
+        plain = _mgr("node-a", tmp_path / "plain")
+        ref = plain.re_rendezvous(join_window=0.05)
+        with chaos.inject("elastic.enroll:1"):
+            faulted = _mgr("node-a", tmp_path / "chaos")
+            got = faulted.re_rendezvous(join_window=0.05)
+            assert chaos.hit_counts().get("elastic.enroll", 0) >= 2
+        assert (got.generation, got.rank, got.world, got.hosts) == \
+            (ref.generation, ref.rank, ref.world, ref.hosts)
+
+
+# ------------------------------------------------------- generation fencing
+
+@pytest.fixture
+def rpc_agent():
+    from paddle_tpu.distributed import rpc
+    os.environ["PADDLE_JOB_ID"] = f"elastic-fleet-{os.getpid()}"
+    agent = rpc.init_rpc("w0", rank=0, world_size=1,
+                         master_endpoint=f"127.0.0.1:{_free_port()}")
+    yield agent
+    rpc.set_generation(None)
+    rpc.shutdown()
+
+
+class TestRpcGenerationFencing:
+    def test_matching_generation_passes(self, rpc_agent):
+        from paddle_tpu.distributed import rpc
+        rpc.set_generation(3)
+        assert rpc.rpc_sync("w0", "builtins:len", args=([1, 2],)) == 2
+
+    def test_stale_generation_is_fenced_fatal(self, rpc_agent):
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.resilience.retry import classify
+        rpc.set_generation(2)
+        with pytest.raises(rpc.StaleGenerationError) as ei:
+            rpc_agent.call("w0", "builtins:len", args=([1],), gen=1)
+        assert "generation 1" in str(ei.value)
+        assert not classify(ei.value)  # fatal: never retried
+        # the fleet moves on; current-generation traffic still flows
+        assert rpc.rpc_sync("w0", "builtins:len", args=([1, 2, 3],)) == 3
+
+    def test_stale_peer_is_fenced_transient(self, rpc_agent):
+        """The RECEIVER is the stale one: the fence still refuses to
+        execute, but the healthy caller gets a TRANSIENT error (the lagging
+        peer will be re-formed shortly) — dying would charge the restart
+        budget to the wrong side."""
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.resilience.retry import classify
+        rpc.set_generation(1)
+        with pytest.raises(rpc.StalePeerError) as ei:
+            rpc_agent.call("w0", "builtins:len", args=([1],), gen=5)
+        assert "peer is behind" in str(ei.value)
+        assert classify(ei.value)  # transient: retry after the peer reforms
+
+    def test_rpc_send_chaos_equality(self, rpc_agent):
+        """Chaos acceptance: the rpc.send site faults BEFORE any wire IO, so
+        a boundary retry produces results exactly equal to fault-free."""
+        from paddle_tpu.distributed import rpc
+
+        def workload():
+            out = []
+            for i in range(5):
+                while True:
+                    try:
+                        out.append(rpc.rpc_sync(
+                            "w0", "builtins:sum", args=(list(range(i + 1)),)))
+                        break
+                    except chaos.ChaosError:
+                        continue  # the caller IS the recovery boundary
+            return out
+
+        fault_free = workload()
+        with chaos.inject("rpc.send:3"):
+            chaotic = workload()
+            assert chaos.hit_counts()["rpc.send"] == 6  # 5 calls + 1 retry
+        assert chaotic == fault_free
+
+    def test_rpc_rendezvous_chaos_still_completes(self):
+        """A chaos-faulted discovery poll is absorbed by the accumulating
+        rendezvous loop: init_rpc still finds the full world."""
+        from paddle_tpu.distributed import rpc
+        os.environ["PADDLE_JOB_ID"] = f"rdv-chaos-{os.getpid()}"
+        with chaos.inject("rpc.rendezvous:1"):
+            agent = rpc.init_rpc("w0", rank=0, world_size=1,
+                                 master_endpoint=f"127.0.0.1:{_free_port()}")
+            try:
+                assert sorted(agent.workers) == ["w0"]
+                assert chaos.hit_counts()["rpc.rendezvous"] >= 2
+            finally:
+                rpc.shutdown()
+
+
+# --------------------------------------------------------- abort-and-reform
+
+class _NeverReady:
+    def is_ready(self):
+        return False
+
+
+class TestElasticCollectiveWait:
+    def test_typed_comm_loss_instead_of_wedge(self, monkeypatch):
+        from paddle_tpu.distributed import collective
+        monkeypatch.setenv("PADDLE_ELASTIC_ACTIVE", "1")
+        with pytest.raises(CommLostError) as ei:
+            collective._finish_wait(_NeverReady(), "barrier", timeout=0.3)
+        assert "collective.barrier" in str(ei.value)
+
+    def test_ready_value_passes_fast(self, monkeypatch):
+        from paddle_tpu.distributed import collective
+        monkeypatch.setenv("PADDLE_ELASTIC_ACTIVE", "1")
+        collective._finish_wait(np.zeros(2), "wait", timeout=5.0)  # no raise
+
+    def test_elastic_active_switch(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_ELASTIC_ACTIVE", raising=False)
+        assert not el.elastic_active()
+        el.set_elastic_active(True)
+        try:
+            assert el.elastic_active()
+        finally:
+            el.set_elastic_active(False)
+        monkeypatch.setenv("PADDLE_ELASTIC_ACTIVE", "1")
+        assert el.elastic_active()
+
+    def test_watchdog_defers_abort_under_elastic(self, tmp_path):
+        """With elastic active a DEADLINE-BOUNDED watchdog timeout must NOT
+        exit 124 — the wait itself raises and owns recovery; the stall is
+        recorded."""
+        script = (
+            "import os, time\n"
+            "os.environ['PADDLE_ELASTIC_ACTIVE'] = '1'\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from paddle_tpu.distributed.comm_watchdog import watch\n"
+            "with watch('barrier', timeout=0.3, deadline_bounded=True):\n"
+            "    time.sleep(0.8)\n"
+            "print('SURVIVED')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script], cwd=REPO, timeout=120,
+            capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": REPO})
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "SURVIVED" in proc.stdout
+        assert "deferring abort" in proc.stderr
+
+    def test_watchdog_still_aborts_unbounded_waits_under_elastic(self):
+        """A watched wait with NO deadline-bounded raise path (e.g. the
+        jax.distributed.initialize rendezvous blocking in C) keeps the
+        exit-124 backstop even when elastic is active — deferral there
+        would turn one lost peer into an unbounded wedge."""
+        script = (
+            "import os, time\n"
+            "os.environ['PADDLE_ELASTIC_ACTIVE'] = '1'\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from paddle_tpu.distributed.comm_watchdog import watch\n"
+            "with watch('init_parallel_env/rendezvous', timeout=0.3):\n"
+            "    time.sleep(30)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script], cwd=REPO, timeout=120,
+            capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": REPO})
+        assert proc.returncode == 124, (proc.returncode, proc.stderr[-800:])
+
+
+class _Toy:
+    """Deterministic numpy trainable implementing the resilience protocol."""
+
+    def __init__(self):
+        self.w = np.zeros(3, np.float32)
+        self.s = 0
+
+    def resilience_state(self):
+        return {"w": self.w, "step": np.asarray(self.s, np.int64)}
+
+    def load_resilience_state(self, tree):
+        self.w = np.asarray(tree["w"], np.float32)
+        self.s = int(np.asarray(tree["step"]))
+
+    def train_step(self, x):
+        self.w = (self.w * np.float32(1.01) + x).astype(np.float32)
+        self.s += 1
+        return float(self.w.sum())
+
+
+def _batch(step):
+    return np.full(3, np.float32(step * 0.5), np.float32)
+
+
+class TestResilientLoopReform:
+    def _loop(self, toy, d, **kw):
+        from paddle_tpu.distributed.resilience.loop import ResilientLoop
+        return ResilientLoop(toy, str(d), save_every=2, handle_signals=False,
+                             **kw)
+
+    def test_inproc_reform_is_bitwise_exact(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import RendezvousResult
+        baseline = _Toy()
+        self._loop(baseline, tmp_path / "ff").run(_batch, 8)
+
+        calls = []
+
+        class Coordinator:
+            def re_rendezvous(self, reason=""):
+                calls.append(reason)
+                return RendezvousResult(1, 0, 1, ["n0"])
+
+        tripped = []
+
+        class Flaky(_Toy):
+            def train_step(self, x):
+                if self.s == 3 and not tripped:
+                    tripped.append(1)
+                    raise CommLostError("collective.barrier", 1, 5.0)
+                return super().train_step(x)
+
+        toy = Flaky()
+        world_changes = []
+        loop = self._loop(toy, tmp_path / "el", elastic=Coordinator(),
+                          on_world_change=world_changes.append)
+        res = loop.run(_batch, 8)
+        assert res.steps == 8 and loop.reforms == 1
+        assert len(calls) == 1 and "CommLostError" in calls[0]
+        assert world_changes and world_changes[0].generation == 1
+        assert np.array_equal(toy.w, baseline.w)  # bitwise, not allclose
+
+    def test_inproc_coordinator_enables_elastic_waits(self, tmp_path,
+                                                      monkeypatch):
+        """Attaching elastic= IS elastic supervision: the collective waits
+        must become deadline-bounded during run() (else a real peer loss
+        blocks in C and exits 124, never reaching _reform) — and the switch
+        is restored afterwards."""
+        monkeypatch.delenv("PADDLE_ELASTIC_ACTIVE", raising=False)
+        from paddle_tpu.distributed.fleet.elastic import RendezvousResult
+
+        seen = []
+
+        class Probe(_Toy):
+            def train_step(self, x):
+                seen.append(el.elastic_active())
+                return super().train_step(x)
+
+        class Coordinator:
+            def re_rendezvous(self, reason=""):
+                return RendezvousResult(1, 0, 1, ["n0"])
+
+        loop = self._loop(Probe(), tmp_path, elastic=Coordinator())
+        loop.run(_batch, 3)
+        assert seen and all(seen)
+        assert not el.elastic_active()  # restored on exit
+
+    def test_reform_exit_75_when_launcher_coordinated(self, tmp_path,
+                                                      monkeypatch):
+        from paddle_tpu.distributed.resilience.loop import REFORM_EXIT
+        monkeypatch.setenv("PADDLE_ELASTIC_ACTIVE", "1")
+
+        class Flaky(_Toy):
+            def train_step(self, x):
+                if self.s == 2:
+                    raise CommLostError("collective.wait", 1, 5.0)
+                return super().train_step(x)
+
+        with pytest.raises(SystemExit) as ei:
+            self._loop(Flaky(), tmp_path).run(_batch, 8)
+        assert ei.value.code == REFORM_EXIT
+        marker = preempt.read_marker(str(tmp_path))
+        assert marker is not None
+        assert marker["step"] == 2
+        assert marker["reason"] == "elastic-reform"
+        assert not marker.get("provisional")
+        # the relaunch resumes step-exact from the emergency checkpoint
+        resumed = _Toy()
+        res = self._loop(resumed, tmp_path).run(_batch, 8)
+        assert res.resumed_from == 2 and res.steps == 8
+        baseline = _Toy()
+        self._loop(baseline, tmp_path / "ff").run(_batch, 8)
+        assert np.array_equal(resumed.w, baseline.w)
+
+    def test_comm_loss_without_elastic_stays_fatal(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.delenv("PADDLE_ELASTIC_ACTIVE", raising=False)
+
+        class Flaky(_Toy):
+            def train_step(self, x):
+                raise CommLostError("collective.barrier", 1, 5.0)
+
+        with pytest.raises(DeadlineExceeded):
+            self._loop(Flaky(), tmp_path).run(_batch, 8)
+
+    def test_transient_io_blip_does_not_reform(self, tmp_path):
+        """A ConnectionError (wire/IO noise, e.g. a checkpoint blip) under
+        elastic supervision keeps the in-place retry/restore discipline —
+        only the typed CommLostError re-forms the fleet."""
+        from paddle_tpu.distributed.fleet.elastic import RendezvousResult
+
+        calls = []
+
+        class Coordinator:
+            def re_rendezvous(self, reason=""):
+                calls.append(reason)
+                return RendezvousResult(1, 0, 1, ["n0"])
+
+        tripped = []
+
+        class Blippy(_Toy):
+            def train_step(self, x):
+                if self.s == 2 and not tripped:
+                    tripped.append(1)
+                    raise ConnectionError("NFS hiccup")
+                return super().train_step(x)
+
+        toy = Blippy()
+        loop = self._loop(toy, tmp_path, elastic=Coordinator())
+        res = loop.run(_batch, 6)
+        assert res.steps == 6
+        assert not calls  # no fleet reform for an IO blip
+        assert loop.restores == 1 and loop.reforms == 0
+
+    def test_reform_storm_bounded_by_max_restores(self, tmp_path,
+                                                  monkeypatch):
+        from paddle_tpu.distributed.fleet.elastic import RendezvousResult
+
+        class Coordinator:
+            def re_rendezvous(self, reason=""):
+                return RendezvousResult(1, 0, 1, ["n0"])
+
+        class AlwaysDown(_Toy):
+            def train_step(self, x):
+                raise CommLostError("collective.wait", 1, 5.0)
+
+        loop = self._loop(AlwaysDown(), tmp_path, elastic=Coordinator(),
+                          max_restores=3)
+        with pytest.raises(DeadlineExceeded) as ei:
+            loop.run(_batch, 4)
+        assert "resilient-loop.reform" in str(ei.value)
+
+
+# ------------------------------------------------ emergency save + verify
+
+class TestEmergencyAsyncSave:
+    def test_marker_repointed_at_fresh_generation(self, tmp_path):
+        from paddle_tpu.distributed.resilience.loop import ResilientLoop
+        toy = _Toy()
+        loop = ResilientLoop(toy, str(tmp_path), save_every=0,
+                             handle_signals=False)
+        fired = []
+        loop.preemption.request()
+
+        res = loop.run(_batch, 8, on_step=lambda s, l: fired.append(s))
+        assert res.preempted
+        marker = preempt.read_marker(str(tmp_path))
+        assert marker is not None and not marker.get("provisional")
+        assert marker["unique_id"] is not None
+        assert marker["reason"] == "preemption"
+
+    def test_failed_emergency_save_keeps_last_good(self, tmp_path):
+        """Chaos kills the emergency write: the marker must survive,
+        provisional, pointing at the anchor generation."""
+        from paddle_tpu.distributed.resilience.loop import ResilientLoop
+        toy = _Toy()
+        loop = ResilientLoop(toy, str(tmp_path), save_every=0,
+                             handle_signals=False)
+        with chaos.inject("ckpt.write:2"):  # hit 1 = anchor, hit 2 = emergency
+            loop.preemption.request()
+            res = loop.run(_batch, 8)
+        assert res.preempted
+        marker = preempt.read_marker(str(tmp_path))
+        assert marker is not None
+        assert marker.get("provisional") is True
+        assert marker["unique_id"] == 0  # the anchor generation
+
+    def test_wait_async_save_timeout_is_named(self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                       wait_async_save)
+        real_savez = np.savez
+
+        def slow_savez(*a, **kw):
+            time.sleep(0.6)
+            return real_savez(*a, **kw)
+
+        monkeypatch.setattr(np, "savez", slow_savez)
+        save_state_dict({"w": np.ones(2, np.float32)}, str(tmp_path),
+                        async_save=True)
+        with pytest.raises(DeadlineExceeded) as ei:
+            wait_async_save(timeout=0.05)
+        assert "ckpt.wait_async_save" in str(ei.value)
+        wait_async_save()  # and without a deadline it completes cleanly
+        assert os.path.exists(tmp_path / "0_metadata.json")
+
+
+class TestSaveSideCrcVerify:
+    def _corrupting_replace(self, monkeypatch):
+        real_replace = os.replace
+
+        def corrupt(src, dst):
+            real_replace(src, dst)
+            if dst.endswith(".npz"):  # the silently-failing filesystem
+                with open(dst, "ab") as f:
+                    f.write(b"\x00bitrot")
+
+        monkeypatch.setattr(os, "replace", corrupt)
+
+    def test_readback_mismatch_retries_then_raises_named(self, tmp_path,
+                                                         monkeypatch):
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+        self._corrupting_replace(monkeypatch)
+        before = metrics.counter("checkpoint.verify_failures").value
+        with pytest.raises(DeadlineExceeded) as ei:
+            save_state_dict({"w": np.ones(4, np.float32)}, str(tmp_path))
+        assert "ckpt.write" in str(ei.value)
+        assert metrics.counter("checkpoint.verify_failures").value \
+            >= before + 3  # every retry re-verified
+        # nothing published: a corrupt shard never hides behind metadata
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith("_metadata.json")]
+
+    def test_verify_disabled_restores_old_behavior(self, tmp_path,
+                                                   monkeypatch):
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+        self._corrupting_replace(monkeypatch)
+        monkeypatch.setenv("PADDLE_CKPT_VERIFY", "0")
+        uid = save_state_dict({"w": np.ones(4, np.float32)}, str(tmp_path))
+        assert os.path.exists(tmp_path / f"{uid}_metadata.json")
+
+    def test_clean_save_verifies_and_loads(self, tmp_path):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+        import jax.numpy as jnp
+        w = np.arange(6, dtype=np.float32)
+        save_state_dict({"w": w}, str(tmp_path))
+        target = {"w": Tensor(jnp.zeros(6, jnp.float32))}
+        load_state_dict(target, str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(target["w"]._value), w)
+
+
+# ----------------------------------------------------------- engine routing
+
+class TestEngineResilientFit:
+    def _engine(self):
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.engine import Engine
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        from paddle_tpu.optimizer import SGD
+        pt.seed(7)
+        self.cfg = GPTConfig.tiny(num_hidden_layers=2)
+        return Engine(GPTForCausalLM(self.cfg),
+                      optimizer=SGD(learning_rate=0.1))
+
+    def _data(self, n=4):
+        rng = np.random.RandomState(0)
+        out = []
+        for _ in range(n):
+            toks = rng.randint(0, self.cfg.vocab_size, (2, 8)).astype(np.int64)
+            out.append((toks, np.roll(toks, -1, axis=1)))
+        return out
+
+    def test_fit_routes_through_resilient_loop(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_CKPT_DIR", str(tmp_path))
+        monkeypatch.delenv("PADDLE_RESILIENT", raising=False)
+        eng = self._engine()
+        out = eng.fit(self._data(), epochs=1)
+        assert out is not None
+        # the resilience protocol ran: a checkpoint generation exists
+        assert [f for f in os.listdir(tmp_path)
+                if f.endswith("_metadata.json")]
+        assert eng._step_i == 4
+
+    def test_fit_opt_out_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_RESILIENT", "0")
+        eng = self._engine()
+        eng.fit(self._data(), epochs=1)
+        assert not os.listdir(tmp_path)  # plain loop: no checkpoints
+
+    def test_fit_without_ckpt_dir_unchanged(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_CKPT_DIR", raising=False)
+        eng = self._engine()
+        loss = eng.fit(self._data(), epochs=1)
+        assert loss is not None
+
+
+# ------------------------------------------------------------------- lint R3
+
+class TestLintBlockingWaits:
+    def _run(self, root):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "lint_resilience", os.path.join(REPO, "tools",
+                                            "lint_resilience.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main([str(root)])
+
+    def _write(self, root, body):
+        pkg = root / "paddle_tpu" / "distributed"
+        pkg.mkdir(parents=True)
+        (pkg / "x.py").write_text(body)
+
+    def test_flags_bare_blocking_wait_in_distributed(self, tmp_path, capsys):
+        self._write(tmp_path,
+                    "import jax\n"
+                    "def f(t):\n"
+                    "    jax.block_until_ready(t)\n")
+        assert self._run(tmp_path) == 1
+        assert "[R3]" in capsys.readouterr().out
+
+    def test_flags_from_import_bare_name_call(self, tmp_path, capsys):
+        self._write(tmp_path,
+                    "from jax import block_until_ready\n"
+                    "def f(t):\n"
+                    "    block_until_ready(t)\n")
+        assert self._run(tmp_path) == 1
+        assert "[R3]" in capsys.readouterr().out
+
+    def test_watch_scoped_wait_is_clean(self, tmp_path):
+        self._write(tmp_path,
+                    "import jax\n"
+                    "from x import watch\n"
+                    "def f(t):\n"
+                    "    with watch('barrier'):\n"
+                    "        jax.block_until_ready(t)\n")
+        assert self._run(tmp_path) == 0
+
+    def test_marker_exempts_audited_wait(self, tmp_path):
+        self._write(tmp_path,
+                    "import jax\n"
+                    "def f(t):\n"
+                    "    jax.block_until_ready(t)  # resilience: ok (audited)\n")
+        assert self._run(tmp_path) == 0
+
+    def test_repo_tree_is_clean(self):
+        assert self._run(REPO) == 0

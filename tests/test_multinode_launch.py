@@ -6,13 +6,16 @@ N launchers against a shared master) plus an elastic end-to-end drill:
 kill a node mid-run → the surviving launcher RESTARTs at the new world
 size → the relaunched trainer resumes from the sharded checkpoint.
 """
+import json
 import os
+import re
 import signal
 import subprocess
 import sys
 import time
 import uuid
 
+import numpy as np
 import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -69,6 +72,141 @@ class TestTwoLauncherRendezvous:
                            for i, (c, o) in enumerate(zip(codes, outs)))
         assert codes == [0, 0], report
         assert any("COLLECTIVES_OK" in o for o in outs), report
+
+
+class TestSelfHealingFleetDrill:
+    """ISSUE 4 acceptance: 3 launcher workers, kill one mid-run → the
+    survivors re-rendezvous (new generation, contiguous ranks), relaunch,
+    and resume step-exact; the post-resume loss trajectory is
+    bitwise-identical to a fault-free run at the same step count."""
+
+    STEPS = 12
+
+    @staticmethod
+    def _expected_losses(steps):
+        """The drill toy's trajectory, recomputed with identical float32
+        numpy ops — bitwise comparison, not allclose."""
+        w = np.zeros(4, np.float32)
+        out = {}
+        for step in range(steps):
+            x = np.full(4, np.float32((step % 7) * 0.125), np.float32)
+            w = (w * np.float32(1.01) + x).astype(np.float32)
+            out[step + 1] = float(w.sum())
+        return out
+
+    def test_kill_one_of_three_rerendezvous_step_exact(self, tmp_path):
+        job = f"sh-{uuid.uuid4().hex[:8]}"
+        eroot = str(tmp_path / "hb")
+        drill = str(tmp_path / "drill")
+        trace = str(tmp_path / "trace")
+        os.makedirs(drill, exist_ok=True)
+        env = {"DRILL_DIR": drill, "DRILL_STEPS": str(self.STEPS),
+               "DRILL_STEP_S": "0.3", "DRILL_BAR_TIMEOUT": "4",
+               "PADDLE_TRACE_DIR": trace}
+        args = ("--elastic_root", eroot, "--job_id", job,
+                "--heartbeat_interval", "0.25", "--elastic_timeout", "60",
+                "--join_window", "0.5")
+        launchers = [
+            _launcher(r, "2:3", "127.0.0.1:0", "elastic_resume.py", job,
+                      extra_env=env, extra_args=args)
+            for r in range(3)
+        ]
+
+        def read_losses():
+            rows = []
+            for node in range(3):
+                path = os.path.join(drill, f"losses.node-{node}.jsonl")
+                if not os.path.exists(path):
+                    continue
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            try:
+                                rows.append(dict(json.loads(line),
+                                                 node=node))
+                            except ValueError:
+                                pass  # racing an in-flight append
+            return rows
+
+        try:
+            # let the fleet get past step 3 on every node, then kill node 0
+            # (the lowest node id — its death forces real rank re-assignment
+            # on BOTH survivors, not just a truncation)
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                rows = read_losses()
+                per_node = {}
+                for r in rows:
+                    per_node[r["node"]] = max(
+                        per_node.get(r["node"], 0), r["step"])
+                if len(per_node) == 3 and min(per_node.values()) >= 3:
+                    break
+                dead = [i for i, p in enumerate(launchers)
+                        if p.poll() is not None]
+                if dead:
+                    outs = launchers[dead[0]].communicate()[0]
+                    pytest.fail(f"launcher {dead[0]} died during warmup:\n"
+                                f"{(outs or '')[-2000:]}")
+                time.sleep(0.3)
+            else:
+                pytest.fail(f"fleet never reached step 3: {read_losses()}")
+
+            launchers[0].send_signal(signal.SIGTERM)
+            launchers[0].wait(timeout=60)
+
+            outs = [None] * 3
+            for i in (1, 2):
+                outs[i], _ = launchers[i].communicate(timeout=240)
+                assert launchers[i].returncode == 0, \
+                    f"launcher {i} rc={launchers[i].returncode}:\n" \
+                    f"{outs[i][-3000:]}"
+
+            survivors = outs[1] + outs[2]
+            # re-rendezvous happened: survivors re-formed at np=2 under a
+            # NEW generation, and no watchdog exit-124 / hang occurred
+            assert "relaunch at np=2 gen=" in survivors, survivors[-3000:]
+            gens = [int(m) for m in
+                    re.findall(r"relaunch at np=2 gen=(\d+)", survivors)]
+            assert gens and max(gens) >= 1, survivors[-3000:]
+            assert "DRILL_DONE" in outs[1] and "DRILL_DONE" in outs[2], \
+                survivors[-3000:]
+            assert "exit 124" not in survivors
+
+            # step-exact, bitwise: every recorded loss at step s equals the
+            # fault-free trajectory's loss at s, and the union covers the
+            # full run
+            expected = self._expected_losses(self.STEPS)
+            got = {}
+            for r in read_losses():
+                got.setdefault(r["step"], set()).add(r["loss"])
+            assert set(range(1, self.STEPS + 1)) <= set(got), sorted(got)
+            for step in range(1, self.STEPS + 1):
+                assert got[step] == {expected[step]}, (
+                    step, got[step], expected[step])
+
+            # postmortem: the new generation is visible in the survivors'
+            # launcher FLIGHT.json, and each rank left its own trace dir
+            regen = []
+            for node in (1, 2):
+                fp = os.path.join(trace, f"node-{node}.launcher",
+                                  "FLIGHT.json")
+                assert os.path.exists(fp), os.listdir(trace)
+                with open(fp) as f:
+                    doc = json.load(f)
+                regen += [e for e in doc["events"]
+                          if e["kind"] == "elastic.regen"]
+            assert regen and max(e["gen"] for e in regen) >= 1, regen
+            for node in (1, 2):
+                rank_dir = os.path.join(trace, f"node-{node}.0")
+                assert os.path.isdir(rank_dir), os.listdir(trace)
+                assert os.path.exists(
+                    os.path.join(rank_dir, "FLIGHT.json")), \
+                    os.listdir(rank_dir)
+        finally:
+            for p in launchers:
+                if p.poll() is None:
+                    p.kill()
 
 
 class TestElasticDrill:

@@ -13,6 +13,13 @@ Flagged (per function, AST-based):
   R2 bare-poll-loop  : a while loop that polls `os.path.exists` and sleeps —
      a filesystem wait with no named deadline error. Use
      resilience.retry.wait_for.
+  R3 bare-blocking-collective-wait : in paddle_tpu/distributed/**, a
+     `block_until_ready(...)` call that is not lexically inside a
+     `with watch(...)` block — a collective/rendezvous wait that bypasses
+     both the comm watchdog AND the elastic deadline layer. One lost peer
+     would wedge it forever (or exit 124) instead of raising the named
+     DeadlineExceeded the re-rendezvous path recovers from. Route through
+     comm_watchdog.watch + collective._finish_wait.
 
 Exemptions:
   * anything under paddle_tpu/distributed/resilience/ (it IS the layer)
@@ -84,6 +91,45 @@ def _loop_findings(loop: ast.AST, lines: list[str]):
                "poll with a named deadline error")
 
 
+def _is_watch_call(expr: ast.AST) -> bool:
+    f = getattr(expr, "func", None)
+    name = getattr(f, "id", None) or getattr(f, "attr", None)
+    return name == "watch"
+
+
+def _blocking_wait_findings(tree: ast.AST, lines: list[str]):
+    """R3: block_until_ready outside a `with watch(...)` (elastic paths)."""
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # both spellings: jax.block_until_ready(x) and the from-import
+        # bare-name call block_until_ready(x)
+        fname = getattr(node.func, "attr", None) \
+            or getattr(node.func, "id", None)
+        if fname != "block_until_ready":
+            continue
+        if node.lineno - 1 < len(lines) and MARKER in lines[node.lineno - 1]:
+            continue
+        cur = parents.get(node)
+        watched = False
+        while cur is not None and not watched:
+            if isinstance(cur, ast.With):
+                watched = any(_is_watch_call(item.context_expr)
+                              for item in cur.items)
+            cur = parents.get(cur)
+        if not watched:
+            yield ("R3", node.lineno,
+                   "bare blocking collective wait (block_until_ready "
+                   "outside `with watch(...)`): route through "
+                   "comm_watchdog.watch + collective._finish_wait so a "
+                   "lost peer raises a named deadline the elastic layer "
+                   "recovers from, or mark '# resilience: ok (<why>)'")
+
+
 def lint_file(path: str):
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -96,6 +142,9 @@ def lint_file(path: str):
     for node in ast.walk(tree):
         if isinstance(node, (ast.While, ast.For)):
             yield from _loop_findings(node, lines)
+    norm = path.replace(os.sep, "/")
+    if "/distributed/" in norm:
+        yield from _blocking_wait_findings(tree, lines)
 
 
 def iter_py_files(root: str):
