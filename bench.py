@@ -90,6 +90,25 @@ def _metrics_payload() -> dict | None:
         return None
 
 
+def _fleet_payload() -> dict | None:
+    """The ``fleet`` sub-object (rank count, straggler events, telemetry
+    drop counter) — present only on multi-rank runs (the launcher exports
+    PADDLE_TRAINERS_NUM > 1). Schema pinned by the bench contract tests."""
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or "1")
+    except ValueError:
+        return None
+    if world <= 1:
+        return None
+    snap = _metrics_payload() or {}
+    counters = snap.get("counters", {})
+    return {
+        "ranks": world,
+        "straggler_events": int(counters.get("fleet.straggler", 0)),
+        "telemetry_drops": int(counters.get("telemetry.drops", 0)),
+    }
+
+
 def _error_payload(msg: str) -> dict:
     err = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -98,6 +117,9 @@ def _error_payload(msg: str) -> dict:
         "error": msg,
         "metrics": _metrics_payload(),
     }
+    fleet = _fleet_payload()
+    if fleet is not None:
+        err["fleet"] = fleet
     # surface the last committed success so an outage at bench time still
     # points the reader at a real number
     try:
@@ -374,6 +396,9 @@ def main() -> int:
         },
         "metrics": _metrics_payload(),
     }
+    fleet = _fleet_payload()
+    if fleet is not None:
+        result["fleet"] = fleet
     if on_tpu:
         # non-default sizes record to their own file: the canonical 850M
         # BENCH_latest.json must not be clobbered by a 2b scale-proof run

@@ -45,6 +45,22 @@ define_flag("comm_timeout_s", 600.0,
 
 __all__ = ["watch", "default_timeout"]
 
+# per-op collective sequence numbers: SPMD ranks issue collectives in the
+# same program order, so the Nth watched wait of op X on rank A is the same
+# collective as the Nth on rank B — the fleet trace merger binds them into
+# one chrome flow by (op, seq). Counted only while tracing is on (the
+# disabled path stays lock-free) — all ranks flip tracing together via the
+# launcher's PADDLE_TRACE_DIR, so the counts stay aligned.
+_seq_lock = threading.Lock()
+_op_seq: dict[str, int] = {}
+
+
+def _collective_seq(op_name: str) -> int:
+    with _seq_lock:
+        n = _op_seq.get(op_name, 0) + 1
+        _op_seq[op_name] = n
+        return n
+
 
 def default_timeout() -> float:
     try:
@@ -131,7 +147,12 @@ def watch(op_name: str, group=None, timeout: float | None = None,
     timer.daemon = True
     timer.start()
     try:
-        with _spans.span("comm." + op_name, cat="collective"):
+        if _spans.tracing_enabled():
+            cm = _spans.span("comm." + op_name, cat="collective",
+                             seq=_collective_seq(op_name))
+        else:
+            cm = _spans.span("comm." + op_name, cat="collective")
+        with cm:
             yield
     finally:
         timer.cancel()

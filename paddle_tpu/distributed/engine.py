@@ -31,7 +31,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import random as _rng
 from ..core.tensor import Parameter, Tensor
-from ..observability import metrics as _metrics, spans as _spans
+from ..observability import fleet as _fleet, metrics as _metrics, \
+    spans as _spans, xplane as _xplane
 from .process_mesh import ProcessMesh
 
 __all__ = ["Engine", "PipelinePlan", "Strategy"]
@@ -636,6 +637,8 @@ class Engine:
                 inputs, labels)
         _metrics.counter("train.steps").inc()
         _metrics.maybe_emit_step(self._step_i)
+        _fleet.maybe_push(self._step_i)     # fleet heartbeat (env-gated)
+        _xplane.maybe_step(self._step_i)    # device-trace window (env-gated)
         return Tensor(loss)
 
     def _put_data(self, x):

@@ -53,7 +53,13 @@ from ...observability import metrics as _metrics, recorder as _recorder, \
 
 __all__ = ["ElasticLevel", "ElasticStatus", "FileRegistry", "KVServer",
            "KVRegistry", "ElasticManager", "RendezvousResult",
-           "elastic_active", "set_elastic_active"]
+           "elastic_active", "set_elastic_active", "TELEMETRY_KEY"]
+
+# durable-KV key under which the rank-0 launcher advertises its admin /
+# telemetry endpoint (observability.admin.AdminServer) — late joiners and
+# re-formed fleets find the observability plane through the registry they
+# already speak, no extra wiring
+TELEMETRY_KEY = "telemetry.admin"
 
 
 _active = [False]
@@ -564,6 +570,21 @@ class ElasticManager:
 
     def world_hosts(self):
         return list(self._last_membership or self.registry.alive_nodes())
+
+    # ---- fleet observability plane discovery ----
+    def publish_telemetry_endpoint(self, endpoint: str):
+        """Advertise the rank-0 admin/telemetry endpoint (host:port) in the
+        durable KV. Best-effort: the fleet runs fine blind."""
+        try:
+            self.registry.kv_put(TELEMETRY_KEY, endpoint)
+        except Exception:
+            pass
+
+    def telemetry_endpoint(self) -> str | None:
+        try:
+            return self.registry.kv_get(TELEMETRY_KEY)
+        except Exception:
+            return None
 
     def rank_of(self, node_id: str | None = None) -> int:
         """Stable node rank = index in the sorted alive membership."""

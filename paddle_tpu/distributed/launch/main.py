@@ -30,6 +30,17 @@ flapping node can't make the fleet thrash. Workers inherit
 PADDLE_ELASTIC_GEN / PADDLE_ELASTIC_ACTIVE / PADDLE_RESILIENT, and when
 PADDLE_TRACE_DIR is set each rank gets its own subdirectory for
 FLIGHT.json postmortems.
+
+Fleet observability (observability.fleet / observability.admin): the
+rank-0 launcher runs the aggregation plane — a TelemetryAggregator fed by
+every rank's TelemetryClient (shared-dir JSONL under PADDLE_TELEMETRY_DIR,
+or HTTP push to the exported PADDLE_TELEMETRY_ENDPOINT) and a live admin
+endpoint (/metrics /snapshot /flight /health /ranks). On exit and on every
+reform it leaves three artifacts under PADDLE_TRACE_DIR: the launcher's own
+FLIGHT.json (now carrying the ranked per-rank step-time table), a merged
+FLEET_FLIGHT.json folding every rank's flight, and FLEET_TRACE.json — one
+clock-aligned chrome trace with a track per (node, rank) and straggler
+attribution (fleet.straggler events name persistently slow ranks).
 """
 from __future__ import annotations
 
@@ -173,6 +184,86 @@ def _make_elastic(args, node_id: str):
     return mgr, server
 
 
+def _telemetry_active(args) -> bool:
+    """The aggregation plane runs when telemetry is configured explicitly
+    (PADDLE_TELEMETRY_DIR / PADDLE_TELEMETRY=1) or the launcher owns more
+    than one local rank (the common mp-simulation case). PADDLE_TELEMETRY=0
+    always wins."""
+    if os.environ.get("PADDLE_TELEMETRY") == "0":
+        return False
+    return bool(os.environ.get("PADDLE_TELEMETRY_DIR")
+                or os.environ.get("PADDLE_TELEMETRY") == "1"
+                or args.nproc_per_node > 1)
+
+
+def _telemetry_start(args, node_id, mgr):
+    """Rank-0 only: start the TelemetryAggregator + admin endpoint, wire
+    the report transport (shared-dir poll, or exported HTTP endpoint), and
+    advertise the endpoint (endpoint file in the telemetry dir + elastic
+    durable KV) so peers and tools can find it."""
+    from ...observability import fleet as _fleet
+    from ...observability.admin import AdminServer, write_endpoint_file
+    agg = _fleet.TelemetryAggregator()
+    try:
+        port = int(os.environ.get("PADDLE_TELEMETRY_ADMIN_PORT", "0") or 0)
+    except ValueError:
+        port = 0
+    admin = AdminServer(port=port, aggregator=agg).start()
+    host = (args.master or "").partition(":")[0]
+    if not host:
+        # no --master (FileRegistry-over-NFS fleets): advertise this
+        # host's address, not a loopback a peer node can't reach
+        import socket
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = "127.0.0.1"
+    ep = f"{host}:{admin.port}"
+    tdir = os.environ.get("PADDLE_TELEMETRY_DIR")
+    if tdir:
+        agg.watch_dir(tdir)
+        try:
+            write_endpoint_file(tdir, ep, node=node_id)
+        except OSError:
+            pass
+    else:
+        # children of THIS launcher push straight to the admin server
+        os.environ["PADDLE_TELEMETRY_ENDPOINT"] = f"127.0.0.1:{admin.port}"
+    if mgr is not None:
+        mgr.publish_telemetry_endpoint(ep)
+    print(f"[launch] telemetry admin at {ep}", file=sys.stderr)
+    return {"agg": agg, "admin": admin, "dir": tdir}
+
+
+def _telemetry_close(telem):
+    """Leave the fleet artifacts behind (merged trace + merged flight) and
+    shut the plane down. Never raises — observability must not turn a clean
+    exit into a failure."""
+    if telem is None:
+        return
+    try:
+        if telem["dir"]:
+            # catch the final reports: peers on OTHER launchers (the slow
+            # rank especially) may still be force-pushing their last span
+            # batch while this launcher's own child already exited
+            telem["agg"].scan_dir(telem["dir"])
+            time.sleep(1.0)  # resilience: ok (bounded exit grace, not a retry loop)
+            telem["agg"].scan_dir(telem["dir"])
+        trace = os.environ.get("PADDLE_TRACE_DIR")
+        if trace:
+            from ...observability import fleet as _fleet
+            telem["agg"].merged_chrome_trace(
+                os.path.join(trace, _fleet.FLEET_TRACE_NAME))
+            _fleet.merge_flight_files(trace)
+    except Exception:
+        pass
+    try:
+        telem["agg"].stop()
+        telem["admin"].stop()
+    except Exception:
+        pass
+
+
 def _stop_procs(procs, grace: float = 5.0):
     """Terminate children, escalating to SIGKILL after `grace`.
 
@@ -220,6 +311,7 @@ def launch(argv=None):
     have_assignment = False  # re_rendezvous already fixed (rank, world)
     procs: list = []
     stop_sig = {"sig": None}
+    telem_box = {"t": None}  # rank-0 aggregation plane (started lazily)
 
     def on_term(sig, _frm):
         # record and let the supervision/wait loops stop the pod AND the
@@ -232,9 +324,23 @@ def launch(argv=None):
             return
         try:
             from ...observability import recorder
+            telem = telem_box["t"]
+            if telem is not None:
+                # the ranked per-rank step-time table rides in every
+                # launcher flight dump: reform postmortems name the slow
+                # rank without re-deriving it
+                try:
+                    recorder.record("fleet.step_table", reason=reason,
+                                    table=telem["agg"].step_time_table(),
+                                    stragglers=telem["agg"].straggler_events)
+                except Exception:
+                    pass
             recorder.dump_flight(
                 os.path.join(os.environ["PADDLE_TRACE_DIR"],
                              f"{node_id}.launcher"), reason=reason)
+            if telem is not None:
+                from ...observability import fleet as _fleet
+                _fleet.merge_flight_files(os.environ["PADDLE_TRACE_DIR"])
         except Exception:
             pass
 
@@ -276,6 +382,16 @@ def launch(argv=None):
                     time.sleep(args.heartbeat_interval)
                 node_rank = rank
             have_assignment = False
+            if telem_box["t"] is None and node_rank == 0 \
+                    and _telemetry_active(args):
+                # rank 0 owns the fleet aggregation plane (started once;
+                # survives reforms — ranks are re-reported under the new
+                # generation)
+                try:
+                    telem_box["t"] = _telemetry_start(args, node_id, mgr)
+                except Exception as e:
+                    print(f"[launch] telemetry plane failed to start ({e}); "
+                          f"running blind", file=sys.stderr)
             world = nnodes * args.nproc_per_node
             base = node_rank * args.nproc_per_node
             gen = mgr.generation if mgr is not None else 0
@@ -348,6 +464,7 @@ def launch(argv=None):
                     _stop_procs(procs)
                     break
                 if alive == 0:
+                    _dump_launcher_flight("run complete")
                     return 0
                 if mgr is not None:
                     st = mgr.watch()
@@ -415,6 +532,7 @@ def launch(argv=None):
             return failed or 1
     finally:
         _stop_procs(procs)  # never orphan trainers past the launcher
+        _telemetry_close(telem_box["t"])  # FLEET_TRACE + FLEET_FLIGHT land
         if mgr is not None:
             mgr.stop()
         if server is not None:

@@ -48,8 +48,8 @@ import jax
 import numpy as np
 
 from ...core.tensor import Tensor
-from ...observability import metrics as _metrics, recorder as _recorder, \
-    spans as _spans
+from ...observability import fleet as _fleet, metrics as _metrics, \
+    recorder as _recorder, spans as _spans
 from . import chaos, preempt
 from .retry import DeadlineExceeded, RetryPolicy, classify
 
@@ -369,10 +369,17 @@ class ResilientLoop:
         while step < num_steps:
             if self.preemption.requested:
                 self._emergency_save()
+                _fleet.maybe_push(step, force=True)  # last words out the door
                 return RunResult(step, _loss_float(last_loss), self.restores,
                                  True, resumed_from)
             try:
-                with _spans.span("loop.step", cat="step", step=step):
+                # loop.step_time_s (NOT train.step_time_s: an Engine/
+                # LlamaTrainStep trainable already observes that inside
+                # _step_fn — two observations of one step would skew the
+                # histogram; the fleet straggler detector prefers train.*
+                # and falls back to loop.*)
+                with _spans.span("loop.step", cat="step", step=step), \
+                        _metrics.timer("loop.step_time_s"):
                     batch = batch_fn(step)
                     if not isinstance(batch, (tuple, list)):
                         batch = (batch,)
@@ -386,6 +393,9 @@ class ResilientLoop:
                     delays = self.policy.delays()
                 if on_step is not None:
                     on_step(step, loss)
+                # fleet telemetry heartbeat: interval-paced, loss-tolerant
+                # (a drop is counted, never raises into the step)
+                _fleet.maybe_push(step)
                 if self.save_every and step < num_steps \
                         and step % self.save_every == 0:
                     self.save_checkpoint()
@@ -413,6 +423,13 @@ class ResilientLoop:
                     raise
                 self._recover(e, delays)
         preempt.clear_marker(self.ckpt_dir)
+        # final push so the aggregator's merged trace covers the tail steps
+        # between the last interval-paced push and exit
+        _fleet.maybe_push(step, force=True)
+        if os.environ.get("PADDLE_TRACE_DIR"):
+            # traced runs leave their flight behind even on success, so the
+            # launcher's FLEET_FLIGHT.json covers every rank's story
+            _recorder.dump_flight(reason="run complete")
         return RunResult(step, _loss_float(last_loss), self.restores, False,
                          resumed_from)
 

@@ -64,7 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..distributed.resilience import chaos
-from ..observability import metrics
+from ..observability import fleet as _fleet, metrics
 from .paging import (PageAllocator, SCRATCH_PAGE, default_page_buckets,
                      pages_for)
 
@@ -180,6 +180,7 @@ class ContinuousBatcher:
         self._queue: deque[ServedRequest] = deque()
         self._finished: dict[int, ServedRequest] = {}
         self._next_rid = 0
+        self._admin = None  # live admin endpoint (start_admin)
         self.stats = {"bursts": 0, "decode_steps": 0, "prefills": 0,
                       "admission_stalls": 0, "preemptions": 0,
                       "chaos_retired": 0, "max_concurrent": 0,
@@ -499,8 +500,11 @@ class ContinuousBatcher:
             metrics.histogram("serve.burst_time_s").observe(dt)
             if emitted and dt > 0:
                 metrics.gauge("serve.tokens_per_s").set(emitted / dt)
-            return
-        self._step_dense()
+        else:
+            self._step_dense()
+        # fleet heartbeat (env-gated, interval-paced, loss-tolerant): the
+        # rank-0 aggregator sees live serve.* gauges between bursts too
+        _fleet.maybe_push(self.stats["decode_steps"])
 
     def _step_dense(self):
         from ..models.llama_decode import llama_decode_burst
@@ -547,6 +551,41 @@ class ContinuousBatcher:
         metrics.counter("serve.tokens").inc(emitted_total)
         if emitted_total and dt > 0:
             metrics.gauge("serve.tokens_per_s").set(emitted_total / dt)
+
+    # ------------------------------------------------------------- admin
+    def start_admin(self, port: int = 0, host: str = "0.0.0.0"):
+        """Serve the live admin endpoint next to the scheduler: /metrics
+        (Prometheus text incl. the serve.* gauges), /snapshot (JSON metrics
+        + a live scheduler summary under extra.serve), /flight, /health.
+        Idempotent; returns the AdminServer (``.port`` for an ephemeral
+        bind). The ROADMAP follow-up 'surface serve.* through the serving
+        admin endpoint' lands here."""
+        if self._admin is None:
+            from ..observability.admin import AdminServer
+            self._admin = AdminServer(port=port, host=host,
+                                      extra={"serve": self.admin_summary})
+            self._admin.start()
+        return self._admin
+
+    def stop_admin(self):
+        if self._admin is not None:
+            self._admin.stop()
+            self._admin = None
+
+    def admin_summary(self) -> dict:
+        """Live scheduler state for /snapshot — what the gauges can't say
+        (queue composition, slot occupancy) without a device sync."""
+        return {
+            "layout": self._layout,
+            "queue_depth": len(self._queue),
+            "active_slots": sum(r is not None for r in self._slot_req),
+            "max_batch": self.B,
+            "pages_in_use": self.pages_in_use,
+            "free_pages": (self._alloc.free_pages
+                           if self._layout == "paged" else None),
+            "finished": len(self._finished),
+            "stats": dict(self.stats),
+        }
 
     @property
     def pending(self) -> int:

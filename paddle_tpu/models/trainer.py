@@ -20,7 +20,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..distributed.process_mesh import ProcessMesh
-from ..observability import metrics as _metrics, spans as _spans
+from ..observability import fleet as _fleet, metrics as _metrics, \
+    spans as _spans, xplane as _xplane
 from ..optimizer import AdamW, Optimizer
 from . import llama as L
 
@@ -206,6 +207,8 @@ class LlamaTrainStep:
         _metrics.counter("train.steps").inc()
         _metrics.counter("train.tokens").inc(int(tokens.size))
         _metrics.maybe_emit_step(self._step_i)
+        _fleet.maybe_push(self._step_i)     # fleet heartbeat (env-gated)
+        _xplane.maybe_step(self._step_i)    # device-trace window (env-gated)
         return loss
 
     @property

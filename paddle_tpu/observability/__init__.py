@@ -16,21 +16,39 @@ pre-PR-2 archipelago (comm_watchdog prints, resilience stderr lines, ad-hoc
              auto-dumps ``FLIGHT.json`` on crash, SIGTERM/preemption (via
              the resilience preempt latch) and on every ResilientLoop
              restore — postmortems of chaos/preemption runs need no re-run.
+  fleet    — fleet-wide telemetry: per-rank ``TelemetryClient`` pushes
+             (metrics snapshot + span batches + heartbeat) to the rank-0
+             launcher's ``TelemetryAggregator``; merged cross-rank chrome
+             trace, straggler detection, FLEET_FLIGHT.json merging.
+  admin    — the live admin HTTP endpoint (/metrics Prometheus text,
+             /snapshot, /flight, /health, /ranks, POST /push) served by
+             the launcher for training and ContinuousBatcher for serving.
+  xplane   — optional on-device (jax.profiler) trace window keyed by
+             PADDLE_XPLANE_DIR, linked from the host chrome trace.
 
 Env vars:
   PADDLE_TRACE_DIR        enable span tracing; chrome trace + FLIGHT.json
                           land here (trace exported at process exit too)
   PADDLE_METRICS_SINK     path ending .jsonl or .csv: per-step metric rows
   PADDLE_FLIGHT_RECORDER  ring capacity (default 512; 0/off disables)
+  PADDLE_TELEMETRY_DIR    shared-dir fleet telemetry transport root
+  PADDLE_TELEMETRY_ENDPOINT  host:port of the rank-0 admin server
+  PADDLE_TELEMETRY_INTERVAL  min seconds between pushes (default 0.5)
+  PADDLE_XPLANE_DIR       device-trace window dump dir (off when unset)
 
-The package imports only the stdlib — any module in paddle_tpu (including
-the earliest-imported resilience layer) can depend on it without cycles.
+The core modules import only the stdlib — any module in paddle_tpu
+(including the earliest-imported resilience layer) can depend on them
+without cycles (fleet/xplane resolve chaos/jax lazily, inside guarded
+calls).
 """
 from __future__ import annotations
 
 from . import metrics  # noqa: F401
 from . import recorder  # noqa: F401
 from . import spans  # noqa: F401
+from . import admin  # noqa: F401
+from . import fleet  # noqa: F401
+from . import xplane  # noqa: F401
 from .metrics import counter, gauge, histogram, snapshot, timer  # noqa: F401
 from .recorder import dump_flight, record  # noqa: F401
 from .spans import (  # noqa: F401
@@ -39,7 +57,7 @@ from .spans import (  # noqa: F401
 )
 
 __all__ = [
-    "spans", "metrics", "recorder",
+    "spans", "metrics", "recorder", "fleet", "admin", "xplane",
     "span", "traced", "tracing_enabled", "enable_tracing", "disable_tracing",
     "export_chrome_trace",
     "counter", "gauge", "histogram", "snapshot", "timer",
@@ -54,3 +72,5 @@ def reset():
     spans.reset()
     metrics.reset()
     recorder.reset()
+    fleet.reset()
+    xplane.reset()

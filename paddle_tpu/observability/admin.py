@@ -1,0 +1,193 @@
+"""Admin HTTP endpoint — the ONE sanctioned live-telemetry server.
+
+Before this module, "what is this process doing right now?" had two answers:
+read the per-step CSV sink after the fact, or attach a debugger. The admin
+server answers it live, over plain HTTP, for BOTH runtimes:
+
+  * training — the rank-0 launcher serves it (distributed/launch/main.py)
+    with a ``fleet.TelemetryAggregator`` attached, so ``/snapshot`` and
+    ``/ranks`` cover every rank of the job, not just the local process;
+  * serving — ``ContinuousBatcher.start_admin()`` serves it next to the
+    scheduler, exposing the live ``serve.*`` gauges (pages_in_use, queue
+    depth, tokens/s) mid-flight.
+
+Routes (GET unauthenticated, mirroring ``KVServer``'s read side):
+  /health    liveness JSON: {"ok": true, pid, time, ranks?}
+  /metrics   Prometheus text exposition of ``metrics.snapshot()``
+  /snapshot  the full metrics snapshot as JSON (+ fleet summary + extras)
+  /flight    the current flight-recorder ring as JSON
+  /ranks     per-rank fleet summary (empty list without an aggregator)
+  /push      POST (token-authed, same job-token discipline as the elastic
+             KV master's mutating endpoints): ingest one TelemetryClient
+             report into the attached aggregator
+
+tools/lint_observability.py rule O3 bans ThreadingHTTPServer / urllib use
+outside observability/ and the audited allowlist — future endpoints extend
+THIS server instead of growing new ad-hoc ones.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metrics, recorder
+
+__all__ = ["AdminServer", "job_token", "render_prometheus",
+           "write_endpoint_file", "read_endpoint_file", "ENDPOINT_FILE"]
+
+ENDPOINT_FILE = "admin.json"
+
+
+def job_token() -> str:
+    """Job token required on mutating admin endpoints (POST /push): a peer
+    outside the job (who does not know PADDLE_JOB_ID / PADDLE_RPC_SECRET)
+    cannot forge telemetry reports into the aggregator. Same derivation
+    discipline as fleet/elastic.py's KV token, domain-separated."""
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    secret = os.environ.get("PADDLE_RPC_SECRET", "")
+    return hashlib.sha256(
+        f"paddle-tpu-admin:{secret}:{job}".encode()).hexdigest()
+
+
+def _prom_name(name: str) -> str:
+    return "paddle_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def render_prometheus(snap: dict) -> str:
+    """``metrics.snapshot()`` → Prometheus text exposition (version 0.0.4).
+    Counters/gauges map 1:1; histograms render as summaries (count, sum,
+    p50/p95/p99 quantile samples over the recent reservoir)."""
+    lines: list[str] = []
+    for n, v in snap.get("counters", {}).items():
+        m = _prom_name(n)
+        lines += [f"# TYPE {m} counter", f"{m} {v}"]
+    for n, v in snap.get("gauges", {}).items():
+        m = _prom_name(n)
+        lines += [f"# TYPE {m} gauge", f"{m} {v}"]
+    for n, st in snap.get("histograms", {}).items():
+        m = _prom_name(n)
+        lines.append(f"# TYPE {m} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            if st.get(key) is not None:
+                lines.append(f'{m}{{quantile="{q}"}} {st[key]}')
+        lines.append(f"{m}_sum {st.get('sum', 0)}")
+        lines.append(f"{m}_count {st.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+class AdminServer:
+    """admin = AdminServer(port=0, aggregator=agg).start(); admin.port
+
+    `aggregator`: a ``fleet.TelemetryAggregator`` (or None for a
+    process-local endpoint — serving uses this). `extra`: {name: callable}
+    evaluated per /snapshot request and merged under "extra" (the serving
+    scheduler exposes queue/slot state this way)."""
+
+    def __init__(self, port: int = 0, aggregator=None, extra: dict | None = None,
+                 host: str = "0.0.0.0"):
+        self.aggregator = aggregator
+        self.extra = dict(extra or {})
+        ref = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body=b"", ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code=200):
+                self._send(code, json.dumps(obj, default=str).encode())
+
+            def do_GET(self):
+                agg = ref.aggregator
+                if self.path == "/health":
+                    doc = {"ok": True, "pid": os.getpid(), "time": time.time()}
+                    if agg is not None:
+                        doc["ranks"] = len(agg.ranks())
+                    return self._json(doc)
+                if self.path == "/metrics":
+                    text = render_prometheus(metrics.snapshot())
+                    return self._send(200, text.encode(),
+                                      "text/plain; version=0.0.4")
+                if self.path == "/snapshot":
+                    doc = {"pid": os.getpid(), "time": time.time(),
+                           "metrics": metrics.snapshot(),
+                           "fleet": (agg.fleet_snapshot()
+                                     if agg is not None else None)}
+                    extras = {}
+                    for name, fn in ref.extra.items():
+                        try:
+                            extras[name] = fn()
+                        except Exception as e:
+                            extras[name] = f"<error: {e}>"
+                    if extras:
+                        doc["extra"] = extras
+                    return self._json(doc)
+                if self.path == "/flight":
+                    return self._json({"pid": os.getpid(),
+                                       "events": recorder.events()})
+                if self.path == "/ranks":
+                    return self._json(agg.ranks() if agg is not None else [])
+                self._send(404)
+
+            def do_POST(self):
+                if self.path != "/push":
+                    return self._send(404)
+                tok = self.headers.get("X-Paddle-Job-Token", "")
+                if not hmac.compare_digest(tok, job_token()):
+                    return self._send(403)
+                if ref.aggregator is None:
+                    return self._send(503)
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                try:
+                    report = json.loads(body)
+                except ValueError:
+                    return self._send(400)
+                ref.aggregator.ingest(report, recv_wall=time.time())
+                self._send(200, b"ok")
+
+        self._httpd = ThreadingHTTPServer((host, port), H)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "AdminServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def write_endpoint_file(directory: str, endpoint: str, node: str | None = None):
+    """Advertise an admin endpoint in a shared telemetry dir (atomic) so
+    tools/tests on other hosts can find the aggregation plane."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, ENDPOINT_FILE)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"endpoint": endpoint, "pid": os.getpid(),
+                   "node": node, "t": time.time()}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_endpoint_file(directory: str) -> str | None:
+    try:
+        with open(os.path.join(directory, ENDPOINT_FILE)) as f:
+            return json.load(f).get("endpoint")
+    except (OSError, ValueError):
+        return None

@@ -220,13 +220,15 @@ _STANDARD_COUNTERS = (
     "checkpoint.save_bytes", "checkpoint.load_bytes", "collective.barriers",
     "serve.requests", "serve.tokens", "serve.tokens_discarded",
     "serve.admission_stalls", "serve.preemptions", "serve.chaos_retired",
+    "telemetry.pushes", "telemetry.drops", "fleet.straggler",
 )
 _STANDARD_GAUGES = (
     "serve.pages_in_use", "serve.tokens_per_s", "serve.kv_read_mb_per_tok",
 )
 _STANDARD_HISTOGRAMS = (
-    "train.step_time_s", "collective.wait_s", "checkpoint.save_time_s",
-    "checkpoint.load_time_s", "checkpoint.crc_time_s", "serve.burst_time_s",
+    "train.step_time_s", "loop.step_time_s", "collective.wait_s",
+    "checkpoint.save_time_s", "checkpoint.load_time_s",
+    "checkpoint.crc_time_s", "serve.burst_time_s",
 )
 
 

@@ -29,7 +29,7 @@ import time
 
 __all__ = ["span", "traced", "tracing_enabled", "enable_tracing",
            "disable_tracing", "export_chrome_trace", "reset", "events",
-           "dropped"]
+           "events_since", "dropped", "set_trace_metadata"]
 
 ENV_DIR = "PADDLE_TRACE_DIR"
 ENV_MAX = "PADDLE_TRACE_MAX_EVENTS"
@@ -40,6 +40,7 @@ _lock = threading.Lock()
 _events: list[dict] = []
 _dropped = [0]  # spans discarded past the ring bound (bounded memory)
 _atexit_registered = [False]
+_extra_meta: dict = {}  # merged into export otherData (xplane links etc.)
 
 
 def _read_max_events() -> int:
@@ -191,12 +192,31 @@ def reset():
     with _lock:
         _events.clear()
         _dropped[0] = 0
+        _extra_meta.clear()
     _max_events = _read_max_events()
 
 
 def events() -> list[dict]:
     with _lock:
         return list(_events)
+
+
+def events_since(start: int) -> tuple[list[dict], int]:
+    """(events appended since index `start`, next cursor). The incremental
+    read the fleet TelemetryClient ships span batches with — O(batch), not
+    O(all spans), per push. A cursor past the list (a reset() happened)
+    rewinds to 0."""
+    with _lock:
+        if start > len(_events) or start < 0:
+            start = 0
+        return list(_events[start:]), len(_events)
+
+
+def set_trace_metadata(key: str, value):
+    """Attach one key to the exported trace's otherData (e.g. the XPlane
+    dump dir, so the host trace links the device-side story)."""
+    with _lock:
+        _extra_meta[key] = value
 
 
 def dropped() -> int:
@@ -215,10 +235,12 @@ def export_chrome_trace(path: str | None = None) -> str:
     with _lock:
         evs = list(_events)
         n_dropped = _dropped[0]
+        extra = dict(_extra_meta)
     meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
              "args": {"name": "paddle_tpu"}}]
     doc = {"traceEvents": meta + evs, "displayTimeUnit": "ms",
-           "otherData": {"clock": "perf_counter", "dropped_events": n_dropped}}
+           "otherData": {"clock": "perf_counter", "dropped_events": n_dropped,
+                         **extra}}
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, default=str)  # numpy scalars etc. in span args

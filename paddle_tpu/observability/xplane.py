@@ -1,0 +1,108 @@
+"""Optional on-device (XPlane) trace hook around a step window.
+
+The host chrome trace shows WHEN a step was slow; the device trace shows
+WHY (which fusion, which DMA). This hook bridges them: when
+``PADDLE_XPLANE_DIR`` is set, ``maybe_step(step)`` (called from the
+Engine / LlamaTrainStep step hooks) starts ``jax.profiler`` at step
+``PADDLE_XPLANE_START`` (default 2 — past compile), stops it
+``PADDLE_XPLANE_STEPS`` steps later (default 2), and records the XPlane
+dump path into the host trace's metadata (``otherData.xplane_dir`` via
+``spans.set_trace_metadata``) plus a flight event — so the merged fleet
+trace names where the device-side story lives.
+
+Without the env var this is a true no-op (one env lookup per step); jax is
+imported lazily and every profiler call is guarded — a broken/absent
+profiler degrades to a recorded warning, never a failed step.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+
+from . import metrics, recorder, spans
+
+__all__ = ["maybe_step", "active", "stop", "reset"]
+
+ENV_DIR = "PADDLE_XPLANE_DIR"
+ENV_START = "PADDLE_XPLANE_START"
+ENV_STEPS = "PADDLE_XPLANE_STEPS"
+
+_state = {"active": False, "done": False, "start_step": None}
+_PROFILER = None  # test seam: inject a fake; None = resolve jax.profiler
+
+
+def _profiler():
+    if _PROFILER is not None:
+        return _PROFILER
+    import jax.profiler
+    return jax.profiler
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def active() -> bool:
+    return _state["active"]
+
+
+def maybe_step(step: int):
+    """Window the device profiler around [START, START+STEPS). A no-op
+    unless PADDLE_XPLANE_DIR is set; runs the window at most once per
+    process."""
+    xdir = os.environ.get(ENV_DIR)
+    if not xdir or _state["done"]:
+        return
+    start = _env_int(ENV_START, 2)
+    n = max(1, _env_int(ENV_STEPS, 2))
+    if not _state["active"]:
+        if start <= step < start + n:
+            _start(xdir, step)
+    elif step >= _state["start_step"] + n:
+        stop()
+
+
+def _start(xdir: str, step: int):
+    try:
+        _profiler().start_trace(xdir)
+    except Exception as e:
+        _state["done"] = True  # don't retry a broken profiler every step
+        recorder.record("xplane.error", echo=True,
+                        message=f"[xplane] start_trace failed: {e}",
+                        error=f"{type(e).__name__}: {e}")
+        return
+    _state["active"] = True
+    _state["start_step"] = step
+    # a run that ends (or is preempted) mid-window must still close the
+    # trace — jax.profiler only writes the XPlane dump on stop_trace
+    atexit.register(stop)
+    spans.set_trace_metadata("xplane_dir", xdir)
+    spans.set_trace_metadata("xplane_start_step", step)
+    metrics.counter("xplane.windows").inc()
+    recorder.record("xplane.start", step=step, dir=xdir)
+
+
+def stop():
+    """Close an open window (also safe to call at shutdown)."""
+    if not _state["active"]:
+        return
+    _state["active"] = False
+    _state["done"] = True
+    try:
+        _profiler().stop_trace()
+    except Exception as e:
+        recorder.record("xplane.error", echo=True,
+                        message=f"[xplane] stop_trace failed: {e}",
+                        error=f"{type(e).__name__}: {e}")
+        return
+    recorder.record("xplane.stop", dir=os.environ.get(ENV_DIR))
+
+
+def reset():
+    """Re-arm the window (tests)."""
+    _state["active"] = False
+    _state["done"] = False
+    _state["start_step"] = None

@@ -19,7 +19,10 @@ reads rank or world size), so the post-resume trajectory of a killed fleet
 must be bitwise-identical to a fault-free run — asserted by the test.
 
 env: DRILL_DIR (shared scratch), DRILL_STEPS, DRILL_STEP_S (per-step
-sleep so the kill lands mid-run), DRILL_BAR_TIMEOUT (barrier deadline).
+sleep so the kill lands mid-run), DRILL_BAR_TIMEOUT (barrier deadline),
+DRILL_SLOW_NODE + DRILL_SLOW_S (fleet-observability drill: the named node
+"computes" slower — a deliberate straggler the rank-0 aggregator must
+attribute; the loss trajectory is unchanged, only the pacing).
 """
 import json
 import os
@@ -33,6 +36,7 @@ from paddle_tpu.distributed.resilience.loop import ResilientLoop
 from paddle_tpu.distributed.resilience.retry import (CommLostError,
                                                      DeadlineExceeded,
                                                      wait_for)
+from paddle_tpu.observability import metrics as _metrics
 
 RANK = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
 WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
@@ -41,6 +45,8 @@ NODE = os.environ.get("PADDLE_NODE_ID") or f"anon-{RANK}"
 DRILL = os.environ["DRILL_DIR"]
 STEPS = int(os.environ.get("DRILL_STEPS", "12"))
 STEP_S = float(os.environ.get("DRILL_STEP_S", "0.3"))
+if NODE == os.environ.get("DRILL_SLOW_NODE", ""):
+    STEP_S = float(os.environ.get("DRILL_SLOW_S", STEP_S))
 BAR_TIMEOUT = float(os.environ.get("DRILL_BAR_TIMEOUT", "5"))
 
 _reg = FileRegistry(DRILL, "bar")
@@ -62,8 +68,14 @@ def _barrier(step: int, preemption):
         return at_step >= WORLD
 
     try:
-        wait_for(ready, f"drill.barrier step={step} gen={GEN} world={WORLD}",
-                 timeout=BAR_TIMEOUT)
+        # time the barrier like the real collectives do (comm_watchdog
+        # observes collective.wait_s): the straggler detector subtracts
+        # wait time from step time, so a rank stalled HERE waiting for a
+        # slow peer is not itself blamed
+        with _metrics.timer("collective.wait_s"):
+            wait_for(ready,
+                     f"drill.barrier step={step} gen={GEN} world={WORLD}",
+                     timeout=BAR_TIMEOUT)
     except DeadlineExceeded as e:
         # a peer never arrived: the typed comm loss the elastic layer
         # answers with re-rendezvous

@@ -611,13 +611,15 @@ class TestBenchNeverJsonless:
         """PR 3 contract: probe exhaustion falls back to the CPU smoke so
         a real (rc=0) JSON line always lands, tagged device=cpu."""
         rc, out, err = self._run_bench(
-            {"JAX_PLATFORMS": "cpu", "BENCH_TPU_WAIT_S": "0"})
+            {"JAX_PLATFORMS": "cpu", "BENCH_TPU_WAIT_S": "0",
+             "PADDLE_TRAINERS_NUM": "1"})
         assert rc == 0
         parsed = self._json_lines(out)
         assert len(parsed) == 1, out
         assert parsed[0]["device"] == "cpu"
         assert "error" not in parsed[0]
         assert parsed[0]["vs_baseline"] == 0.0   # CPU numbers never score
+        assert "fleet" not in parsed[0]          # single-rank: no sub-object
 
     def test_require_tpu_restores_strict_error_exit(self):
         """BENCH_REQUIRE_TPU=1 keeps the old behavior: error JSON line +
@@ -643,6 +645,25 @@ class TestBenchNeverJsonless:
         assert len(parsed) == 1, out
         assert "error" in parsed[0]
         assert "SIGTERM" in parsed[0]["error"]
+
+    def test_multirank_fleet_subobject_schema(self):
+        """ISSUE 5 satellite: on multi-rank runs (PADDLE_TRAINERS_NUM > 1,
+        exported by the launcher) the JSON line carries a `fleet`
+        sub-object with exactly rank count + straggler/drop counters;
+        single-rank runs omit it."""
+        rc, out, err = self._run_bench(
+            {"JAX_PLATFORMS": "cpu", "BENCH_TPU_WAIT_S": "0",
+             "PADDLE_TRAINERS_NUM": "3"})
+        assert rc == 0
+        parsed = self._json_lines(out)
+        assert len(parsed) == 1, out
+        fleet = parsed[0].get("fleet")
+        assert fleet is not None, parsed[0]
+        assert set(fleet) == {"ranks", "straggler_events",
+                              "telemetry_drops"}, fleet
+        assert fleet["ranks"] == 3
+        assert isinstance(fleet["straggler_events"], int)
+        assert isinstance(fleet["telemetry_drops"], int)
 
     def test_retry_window_capped_below_driver_budget(self):
         """Even an absurd BENCH_TPU_WAIT_S is clamped to (budget - 300 s):

@@ -15,12 +15,19 @@ Flagged (AST-based):
      in ``metrics.timer(name)`` / ``spans.span(name)``; wall-clock reads
      without subtraction (timestamps, deadlines via addition/comparison)
      are fine.
+  O3 ad-hoc-http      : ``http.server`` (ThreadingHTTPServer & co.) or
+     ``urllib`` use outside the sanctioned transports. Live telemetry is
+     served by ``observability.admin.AdminServer`` and pushed by
+     ``observability.fleet.TelemetryClient`` — a new hand-rolled endpoint
+     splits the observability plane again. Audited non-telemetry HTTP
+     (elastic KV registry, rpc discovery, hub downloads) lives in
+     HTTP_ALLOWLIST with a recorded reason.
 
 Exemptions:
   * paddle_tpu/observability/ and paddle_tpu/profiler/ (they ARE the layer)
-  * files in ALLOWLIST — interactive/user-facing printers whose stdout IS
-    the product (model summaries, CLI launchers, build tools), each with a
-    recorded reason
+  * files in ALLOWLIST (O1/O2) — interactive/user-facing printers whose
+    stdout IS the product (model summaries, CLI launchers, build tools) —
+    and HTTP_ALLOWLIST (O3), each with a recorded reason
   * a line carrying ``# observability: ok (<why>)`` — an audited use (e.g.
     a wall-clock liveness TTL that looks like timing math). The why is
     mandatory: a bare marker is itself a finding.
@@ -50,6 +57,18 @@ ALLOWLIST = {
     "paddle_tpu/distributed/launch/main.py": "CLI launcher stdout",
 }
 
+# audited non-telemetry HTTP: transports the admin/fleet plane builds on,
+# or IO whose payload is data, not runtime telemetry
+HTTP_ALLOWLIST = {
+    "paddle_tpu/distributed/fleet/elastic.py":
+        "KVServer/KVRegistry — the sanctioned registry transport the "
+        "admin/fleet plane mirrors (token-authed, retry-wrapped)",
+    "paddle_tpu/distributed/rpc.py":
+        "rpc worker discovery GET against the elastic registry master",
+    "paddle_tpu/hub.py":
+        "model/file download (paddle.hub parity) — data plane, not telemetry",
+}
+
 MARKER = "# observability: ok ("
 
 
@@ -67,7 +86,36 @@ def _is_time_time(node: ast.AST) -> bool:
             and node.func.value.id == "time")
 
 
-def lint_file(path: str):
+# transports only: urllib.parse (pure URL string munging) and the rest of
+# urllib/http stay legal — the rule is about wire IO, not URL strings
+_HTTP_MODULES = ("http.server", "urllib.request", "urllib.error")
+_HTTP_NAMES = ("ThreadingHTTPServer", "HTTPServer", "BaseHTTPRequestHandler")
+
+
+def _http_import(node: ast.AST) -> str | None:
+    """The offending module/name when `node` imports an HTTP transport."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            for mod in _HTTP_MODULES:
+                if alias.name == mod or alias.name.startswith(mod + "."):
+                    return alias.name
+    elif isinstance(node, ast.ImportFrom) and node.module:
+        for mod in _HTTP_MODULES:
+            if node.module == mod or node.module.startswith(mod + "."):
+                return node.module
+        if node.module == "http" and any(a.name == "server"
+                                         for a in node.names):
+            return "http.server"
+        if node.module == "urllib" and any(a.name in ("request", "error")
+                                           for a in node.names):
+            return "urllib." + next(a.name for a in node.names
+                                    if a.name in ("request", "error"))
+    return None
+
+
+def lint_file(path: str, relpath: str | None = None):
+    """relpath (repo-relative, / separators) selects per-rule allowlists;
+    None applies every rule."""
     with open(path, encoding="utf-8") as f:
         src = f.read()
     try:
@@ -76,18 +124,21 @@ def lint_file(path: str):
         yield ("SYNTAX", e.lineno or 0, f"unparseable: {e.msg}")
         return
     lines = src.splitlines()
+    check_print = relpath not in ALLOWLIST
+    check_http = relpath not in HTTP_ALLOWLIST
 
     def marked(lineno: int) -> bool:
         return lineno - 1 < len(lines) and MARKER in lines[lineno - 1]
 
     for node in ast.walk(tree):
-        if _is_print(node) and not marked(node.lineno):
+        if check_print and _is_print(node) and not marked(node.lineno):
             yield ("O1", node.lineno,
                    "bare print(): route runtime events through "
                    "observability.recorder.record(..., echo=True), or mark "
                    "the line '# observability: ok (<why>)' if stdout is the "
                    "product")
-        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+        elif check_print and isinstance(node, ast.BinOp) \
+                and isinstance(node.op, ast.Sub):
             if (_is_time_time(node.left) or _is_time_time(node.right)) \
                     and not marked(node.lineno):
                 yield ("O2", node.lineno,
@@ -95,6 +146,21 @@ def lint_file(path: str):
                        "observability.metrics.timer(name) / spans.span(name) "
                        "(or time.perf_counter for a monotonic clock), or "
                        "mark '# observability: ok (<why>)'")
+        elif check_http and not marked(getattr(node, "lineno", 0)):
+            offender = _http_import(node)
+            if offender is not None:
+                yield ("O3", node.lineno,
+                       f"ad-hoc HTTP transport ({offender}): serve live "
+                       "telemetry through observability.admin.AdminServer "
+                       "and push through observability.fleet."
+                       "TelemetryClient; audited non-telemetry HTTP belongs "
+                       "in HTTP_ALLOWLIST (or mark the line "
+                       "'# observability: ok (<why>)')")
+            elif isinstance(node, ast.Name) and node.id in _HTTP_NAMES:
+                yield ("O3", node.lineno,
+                       f"ad-hoc HTTP server ({node.id}): extend "
+                       "observability.admin.AdminServer instead (or mark "
+                       "'# observability: ok (<why>)')")
 
 
 def iter_py_files(root: str):
@@ -106,10 +172,7 @@ def iter_py_files(root: str):
             continue
         for fn in files:
             if fn.endswith(".py"):
-                p = os.path.join(base, fn)
-                if os.path.relpath(p, root).replace(os.sep, "/") in ALLOWLIST:
-                    continue
-                yield p
+                yield os.path.join(base, fn)
 
 
 def main(argv=None) -> int:
@@ -118,7 +181,8 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     findings = []
     for path in sorted(iter_py_files(root)):
-        for rule, lineno, msg in lint_file(path):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        for rule, lineno, msg in lint_file(path, rel):
             findings.append((os.path.relpath(path, root), lineno, rule, msg))
     for path, lineno, rule, msg in findings:
         print(f"{path}:{lineno}: [{rule}] {msg}")
