@@ -90,6 +90,20 @@ def _metrics_payload() -> dict | None:
         return None
 
 
+def _slo_payload() -> dict | None:
+    """The ``slo`` sub-object (TTFT/TPOT/e2e p50+p95 + breach count) —
+    present ONLY when this process exercised serving (slo.e2e_s has
+    observations); a pure-training bench line carries no slo key at all.
+    Schema pinned by the bench contract tests."""
+    try:
+        if "paddle_tpu" not in sys.modules:
+            return None  # paddle never imported => nothing ever served
+        from paddle_tpu.observability import slo
+        return slo.bench_payload()
+    except Exception:
+        return None
+
+
 def _fleet_payload() -> dict | None:
     """The ``fleet`` sub-object (rank count, straggler events, telemetry
     drop counter) — present only on multi-rank runs (the launcher exports
@@ -120,6 +134,9 @@ def _error_payload(msg: str) -> dict:
     fleet = _fleet_payload()
     if fleet is not None:
         err["fleet"] = fleet
+    slo = _slo_payload()
+    if slo is not None:
+        err["slo"] = slo
     # surface the last committed success so an outage at bench time still
     # points the reader at a real number
     try:
@@ -399,6 +416,9 @@ def main() -> int:
     fleet = _fleet_payload()
     if fleet is not None:
         result["fleet"] = fleet
+    slo = _slo_payload()
+    if slo is not None:
+        result["slo"] = slo
     if on_tpu:
         # non-default sizes record to their own file: the canonical 850M
         # BENCH_latest.json must not be clobbered by a 2b scale-proof run

@@ -147,11 +147,19 @@ def main():
     paged_vs_dense = sum(out[r] != dense_out[d]
                          for r, d in zip(rids, dense_rids))
 
+    # request-level SLO distributions (ISSUE 6): TTFT/TPOT/e2e p50+p95 and
+    # the breach count over every request the serving passes retired —
+    # schema pinned by the bench contract tests, absent only when serving
+    # is not exercised (never here)
+    from paddle_tpu.observability import slo as _slo
+    slo_obj = _slo.bench_payload()
+
     print(json.dumps({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": round(total_new / cont_s, 1),
         "unit": "tokens/s",
         "kv_layout": "paged",
+        "slo": slo_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),
         "vs_dense_slots": round(dense_s / cont_s, 2),
         "config": {"requests": n_req, "max_batch": max_batch,

@@ -69,6 +69,16 @@ def default_timeout() -> float:
         return 600.0
 
 
+def _warn_frac() -> float:
+    """Fraction of the abort budget at which the near-deadline telemetry
+    fires (PADDLE_WATCHDOG_WARN_FRAC, default 0.75; <=0 or >=1 disables)."""
+    try:
+        return float(os.environ.get("PADDLE_WATCHDOG_WARN_FRAC", "0.75")
+                     or 0.75)
+    except ValueError:
+        return 0.75
+
+
 def _describe_group(group) -> str:
     try:
         if group is None:
@@ -100,6 +110,18 @@ def watch(op_name: str, group=None, timeout: float | None = None,
     if t <= 0:
         yield
         return
+
+    def warn():
+        # near-deadline signal (ISSUE 6): the wait is most of the way to
+        # the abort budget but hasn't fired — the trigger engine reacts by
+        # arming an XPlane window WHILE the op is still slow, instead of
+        # postmorteming a dead process. Telemetry only, never an abort.
+        _metrics.counter("watchdog.near_deadline").inc()
+        _recorder.record(
+            "watchdog.near_deadline",
+            message=f"[comm-watchdog] op={op_name} at "
+                    f"{_warn_frac() * 100:.0f}% of its {t:.0f}s budget",
+            op=op_name, group=_describe_group(group), timeout_s=t)
 
     def fire():
         rank = os.environ.get("PADDLE_TRAINER_ID", "?")
@@ -143,9 +165,32 @@ def watch(op_name: str, group=None, timeout: float | None = None,
             sys.stderr.flush()
             os._exit(124)
 
-    timer = threading.Timer(t, fire)
-    timer.daemon = True
-    timer.start()
+    # ONE live timer per watched wait (same steady-state cost as before the
+    # near-deadline signal): with a warn fraction configured, the timer
+    # first fires the warn at frac*t and RE-ARMS itself for the remaining
+    # (1-frac)*t to do the abort — no second thread on the happy path.
+    state_lk = threading.Lock()
+    state: dict = {"done": False, "timer": None}
+
+    def _arm(delay, fn):
+        with state_lk:
+            if state["done"]:
+                return
+            tm = threading.Timer(delay, fn)
+            tm.daemon = True
+            state["timer"] = tm
+            tm.start()
+
+    frac = _warn_frac()
+
+    def warn_then_rearm():
+        warn()
+        _arm(t * (1.0 - frac), fire)
+
+    if 0.0 < frac < 1.0:
+        _arm(t * frac, warn_then_rearm)
+    else:
+        _arm(t, fire)
     try:
         if _spans.tracing_enabled():
             cm = _spans.span("comm." + op_name, cat="collective",
@@ -155,4 +200,7 @@ def watch(op_name: str, group=None, timeout: float | None = None,
         with cm:
             yield
     finally:
-        timer.cancel()
+        with state_lk:
+            state["done"] = True  # a mid-flight warn must not re-arm
+            if state["timer"] is not None:
+                state["timer"].cancel()

@@ -231,8 +231,31 @@ def _telemetry_start(args, node_id, mgr):
         os.environ["PADDLE_TELEMETRY_ENDPOINT"] = f"127.0.0.1:{admin.port}"
     if mgr is not None:
         mgr.publish_telemetry_endpoint(ep)
+    # ISSUE 6: external sink + trigger-driven deep capture ride with the
+    # aggregation plane. Exporter only when PADDLE_METRICS_EXPORT_URL is
+    # set; triggers unless PADDLE_TRIGGERS=0 (cheap background poll that
+    # reacts to stragglers / reported slo.breach / watchdog.near_deadline
+    # by arming an XPlane window on the offending rank via post_command).
+    from ...observability import exporters as _exporters, \
+        metrics as _metrics, triggers as _triggers
+
+    def _export_blocks():
+        # the launcher's own registry PLUS every fresh rank's reported
+        # snapshot, labeled (node, rank) — aggregated fleet metrics leave
+        # the pod, not just the aggregator process's counters
+        return ([({"node": node_id, "role": "launcher"},
+                  _metrics.snapshot())]
+                + agg.export_blocks())
+
+    exporter = _exporters.maybe_from_env(
+        labels={"node": node_id, "role": "launcher"},
+        blocks_fn=_export_blocks)
+    trig = None
+    if _triggers.enabled():
+        trig = _triggers.TriggerEngine(aggregator=agg).start()
     print(f"[launch] telemetry admin at {ep}", file=sys.stderr)
-    return {"agg": agg, "admin": admin, "dir": tdir}
+    return {"agg": agg, "admin": admin, "dir": tdir,
+            "exporter": exporter, "triggers": trig}
 
 
 def _telemetry_close(telem):
@@ -255,6 +278,13 @@ def _telemetry_close(telem):
             telem["agg"].merged_chrome_trace(
                 os.path.join(trace, _fleet.FLEET_TRACE_NAME))
             _fleet.merge_flight_files(trace)
+    except Exception:
+        pass
+    try:
+        if telem.get("triggers") is not None:
+            telem["triggers"].stop()
+        if telem.get("exporter") is not None:
+            telem["exporter"].stop()  # final flush to the external sink
     except Exception:
         pass
     try:

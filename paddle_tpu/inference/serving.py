@@ -46,6 +46,19 @@ Metrics published (observability.metrics): ``serve.pages_in_use`` gauge,
 ``serve.tokens_per_s`` and ``serve.kv_read_mb_per_tok`` gauges,
 ``serve.burst_time_s`` histogram.
 
+Request-level SLO observability (ISSUE 6 tentpole): every request gets a
+process-unique trace id at enqueue and its lifecycle edges
+(enqueue→admit→first-token→tokens→preempt→retire) are reported to an
+``observability.slo.RequestTracker`` — TTFT / TPOT / queue-wait / e2e
+histograms fill per retire, an ``SloPolicy`` (``PADDLE_SLO_*``) emits
+``slo.breach`` + a flight event naming the breaching request, and (with
+tracing on) per-request phase spans land on the same timeline as bursts.
+All request timing goes through ``slo.now()`` — lint rule O4 bans ad-hoc
+``perf_counter`` request timing in inference/. The scheduler also drives
+``xplane.maybe_step`` per burst so a trigger-armed device-trace window
+opens WHILE serving is slow, and lazily starts a loss-tolerant metrics
+exporter when ``PADDLE_METRICS_EXPORT_URL`` is set.
+
 The host scheduler is plain Python between device calls: it owns the
 request queue, slot table, block tables, and per-request output buffers.
 burst=1 gives token-level admission latency; larger bursts amortize
@@ -55,7 +68,7 @@ provided as a thin pool of independent predictors.
 from __future__ import annotations
 
 import dataclasses
-import time
+import os
 from collections import deque
 from typing import Any, Sequence
 
@@ -64,7 +77,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..distributed.resilience import chaos
-from ..observability import fleet as _fleet, metrics
+from ..observability import (exporters as _exporters, fleet as _fleet,
+                             metrics, slo as _slo, triggers as _triggers,
+                             xplane as _xplane)
 from .paging import (PageAllocator, SCRATCH_PAGE, default_page_buckets,
                      pages_for)
 
@@ -100,7 +115,8 @@ class ContinuousBatcher:
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  precision: str | None = None, kv_layout: str = "paged",
                  page_size: int = 16, num_pages: int | None = None,
-                 page_buckets: Sequence[int] | None = None):
+                 page_buckets: Sequence[int] | None = None,
+                 slo_policy=None):
         self._dequant = None
         if precision in ("int8", "weight_only_int8"):
             # int8 weight-only serving: weights live quantized in HBM and
@@ -185,6 +201,23 @@ class ContinuousBatcher:
                       "admission_stalls": 0, "preemptions": 0,
                       "chaos_retired": 0, "max_concurrent": 0,
                       "page_buckets_used": []}
+        # request-level SLO observability: lifecycle tracker + policy
+        # (PADDLE_SLO_* env unless an explicit policy is given); pure
+        # observation — no tracker call can change a served token
+        self.slo = _slo.RequestTracker(policy=slo_policy)
+        # external metric sink (PADDLE_METRICS_EXPORT_URL): the PROCESS-
+        # SHARED background exporter (the registry is process-global — N
+        # batchers must not push N duplicate snapshots), None when
+        # unconfigured; atexit guarantees the final flush
+        self._exporter = _exporters.shared_from_env(
+            labels={"role": "serving"})
+        # trigger-driven deep capture: local engine polled per step (a
+        # breach arms a bounded XPlane window while serving is slow)
+        self._triggers = (_triggers.TriggerEngine()
+                          if _triggers.enabled() and (
+                              self.slo.policy.active
+                              or os.environ.get("PADDLE_TRACE_DIR"))
+                          else None)
 
     # ------------------------------------------------------------- intake
     def add_request(self, prompt_ids, max_new_tokens: int = 32) -> int:
@@ -221,15 +254,19 @@ class ContinuousBatcher:
         self._next_rid += 1
         self._queue.append(ServedRequest(rid, prompt, max_new_tokens))
         metrics.counter("serve.requests").inc()
+        self.slo.on_enqueue(rid)  # trace id issued; queue-wait clock starts
         return rid
 
     def _bucket_len(self, n: int) -> int:
         return next(b for b in self._buckets if b >= n)
 
     # ----------------------------------------------------------- shared
-    def _finish(self, req: ServedRequest) -> None:
+    def _finish(self, req: ServedRequest, reason: str = "complete") -> None:
         req.done = True
         self._finished[req.rid] = req
+        # the ONE retire point: histograms fill + SLO policy evaluates
+        # exactly once per request, whatever path ended it
+        self.slo.on_retire(req.rid, n_tokens=len(req.out), reason=reason)
 
     def _retire_slot(self, slot: int) -> None:
         """Free a slot (and, paged, its pages) after its request finished
@@ -253,7 +290,7 @@ class ContinuousBatcher:
                 continue
             self.stats["chaos_retired"] += 1
             metrics.counter("serve.chaos_retired").inc()
-            self._finish(req)
+            self._finish(req, reason=why)
             self._retire_slot(slot)
 
     # ------------------------------------------------------------- admit
@@ -267,8 +304,10 @@ class ContinuousBatcher:
             except chaos.ChaosError:
                 self.stats["chaos_retired"] += 1
                 metrics.counter("serve.chaos_retired").inc()
-                self._finish(req)  # partial (empty) output, queue moves on
+                # partial (empty) output, queue moves on
+                self._finish(req, reason="chaos serve.admit")
                 continue
+            self.slo.on_admit(req.rid)
             slot = self._slot_req.index(None)
             tlen = len(req.prompt)
             tb = self._bucket_len(tlen)
@@ -291,6 +330,7 @@ class ContinuousBatcher:
         firsts = [int(v) for v in jax.device_get([f for *_, f in staged])]
         for (req, slot, tlen, _), first in zip(staged, firsts):
             req.out.append(first)
+            self.slo.on_first_token(req.rid)
             if req.max_new_tokens <= 1 or first == self.eos_id:
                 self._finish(req)
                 self._slot_req[slot] = None
@@ -318,6 +358,7 @@ class ContinuousBatcher:
         self._retire_slot(slot)
         self.stats["preemptions"] += 1
         metrics.counter("serve.preemptions").inc()
+        self.slo.on_preempt(req.rid)  # same trace id; e2e clock keeps going
 
     def _dispatch_burst_paged(self):
         """Grow block tables to cover this burst's writes, then dispatch
@@ -407,8 +448,10 @@ class ContinuousBatcher:
             except chaos.ChaosError:
                 self.stats["chaos_retired"] += 1
                 metrics.counter("serve.chaos_retired").inc()
-                self._finish(req)  # partial (empty) output, queue moves on
+                # partial (empty) output, queue moves on
+                self._finish(req, reason="chaos serve.admit")
                 continue
+            self.slo.on_admit(req.rid)
             pages = self._alloc.alloc(bucket_pages)
             slot = self._slot_req.index(None)
             toks = np.full(tb, self.pad_id, np.int32)
@@ -461,6 +504,7 @@ class ContinuousBatcher:
                 n_new = int(self._pos[slot] - old_pos[slot])
                 req.out.extend(int(t) for t in emitted[:n_new, slot])
                 emitted_total += n_new
+                self.slo.on_tokens(req.rid, n_new)
                 if done[slot]:
                     self._finish(req)
                     self._retire_slot(slot)
@@ -468,6 +512,7 @@ class ContinuousBatcher:
             first = int(first)
             req.out.append(first)
             emitted_total += 1
+            self.slo.on_first_token(req.rid)
             if req.max_new_tokens <= 1 or first == self.eos_id:
                 self._finish(req)
                 self._retire_slot(slot)
@@ -492,11 +537,11 @@ class ContinuousBatcher:
         readback. Dense (legacy order): admit synchronously, then burst.
         """
         if self._layout == "paged":
-            t0 = time.perf_counter()
+            t0 = _slo.now()  # the sanctioned request-timing clock (lint O4)
             inflight = self._dispatch_burst_paged()
             staged = self._admit_paged()
             emitted = self._sync_merge_paged(inflight, staged)
-            dt = time.perf_counter() - t0
+            dt = _slo.now() - t0
             metrics.histogram("serve.burst_time_s").observe(dt)
             if emitted and dt > 0:
                 metrics.gauge("serve.tokens_per_s").set(emitted / dt)
@@ -505,6 +550,11 @@ class ContinuousBatcher:
         # fleet heartbeat (env-gated, interval-paced, loss-tolerant): the
         # rank-0 aggregator sees live serve.* gauges between bursts too
         _fleet.maybe_push(self.stats["decode_steps"])
+        # device-trace window state machine: an env window or a
+        # trigger/fleet-armed window opens at the next burst boundary
+        _xplane.maybe_step(self.stats["bursts"])
+        if self._triggers is not None:
+            self._triggers.poll()
 
     def _step_dense(self):
         from ..models.llama_decode import llama_decode_burst
@@ -520,7 +570,7 @@ class ContinuousBatcher:
             self.stats["max_concurrent"],
             sum(r is not None for r in self._slot_req))
         old_pos = self._pos.copy()
-        t0 = time.perf_counter()
+        t0 = _slo.now()
         self._key, sub = jax.random.split(self._key)
         (self._cache, pos_d, tok_d, done_d, emitted) = llama_decode_burst(
             self._params, self._cache, jnp.asarray(self._pos),
@@ -543,10 +593,11 @@ class ContinuousBatcher:
             n_new = int(self._pos[slot] - old_pos[slot])
             req.out.extend(int(t) for t in np.asarray(emitted)[:n_new, slot])
             emitted_total += n_new
+            self.slo.on_tokens(req.rid, n_new)
             if done[slot]:
                 self._finish(req)
                 self._retire_slot(slot)
-        dt = time.perf_counter() - t0
+        dt = _slo.now() - t0
         metrics.histogram("serve.burst_time_s").observe(dt)
         metrics.counter("serve.tokens").inc(emitted_total)
         if emitted_total and dt > 0:
@@ -572,6 +623,14 @@ class ContinuousBatcher:
             self._admin.stop()
             self._admin = None
 
+    def stop_exporter(self):
+        """Flush the shared metric exporter and detach. The exporter
+        itself keeps running (it is process-shared — another batcher may
+        still be serving); atexit owns the true shutdown."""
+        if self._exporter is not None:
+            _exporters.flush_shared()
+            self._exporter = None
+
     def admin_summary(self) -> dict:
         """Live scheduler state for /snapshot — what the gauges can't say
         (queue composition, slot occupancy) without a device sync."""
@@ -585,6 +644,7 @@ class ContinuousBatcher:
                            if self._layout == "paged" else None),
             "finished": len(self._finished),
             "stats": dict(self.stats),
+            "slo": self.slo.summary(),
         }
 
     @property

@@ -20,11 +20,24 @@ pre-PR-2 archipelago (comm_watchdog prints, resilience stderr lines, ad-hoc
              (metrics snapshot + span batches + heartbeat) to the rank-0
              launcher's ``TelemetryAggregator``; merged cross-rank chrome
              trace, straggler detection, FLEET_FLIGHT.json merging.
-  admin    — the live admin HTTP endpoint (/metrics Prometheus text,
-             /snapshot, /flight, /health, /ranks, POST /push) served by
-             the launcher for training and ContinuousBatcher for serving.
+  admin    — the live admin HTTP endpoint (/metrics Prometheus text with
+             full histogram buckets, /snapshot, /flight, /health, /ranks,
+             /logs?rank=N, POST /push; PADDLE_ADMIN_READ_TOKEN read auth)
+             served by the launcher for training and ContinuousBatcher
+             for serving.
   xplane   — optional on-device (jax.profiler) trace window keyed by
-             PADDLE_XPLANE_DIR, linked from the host chrome trace.
+             PADDLE_XPLANE_DIR, linked from the host chrome trace; also
+             programmatically armable (``xplane.arm``) by the triggers.
+  slo      — request-level SLO observability: per-request trace ids +
+             lifecycle spans, TTFT/TPOT/queue-wait/e2e histograms, and an
+             SloPolicy (PADDLE_SLO_*) emitting ``slo.breach`` per
+             breaching request.
+  exporters— background push of metric snapshots to an external sink
+             (PADDLE_METRICS_EXPORT_URL; Prometheus text or OTLP/JSON),
+             loss-tolerant like telemetry pushes.
+  triggers — rule engine turning fleet.straggler / slo.breach /
+             watchdog.near_deadline signals into bounded automatic XPlane
+             captures + CAPTURE_<n>.json snapshots.
 
 Env vars:
   PADDLE_TRACE_DIR        enable span tracing; chrome trace + FLIGHT.json
@@ -35,6 +48,10 @@ Env vars:
   PADDLE_TELEMETRY_ENDPOINT  host:port of the rank-0 admin server
   PADDLE_TELEMETRY_INTERVAL  min seconds between pushes (default 0.5)
   PADDLE_XPLANE_DIR       device-trace window dump dir (off when unset)
+  PADDLE_SLO_TTFT_S / _TPOT_S / _E2E_S / _QUEUE_S   serving SLO targets
+  PADDLE_METRICS_EXPORT_URL / _FORMAT / _INTERVAL   external metric sink
+  PADDLE_ADMIN_READ_TOKEN admin GET read auth (403 without when set)
+  PADDLE_TRIGGERS         0 disables trigger-driven deep capture
 
 The core modules import only the stdlib — any module in paddle_tpu
 (including the earliest-imported resilience layer) can depend on them
@@ -47,8 +64,11 @@ from . import metrics  # noqa: F401
 from . import recorder  # noqa: F401
 from . import spans  # noqa: F401
 from . import admin  # noqa: F401
-from . import fleet  # noqa: F401
 from . import xplane  # noqa: F401
+from . import fleet  # noqa: F401
+from . import slo  # noqa: F401
+from . import exporters  # noqa: F401
+from . import triggers  # noqa: F401
 from .metrics import counter, gauge, histogram, snapshot, timer  # noqa: F401
 from .recorder import dump_flight, record  # noqa: F401
 from .spans import (  # noqa: F401
@@ -58,6 +78,7 @@ from .spans import (  # noqa: F401
 
 __all__ = [
     "spans", "metrics", "recorder", "fleet", "admin", "xplane",
+    "slo", "exporters", "triggers",
     "span", "traced", "tracing_enabled", "enable_tracing", "disable_tracing",
     "export_chrome_trace",
     "counter", "gauge", "histogram", "snapshot", "timer",
@@ -74,3 +95,4 @@ def reset():
     recorder.reset()
     fleet.reset()
     xplane.reset()
+    exporters.reset()

@@ -11,15 +11,29 @@ server answers it live, over plain HTTP, for BOTH runtimes:
     scheduler, exposing the live ``serve.*`` gauges (pages_in_use, queue
     depth, tokens/s) mid-flight.
 
-Routes (GET unauthenticated, mirroring ``KVServer``'s read side):
+Routes:
   /health    liveness JSON: {"ok": true, pid, time, ranks?}
-  /metrics   Prometheus text exposition of ``metrics.snapshot()``
+  /metrics   Prometheus text exposition of ``metrics.snapshot()`` — full
+             histogram ``_bucket{le=...}`` series (exact cumulative
+             counts), ``_sum``, ``_count``
   /snapshot  the full metrics snapshot as JSON (+ fleet summary + extras)
   /flight    the current flight-recorder ring as JSON
   /ranks     per-rank fleet summary (empty list without an aggregator)
+  /logs      ?rank=N[&node=X][&limit=K] — that rank's recent flight/log
+             tail, streamed in through the telemetry channel (the PR-5
+             log-tailing carry-over); without an aggregator (serving) the
+             LOCAL ring, so /logs is uniform across both runtimes
   /push      POST (token-authed, same job-token discipline as the elastic
              KV master's mutating endpoints): ingest one TelemetryClient
-             report into the attached aggregator
+             report into the attached aggregator; the response body
+             carries any queued aggregator->rank commands (trigger-armed
+             XPlane capture) piggy-backed on the same round trip
+
+Read auth (the PR-5 carry-over; TLS stays open): when
+``PADDLE_ADMIN_READ_TOKEN`` is set, EVERY GET requires it (header
+``X-Paddle-Admin-Token`` or ``Authorization: Bearer``) and is 403 without —
+multi-tenant pods stop leaking metrics/logs to whoever finds the port.
+POST /push keeps its own job-token discipline, unchanged.
 
 tools/lint_observability.py rule O3 bans ThreadingHTTPServer / urllib use
 outside observability/ and the audited allowlist — future endpoints extend
@@ -35,6 +49,7 @@ import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from . import metrics, recorder
 
@@ -59,25 +74,48 @@ def _prom_name(name: str) -> str:
     return "paddle_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
-def render_prometheus(snap: dict) -> str:
+def _fmt_le(b: float) -> str:
+    """Prometheus-conventional bound text: integral bounds without the
+    trailing .0 (le="1" not le="1.0")."""
+    return str(int(b)) if float(b) == int(b) else repr(float(b))
+
+
+def _label_str(labels: dict | None, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in sorted((labels or {}).items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(snap: dict, labels: dict | None = None) -> str:
     """``metrics.snapshot()`` → Prometheus text exposition (version 0.0.4).
-    Counters/gauges map 1:1; histograms render as summaries (count, sum,
-    p50/p95/p99 quantile samples over the recent reservoir)."""
+    Counters/gauges map 1:1; histograms render as REAL histograms — the
+    full cumulative ``_bucket{le=...}`` series (exact counts from
+    metrics.Histogram.buckets, +Inf included) plus ``_sum``/``_count`` —
+    so scrapers and the push exporter see latency DISTRIBUTIONS
+    (TTFT/TPOT p95 via histogram_quantile), not summary points. `labels`
+    (e.g. {"node": ...}) are stamped on every sample."""
+    lab = _label_str(labels)
     lines: list[str] = []
     for n, v in snap.get("counters", {}).items():
         m = _prom_name(n)
-        lines += [f"# TYPE {m} counter", f"{m} {v}"]
+        lines += [f"# TYPE {m} counter", f"{m}{lab} {v}"]
     for n, v in snap.get("gauges", {}).items():
         m = _prom_name(n)
-        lines += [f"# TYPE {m} gauge", f"{m} {v}"]
+        lines += [f"# TYPE {m} gauge", f"{m}{lab} {v}"]
     for n, st in snap.get("histograms", {}).items():
         m = _prom_name(n)
-        lines.append(f"# TYPE {m} summary")
-        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            if st.get(key) is not None:
-                lines.append(f'{m}{{quantile="{q}"}} {st[key]}')
-        lines.append(f"{m}_sum {st.get('sum', 0)}")
-        lines.append(f"{m}_count {st.get('count', 0)}")
+        lines.append(f"# TYPE {m} histogram")
+        bk = st.get("buckets") or {}
+        bounds, cum = bk.get("bounds") or [], bk.get("cum") or []
+        for b, c in zip(bounds, cum):
+            le = 'le="%s"' % _fmt_le(b)
+            lines.append(f"{m}_bucket{_label_str(labels, le)} {c}")
+        total = cum[-1] if cum else st.get("count", 0)
+        inf = 'le="+Inf"'
+        lines.append(f"{m}_bucket{_label_str(labels, inf)} {total}")
+        lines.append(f"{m}_sum{lab} {st.get('sum', 0)}")
+        lines.append(f"{m}_count{lab} {st.get('count', 0)}")
     return "\n".join(lines) + "\n"
 
 
@@ -109,18 +147,36 @@ class AdminServer:
             def _json(self, obj, code=200):
                 self._send(code, json.dumps(obj, default=str).encode())
 
+            def _read_authorized(self) -> bool:
+                """PADDLE_ADMIN_READ_TOKEN gates every GET when set (read
+                at request time so long-lived servers honor env changes).
+                Accepts the dedicated header or a Bearer token."""
+                tok = os.environ.get("PADDLE_ADMIN_READ_TOKEN", "")
+                if not tok:
+                    return True
+                given = self.headers.get("X-Paddle-Admin-Token", "")
+                if not given:
+                    auth = self.headers.get("Authorization", "")
+                    if auth.startswith("Bearer "):
+                        given = auth[len("Bearer "):]
+                return hmac.compare_digest(given, tok)
+
             def do_GET(self):
+                if not self._read_authorized():
+                    return self._send(403)
                 agg = ref.aggregator
-                if self.path == "/health":
+                parsed = urlsplit(self.path)
+                route, query = parsed.path, parse_qs(parsed.query)
+                if route == "/health":
                     doc = {"ok": True, "pid": os.getpid(), "time": time.time()}
                     if agg is not None:
                         doc["ranks"] = len(agg.ranks())
                     return self._json(doc)
-                if self.path == "/metrics":
+                if route == "/metrics":
                     text = render_prometheus(metrics.snapshot())
                     return self._send(200, text.encode(),
                                       "text/plain; version=0.0.4")
-                if self.path == "/snapshot":
+                if route == "/snapshot":
                     doc = {"pid": os.getpid(), "time": time.time(),
                            "metrics": metrics.snapshot(),
                            "fleet": (agg.fleet_snapshot()
@@ -134,11 +190,29 @@ class AdminServer:
                     if extras:
                         doc["extra"] = extras
                     return self._json(doc)
-                if self.path == "/flight":
+                if route == "/flight":
                     return self._json({"pid": os.getpid(),
                                        "events": recorder.events()})
-                if self.path == "/ranks":
+                if route == "/ranks":
                     return self._json(agg.ranks() if agg is not None else [])
+                if route == "/logs":
+                    try:
+                        limit = int(query.get("limit", ["200"])[0])
+                    except ValueError:
+                        limit = 200
+                    node = query.get("node", [None])[0]
+                    if agg is None:
+                        # serving / single process: the local ring IS the log
+                        return self._json({"rank": None, "source": "local",
+                                           "lines": recorder.events()[-limit:]})
+                    try:
+                        rank = int(query.get("rank", [""])[0])
+                    except ValueError:
+                        return self._send(400, b'{"error": "rank=N required"}')
+                    return self._json({"rank": rank, "node": node,
+                                       "source": "fleet",
+                                       "lines": agg.logs(rank, node=node,
+                                                         limit=limit)})
                 self._send(404)
 
             def do_POST(self):
@@ -156,7 +230,18 @@ class AdminServer:
                 except ValueError:
                     return self._send(400)
                 ref.aggregator.ingest(report, recv_wall=time.time())
-                self._send(200, b"ok")
+                # piggy-back queued aggregator->rank commands on the push
+                # response: the rank that just reported is reachable RIGHT
+                # NOW, no second channel needed (trigger deep capture)
+                cmds = []
+                try:
+                    if isinstance(report, dict) and "node" in report \
+                            and "rank" in report:
+                        cmds = ref.aggregator.take_commands(
+                            report["node"], report["rank"])
+                except Exception:
+                    cmds = []
+                self._json({"ok": True, "commands": cmds})
 
         self._httpd = ThreadingHTTPServer((host, port), H)
         self.port = self._httpd.server_address[1]

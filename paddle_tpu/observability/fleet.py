@@ -38,6 +38,18 @@ chaos site ``telemetry.push``) increments ``telemetry.drops`` and returns —
 it can NEVER raise into a training step, so a chaos-on run stays bitwise
 identical to fault-free.
 
+ISSUE 6 rides two more payloads on the same channel (no new transport):
+  * reports carry a FLIGHT TAIL (recorder.events_since batches) so the
+    rank-0 admin endpoint can serve ``/logs?rank=N`` — per-rank recent
+    log/flight lines without ssh;
+  * the aggregator can queue COMMANDS for a (node, rank)
+    (``post_command``) — e.g. the trigger engine arming an XPlane window
+    on the slow rank. HTTP transport piggy-backs them on the ``/push``
+    response body; shared-dir transport writes ``cmd.<node>.<rank>.jsonl``
+    next to the push files. The client applies commands AFTER a
+    successful push (xplane.arm / flight dump), swallowing every error —
+    a malformed command is a recorded curiosity, never a step failure.
+
 Env:
   PADDLE_TELEMETRY_DIR       shared-dir transport root
   PADDLE_TELEMETRY_ENDPOINT  host:port of the rank-0 admin server
@@ -56,7 +68,7 @@ import time
 import urllib.request
 from collections import deque
 
-from . import metrics, recorder, spans
+from . import metrics, recorder, spans, xplane
 from .admin import job_token
 
 __all__ = ["TelemetryClient", "TelemetryAggregator", "maybe_push",
@@ -75,6 +87,8 @@ FLEET_FLIGHT_NAME = "FLEET_FLIGHT.json"
 FLEET_TRACE_NAME = "FLEET_TRACE.json"
 
 _SPANS_PER_RANK = 50000  # merged-trace memory bound per rank
+_LOGS_PER_RANK = 500     # /logs?rank= tail bound per rank
+_FLIGHT_BATCH = 200      # flight-tail events shipped per push (newest win)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -104,11 +118,14 @@ class TelemetryClient:
             if timeout is None else float(timeout)
         self._last = 0.0          # monotonic time of the last push attempt
         self._cursor = 0          # spans already shipped (events_since)
+        self._flight_cursor = 0   # flight/log events already shipped
+        self._cmd_off = 0         # shared-dir command-file read offset
         self._lk = threading.Lock()
 
-    def build_report(self, step=None) -> tuple[dict, int]:
-        """(report, next span cursor) — the cursor only advances once the
-        report is actually delivered, so spans survive a dropped push."""
+    def build_report(self, step=None) -> tuple[dict, dict]:
+        """(report, next cursors) — the cursors only advance once the
+        report is actually delivered, so spans/log lines survive a
+        dropped push."""
         snap = metrics.snapshot()
         hists = snap["histograms"]
         step_h = hists.get("train.step_time_s") \
@@ -116,6 +133,8 @@ class TelemetryClient:
         wait_h = hists.get("collective.wait_s")
         batch, nxt = (spans.events_since(self._cursor)
                       if spans.tracing_enabled() else ([], self._cursor))
+        flight_batch, flight_nxt = recorder.events_since(self._flight_cursor)
+        flight_batch = flight_batch[-_FLIGHT_BATCH:]
         now_wall = time.time()
         report = {
             "v": 1,
@@ -137,8 +156,10 @@ class TelemetryClient:
             "metrics": snap,
             "spans": batch,
             "spans_dropped": spans.dropped(),
+            # recent flight/log lines: the rank-0 /logs?rank= tail
+            "flight": flight_batch,
         }
-        return report, nxt
+        return report, {"spans": nxt, "flight": flight_nxt}
 
     def _send(self, report: dict):
         data = json.dumps(report, default=str)
@@ -149,7 +170,14 @@ class TelemetryClient:
                 f"{base}/push", method="POST", data=data.encode(),
                 headers={"X-Paddle-Job-Token": job_token(),
                          "Content-Type": "application/json"})
-            urllib.request.urlopen(req, timeout=self.timeout).read()
+            body = urllib.request.urlopen(req, timeout=self.timeout).read()
+            # piggy-backed commands ride the push RESPONSE (no second
+            # transport); a legacy plain-"ok" body simply carries none
+            try:
+                cmds = json.loads(body).get("commands") or []
+            except (ValueError, AttributeError):
+                cmds = []
+            self._apply_commands(cmds)
             return
         if self.directory:
             os.makedirs(self.directory, exist_ok=True)
@@ -159,8 +187,56 @@ class TelemetryClient:
             # file, so the aggregator's line-split read never interleaves
             with open(path, "a") as f:
                 f.write(data + "\n")
+            self._apply_commands(self._read_dir_commands())
             return
         raise RuntimeError("TelemetryClient has no transport configured")
+
+    def _read_dir_commands(self) -> list[dict]:
+        """New whole lines of this rank's command file (aggregator-written
+        mirror of the push files), tracked by a private offset."""
+        path = os.path.join(self.directory,
+                            f"cmd.{self.node}.{self.rank}.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._cmd_off)
+                chunk = f.read()
+        except OSError:
+            return []
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return []
+        self._cmd_off += last_nl + 1
+        cmds = []
+        for line in chunk[:last_nl].splitlines():
+            try:
+                cmds.append(json.loads(line))
+            except ValueError:
+                continue
+        return cmds
+
+    def _apply_commands(self, cmds: list):
+        """Apply aggregator commands (trigger-armed deep capture). Every
+        failure is swallowed into a flight event — commands are advice
+        from the observability plane, never a correctness dependency."""
+        for cmd in cmds:
+            try:
+                if not isinstance(cmd, dict):
+                    continue
+                kind = cmd.get("cmd")
+                if kind == "xplane":
+                    xplane.arm(steps=cmd.get("steps"),
+                               xdir=cmd.get("dir"),
+                               reason=cmd.get("reason") or "fleet-command")
+                elif kind == "flight_dump":
+                    recorder.dump_flight(
+                        reason=cmd.get("reason") or "fleet-command")
+                else:
+                    recorder.record("telemetry.cmd_unknown", cmd=str(cmd))
+                    continue
+                metrics.counter("telemetry.commands").inc()
+            except Exception as e:
+                recorder.record("telemetry.cmd_error", cmd=str(cmd),
+                                error=f"{type(e).__name__}: {e}")
 
     def maybe_push(self, step=None, force: bool = False) -> bool:
         """Push a report if the pacing interval elapsed. Loss-tolerant BY
@@ -173,7 +249,7 @@ class TelemetryClient:
                 return False
             self._last = now
         try:
-            report, nxt = self.build_report(step)
+            report, cursors = self.build_report(step)
             try:
                 # lazy: chaos lives above observability in the import DAG
                 from ..distributed.resilience import chaos
@@ -187,7 +263,8 @@ class TelemetryClient:
                             error=f"{type(e).__name__}: {e}")
             return False
         with self._lk:
-            self._cursor = nxt
+            self._cursor = cursors["spans"]
+            self._flight_cursor = cursors["flight"]
         metrics.counter("telemetry.pushes").inc()
         return True
 
@@ -248,6 +325,9 @@ class TelemetryAggregator:
         self._lk = threading.Lock()
         self._ranks: dict[tuple, dict] = {}   # (node, rank) -> state
         self._spans: dict[tuple, deque] = {}  # (node, rank) -> span events
+        self._logs: dict[tuple, deque] = {}   # (node, rank) -> flight tail
+        self._commands: dict[tuple, list] = {}  # (node, rank) -> queued cmds
+        self._cmd_dir: str | None = None      # shared-dir command mirror
         self.received = 0
         self.malformed = 0
         self.straggler_events: list[dict] = []
@@ -292,6 +372,8 @@ class TelemetryAggregator:
             rec["step_time"] = report.get("step_time")
             rec["wait_time"] = report.get("wait_time")
             rec["counters"] = (report.get("metrics") or {}).get("counters", {})
+            rec["snap"] = report.get("metrics") or {}  # full: the launcher
+            # exporter ships every rank's series out of the pod
             if busy is not None:
                 rec["busy_s"] = busy
             batch = report.get("spans") or []
@@ -299,6 +381,10 @@ class TelemetryAggregator:
                 dq = self._spans.setdefault(
                     key, deque(maxlen=_SPANS_PER_RANK))
                 dq.extend(e for e in batch if isinstance(e, dict))
+            fl = report.get("flight") or []
+            if fl:
+                dq = self._logs.setdefault(key, deque(maxlen=_LOGS_PER_RANK))
+                dq.extend(e for e in fl if isinstance(e, dict))
             self.received += 1
         self._check_straggler(key)
 
@@ -359,6 +445,7 @@ class TelemetryAggregator:
     def watch_dir(self, directory: str, interval: float = 0.25):
         """Poll `directory` on a daemon thread until ``stop()``."""
         self.stop()
+        self._cmd_dir = directory  # command mirror rides the same dir
         stop = threading.Event()
 
         def poll():
@@ -424,6 +511,73 @@ class TelemetryAggregator:
             with self._lk:
                 rec["streak"] = 0
                 rec["flagged"] = False  # recovered: re-arm the detector
+
+    # ---- command channel (piggy-backed on the telemetry transport) ----
+    def post_command(self, node, rank, cmd: dict):
+        """Queue one command for a (node, rank) — the trigger engine's
+        deep-capture hook. HTTP clients receive it in their next /push
+        response; shared-dir clients read the mirrored
+        ``cmd.<node>.<rank>.jsonl`` line at their next push."""
+        key = (str(node), int(rank))
+        with self._lk:
+            self._commands.setdefault(key, []).append(dict(cmd))
+        if self._cmd_dir:
+            try:
+                os.makedirs(self._cmd_dir, exist_ok=True)
+                path = os.path.join(self._cmd_dir,
+                                    f"cmd.{key[0]}.{key[1]}.jsonl")
+                with open(path, "a") as f:
+                    f.write(json.dumps(cmd, default=str) + "\n")
+            except OSError:
+                pass  # the HTTP fallback (if any) still carries it
+        recorder.record("fleet.command", node=key[0], rank=key[1],
+                        cmd=cmd.get("cmd"), detail=cmd)
+
+    def take_commands(self, node, rank) -> list[dict]:
+        """Pop every queued command for (node, rank) — the admin /push
+        handler drains these into the push response."""
+        key = (str(node), int(rank))
+        with self._lk:
+            return self._commands.pop(key, [])
+
+    # ---- per-rank accessors ----
+    def rank_counters(self) -> list[dict]:
+        """[{node, rank, counters}] of the latest reported counter
+        snapshot per rank — what the trigger engine watches for
+        slo.breach / watchdog.near_deadline deltas."""
+        with self._lk:
+            items = sorted(self._ranks.items())
+        return [{"node": node, "rank": rank,
+                 "counters": dict(rec.get("counters") or {})}
+                for (node, rank), rec in items]
+
+    def export_blocks(self) -> list[tuple[dict, dict]]:
+        """[({node, rank}, latest reported metrics snapshot)] for every
+        FRESH rank — what the launcher's MetricsExporter pushes so the
+        external sink sees per-rank train/collective/serve series, not
+        just the launcher's own registry."""
+        now = time.time()
+        with self._lk:
+            items = sorted(self._ranks.items())
+        out = []
+        for (node, rank), rec in items:
+            snap = rec.get("snap")
+            if snap and self._is_fresh(rec, now):
+                out.append(({"node": node, "rank": str(rank)}, snap))
+        return out
+
+    def logs(self, rank: int, node=None, limit: int = 200) -> list[dict]:
+        """The recent flight/log tail of one rank (newest last). With
+        several nodes carrying the same rank id, `node` narrows it."""
+        with self._lk:
+            keys = [k for k in self._logs
+                    if k[1] == int(rank) and (node is None or k[0] == str(node))]
+            out = []
+            for k in sorted(keys):
+                out.extend(dict(e, node=k[0], rank=k[1])
+                           for e in self._logs[k])
+        out.sort(key=lambda e: (e.get("t") or 0, e.get("seq") or 0))
+        return out[-int(limit):]
 
     # ---- summaries ----
     def ranks(self) -> list[dict]:

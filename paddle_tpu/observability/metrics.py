@@ -14,12 +14,17 @@ Contracts:
     and data workers all report concurrently).
   * histograms keep running count/sum/min/max exactly and percentiles over
     a bounded reservoir of the most recent observations (bounded memory on
-    million-step runs).
+    million-step runs). They ALSO keep exact cumulative bucket counts over
+    fixed boundaries (seconds-scale latency defaults) so external scrapers
+    and push exporters see full latency distributions
+    (``_bucket{le=...}``), not just summary counts — the ISSUE 6
+    request-latency (TTFT/TPOT/e2e) distributions ride on this.
 
 No jax, no paddle_tpu imports — safe to import from anywhere in the tree.
 """
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
@@ -27,7 +32,8 @@ import time
 from collections import deque
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
-           "snapshot", "timer", "set_sink", "maybe_emit_step", "reset"]
+           "snapshot", "counter_values", "timer", "set_sink",
+           "maybe_emit_step", "reset", "DEFAULT_BUCKETS"]
 
 ENV_SINK = "PADDLE_METRICS_SINK"
 
@@ -37,6 +43,14 @@ _gauges: dict[str, "Gauge"] = {}
 _histograms: dict[str, "Histogram"] = {}
 
 _RESERVOIR = 4096  # most-recent observations kept per histogram
+
+# Default bucket boundaries: seconds-scale latencies from 100 µs to 5 min.
+# Exact counts (unlike the percentile reservoir, which is windowed), so a
+# scraped histogram is correct over the whole process life.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
 
 
 class Counter:
@@ -79,12 +93,13 @@ class Gauge:
 
 
 class Histogram:
-    """Running count/sum/min/max + recent-window percentiles."""
+    """Running count/sum/min/max + recent-window percentiles + exact
+    cumulative bucket counts (Prometheus-style ``le`` boundaries)."""
 
     __slots__ = ("name", "_lk", "_count", "_sum", "_min", "_max", "_last",
-                 "_window")
+                 "_window", "_bounds", "_bucket_counts")
 
-    def __init__(self, name):
+    def __init__(self, name, buckets=DEFAULT_BUCKETS):
         self.name = name
         self._lk = threading.Lock()
         self._count = 0
@@ -93,6 +108,9 @@ class Histogram:
         self._max = None
         self._last = None
         self._window = deque(maxlen=_RESERVOIR)
+        self._bounds = tuple(sorted(float(b) for b in buckets))
+        # per-bucket (non-cumulative) counts; index len(bounds) == +Inf
+        self._bucket_counts = [0] * (len(self._bounds) + 1)
 
     def observe(self, v: float):
         v = float(v)
@@ -105,6 +123,19 @@ class Histogram:
             if self._max is None or v > self._max:
                 self._max = v
             self._window.append(v)
+            i = bisect.bisect_left(self._bounds, v)
+            self._bucket_counts[i] += 1
+
+    def buckets(self) -> tuple[tuple[float, ...], list[int]]:
+        """(upper bounds, CUMULATIVE counts) — counts has one extra entry
+        (the +Inf bucket, == total count). Exact over the process life."""
+        with self._lk:
+            per = list(self._bucket_counts)
+        cum, running = [], 0
+        for c in per:
+            running += c
+            cum.append(running)
+        return self._bounds, cum
 
     @property
     def count(self) -> int:
@@ -124,6 +155,13 @@ class Histogram:
             win = sorted(self._window)
             count, total = self._count, self._sum
             lo, hi, last = self._min, self._max, self._last
+            per = list(self._bucket_counts)  # SAME lock scope as count:
+            # the exported +Inf bucket must equal _count in one exposition
+        bounds = self._bounds
+        cum, running = [], 0
+        for c in per:
+            running += c
+            cum.append(running)
 
         def pct(p):
             if not win:
@@ -134,7 +172,11 @@ class Histogram:
         return {"count": count, "sum": total,
                 "mean": (total / count) if count else None,
                 "min": lo, "max": hi, "last": last,
-                "p50": pct(50), "p95": pct(95), "p99": pct(99)}
+                "p50": pct(50), "p95": pct(95), "p99": pct(99),
+                # exact cumulative distribution (last entry = +Inf = count):
+                # exporters / the Prometheus endpoint render _bucket series
+                # straight from the snapshot, no second registry walk
+                "buckets": {"bounds": list(bounds), "cum": cum}}
 
 
 def counter(name: str) -> Counter:
@@ -183,6 +225,14 @@ class timer:
         return False
 
 
+def counter_values() -> dict:
+    """Counters only — no histogram-window sorting. The cheap read for
+    per-step pollers (the trigger engine) that only watch counters."""
+    with _lock:
+        cs = dict(_counters)
+    return {n: c.value for n, c in cs.items()}
+
+
 def snapshot() -> dict:
     """One JSON-serializable dict of every metric in the process."""
     with _lock:
@@ -221,6 +271,8 @@ _STANDARD_COUNTERS = (
     "serve.requests", "serve.tokens", "serve.tokens_discarded",
     "serve.admission_stalls", "serve.preemptions", "serve.chaos_retired",
     "telemetry.pushes", "telemetry.drops", "fleet.straggler",
+    "slo.breach", "telemetry.exports", "telemetry.export_drops",
+    "trigger.captures", "watchdog.near_deadline",
 )
 _STANDARD_GAUGES = (
     "serve.pages_in_use", "serve.tokens_per_s", "serve.kv_read_mb_per_tok",
@@ -229,6 +281,7 @@ _STANDARD_HISTOGRAMS = (
     "train.step_time_s", "loop.step_time_s", "collective.wait_s",
     "checkpoint.save_time_s", "checkpoint.load_time_s",
     "checkpoint.crc_time_s", "serve.burst_time_s",
+    "slo.ttft_s", "slo.tpot_s", "slo.queue_wait_s", "slo.e2e_s",
 )
 
 

@@ -34,8 +34,8 @@ import time
 import traceback
 from collections import deque
 
-__all__ = ["record", "dump_flight", "events", "reset", "enabled",
-           "install_crash_hook", "FLIGHT_NAME"]
+__all__ = ["record", "dump_flight", "events", "events_since", "reset",
+           "enabled", "install_crash_hook", "FLIGHT_NAME"]
 
 ENV_CAP = "PADDLE_FLIGHT_RECORDER"
 FLIGHT_NAME = "FLIGHT.json"
@@ -110,6 +110,17 @@ def events() -> list[dict]:
         except RuntimeError:
             continue
     return list(ring)
+
+
+def events_since(seq: int) -> tuple[list[dict], int]:
+    """(events with ring seq > `seq`, next cursor). The incremental read the
+    fleet TelemetryClient ships flight/log tails with (mirror of
+    spans.events_since) — the rank-0 ``/logs?rank=`` tail is fed from these
+    batches. Eviction-safe: a cursor older than the ring's oldest event
+    simply returns the whole ring."""
+    evs = [e for e in events() if e.get("seq", 0) > seq]
+    nxt = max((e.get("seq", 0) for e in evs), default=seq)
+    return evs, nxt
 
 
 def reset():

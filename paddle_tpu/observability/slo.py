@@ -1,0 +1,332 @@
+"""Request-level SLO observability: per-request lifecycle tracing + policy.
+
+PR 3 made serving fast but observable only in AGGREGATE (``serve.*`` gauges
+per burst). The ROADMAP's replicated-serving item needs per-request latency
+distributions (TTFT / TPOT / e2e p95) before SLO-aware admission and
+least-loaded routing can exist, and the paged layout ("Ragged Paged
+Attention", PAPERS.md) makes per-request cost visible only if the request
+LIFECYCLE is traced, not the burst. This module is that substrate:
+
+  * every request gets a process-unique, monotonic **trace id** at enqueue;
+  * the scheduler reports lifecycle edges (``on_enqueue`` → ``on_admit`` →
+    ``on_first_token`` → ``on_tokens``* → ``on_preempt``* → ``on_retire``)
+    through a ``RequestTracker`` — pure observation, never a raise into the
+    serving step;
+  * retire feeds the PRE-REGISTERED latency histograms (exact bucket
+    counts, metrics.DEFAULT_BUCKETS):
+      slo.ttft_s        enqueue → first generated token (queue included)
+      slo.tpot_s        mean seconds per output token after the first
+      slo.queue_wait_s  enqueue → admission
+      slo.e2e_s         enqueue → retire
+  * an ``SloPolicy`` (targets from ``PADDLE_SLO_TTFT_S`` /
+    ``PADDLE_SLO_TPOT_S`` / ``PADDLE_SLO_E2E_S`` / ``PADDLE_SLO_QUEUE_S``;
+    a dimension with no env var has no target) evaluates each retire ONCE:
+    a breaching request increments ``slo.breach`` (plus a per-dimension
+    ``slo.breach.<dim>``) and records a flight event naming the request
+    (rid, trace id, dims, measured vs target) — the signal
+    observability.triggers turns into an automatic XPlane capture, and the
+    measurement the ROADMAP's SLO-aware admission will consume;
+  * with span tracing on, retire reconstructs the request's phase spans
+    (``req.queue`` / ``req.prefill`` / ``req.decode`` under one
+    ``req`` span, cat="request", args carrying rid/trace/tokens/breach) so
+    the merged fleet trace shows request lifecycles next to bursts.
+
+``now()`` is the sanctioned request-timing clock for ``inference/`` —
+tools/lint_observability.py rule O4 bans ad-hoc ``time.perf_counter()``
+request timing there so latency math cannot drift away from the histograms
+the SLO policy evaluates.
+
+Preemption semantics: a preempted request keeps its trace id and its
+ENQUEUE anchor (e2e covers the whole life, preemptions included) and keeps
+its first-token time from the first attempt — the preempt is recorded as a
+count + span, not a measurement reset. Queue wait accumulates only time
+actually spent WAITING (enqueue→first admit, plus each
+preemption→re-admit gap — never an earlier attempt's execution). At
+temperature=0 the regenerated tokens are identical, so this is the honest
+client-visible story.
+
+No jax imports; safe from any layer.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+from . import metrics, recorder, spans
+
+__all__ = ["SloPolicy", "RequestTracker", "now", "bench_payload",
+           "HIST_TTFT", "HIST_TPOT", "HIST_QUEUE", "HIST_E2E"]
+
+ENV_TTFT = "PADDLE_SLO_TTFT_S"
+ENV_TPOT = "PADDLE_SLO_TPOT_S"
+ENV_E2E = "PADDLE_SLO_E2E_S"
+ENV_QUEUE = "PADDLE_SLO_QUEUE_S"
+
+HIST_TTFT = "slo.ttft_s"
+HIST_TPOT = "slo.tpot_s"
+HIST_QUEUE = "slo.queue_wait_s"
+HIST_E2E = "slo.e2e_s"
+
+COUNTER_BREACH = "slo.breach"
+
+# process-wide: trace ids stay unique and monotonic across engine instances
+# (a serving process that rebuilds its batcher must not reissue ids)
+_trace_ids = itertools.count(1)
+
+
+def now() -> float:
+    """The request-timing clock (``time.perf_counter``): same clock as
+    spans, so request phase spans land on the trace timeline unshifted."""
+    return time.perf_counter()
+
+
+def _env_target(name: str) -> float | None:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+class SloPolicy:
+    """Latency targets, evaluated once per retired request.
+
+    Explicit constructor args override the env; ``None`` falls back to the
+    ``PADDLE_SLO_*`` env var; an unset dimension has no target. With no
+    targets at all the policy is inert (``active`` False) and evaluation
+    is a no-op returning []."""
+
+    def __init__(self, ttft_s: float | None = None, tpot_s: float | None = None,
+                 e2e_s: float | None = None, queue_s: float | None = None):
+        self.targets = {
+            "ttft": _env_target(ENV_TTFT) if ttft_s is None else float(ttft_s),
+            "tpot": _env_target(ENV_TPOT) if tpot_s is None else float(tpot_s),
+            "e2e": _env_target(ENV_E2E) if e2e_s is None else float(e2e_s),
+            "queue": _env_target(ENV_QUEUE) if queue_s is None
+            else float(queue_s),
+        }
+        self.targets = {k: v for k, v in self.targets.items()
+                        if v is not None and v > 0}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.targets)
+
+    def evaluate(self, measured: dict) -> list[dict]:
+        """[{dim, value, target}] for every dimension that has BOTH a
+        measurement and a target and exceeds it."""
+        breaches = []
+        for dim, target in self.targets.items():
+            v = measured.get(dim)
+            if v is not None and v > target:
+                breaches.append({"dim": dim, "value": round(float(v), 6),
+                                 "target": target})
+        return breaches
+
+
+class _Rec:
+    __slots__ = ("trace_id", "t_enqueue", "t_admit", "t_first", "t_last",
+                 "t_requeued", "queue_s", "admitted", "preemptions", "spans")
+
+    def __init__(self, trace_id, t_enqueue):
+        self.trace_id = trace_id
+        self.t_enqueue = t_enqueue
+        self.t_admit = None      # CURRENT attempt's admit time
+        self.t_first = None      # first token EVER (first attempt)
+        self.t_last = None
+        self.t_requeued = None   # when a preemption put it back in queue
+        self.queue_s = 0.0       # accumulated PURE queue wait (all waits)
+        self.admitted = False
+        self.preemptions = 0
+        self.spans = []  # (name, t0, t1) preempted attempts
+
+
+class RequestTracker:
+    """Per-engine lifecycle observer. Thread-safe (the admin endpoint may
+    snapshot while the scheduler steps). Every hook is a few dict ops and
+    clock reads; none can raise into the scheduler (defensive except)."""
+
+    def __init__(self, policy: SloPolicy | None = None, source: str = "serve"):
+        self.policy = SloPolicy() if policy is None else policy
+        self.source = source
+        self._recs: dict[int, _Rec] = {}
+        self._lk = threading.Lock()
+        self.breached: int = 0
+        # pre-register so scrapers/exporters see the latency series (and
+        # the breach counter) before the first request ever lands
+        for h in (HIST_TTFT, HIST_TPOT, HIST_QUEUE, HIST_E2E):
+            metrics.histogram(h)
+        metrics.counter(COUNTER_BREACH)
+
+    # ---------------------------------------------------------- lifecycle
+    def on_enqueue(self, rid: int) -> int:
+        t = now()
+        tid = next(_trace_ids)
+        with self._lk:
+            self._recs[rid] = _Rec(tid, t)
+        return tid
+
+    def on_admit(self, rid: int):
+        t = now()
+        with self._lk:
+            rec = self._recs.get(rid)
+            if rec is not None and rec.t_admit is None:
+                rec.t_admit = t
+                rec.admitted = True
+                # queue wait accumulates only TIME SPENT WAITING: from
+                # enqueue (first admit) or from the preemption that
+                # re-queued it — never the earlier attempt's execution
+                start = rec.t_requeued if rec.t_requeued is not None \
+                    else rec.t_enqueue
+                rec.queue_s += max(0.0, t - start)
+
+    def on_first_token(self, rid: int):
+        t = now()
+        with self._lk:
+            rec = self._recs.get(rid)
+            if rec is None:
+                return
+            if rec.t_first is None:
+                rec.t_first = t
+            rec.t_last = t
+
+    def on_tokens(self, rid: int, n: int):
+        if n <= 0:
+            return
+        t = now()
+        with self._lk:
+            rec = self._recs.get(rid)
+            if rec is not None:
+                rec.t_last = t
+
+    def on_preempt(self, rid: int):
+        t = now()
+        with self._lk:
+            rec = self._recs.get(rid)
+            if rec is None:
+                return
+            rec.preemptions += 1
+            if rec.t_admit is not None:
+                rec.spans.append(("req.attempt", rec.t_admit, t))
+            # back to the queue: admission restarts, the queue-wait clock
+            # resumes from NOW, and ttft/e2e keep their first-attempt
+            # anchors (honest client-visible story)
+            rec.t_admit = None
+            rec.t_requeued = t
+
+    def trace_id(self, rid: int) -> int | None:
+        with self._lk:
+            rec = self._recs.get(rid)
+            return None if rec is None else rec.trace_id
+
+    # ------------------------------------------------------------- retire
+    def on_retire(self, rid: int, n_tokens: int = 0, reason: str = "complete"):
+        t = now()
+        with self._lk:
+            rec = self._recs.pop(rid, None)
+        if rec is None:
+            return
+        measured = {"e2e": t - rec.t_enqueue}
+        if rec.admitted:
+            measured["queue"] = rec.queue_s
+        if rec.t_first is not None:
+            measured["ttft"] = rec.t_first - rec.t_enqueue
+            if n_tokens >= 2 and rec.t_last is not None \
+                    and rec.t_last > rec.t_first:
+                measured["tpot"] = (rec.t_last - rec.t_first) / (n_tokens - 1)
+        for dim, hist in (("ttft", HIST_TTFT), ("tpot", HIST_TPOT),
+                          ("queue", HIST_QUEUE), ("e2e", HIST_E2E)):
+            if dim in measured:
+                metrics.histogram(hist).observe(measured[dim])
+
+        breaches = self.policy.evaluate(measured)
+        if breaches:
+            self.breached += 1
+            metrics.counter(COUNTER_BREACH).inc()
+            for b in breaches:
+                metrics.counter(f"{COUNTER_BREACH}.{b['dim']}").inc()
+            recorder.record(
+                "slo.breach",
+                message=f"[slo] request {rid} (trace {rec.trace_id}) "
+                        f"breached {'+'.join(b['dim'] for b in breaches)}: "
+                        + ", ".join(f"{b['dim']} {b['value'] * 1e3:.1f}ms > "
+                                    f"{b['target'] * 1e3:.1f}ms"
+                                    for b in breaches),
+                rid=rid, trace_id=rec.trace_id, source=self.source,
+                node=os.environ.get("PADDLE_NODE_ID"),
+                rank=int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0),
+                tokens=n_tokens, reason=reason, breaches=breaches,
+                measured={k: round(v, 6) for k, v in measured.items()})
+
+        if spans.tracing_enabled():
+            try:
+                self._emit_spans(rec, rid, t, n_tokens, reason, breaches)
+            except Exception:
+                pass  # tracing must never fail a retire
+
+    def _emit_spans(self, rec: _Rec, rid: int, t_retire: float,
+                    n_tokens: int, reason: str, breaches: list):
+        args = {"rid": rid, "trace": rec.trace_id, "tokens": n_tokens,
+                "preemptions": rec.preemptions, "reason": reason}
+        if breaches:
+            args["breach"] = "+".join(b["dim"] for b in breaches)
+        spans.add_span("req", "request", rec.t_enqueue, t_retire, **args)
+        admit = rec.t_admit if rec.t_admit is not None else t_retire
+        spans.add_span("req.queue", "request", rec.t_enqueue, admit,
+                       rid=rid, trace=rec.trace_id)
+        if rec.t_first is not None:
+            # prefill span only when the first token belongs to the
+            # CURRENT attempt (a preempted request's final admit can come
+            # after its first-attempt token — no backwards span)
+            if rec.t_admit is not None and rec.t_admit <= rec.t_first:
+                spans.add_span("req.prefill", "request", rec.t_admit,
+                               rec.t_first, rid=rid, trace=rec.trace_id)
+            spans.add_span("req.decode", "request", rec.t_first,
+                           rec.t_last or t_retire, rid=rid,
+                           trace=rec.trace_id, tokens=n_tokens)
+        for name, t0, t1 in rec.spans:  # preempted attempts
+            spans.add_span(name, "request", t0, t1, rid=rid,
+                           trace=rec.trace_id, preempted=True)
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> dict:
+        """Live tracker state for the serving admin /snapshot."""
+        with self._lk:
+            inflight = len(self._recs)
+        snap = metrics.snapshot()["histograms"]
+
+        def pick(name):
+            h = snap.get(name) or {}
+            return {"p50": h.get("p50"), "p95": h.get("p95"),
+                    "count": h.get("count", 0)}
+
+        return {"inflight": inflight, "breached": self.breached,
+                "targets": dict(self.policy.targets),
+                "ttft": pick(HIST_TTFT), "tpot": pick(HIST_TPOT),
+                "e2e": pick(HIST_E2E)}
+
+
+def bench_payload() -> dict | None:
+    """The ``slo`` sub-object for bench JSON lines (schema pinned by the
+    bench contract tests): ttft/tpot/e2e/queue p50+p95+count plus the
+    breach counter. Returns None when serving was never exercised in this
+    process (no e2e observations) — the sub-object is ABSENT, not empty,
+    on pure-training runs."""
+    snap = metrics.snapshot()
+    e2e = snap["histograms"].get(HIST_E2E)
+    if not e2e or not e2e.get("count"):
+        return None
+
+    def pick(name):
+        h = snap["histograms"].get(name) or {}
+        return {"p50": h.get("p50"), "p95": h.get("p95"),
+                "count": h.get("count", 0)}
+
+    return {"ttft": pick(HIST_TTFT), "tpot": pick(HIST_TPOT),
+            "e2e": pick(HIST_E2E), "queue_wait": pick(HIST_QUEUE),
+            "breaches": int(snap["counters"].get(COUNTER_BREACH, 0))}

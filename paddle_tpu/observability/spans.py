@@ -27,7 +27,7 @@ import os
 import threading
 import time
 
-__all__ = ["span", "traced", "tracing_enabled", "enable_tracing",
+__all__ = ["span", "traced", "add_span", "tracing_enabled", "enable_tracing",
            "disable_tracing", "export_chrome_trace", "reset", "events",
            "events_since", "dropped", "set_trace_metadata"]
 
@@ -162,6 +162,29 @@ def traced(name: str, cat: str = "user", **args):
                 return fn(*a, **k)
         return wrapped
     return deco
+
+
+def add_span(name: str, cat: str, t0: float, t1: float, **args):
+    """Append a COMPLETED span with explicit ``time.perf_counter`` endpoints
+    (seconds). The request-lifecycle tracker (observability.slo) records
+    phase timestamps as requests move through the scheduler and
+    reconstructs the queue/prefill/decode spans at retire time — a live
+    ``span()`` context manager can't straddle the scheduler's interleaved
+    per-request phases. No-op while tracing is disabled."""
+    if not _enabled:
+        return
+    ev = {
+        "name": name, "cat": cat, "ph": "X",
+        "ts": float(t0) * 1e6, "dur": max(0.0, (float(t1) - float(t0)) * 1e6),
+        "pid": os.getpid(), "tid": threading.get_ident(),
+    }
+    if args:
+        ev["args"] = args
+    with _lock:
+        if len(_events) < _max_events:
+            _events.append(ev)
+        else:
+            _dropped[0] += 1
 
 
 def tracing_enabled() -> bool:

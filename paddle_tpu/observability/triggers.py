@@ -1,0 +1,228 @@
+"""Trigger-driven deep capture: react to slowness WHILE it is slow.
+
+PR 5 could NAME a straggler; this module closes the ROADMAP follow-up
+"auto XPlane capture of the slow rank while it is slow" ("MPMD Pipeline
+Parallelism", PAPERS.md) by turning observability signals into bounded
+device-trace captures automatically:
+
+  signal                         where it fires          reaction
+  ------------------------------ ----------------------- ------------------
+  fleet.straggler event          rank-0 aggregator       arm XPlane on the
+                                                         named (node, rank)
+  slo.breach counter delta       serving process / any   arm XPlane locally
+                                 rank's reported counters (or command the
+                                                         breaching rank)
+  watchdog.near_deadline delta   any rank's counters     same
+
+Every capture also snapshots the flight ring and (fleet mode) the ranked
+step-time table into ``CAPTURE_<n>.json`` under the capture dir — the
+postmortem names the breaching request / slow rank without re-deriving it.
+
+Remote arming piggy-backs on the EXISTING telemetry channel (no new
+transport, lint O3 stays honest): ``TelemetryAggregator.post_command``
+queues ``{"cmd": "xplane", ...}`` for a (node, rank); the command rides
+back in the admin ``POST /push`` response (HTTP transport) or in a
+``cmd.<node>.<rank>.jsonl`` file next to the push files (shared-dir
+transport), and the rank's TelemetryClient applies it at its next push.
+
+Bounded by construction: at most ``PADDLE_TRIGGER_MAX_CAPTURES`` (3) per
+process, one per ``PADDLE_TRIGGER_COOLDOWN_S`` (30) — a breach storm
+collapses to one capture, never a profiler pile-up. ``PADDLE_TRIGGERS=0``
+disables the engine wherever it would auto-start.
+
+The engine is pull-based: ``poll()`` reads counters/event lists (a few
+dict reads — cheap enough for a serving step boundary); ``start()`` wraps
+poll in a daemon thread for the launcher.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import metrics, recorder, xplane
+
+__all__ = ["TriggerEngine", "enabled"]
+
+ENV_ENABLE = "PADDLE_TRIGGERS"
+ENV_MAX = "PADDLE_TRIGGER_MAX_CAPTURES"
+ENV_COOLDOWN = "PADDLE_TRIGGER_COOLDOWN_S"
+ENV_XPLANE_STEPS = "PADDLE_TRIGGER_XPLANE_STEPS"
+
+# counters watched per rank (fleet mode: from each rank's reported
+# snapshot; local mode: from the process registry)
+_WATCHED_COUNTERS = ("slo.breach", "watchdog.near_deadline")
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_ENABLE, "1") != "0"
+
+
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class TriggerEngine:
+    """eng = TriggerEngine(aggregator=agg)   # fleet mode, on the launcher
+    eng = TriggerEngine()                    # local mode, e.g. serving
+
+    Baselines every watched signal at CONSTRUCTION: only signals that fire
+    after the engine exists trigger captures (an old breach counter from a
+    previous serving wave is history, not an alarm)."""
+
+    def __init__(self, aggregator=None, capture_dir: str | None = None,
+                 xplane_steps: int | None = None,
+                 cooldown_s: float | None = None,
+                 max_captures: int | None = None):
+        self.aggregator = aggregator
+        # None (no PADDLE_TRACE_DIR, no explicit dir) arms windows and
+        # records events but writes no CAPTURE files — an untraced process
+        # must not litter its cwd
+        self.capture_dir = capture_dir or os.environ.get("PADDLE_TRACE_DIR")
+        self.xplane_steps = int(_env_num(ENV_XPLANE_STEPS, 4)) \
+            if xplane_steps is None else int(xplane_steps)
+        self.cooldown_s = _env_num(ENV_COOLDOWN, 30.0) \
+            if cooldown_s is None else float(cooldown_s)
+        self.max_captures = int(_env_num(ENV_MAX, 3)) \
+            if max_captures is None else int(max_captures)
+        self.captures: list[dict] = []
+        self._last_fire = 0.0
+        self._lk = threading.Lock()
+        self._stop: threading.Event | None = None
+        self._thread = None
+        # baselines
+        self._seen_stragglers = len(aggregator.straggler_events) \
+            if aggregator is not None else 0
+        self._counter_base: dict = {}
+        for key, counters in self._counter_sources().items():
+            for name in _WATCHED_COUNTERS:
+                self._counter_base[(key, name)] = int(counters.get(name, 0))
+
+    # ------------------------------------------------------------ sources
+    def _counter_sources(self) -> dict:
+        """{origin_key: counters} — per rank in fleet mode (None node/rank
+        entries are skipped), the local registry otherwise. Local counters
+        are ALWAYS included: the launcher process's own watchdog/slo
+        signals must not need a telemetry round-trip."""
+        out = {("local", None, None): metrics.counter_values()}
+        if self.aggregator is not None:
+            for row in self.aggregator.rank_counters():
+                out[("rank", row["node"], row["rank"])] = row["counters"]
+        return out
+
+    # --------------------------------------------------------------- poll
+    def poll(self) -> int:
+        """Evaluate every rule once; returns how many captures fired."""
+        fired = 0
+        # rule 1: new straggler events name their (node, rank) directly
+        if self.aggregator is not None:
+            evs = list(self.aggregator.straggler_events)
+            for ev in evs[self._seen_stragglers:]:
+                fired += self._fire("fleet.straggler", node=ev.get("node"),
+                                    rank=ev.get("rank"), detail=ev)
+            self._seen_stragglers = len(evs)
+        # rule 2: watched counter deltas (slo.breach, watchdog.near_deadline)
+        for key, counters in self._counter_sources().items():
+            kind, node, rank = key
+            for name in _WATCHED_COUNTERS:
+                cur = int(counters.get(name, 0))
+                base = self._counter_base.get((key, name), 0)
+                if cur > base:
+                    fired += self._fire(name,
+                                        node=node if kind == "rank" else None,
+                                        rank=rank if kind == "rank" else None,
+                                        detail={"counter": name,
+                                                "delta": cur - base})
+                self._counter_base[(key, name)] = cur
+        return fired
+
+    # --------------------------------------------------------------- fire
+    def _fire(self, rule: str, node=None, rank=None, detail=None) -> int:
+        with self._lk:
+            now = time.monotonic()
+            if len(self.captures) >= self.max_captures:
+                return 0
+            if self.captures and now - self._last_fire < self.cooldown_s:
+                return 0
+            self._last_fire = now
+            n = len(self.captures) + 1
+        remote = self.aggregator is not None and node is not None \
+            and rank is not None
+        if remote:
+            # piggy-back on the telemetry channel: the offending rank arms
+            # its own profiler at its next push
+            self.aggregator.post_command(node, rank, {
+                "cmd": "xplane", "steps": self.xplane_steps,
+                "reason": f"trigger:{rule}"})
+        else:
+            xplane.arm(self.xplane_steps, reason=f"trigger:{rule}")
+        cap = {
+            "n": n, "rule": rule, "t": time.time(),
+            "node": node, "rank": rank, "detail": detail,
+            "armed": "remote" if remote else "local",
+            "xplane_steps": self.xplane_steps,
+        }
+        metrics.counter("trigger.captures").inc()
+        recorder.record(
+            "trigger.capture", echo=True,
+            message=f"[trigger] {rule} -> "
+                    f"{'rank (' + str(node) + ',' + str(rank) + ')' if remote else 'local'}"
+                    f" xplane window ({self.xplane_steps} steps) + snapshot",
+            **{k: v for k, v in cap.items() if k != "t"})
+        self._write_capture(cap)
+        with self._lk:
+            self.captures.append(cap)
+        return 1
+
+    def _write_capture(self, cap: dict):
+        """CAPTURE_<n>.json: flight ring (carries the slo.breach events
+        naming the breaching request), ranked step-time table + straggler
+        list (fleet mode). Never raises."""
+        if not self.capture_dir:
+            return
+        try:
+            doc = dict(cap)
+            doc["flight"] = recorder.events()
+            doc["breaches"] = [e for e in doc["flight"]
+                               if e.get("kind") == "slo.breach"][-20:]
+            if self.aggregator is not None:
+                doc["step_table"] = self.aggregator.step_time_table()
+                doc["stragglers"] = list(self.aggregator.straggler_events)
+            os.makedirs(self.capture_dir, exist_ok=True)
+            path = os.path.join(self.capture_dir, f"CAPTURE_{cap['n']}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+            os.replace(tmp, path)
+            cap["path"] = path
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, interval: float = 0.5) -> "TriggerEngine":
+        """Poll on a daemon thread (the launcher's mode)."""
+        if self._thread is not None:
+            return self
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval):
+                try:
+                    self.poll()
+                except Exception:
+                    pass  # the poll thread must outlive any one bad poll
+
+        self._stop = stop
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="paddle-trigger-engine")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+            self._stop = self._thread = None

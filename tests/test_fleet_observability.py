@@ -408,7 +408,11 @@ class TestAdminServer:
             assert "# TYPE paddle_train_steps counter" in prom
             assert "paddle_train_steps 3" in prom
             assert "paddle_serve_pages_in_use 9" in prom
-            assert 'paddle_train_step_time_s{quantile="0.5"} 0.5' in prom
+            # ISSUE 6 satellite: real histogram exposition (full bucket
+            # series), not summary quantile points
+            assert "# TYPE paddle_train_step_time_s histogram" in prom
+            assert 'paddle_train_step_time_s_bucket{le="0.5"} 1' in prom
+            assert 'paddle_train_step_time_s_bucket{le="+Inf"} 1' in prom
             assert "paddle_train_step_time_s_count 1" in prom
             snap = json.loads(_get(base + "/snapshot"))
             assert snap["metrics"]["counters"]["train.steps"] == 3

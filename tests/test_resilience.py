@@ -620,6 +620,7 @@ class TestBenchNeverJsonless:
         assert "error" not in parsed[0]
         assert parsed[0]["vs_baseline"] == 0.0   # CPU numbers never score
         assert "fleet" not in parsed[0]          # single-rank: no sub-object
+        assert "slo" not in parsed[0]            # no serving: no slo object
 
     def test_require_tpu_restores_strict_error_exit(self):
         """BENCH_REQUIRE_TPU=1 keeps the old behavior: error JSON line +

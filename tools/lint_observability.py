@@ -22,6 +22,13 @@ Flagged (AST-based):
      splits the observability plane again. Audited non-telemetry HTTP
      (elastic KV registry, rpc discovery, hub downloads) lives in
      HTTP_ALLOWLIST with a recorded reason.
+  O4 ad-hoc-request-timing : a ``time.perf_counter()`` / ``time.monotonic()``
+     call inside ``paddle_tpu/inference/``. Request latency there is the
+     SLO substrate's ground truth — timing math that bypasses
+     ``observability.slo`` (``slo.now()`` / ``RequestTracker``) or
+     ``metrics.timer`` drifts away from the TTFT/TPOT/e2e histograms the
+     SLO policy evaluates and the exporter ships. Audited user-facing
+     profiling lives in TIMING_ALLOWLIST with a recorded reason.
 
 Exemptions:
   * paddle_tpu/observability/ and paddle_tpu/profiler/ (they ARE the layer)
@@ -57,6 +64,18 @@ ALLOWLIST = {
     "paddle_tpu/distributed/launch/main.py": "CLI launcher stdout",
 }
 
+# audited request-adjacent timing in inference/ that is NOT SLO ground
+# truth: user-facing profile reports (reference API parity)
+TIMING_ALLOWLIST = {
+    "paddle_tpu/inference/__init__.py":
+        "Predictor/LLMPredictor Config(enable_profile) per-run profile "
+        "report — reference API parity, user-facing, not the SLO substrate",
+}
+
+# the O4 scope: request-serving code, where ad-hoc clocks bypass the
+# request-span/SLO API
+TIMING_SCOPE = "paddle_tpu/inference/"
+
 # audited non-telemetry HTTP: transports the admin/fleet plane builds on,
 # or IO whose payload is data, not runtime telemetry
 HTTP_ALLOWLIST = {
@@ -82,6 +101,16 @@ def _is_time_time(node: ast.AST) -> bool:
     return (isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _is_monotonic_clock(node: ast.AST) -> bool:
+    """time.perf_counter() / time.monotonic() — the O4 request-timing ban
+    inside TIMING_SCOPE."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("perf_counter", "monotonic")
             and isinstance(node.func.value, ast.Name)
             and node.func.value.id == "time")
 
@@ -126,6 +155,8 @@ def lint_file(path: str, relpath: str | None = None):
     lines = src.splitlines()
     check_print = relpath not in ALLOWLIST
     check_http = relpath not in HTTP_ALLOWLIST
+    check_timing = (relpath is None or relpath.startswith(TIMING_SCOPE)) \
+        and relpath not in TIMING_ALLOWLIST
 
     def marked(lineno: int) -> bool:
         return lineno - 1 < len(lines) and MARKER in lines[lineno - 1]
@@ -146,6 +177,16 @@ def lint_file(path: str, relpath: str | None = None):
                        "observability.metrics.timer(name) / spans.span(name) "
                        "(or time.perf_counter for a monotonic clock), or "
                        "mark '# observability: ok (<why>)'")
+        elif check_timing and _is_monotonic_clock(node) \
+                and not marked(node.lineno):
+            yield ("O4", node.lineno,
+                   "ad-hoc request timing in inference/: route request "
+                   "latency through observability.slo (slo.now() / "
+                   "RequestTracker) or metrics.timer(name) so it feeds the "
+                   "TTFT/TPOT/e2e histograms the SLO policy evaluates; "
+                   "audited user-facing profiling belongs in "
+                   "TIMING_ALLOWLIST (or mark "
+                   "'# observability: ok (<why>)')")
         elif check_http and not marked(getattr(node, "lineno", 0)):
             offender = _http_import(node)
             if offender is not None:
