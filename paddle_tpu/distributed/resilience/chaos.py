@@ -15,18 +15,11 @@ Spec grammar (comma-separated):  ``PADDLE_CHAOS="site:sel[,site:sel...]"``
                   ``PADDLE_CHAOS_SEED`` + the site name (deterministic
                   per (seed, site, hit-index) — reruns reproduce exactly)
 
-Known sites (grep `chaos.hit` for ground truth):
-  ckpt.write       before a checkpoint shard file is written
-  ckpt.rename      between the shard tmp-write and its atomic rename
-  collective.wait  before a blocking collective wait/barrier
-  rendezvous       before distributed rendezvous / parallel-env init
-  data.next        before a data-loader batch is handed to the trainer
-  kv.heartbeat     before an elastic KV heartbeat PUT
-  rpc.send         before any wire IO of an rpc call (retry-safe fault)
-  rpc.rendezvous   one discovery poll of init_rpc's accumulating loop
-  elastic.enroll   before a re-rendezvous enrollment write
-  serve.admit      before a serving request is admitted to a slot
-  serve.burst      before a serving decode burst is dispatched
+Known sites: the ``SITES`` registry below is the ground truth — every
+``chaos.hit`` call site must use a string literal registered there (static
+rule A2 in ``tools/analyze`` enforces literal, registered, deduplicated,
+and test-covered sites; at runtime an unregistered site warn-and-records a
+flight event instead of silently counting).
 
 ``ChaosError`` subclasses ``retry.TransientError`` so recovery layers
 (ResilientLoop, checkpoint fallback) treat it like a real transient fault —
@@ -43,10 +36,34 @@ import threading
 from ...observability import metrics as _metrics, recorder as _recorder
 from .retry import TransientError
 
-__all__ = ["ChaosError", "hit", "active", "reset", "inject", "hit_counts"]
+__all__ = ["ChaosError", "SITES", "hit", "active", "reset", "inject",
+           "hit_counts"]
 
 ENV_VAR = "PADDLE_CHAOS"
 SEED_VAR = "PADDLE_CHAOS_SEED"
+
+# The chaos-site registry: site -> one-line "what fails here". The static
+# analyzer (rule A2) checks every chaos.hit literal against this dict,
+# rejects duplicates/dynamic sites, and requires each site to be named by
+# at least one test; hit() itself warns once per unregistered site at
+# runtime. Keep it sorted.
+SITES: dict[str, str] = {
+    "ckpt.rename":     "between a shard's tmp-write and its atomic rename",
+    "ckpt.write":      "before a checkpoint shard file is written",
+    "collective.wait": "before a blocking collective wait/barrier",
+    "data.next":       "before a data-loader batch reaches the trainer",
+    "elastic.enroll":  "before a re-rendezvous enrollment write",
+    "kv.heartbeat":    "before an elastic KV heartbeat PUT",
+    "rendezvous":      "before distributed rendezvous / parallel-env init",
+    "rpc.rendezvous":  "one discovery poll of init_rpc's accumulating loop",
+    "rpc.send":        "before any wire IO of an rpc call (retry-safe)",
+    "serve.admit":     "before a serving request is admitted to a slot",
+    "serve.burst":     "before a serving decode burst is dispatched",
+    "telemetry.export": "before an external metric-sink push",
+    "telemetry.push":  "before a fleet telemetry report is sent",
+}
+
+_warned_unregistered: set[str] = set()
 
 
 class ChaosError(TransientError):
@@ -103,6 +120,21 @@ def hit(site: str) -> int:
     sites live on hot paths (collective waits, data loading)."""
     if not os.environ.get(ENV_VAR):
         return 0
+    if site not in SITES:
+        # warn-and-record, never raise: an unregistered site is a lint
+        # finding (rule A2) and a postmortem breadcrumb, not a crash. Only
+        # reachable with injection configured, so the no-chaos hot path
+        # stays a single env lookup.
+        with _lock:
+            first = site not in _warned_unregistered
+            if first:
+                _warned_unregistered.add(site)
+        if first:
+            _recorder.record(
+                "chaos.unregistered_site", echo=True,
+                message=f"[chaos] hit() at unregistered site {site!r} — "
+                        "register it in resilience.chaos.SITES",
+                site=site)
     with _lock:
         n = _counters.get(site, 0) + 1
         _counters[site] = n
@@ -136,6 +168,7 @@ def reset():
     global _parsed
     with _lock:
         _counters.clear()
+        _warned_unregistered.clear()
     _parsed = None
 
 

@@ -56,9 +56,6 @@ ENV_FORMAT = "PADDLE_METRICS_EXPORT_FORMAT"
 ENV_INTERVAL = "PADDLE_METRICS_EXPORT_INTERVAL"
 ENV_TIMEOUT = "PADDLE_METRICS_EXPORT_TIMEOUT"
 
-CHAOS_SITE = "telemetry.export"
-
-
 def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, "") or default)
@@ -220,7 +217,7 @@ class MetricsExporter:
             try:
                 # lazy: chaos lives above observability in the import DAG
                 from ..distributed.resilience import chaos
-                chaos.hit(CHAOS_SITE)
+                chaos.hit("telemetry.export")
             except ImportError:
                 pass
             req = urllib.request.Request(

@@ -196,16 +196,20 @@ class TelemetryClient:
         mirror of the push files), tracked by a private offset."""
         path = os.path.join(self.directory,
                             f"cmd.{self.node}.{self.rank}.jsonl")
-        try:
-            with open(path, "rb") as f:
-                f.seek(self._cmd_off)
-                chunk = f.read()
-        except OSError:
-            return []
-        last_nl = chunk.rfind(b"\n")
-        if last_nl < 0:
-            return []
-        self._cmd_off += last_nl + 1
+        # offset read + advance under the client lock: concurrent pushes
+        # (engine hook + a force-push) would otherwise both read from the
+        # same offset and apply the same commands twice
+        with self._lk:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(self._cmd_off)
+                    chunk = f.read()
+            except OSError:
+                return []
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                return []
+            self._cmd_off += last_nl + 1
         cmds = []
         for line in chunk[:last_nl].splitlines():
             try:

@@ -246,7 +246,8 @@ class RequestTracker:
 
         breaches = self.policy.evaluate(measured)
         if breaches:
-            self.breached += 1
+            with self._lk:  # summary() reads from the admin thread
+                self.breached += 1
             metrics.counter(COUNTER_BREACH).inc()
             for b in breaches:
                 metrics.counter(f"{COUNTER_BREACH}.{b['dim']}").inc()

@@ -1,0 +1,232 @@
+"""The PADDLE_* environment-flag registry: one declaration per flag.
+
+Every ``PADDLE_*`` env var the runtime reads is declared here with its
+default and a one-line doc — the single inventory the static analyzer
+(``tools/analyze`` rule A4) checks every flag-shaped literal in the tree
+against, and the source the README "Environment flags" reference table is
+generated from (``python -m tools.analyze --env-table``). Before this
+registry existed, ~60 flags were read ad-hoc and a typo'd env var failed
+OPEN: the default silently applied and nothing ever reported the dead
+knob. Now an undeclared (or edit-distance-1 mistyped) flag name anywhere
+in the tree is a lint finding.
+
+Declaring is the contract; call sites MAY keep their existing
+``os.environ.get`` reads (the analyzer matches names, not call forms) or
+use :func:`get` / :func:`get_bool` here for the documented default.
+
+Import-light on purpose: stdlib only, no paddle_tpu imports — both the
+runtime and the (jax-free) analyzer tooling can load it.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["EnvFlag", "FLAGS", "declare", "declared", "get", "get_bool",
+           "get_float", "get_int", "table_rows"]
+
+
+@dataclass(frozen=True)
+class EnvFlag:
+    name: str
+    default: str
+    doc: str
+
+
+FLAGS: dict[str, EnvFlag] = {}
+
+
+def declare(name: str, default: str, doc: str) -> str:
+    """Register one flag (name, default-as-string, one-line doc). Returns
+    the name so modules can bind constants: ENV_X = declare("PADDLE_X",...)."""
+    if name in FLAGS:
+        raise ValueError(f"env flag {name} declared twice")
+    FLAGS[name] = EnvFlag(name, default, doc)
+    return name
+
+
+def declared(name: str) -> bool:
+    return name in FLAGS
+
+
+def get(name: str, default: str | None = None) -> str:
+    """The env value, else the explicit default, else the DECLARED default.
+    Unknown names raise — reads through this helper cannot typo."""
+    if name not in FLAGS:
+        raise KeyError(f"undeclared env flag {name!r} — declare it in "
+                       "paddle_tpu/utils/env_flags.py")
+    v = os.environ.get(name)
+    if v is not None:
+        return v
+    return FLAGS[name].default if default is None else default
+
+
+def get_bool(name: str) -> bool:
+    return get(name).lower() in ("1", "true", "yes", "on")
+
+
+def get_float(name: str) -> float:
+    try:
+        return float(get(name) or 0)
+    except ValueError:
+        return float(FLAGS[name].default or 0)
+
+
+def get_int(name: str) -> int:
+    try:
+        return int(get(name) or 0)
+    except ValueError:
+        return int(FLAGS[name].default or 0)
+
+
+def table_rows() -> list[tuple[str, str, str]]:
+    """(name, default, doc) sorted by name — the README table's source."""
+    return [(f.name, f.default, f.doc) for _, f in sorted(FLAGS.items())]
+
+
+# ---------------------------------------------------------------- identity
+
+declare("PADDLE_JOB_ID", "default",
+        "job identity scoping rpc/elastic/admin auth tokens and KV spaces")
+declare("PADDLE_NODE_ID", "",
+        "stable node identity (launcher-assigned; telemetry/elastic keys)")
+declare("PADDLE_NODE_RANK", "-1",
+        "node rank for the launcher (-1 = take from --rank/registry)")
+declare("PADDLE_NNODES", "1",
+        "node count (launcher; supports min:max elastic ranges)")
+declare("PADDLE_LOCAL_RANK", "0",
+        "process-local rank on this node")
+declare("PADDLE_TRAINER_ID", "0",
+        "global trainer rank of this process")
+declare("PADDLE_TRAINERS_NUM", "1",
+        "global world size (trainer count)")
+declare("PADDLE_TRAINER_ENDPOINTS", "",
+        "comma-separated endpoints of every trainer (reference parity)")
+declare("PADDLE_CURRENT_ENDPOINT", "",
+        "this trainer's own endpoint (reference parity)")
+declare("PADDLE_DIST_INITIALIZED", "",
+        "set to '1' by init_parallel_env once distributed init has run")
+declare("PADDLE_MASTER", "",
+        "master endpoint host:port for elastic/rpc rendezvous")
+
+# --------------------------------------------------------------- transport
+
+declare("PADDLE_RPC_SECRET", "",
+        "shared secret for rpc/elastic-KV/admin write auth (real boundary; "
+        "without it the job-id-derived token only stops accidents)")
+declare("PADDLE_RPC_BIND_HOST", "",
+        "explicit rpc server bind interface (default: derive from master)")
+declare("PADDLE_RPC_TIMEOUT", "300",
+        "rpc rendezvous deadline in seconds")
+declare("PADDLE_RPC_DEBUG", "",
+        "'1' records rpc rendezvous debug events to the flight recorder")
+
+# -------------------------------------------------------------- resilience
+
+declare("PADDLE_CHAOS", "",
+        "deterministic fault injection spec 'site:sel[,site:sel...]' "
+        "(sel: N exact | N+ from | pP probability); off when unset")
+declare("PADDLE_CHAOS_SEED", "0",
+        "seed for probabilistic chaos selectors (reruns reproduce exactly)")
+declare("PADDLE_CKPT_DIR", "",
+        "checkpoint directory; when set, Engine.fit routes through "
+        "ResilientLoop (restore + bitwise replay)")
+declare("PADDLE_CKPT_KEEP", "0",
+        "garbage-collect checkpoint generations older than the newest K "
+        "published ones (0 = keep everything)")
+declare("PADDLE_CKPT_VERIFY", "1",
+        "save-side crc read-back verify of every renamed shard "
+        "('0' disables)")
+declare("PADDLE_RESILIENT", "1",
+        "'0' opts Engine.fit out of the ResilientLoop routing")
+declare("PADDLE_PREEMPT_GRACE_S", "0",
+        "SIGTERM grace budget in seconds for the emergency save")
+declare("PADDLE_ELASTIC_ACTIVE", "",
+        "'1' under elastic supervision: collective waits become "
+        "deadline-bounded and the watchdog defers to re-rendezvous")
+declare("PADDLE_ELASTIC_GEN", "0",
+        "current re-rendezvous generation (rpc generation fencing)")
+declare("PADDLE_WATCHDOG_WARN_FRAC", "0.75",
+        "fraction of the comm-watchdog abort budget at which the "
+        "near-deadline warn signal fires")
+
+# ----------------------------------------------------------- observability
+
+declare("PADDLE_TRACE_DIR", "",
+        "enables span tracing; traces, FLIGHT.json and capture artifacts "
+        "land here (launcher fans out per-(node,rank) subdirs)")
+declare("PADDLE_TRACE_MAX_EVENTS", "100000",
+        "span ring bound; spans past it are counted as dropped")
+declare("PADDLE_FLIGHT_RECORDER", "512",
+        "flight-recorder ring capacity ('0'/'off' disables)")
+declare("PADDLE_METRICS_SINK", "",
+        "per-step metrics sink path (.csv or .jsonl)")
+declare("PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_trace",
+        "profiler chrome-trace export directory")
+declare("PADDLE_XPLANE_DIR", "",
+        "XPlane (jax.profiler) dump dir; enables the env-configured "
+        "capture window")
+declare("PADDLE_XPLANE_START", "2",
+        "first step of the env XPlane window")
+declare("PADDLE_XPLANE_STEPS", "2",
+        "length in steps of the env XPlane window")
+
+# ------------------------------------------------------------ fleet plane
+
+declare("PADDLE_TELEMETRY", "",
+        "'1' forces the fleet telemetry plane on, '0' kills it "
+        "(default: on when a transport or nproc>1 says so)")
+declare("PADDLE_TELEMETRY_DIR", "",
+        "shared-directory telemetry transport (push.<node>.<rank>.jsonl)")
+declare("PADDLE_TELEMETRY_ENDPOINT", "",
+        "HTTP telemetry push endpoint (the rank-0 admin server)")
+declare("PADDLE_TELEMETRY_INTERVAL", "0.5",
+        "minimum seconds between telemetry pushes per rank")
+declare("PADDLE_TELEMETRY_TIMEOUT", "1.0",
+        "telemetry HTTP push timeout in seconds")
+declare("PADDLE_TELEMETRY_STALE_S", "30",
+        "ranks silent this long leave the fleet views (world count, "
+        "straggler median)")
+declare("PADDLE_TELEMETRY_ADMIN_PORT", "0",
+        "fixed port for the rank-0 admin endpoint (0 = ephemeral)")
+declare("PADDLE_ADMIN_READ_TOKEN", "",
+        "when set, every admin GET requires this token (header or Bearer)")
+declare("PADDLE_STRAGGLER_K", "2.0",
+        "straggler threshold: compute-time multiplier over fleet median")
+declare("PADDLE_STRAGGLER_CHECKS", "3",
+        "consecutive over-threshold reports before a rank is named")
+
+# ------------------------------------------------------------- SLO + export
+
+declare("PADDLE_SLO_TTFT_S", "",
+        "time-to-first-token SLO target in seconds (empty = no target)")
+declare("PADDLE_SLO_TPOT_S", "",
+        "per-output-token SLO target in seconds (empty = no target)")
+declare("PADDLE_SLO_E2E_S", "",
+        "end-to-end request SLO target in seconds (empty = no target)")
+declare("PADDLE_SLO_QUEUE_S", "",
+        "queue-wait SLO target in seconds (empty = no target)")
+declare("PADDLE_METRICS_EXPORT_URL", "",
+        "external metric sink URL (exporter off when unset)")
+declare("PADDLE_METRICS_EXPORT_FORMAT", "prom",
+        "'prom' text exposition or 'otlp' JSON (auto-otlp when the URL "
+        "ends in /v1/metrics)")
+declare("PADDLE_METRICS_EXPORT_INTERVAL", "10",
+        "seconds between exporter pushes")
+declare("PADDLE_METRICS_EXPORT_TIMEOUT", "2",
+        "exporter HTTP timeout in seconds")
+declare("PADDLE_TRIGGERS", "1",
+        "'0' disables the trigger engine (auto deep-capture)")
+declare("PADDLE_TRIGGER_COOLDOWN_S", "30",
+        "minimum seconds between trigger-armed captures")
+declare("PADDLE_TRIGGER_MAX_CAPTURES", "3",
+        "maximum trigger-armed captures per process")
+declare("PADDLE_TRIGGER_XPLANE_STEPS", "4",
+        "steps per trigger-armed XPlane window")
+
+# ------------------------------------------------------------------- misc
+
+declare("PADDLE_EXTENSION_DIR", "<tempdir>/paddle_tpu_extensions",
+        "build/cache dir for cpp_extension artifacts")
+declare("PADDLE_TPU_HUB_DIR", "~/.cache/paddle_tpu/hub",
+        "paddle.hub download cache directory")

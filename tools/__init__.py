@@ -1,0 +1,1 @@
+# repo tooling package (static analysis lives in tools.analyze)

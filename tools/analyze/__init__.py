@@ -1,0 +1,34 @@
+"""paddle-analyze: the repo's unified static-analysis framework.
+
+One walker, one AST parse per file, one finding/marker/allowlist/baseline
+vocabulary — every static rule in the repo is a plugin here instead of a
+standalone script with its own file walk (the pre-ISSUE-7 state: two lints
+that each re-implemented walking, markers, and allowlists, while whole
+invariant classes — chaos sites, env flags, telemetry names, SPMD
+collective order, lock discipline — had no static check at all).
+
+Layout:
+  core.py                 Finding / FileCtx (per-file AST cache) / walker /
+                          marker + baseline handling / report
+  registry.py             Rule base class + the rule registry
+  rules_resilience.py     R1-R3  (migrated from tools/lint_resilience.py)
+  rules_observability.py  O1-O4  (migrated from tools/lint_observability.py)
+  rules_spmd.py           A1     spmd-divergent-collective
+  rules_chaos.py          A2     chaos-site-registry
+  rules_telemetry.py      A3     telemetry-name-registry
+  rules_envflags.py       A4     env-flag-registry
+  rules_locks.py          A5     lock-discipline
+  markers.py              M1     bare-marker-without-reason
+  __main__.py             the driver: python -m tools.analyze
+
+The old CLIs (tools/lint_resilience.py, tools/lint_observability.py) are
+thin shims over run() with the rule set restricted to their families —
+identical exit-code/output contracts, so the pre-existing lint tests keep
+passing byte-for-byte.
+
+Run: python -m tools.analyze [root] [--rules R1,A2] [--json]
+     [--baseline PATH] [--changed] [--fix-markers] [--env-table]
+"""
+from .core import Finding, FileCtx, RepoCtx, walk_repo, load_baseline  # noqa: F401
+from .registry import RULES, get_rules, rule_catalog  # noqa: F401
+from .runner import run  # noqa: F401

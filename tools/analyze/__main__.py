@@ -1,0 +1,161 @@
+"""The paddle-analyze driver.
+
+  python -m tools.analyze [root]            run every rule, exit 1 on
+                                            un-baselined findings
+  --rules R1,A2,...                         restrict the rule set
+  --json                                    machine-readable report
+  --baseline PATH                           baseline file (default:
+                                            <root>/ANALYZE_BASELINE.json)
+  --no-baseline                             ignore the baseline entirely
+  --changed                                 git-diff-scoped per-file checks
+                                            (fast pre-commit mode)
+  --fix-markers                             list baseline entries whose
+                                            finding no longer reproduces
+                                            (delete them: the baseline only
+                                            ever shrinks); exit 1 if any
+  --list                                    print the rule catalog
+  --env-table                               print the generated README
+                                            "Environment flags" table
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import BASELINE_NAME, load_baseline
+from .registry import rule_catalog
+from .runner import changed_files, code_line, run
+
+
+def _default_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def env_table(root: str) -> str:
+    """The markdown env-flag reference table, generated from the registry
+    (statically — no runtime import, no jax)."""
+    from .core import FileCtx
+    from .rules_envflags import REGISTRY_REL, parse_registry
+    path = os.path.join(root, *REGISTRY_REL.split("/"))
+    ctx = FileCtx(root, REGISTRY_REL) if os.path.isfile(path) else None
+    flags = parse_registry(ctx)
+    lines = ["| Flag | Default | What it does |",
+             "| --- | --- | --- |"]
+    for name in sorted(flags):
+        _lineno, default, doc = flags[name]
+        default = default.strip("\"'") or "(unset)"
+        lines.append(f"| `{name}` | `{default}` | {doc} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m tools.analyze")
+    p.add_argument("root", nargs="?", default=None)
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--changed", action="store_true")
+    p.add_argument("--fix-markers", action="store_true", dest="fix_markers")
+    p.add_argument("--list", action="store_true", dest="list_rules")
+    p.add_argument("--env-table", action="store_true", dest="env_table")
+    args = p.parse_args(argv)
+
+    root = os.path.abspath(args.root or _default_root())
+
+    if args.list_rules:
+        for r in rule_catalog():
+            print(f"{r['id']:>6}  [{r['layer']}] {r['title']}: "
+                  f"{r['rationale']}")
+        return 0
+    if args.env_table:
+        print(env_table(root))
+        return 0
+
+    rule_ids = args.rules.split(",") if args.rules else None
+    files = None
+    if args.changed and not args.fix_markers:
+        # --fix-markers ignores --changed: staleness is only meaningful
+        # against a FULL run (a diff-scoped pass never visits the files
+        # whose entries it would otherwise call stale)
+        files = changed_files(root)
+        if not files:
+            print("analyze: no changed .py files in scope")
+            return 0
+    try:
+        findings = run(root, rule_ids=rule_ids, files=files)
+    except KeyError as e:
+        print(f"analyze: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    bl_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    baseline = None
+    if not args.no_baseline and os.path.isfile(bl_path):
+        baseline = load_baseline(bl_path)
+        errors = baseline.errors()
+        if errors:
+            for e in errors:
+                print(e, file=sys.stderr)
+            return 2
+
+    live, suppressed = [], []
+    if baseline:
+        baseline.begin_run()
+    for f in findings:
+        entry = baseline.consume(f, code_line(root, f)) if baseline else None
+        if entry is not None:
+            suppressed.append(f)
+        else:
+            live.append(f)
+    # staleness is only computable from a full-scope run: a --changed pass
+    # skipped the files whose entries would look unconsumed
+    stale = baseline.stale() if baseline and files is None else []
+
+    if args.fix_markers:
+        if not baseline:
+            print("analyze: no baseline file — nothing to shrink")
+            return 0
+        if not stale:
+            print(f"analyze: all {len(baseline.entries)} baseline "
+                  "entr(y/ies) still reproduce — nothing to delete")
+            return 0
+        print("analyze: these baseline entries no longer reproduce — "
+              "DELETE them (the baseline only ever shrinks):")
+        for e in stale:
+            print(f"  {e.get('rule')} {e.get('path')} :: {e.get('code')}"
+                  f"  (reason was: {e.get('reason')})")
+        return 1
+
+    if args.as_json:
+        print(json.dumps({
+            "root": root,
+            "rules": [r["id"] for r in rule_catalog()]
+            if rule_ids is None else [r.strip().upper()
+                                      for r in rule_ids if r.strip()],
+            "findings": [f.to_dict() for f in live],
+            "baselined": [f.to_dict() for f in suppressed],
+            "stale_baseline": stale,
+            "counts": {"live": len(live), "baselined": len(suppressed),
+                       "stale_baseline": len(stale)},
+        }, indent=1))
+    else:
+        for f in live:
+            print(f.render())
+        if suppressed:
+            print(f"analyze: {len(suppressed)} baselined finding(s) "
+                  "suppressed (see ANALYZE_BASELINE.json)")
+        if stale:
+            print(f"analyze: {len(stale)} stale baseline entr(y/ies) — "
+                  "run --fix-markers and delete them", file=sys.stderr)
+    if live:
+        print(f"\n{len(live)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Shared infrastructure: findings, per-file AST cache, walker, markers,
+baseline. No paddle_tpu imports — the analyzer must run without jax."""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. `path` is repo-relative with '/' separators;
+    `line` is 1-based (0 for file-level findings)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+# ---------------------------------------------------------------- file ctx
+
+class FileCtx:
+    """One scanned file: source, lines, and the AST parsed exactly ONCE and
+    shared by every rule (the pre-framework lints each re-parsed)."""
+
+    def __init__(self, root: str, rel: str):
+        self.root = root
+        self.rel = rel  # '/'-separated, repo-relative
+        self.path = os.path.join(root, *rel.split("/"))
+        with open(self.path, encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.syntax_error: SyntaxError | None = None
+        self._tree: ast.AST | None = None
+        self._parsed = False
+        self._constants: dict[str, str] | None = None
+        self._nodes: list[ast.AST] | None = None
+        self._by_type: dict[type, list] | None = None
+
+    @property
+    def tree(self) -> ast.AST | None:
+        """The parsed module, or None on a syntax error (recorded in
+        `syntax_error`; the runner emits one SYNTAX finding per file)."""
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.src, filename=self.path)
+            except SyntaxError as e:
+                self.syntax_error = e
+                self._tree = None
+        return self._tree
+
+    def nodes(self) -> list[ast.AST]:
+        """Every AST node, walked ONCE and shared by all full-tree rules
+        (ast.walk order). Per-construct sub-walks stay with the rules."""
+        if self._nodes is None:
+            self._nodes = [] if self.tree is None else list(ast.walk(self.tree))
+        return self._nodes
+
+    def nodes_of(self, *types: type) -> list[ast.AST]:
+        """The shared walk, pre-bucketed by node type — rules that only
+        care about Calls (or Imports, Constants, ...) iterate ~10x fewer
+        nodes than a full pass, and the bucketing itself happens once."""
+        if self._by_type is None:
+            by: dict[type, list] = {}
+            for n in self.nodes():
+                by.setdefault(n.__class__, []).append(n)
+            self._by_type = by
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, ()))
+        return out
+
+    def marked(self, lineno: int, layer: str) -> bool:
+        """True when the source line carries an audited `# <layer>: ok (`
+        marker (reason opening paren required — the bare-marker rule M1
+        flags reasonless markers as findings in their own right)."""
+        return (0 < lineno <= len(self.lines)
+                and f"# {layer}: ok (" in self.lines[lineno - 1])
+
+    def module_constants(self) -> dict[str, str]:
+        """Module-level NAME = "string literal" assignments — the one level
+        of indirection rules resolve (ENV_FOO = "PADDLE_FOO";
+        os.environ.get(ENV_FOO) still counts as a read of PADDLE_FOO)."""
+        if self._constants is None:
+            self._constants = {}
+            if self.tree is not None:
+                for node in self.tree.body:
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Constant) \
+                            and isinstance(node.value.value, str):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self._constants[t.id] = node.value.value
+        return self._constants
+
+    def resolve_str_arg(self, node: ast.AST) -> str | None:
+        """A literal string, or a module-level constant holding one."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.module_constants().get(node.id)
+        return None
+
+
+# ------------------------------------------------------------------ walker
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache"}
+
+# what the repo-wide walk covers: the runtime package plus the bench entry
+# points (env flags and chaos sites live there too). tools/ and tests/ are
+# deliberately NOT walked — rule fixtures and message strings would trip
+# the very rules that quote them.
+EXTRA_FILES = ("bench.py",)
+EXTRA_DIRS = ("benchmarks",)
+
+
+def walk_repo(root: str) -> list[str]:
+    """Repo-relative '/'-separated paths of every .py file in scope,
+    sorted. Works on fixture trees (any dir containing a paddle_tpu/)."""
+    rels: list[str] = []
+    pkg = os.path.join(root, "paddle_tpu")
+    for base, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                rels.append(os.path.relpath(os.path.join(base, fn), root)
+                            .replace(os.sep, "/"))
+    for fn in EXTRA_FILES:
+        if os.path.isfile(os.path.join(root, fn)):
+            rels.append(fn)
+    for d in EXTRA_DIRS:
+        sub = os.path.join(root, d)
+        if os.path.isdir(sub):
+            for fn in sorted(os.listdir(sub)):
+                if fn.endswith(".py"):
+                    rels.append(f"{d}/{fn}")
+    return sorted(rels)
+
+
+class RepoCtx:
+    """Whole-repo context for cross-file rules: cached FileCtx access (the
+    AST cache) plus the tests/ corpus for coverage checks."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._files: dict[str, FileCtx] = {}
+
+    def file(self, rel: str) -> FileCtx | None:
+        if rel not in self._files:
+            path = os.path.join(self.root, *rel.split("/"))
+            if not os.path.isfile(path):
+                self._files[rel] = None
+            else:
+                self._files[rel] = FileCtx(self.root, rel)
+        return self._files[rel]
+
+    def tests_text(self) -> str | None:
+        """Concatenated source of tests/**/*.py, or None when the root has
+        no tests dir (fixture trees) — coverage checks are skipped then."""
+        tdir = os.path.join(self.root, "tests")
+        if not os.path.isdir(tdir):
+            return None
+        chunks = []
+        for base, dirs, files in os.walk(tdir):
+            dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    try:
+                        with open(os.path.join(base, fn),
+                                  encoding="utf-8") as f:
+                            chunks.append(f.read())
+                    except OSError:
+                        continue
+        return "\n".join(chunks)
+
+
+# ---------------------------------------------------------------- baseline
+
+BASELINE_NAME = "ANALYZE_BASELINE.json"
+
+
+@dataclass
+class Baseline:
+    """Reviewed, grandfathered findings. An entry matches a finding by
+    (rule, path, stripped source line) — line numbers drift, code does not.
+    Every entry MUST carry a non-empty reason; a reasonless entry is a
+    configuration error the driver refuses."""
+
+    path: str
+    entries: list[dict] = field(default_factory=list)
+
+    def errors(self) -> list[str]:
+        out = []
+        for i, e in enumerate(self.entries):
+            missing = [k for k in ("rule", "path", "code", "reason")
+                       if not str(e.get(k) or "").strip()]
+            if missing:
+                out.append(f"{self.path}: entry {i} ({e.get('rule')!r} "
+                           f"{e.get('path')!r}) missing {', '.join(missing)}"
+                           " — every baseline entry needs a written reason")
+        return out
+
+    def _key(self, rule: str, path: str, code: str):
+        return (rule, path, " ".join(code.split()))
+
+    def begin_run(self) -> None:
+        """Start a matching pass: entries are ONE-SHOT per run — each can
+        absorb exactly one finding, so a freshly pasted copy of a
+        grandfathered offending line surfaces as a live finding instead of
+        riding the old entry (the ratchet holds)."""
+        self._remaining = list(self.entries)
+
+    def consume(self, finding: Finding, code_line: str) -> dict | None:
+        k = self._key(finding.rule, finding.path, code_line)
+        for i, e in enumerate(self._remaining):
+            if self._key(e.get("rule", ""), e.get("path", ""),
+                         e.get("code", "")) == k:
+                return self._remaining.pop(i)
+        return None
+
+    def stale(self) -> list[dict]:
+        """Entries no finding consumed this run — they no longer reproduce
+        and must be deleted (the baseline only ever shrinks)."""
+        return list(self._remaining)
+
+
+def load_baseline(path: str) -> Baseline:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return Baseline(path, [])
+    entries = doc.get("entries", []) if isinstance(doc, dict) else doc
+    return Baseline(path, list(entries))
+
+
+# ------------------------------------------------------- shared AST helpers
+
+def names_in(node: ast.AST) -> set[str]:
+    """Every bare name and attribute name under `node` — the cheap 'does
+    this expression mention X' predicate rules share."""
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def call_name(node: ast.Call) -> str | None:
+    return getattr(node.func, "attr", None) or getattr(node.func, "id", None)
+
+
+def edit_distance_1(a: str, b: str) -> bool:
+    """True when a != b and Levenshtein distance is exactly 1 — the typo
+    neighborhood the env-flag rule checks."""
+    if a == b:
+        return False
+    la, lb = len(a), len(b)
+    if abs(la - lb) > 1:
+        return False
+    if la == lb:  # one substitution
+        return sum(x != y for x, y in zip(a, b)) == 1
+    if la > lb:
+        a, b, la, lb = b, a, lb, la
+    # one insertion into a yields b
+    i = 0
+    while i < la and a[i] == b[i]:
+        i += 1
+    return a[i:] == b[i + 1:]
+
+
+_PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_name(name: str) -> str:
+    """The Prometheus exposition name a metric renders under — same
+    mapping as observability.admin._prom_name so the A3 shadow check
+    reasons about the series scrapers actually see."""
+    return "paddle_" + _PROM_SANITIZE.sub("_", name)

@@ -1,0 +1,51 @@
+"""M1 bare-marker: an audit marker without a reason is not an audit.
+
+The unified suppression grammar is `# <layer>: ok (<why>)` — resilience,
+observability, spmd, chaos, telemetry, envflag, locks. The parenthesized
+why is the audit trail; a bare `# <layer>: ok` (or an empty `()`) claims
+an exemption nobody can review. Bare markers never suppressed anything in
+the old lints either — this rule makes them a finding in their own right
+instead of a silently ignored comment.
+"""
+from __future__ import annotations
+
+import re
+
+from .core import Finding, FileCtx
+from .registry import RULES, Rule, register
+
+
+def _known_layers() -> set[str]:
+    return {cls.layer for cls in RULES.values()} | {"analyze"}
+
+
+_MARKER_RE = re.compile(r"#\s*([a-z]+):\s*ok\b")
+_REASON_RE = re.compile(r"^\s*\(\s*[^)\s][^)]*\)")  # non-empty (...) follows
+
+
+@register
+class BareMarker(Rule):
+    id = "M1"
+    layer = "analyze"
+    title = "bare-marker"
+    rationale = ("`# <layer>: ok` without a parenthesized why is an "
+                 "exemption claim with no audit trail — and it does not "
+                 "even suppress, so it is pure debt")
+
+    def scope(self, rel: str) -> bool:
+        return True
+
+    def check_file(self, ctx: FileCtx):
+        layers = _known_layers()
+        for i, line in enumerate(ctx.lines, start=1):
+            if "#" not in line:
+                continue
+            for m in _MARKER_RE.finditer(line):
+                if m.group(1) in layers \
+                        and not _REASON_RE.match(line[m.end():]):
+                    yield Finding(
+                        "M1", ctx.rel, i,
+                        f"bare marker '# {m.group(1)}: ok' without a "
+                        "reason: write '# " + m.group(1) + ": ok (<why>)' "
+                        "— a reasonless exemption cannot be reviewed (and "
+                        "does not suppress)")

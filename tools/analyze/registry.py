@@ -1,0 +1,83 @@
+"""The rule registry: every static rule is a Rule subclass registered here.
+
+A rule declares:
+  id         "R1" / "O3" / "A5" ... (unique, case-insensitive on the CLI)
+  layer      the marker token — `# <layer>: ok (<why>)` on the flagged
+             line suppresses the finding (with a mandatory reason; rule M1
+             flags bare markers)
+  title      short kebab-case name
+  rationale  one line of WHY, surfaced in the README rule catalog
+
+and implements either/both:
+  check_file(ctx)   per in-scope file; yields Findings (ctx.tree is the
+                    shared, once-parsed AST)
+  finalize(repo)    once per run, after every file was visited — the hook
+                    cross-file rules (registries, name tables) emit from
+
+Rules are instantiated fresh per run, so check_file may accumulate state
+for finalize without leaking across runs.
+"""
+from __future__ import annotations
+
+from .core import Finding, FileCtx, RepoCtx  # noqa: F401  (rule imports)
+
+RULES: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: adds the rule to the registry, keyed by id."""
+    rid = cls.id.upper()
+    if rid in RULES:
+        raise ValueError(f"duplicate rule id {rid}")
+    RULES[rid] = cls
+    return cls
+
+
+class Rule:
+    id = "?"
+    layer = "analyze"
+    title = ""
+    rationale = ""
+
+    def scope(self, rel: str) -> bool:
+        """Which walked files this rule examines (repo-relative path)."""
+        return rel.startswith("paddle_tpu/")
+
+    def check_file(self, ctx: FileCtx):
+        return ()
+
+    def finalize(self, repo: RepoCtx):
+        return ()
+
+
+def _load_all():
+    # importing the modules populates RULES via @register
+    from . import (markers, rules_chaos, rules_envflags, rules_locks,  # noqa: F401
+                   rules_observability, rules_resilience, rules_spmd,
+                   rules_telemetry)
+
+
+def get_rules(ids=None) -> list[Rule]:
+    """Fresh rule instances — all, or the requested subset ('R1,A2' style
+    ids, case-insensitive; unknown ids raise)."""
+    _load_all()
+    if ids is None:
+        selected = sorted(RULES)
+    else:
+        selected = []
+        for rid in ids:
+            rid = rid.strip().upper()
+            if not rid:
+                continue
+            if rid not in RULES:
+                raise KeyError(f"unknown rule {rid!r} "
+                               f"(known: {', '.join(sorted(RULES))})")
+            selected.append(rid)
+    return [RULES[rid]() for rid in selected]
+
+
+def rule_catalog() -> list[dict]:
+    _load_all()
+    return [{"id": rid, "layer": RULES[rid].layer, "title": RULES[rid].title,
+             "rationale": RULES[rid].rationale}
+            for rid in sorted(RULES)]

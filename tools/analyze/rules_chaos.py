@@ -1,0 +1,156 @@
+"""A2 chaos-site-registry: every chaos site is literal, registered, tested.
+
+Chaos sites were string-keyed call sites (`chaos.hit("serve.burst")`) with
+the docstring as the only inventory — a typo'd site silently never fires
+and an undocumented one is invisible to `PADDLE_CHAOS` spec writers. The
+registry is ``SITES`` in paddle_tpu/distributed/resilience/chaos.py
+(site -> one-line description); this rule enforces, statically:
+
+  * every ``chaos.hit(...)`` argument is a STRING LITERAL (a name or
+    f-string is a dynamically-built site no grep or registry audit sees);
+  * every literal site is registered in SITES;
+  * SITES has no duplicate keys (a dict literal silently drops the first);
+  * every registered site is exercised: named by at least one test under
+    tests/ (skipped on fixture trees without a tests/ dir);
+  * every registered site description is non-empty.
+
+The runtime mirror: ``chaos.hit`` warn-and-records a flight event on an
+unregistered site when injection is active.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileCtx, RepoCtx
+from .registry import Rule, register
+
+REGISTRY_REL = "paddle_tpu/distributed/resilience/chaos.py"
+REGISTRY_VAR = "SITES"
+
+# modules whose .hit() is chaos injection (import aliases seen in-tree)
+_CHAOS_ALIASES = ("chaos", "_chaos")
+
+
+@register
+class ChaosSiteRegistry(Rule):
+    id = "A2"
+    layer = "chaos"
+    title = "chaos-site-registry"
+    rationale = ("an unregistered or dynamically-built chaos site is "
+                 "invisible to PADDLE_CHAOS spec writers and silently "
+                 "never fires — SITES in resilience/chaos.py is the "
+                 "ground truth, and every site must be tested")
+
+    def __init__(self):
+        self._hits: list[tuple[str, int, str | None, bool]] = []
+        # (rel, lineno, site-or-None, literal?)
+
+    def scope(self, rel: str) -> bool:
+        return True  # paddle_tpu/** + bench.py + benchmarks/
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Call):
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "hit"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _CHAOS_ALIASES):
+                continue
+            if ctx.marked(node.lineno, self.layer):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self._hits.append((ctx.rel, node.lineno, arg.value, True))
+            else:
+                self._hits.append((ctx.rel, node.lineno, None, False))
+        return ()
+
+    def finalize(self, repo: RepoCtx):
+        sites, findings = self._load_registry(repo)
+        yield from findings
+        for rel, lineno, site, literal in self._hits:
+            if rel == REGISTRY_REL:
+                continue  # chaos.py's own hit() definition / docs
+            if not literal:
+                yield Finding(
+                    "A2", rel, lineno,
+                    "chaos.hit() with a non-literal site: sites must be "
+                    "string literals so the SITES registry, grep, and "
+                    "PADDLE_CHAOS spec writers all see the same name — "
+                    "inline the literal (or mark '# chaos: ok (<why>)')")
+            elif sites is not None and site not in sites:
+                yield Finding(
+                    "A2", rel, lineno,
+                    f"unregistered chaos site {site!r}: add it to SITES in "
+                    f"{REGISTRY_REL} with a one-line description (and a "
+                    "test that names it)")
+        if sites:
+            tests = repo.tests_text()
+            if tests is not None:
+                for site, (lineno, _desc) in sorted(sites.items()):
+                    # substring, not exact-quoted: tests name sites inside
+                    # PADDLE_CHAOS spec strings ("serve.admit:1")
+                    if site not in tests:
+                        yield Finding(
+                            "A2", REGISTRY_REL, lineno,
+                            f"registered chaos site {site!r} is named by no "
+                            "test under tests/ — an untested fault site is "
+                            "a recovery path that has never run")
+
+    def _load_registry(self, repo: RepoCtx):
+        """({site: (lineno, description)} or None, findings). None means the
+        registry file/variable is absent — every literal hit is then
+        unverifiable, reported once at the first hit site."""
+        findings: list[Finding] = []
+        ctx = repo.file(REGISTRY_REL)
+        if ctx is None or ctx.tree is None:
+            if self._hits:
+                rel, lineno, _, _ = self._hits[0]
+                findings.append(Finding(
+                    "A2", REGISTRY_REL, 0,
+                    f"chaos.hit sites exist (first: {rel}:{lineno}) but "
+                    f"{REGISTRY_REL} has no parseable SITES registry"))
+            return None, findings
+        table = None
+        for node in ctx.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            if any(isinstance(t, ast.Name) and t.id == REGISTRY_VAR
+                   for t in targets) and isinstance(node.value, ast.Dict):
+                table = node.value
+                break
+        if table is None:
+            if self._hits:
+                findings.append(Finding(
+                    "A2", REGISTRY_REL, 0,
+                    f"no SITES dict literal in {REGISTRY_REL}: the chaos "
+                    "site registry is missing"))
+            return None, findings
+        sites: dict[str, tuple[int, str]] = {}
+        for k, v in zip(table.keys, table.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                findings.append(Finding(
+                    "A2", REGISTRY_REL, getattr(k, "lineno", table.lineno),
+                    "non-literal key in SITES: the registry must be a "
+                    "plain string->string dict literal"))
+                continue
+            desc = v.value if (isinstance(v, ast.Constant)
+                               and isinstance(v.value, str)) else ""
+            if k.value in sites:
+                findings.append(Finding(
+                    "A2", REGISTRY_REL, k.lineno,
+                    f"duplicate chaos site {k.value!r} in SITES: a "
+                    "duplicate dict key silently drops the first entry"))
+                continue
+            if not desc.strip():
+                findings.append(Finding(
+                    "A2", REGISTRY_REL, k.lineno,
+                    f"chaos site {k.value!r} registered without a "
+                    "description — the one-line 'what fails here' is the "
+                    "point of the registry"))
+            sites[k.value] = (k.lineno, desc)
+        return sites, findings

@@ -1,0 +1,126 @@
+"""A4 env-flag-registry: every PADDLE_* env flag is declared, no typos.
+
+~60 `PADDLE_*` env flags were read ad-hoc (`os.environ.get("PADDLE_X")`,
+or through little `_env_float(name, default)` helpers) with defaults and
+meaning recorded nowhere central — and an env-var typo fails OPEN: the
+default silently applies and nothing ever reports the dead knob. The
+registry is ``paddle_tpu/utils/env_flags.py``: one
+``declare(name, default, doc)`` per flag. This pass enforces:
+
+  * every flag-shaped string literal in the walked tree (`PADDLE_[A-Z0-9_]+`
+    — direct env reads, `ENV_X = "PADDLE_X"` constants, helper-wrapped
+    reads, launcher env writes) names a DECLARED flag;
+  * an undeclared name at edit distance 1 from a declared flag is called
+    out as a probable TYPO naming the intended flag;
+  * a declared flag that appears nowhere in the walked tree is flagged (a
+    registry of aspirational knobs rots immediately).
+
+Literal-shape matching (rather than only strict `os.environ` call forms)
+is deliberate: it sees through the repo's `_env_float`/`_env_target`
+helper idiom, and a flag-shaped literal that ISN'T an env name is worth a
+look anyway. The audited escape is `# envflag: ok (<why>)` on the line.
+
+The README "Environment flags" table is generated from the same registry
+(`python -m tools.analyze --env-table`) and staleness-checked by a test.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict
+
+from .core import Finding, FileCtx, RepoCtx, edit_distance_1
+from .registry import Rule, register
+
+REGISTRY_REL = "paddle_tpu/utils/env_flags.py"
+FLAG_RE = re.compile(r"^PADDLE_[A-Z0-9_]+$")
+
+
+def parse_registry(ctx: FileCtx | None) -> dict[str, tuple[int, str, str]]:
+    """{flag: (lineno, default-source, doc)} from declare(...) calls —
+    parsed statically so the analyzer never imports the runtime."""
+    flags: dict[str, tuple[int, str, str]] = {}
+    if ctx is None or ctx.tree is None:
+        return flags
+    for node in ctx.nodes():
+        if isinstance(node, ast.Call) \
+                and (getattr(node.func, "id", None) == "declare"
+                     or getattr(node.func, "attr", None) == "declare") \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+            default = ast.unparse(node.args[1]) if len(node.args) > 1 else ""
+            doc = ""
+            if len(node.args) > 2 and isinstance(node.args[2], ast.Constant):
+                doc = str(node.args[2].value)
+            for kw in node.keywords:
+                if kw.arg == "default":
+                    default = ast.unparse(kw.value)
+                elif kw.arg == "doc" and isinstance(kw.value, ast.Constant):
+                    doc = str(kw.value.value)
+            flags[name] = (node.lineno, default, doc)
+    return flags
+
+
+@register
+class EnvFlagRegistry(Rule):
+    id = "A4"
+    layer = "envflag"
+    title = "env-flag-registry"
+    rationale = ("an undeclared PADDLE_* env flag has no documented "
+                 "default and a typo'd one fails open forever — "
+                 "utils/env_flags.py is the single inventory")
+
+    def __init__(self):
+        self._uses: dict[str, list[tuple[str, int]]] = defaultdict(list)
+
+    def scope(self, rel: str) -> bool:
+        return rel != REGISTRY_REL  # whole walk except the registry itself
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Constant):
+            if isinstance(node.value, str) \
+                    and FLAG_RE.match(node.value) \
+                    and not ctx.marked(getattr(node, "lineno", 0),
+                                       self.layer):
+                self._uses[node.value].append((ctx.rel, node.lineno))
+        return ()
+
+    def finalize(self, repo: RepoCtx):
+        declared = parse_registry(repo.file(REGISTRY_REL))
+        if not declared:
+            if self._uses:
+                flag = sorted(self._uses)[0]
+                rel, lineno = sorted(self._uses[flag])[0]
+                yield Finding(
+                    "A4", REGISTRY_REL, 0,
+                    f"PADDLE_* env flags are used (first: {flag} at "
+                    f"{rel}:{lineno}) but {REGISTRY_REL} declares none")
+            return
+        for flag in sorted(self._uses):
+            if flag in declared:
+                continue
+            rel, lineno = sorted(self._uses[flag])[0]
+            typo_of = [d for d in declared if edit_distance_1(flag, d)]
+            if typo_of:
+                yield Finding(
+                    "A4", rel, lineno,
+                    f"undeclared env flag {flag!r} is edit-distance-1 from "
+                    f"registered {sorted(typo_of)[0]!r} — almost certainly "
+                    "a typo that silently falls back to the default")
+            else:
+                yield Finding(
+                    "A4", rel, lineno,
+                    f"undeclared env flag {flag!r}: declare it in "
+                    f"{REGISTRY_REL} (name, default, one-line doc) so the "
+                    "flag surface stays inventoried, or mark the line "
+                    "'# envflag: ok (<why>)'")
+        used = set(self._uses)
+        for flag, (lineno, _d, _doc) in sorted(declared.items()):
+            if flag not in used:
+                yield Finding(
+                    "A4", REGISTRY_REL, lineno,
+                    f"declared env flag {flag!r} is used nowhere in the "
+                    "walked tree — delete it or wire it up (a registry of "
+                    "dead knobs stops being trusted)")

@@ -1,0 +1,152 @@
+"""A5 lock-discipline: shared state is mutated under the class lock, always.
+
+Scope: paddle_tpu/observability/** and paddle_tpu/inference/serving.py —
+every threaded class in the telemetry plane (admin server thread, exporter
+loop, trigger poller, aggregator scan thread) shares state with the
+step/scheduler thread, and the repo's convention is one `self._lk` /
+`self._lock` guarding it. Two checks per class:
+
+  * A5-split: a `self._<attr>` mutated BOTH inside and outside
+    `with self._lock` blocks in the same class — the classic half-guarded
+    attribute: the locked sites suggest the author knew it was shared, the
+    unlocked one is the race. (`__init__` is construction, not a race, and
+    is exempt.)
+  * A5-rmw: in a class that uses `with self._lock` at all, an UNLOCKED
+    read-modify-write (`self.x += ...`) on any attribute — `+=` on a
+    shared attribute is a lost-update race even when plain stores would be
+    benign, and a lock-using class says concurrency is in play.
+
+Mutation = assignment / augmented assignment / subscript store / a known
+mutator method call (append, pop, update, ...). Lock = a `with` on a self
+attribute whose name contains lock/lk/cv/mutex. Escape: `# locks: ok
+(<why>)` on the line (e.g. an attr only ever touched by one thread by
+construction).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict
+
+from .core import Finding, FileCtx
+from .registry import Rule, register
+
+SCOPE_DIRS = ("paddle_tpu/observability/",)
+SCOPE_FILES = ("paddle_tpu/inference/serving.py",)
+
+_LOCKNAME = re.compile(r"lock|(^|_)lk($|_)|(^|_)cv($|_)|mutex")
+_MUTATORS = frozenset({
+    "append", "extend", "add", "insert", "pop", "popleft", "appendleft",
+    "update", "clear", "remove", "discard", "setdefault",
+})
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_lock_with(node: ast.With) -> bool:
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None and _LOCKNAME.search(attr):
+            return True
+    return False
+
+
+def _mutated_attrs(stmt: ast.AST):
+    """(attr, lineno) for every self-attribute mutation in one statement
+    head (assignment targets / mutator calls), excluding lock attrs."""
+    out = []
+    if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+            if attr is not None and not _LOCKNAME.search(attr):
+                out.append((attr, stmt.lineno))
+    elif isinstance(stmt, ast.Call) \
+            and isinstance(stmt.func, ast.Attribute) \
+            and stmt.func.attr in _MUTATORS:
+        attr = _self_attr(stmt.func.value)
+        if attr is not None and not _LOCKNAME.search(attr):
+            out.append((attr, stmt.lineno))
+    return out
+
+
+@register
+class LockDiscipline(Rule):
+    id = "A5"
+    layer = "locks"
+    title = "lock-discipline"
+    rationale = ("an attribute mutated both inside and outside the class "
+                 "lock, or an unlocked `+=` in a lock-using class, is a "
+                 "data race the GIL only makes intermittent")
+
+    def scope(self, rel: str) -> bool:
+        return rel in SCOPE_FILES \
+            or any(rel.startswith(d) for d in SCOPE_DIRS)
+
+    def check_file(self, ctx: FileCtx):
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef):
+        uses_lock = any(isinstance(n, ast.With) and _is_lock_with(n)
+                        for n in ast.walk(cls))
+        if not uses_lock:
+            return
+        inside: dict[str, list[int]] = defaultdict(list)
+        outside: dict[str, list[int]] = defaultdict(list)
+        rmw: list[tuple[str, int]] = []
+
+        def walk(node, under_lock, in_init):
+            for child in ast.iter_child_nodes(node):
+                under = under_lock
+                if isinstance(child, ast.With) and _is_lock_with(child):
+                    under = True
+                if isinstance(child, ast.ClassDef):
+                    continue  # nested classes audited on their own
+                if not in_init:
+                    for attr, lineno in _mutated_attrs(child):
+                        if ctx.marked(lineno, self.layer):
+                            continue
+                        (inside if under else outside)[attr].append(lineno)
+                        if not under and isinstance(child, ast.AugAssign):
+                            rmw.append((attr, lineno))
+                walk(child, under, in_init)
+
+        for meth in [n for n in cls.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            walk(meth, False, meth.name == "__init__")
+
+        rmw_lines = set()
+        for attr, lineno in sorted(rmw):
+            rmw_lines.add((attr, lineno))
+            yield Finding(
+                "A5", ctx.rel, lineno,
+                f"unlocked read-modify-write `self.{attr} +=` in "
+                f"lock-using class {cls.name}: `+=` is a lost-update race "
+                "— take the class lock around it, or mark "
+                "'# locks: ok (<why>)' if this attr is single-threaded by "
+                "construction")
+        for attr in sorted(set(inside) & set(outside)):
+            if not attr.startswith("_"):
+                continue
+            for lineno in sorted(set(outside[attr])):
+                if (attr, lineno) in rmw_lines:
+                    continue  # already reported as the sharper rmw finding
+                yield Finding(
+                    "A5", ctx.rel, lineno,
+                    f"self.{attr} is mutated under the class lock at line"
+                    f"{'s' if len(inside[attr]) > 1 else ''} "
+                    f"{', '.join(map(str, sorted(set(inside[attr]))))} but "
+                    f"WITHOUT it here in class {cls.name} — the locked "
+                    "sites say it is shared; guard this mutation too, or "
+                    "mark '# locks: ok (<why>)'")

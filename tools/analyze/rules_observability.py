@@ -1,0 +1,218 @@
+"""O1-O4: the observability lints, migrated from tools/lint_observability.py.
+
+Runtime telemetry goes through paddle_tpu.observability — these rules ban
+the pre-PR-2 archipelago of stderr prints, ad-hoc wall-clock math, hand-
+rolled HTTP endpoints, and (O4) request timing in inference/ that bypasses
+the SLO substrate. Semantics unchanged from the standalone lint; the old
+CLI is a shim over this module.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileCtx
+from .registry import Rule, register
+
+LAYER = "observability"
+
+EXEMPT_DIRS = ("paddle_tpu/observability/", "paddle_tpu/profiler/")
+
+# user-facing printers: stdout is their product, not runtime telemetry
+ALLOWLIST = {
+    "paddle_tpu/hapi/callbacks.py":        "ProgBarLogger: the training progress bar",
+    "paddle_tpu/hapi/summary.py":          "model summary tables (paddle.summary parity)",
+    "paddle_tpu/amp/debugging.py":         "user-invoked op-list debug printer",
+    "paddle_tpu/optimizer/lr.py":          "LRScheduler(verbose=True) reference parity",
+    "paddle_tpu/distributed/auto_tuner/__init__.py": "interactive tuning progress report",
+    "paddle_tpu/utils/cpp_extension.py":   "build-tool output",
+    "paddle_tpu/distributed/launch/main.py": "CLI launcher stdout",
+}
+
+# audited request-adjacent timing in inference/ that is NOT SLO ground
+# truth: user-facing profile reports (reference API parity)
+TIMING_ALLOWLIST = {
+    "paddle_tpu/inference/__init__.py":
+        "Predictor/LLMPredictor Config(enable_profile) per-run profile "
+        "report — reference API parity, user-facing, not the SLO substrate",
+}
+
+# the O4 scope: request-serving code, where ad-hoc clocks bypass the
+# request-span/SLO API
+TIMING_SCOPE = "paddle_tpu/inference/"
+
+# audited non-telemetry HTTP: transports the admin/fleet plane builds on,
+# or IO whose payload is data, not runtime telemetry
+HTTP_ALLOWLIST = {
+    "paddle_tpu/distributed/fleet/elastic.py":
+        "KVServer/KVRegistry — the sanctioned registry transport the "
+        "admin/fleet plane mirrors (token-authed, retry-wrapped)",
+    "paddle_tpu/distributed/rpc.py":
+        "rpc worker discovery GET against the elastic registry master",
+    "paddle_tpu/hub.py":
+        "model/file download (paddle.hub parity) — data plane, not telemetry",
+}
+
+
+def _is_print(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print")
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _is_monotonic_clock(node: ast.AST) -> bool:
+    """time.perf_counter() / time.monotonic() — the O4 request-timing ban
+    inside TIMING_SCOPE."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("perf_counter", "monotonic")
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+# transports only: urllib.parse (pure URL string munging) and the rest of
+# urllib/http stay legal — the rule is about wire IO, not URL strings
+_HTTP_MODULES = ("http.server", "urllib.request", "urllib.error")
+_HTTP_NAMES = ("ThreadingHTTPServer", "HTTPServer", "BaseHTTPRequestHandler")
+
+
+def _http_import(node: ast.AST) -> str | None:
+    """The offending module/name when `node` imports an HTTP transport."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            for mod in _HTTP_MODULES:
+                if alias.name == mod or alias.name.startswith(mod + "."):
+                    return alias.name
+    elif isinstance(node, ast.ImportFrom) and node.module:
+        for mod in _HTTP_MODULES:
+            if node.module == mod or node.module.startswith(mod + "."):
+                return node.module
+        if node.module == "http" and any(a.name == "server"
+                                         for a in node.names):
+            return "http.server"
+        if node.module == "urllib" and any(a.name in ("request", "error")
+                                           for a in node.names):
+            return "urllib." + next(a.name for a in node.names
+                                    if a.name in ("request", "error"))
+    return None
+
+
+class _ObservabilityRule(Rule):
+    layer = LAYER
+
+    def scope(self, rel: str) -> bool:
+        return rel.startswith("paddle_tpu/") \
+            and not any(rel.startswith(d) for d in EXEMPT_DIRS)
+
+
+@register
+class BarePrint(_ObservabilityRule):
+    id = "O1"
+    title = "bare-print"
+    rationale = ("runtime events belong in recorder.record(..., echo=True) "
+                 "so they reach FLIGHT.json, not just a lost stderr line")
+
+    def scope(self, rel: str) -> bool:
+        return super().scope(rel) and rel not in ALLOWLIST
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Call):
+            if _is_print(node) and not ctx.marked(node.lineno, LAYER):
+                yield Finding(
+                    "O1", ctx.rel, node.lineno,
+                    "bare print(): route runtime events through "
+                    "observability.recorder.record(..., echo=True), or mark "
+                    "the line '# observability: ok (<why>)' if stdout is "
+                    "the product")
+
+
+@register
+class RawWallTiming(_ObservabilityRule):
+    id = "O2"
+    title = "raw-wall-timing"
+    rationale = ("time.time() subtraction is ad-hoc duration math on the "
+                 "WALL clock — metrics.timer/spans.span own durations")
+
+    def scope(self, rel: str) -> bool:
+        return super().scope(rel) and rel not in ALLOWLIST
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.BinOp):
+            if isinstance(node.op, ast.Sub):
+                if (_is_time_time(node.left) or _is_time_time(node.right)) \
+                        and not ctx.marked(node.lineno, LAYER):
+                    yield Finding(
+                        "O2", ctx.rel, node.lineno,
+                        "raw time.time() duration math: use "
+                        "observability.metrics.timer(name) / "
+                        "spans.span(name) (or time.perf_counter for a "
+                        "monotonic clock), or mark "
+                        "'# observability: ok (<why>)'")
+
+
+@register
+class AdHocHttp(_ObservabilityRule):
+    id = "O3"
+    title = "ad-hoc-http"
+    rationale = ("a hand-rolled HTTP endpoint splits the observability "
+                 "plane — AdminServer serves, TelemetryClient pushes; "
+                 "audited non-telemetry HTTP lives in HTTP_ALLOWLIST")
+
+    def scope(self, rel: str) -> bool:
+        return super().scope(rel) and rel not in HTTP_ALLOWLIST
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Import, ast.ImportFrom, ast.Name):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                offender = _http_import(node)
+                if offender is not None \
+                        and not ctx.marked(node.lineno, LAYER):
+                    yield Finding(
+                        "O3", ctx.rel, node.lineno,
+                        f"ad-hoc HTTP transport ({offender}): serve live "
+                        "telemetry through observability.admin.AdminServer "
+                        "and push through observability.fleet."
+                        "TelemetryClient; audited non-telemetry HTTP "
+                        "belongs in HTTP_ALLOWLIST (or mark the line "
+                        "'# observability: ok (<why>)')")
+            elif isinstance(node, ast.Name) and node.id in _HTTP_NAMES \
+                    and not ctx.marked(node.lineno, LAYER):
+                yield Finding(
+                    "O3", ctx.rel, node.lineno,
+                    f"ad-hoc HTTP server ({node.id}): extend "
+                    "observability.admin.AdminServer instead (or mark "
+                    "'# observability: ok (<why>)')")
+
+
+@register
+class AdHocRequestTiming(_ObservabilityRule):
+    id = "O4"
+    title = "ad-hoc-request-timing"
+    rationale = ("perf_counter/monotonic in inference/ drifts latency math "
+                 "away from the TTFT/TPOT/e2e histograms the SLO policy "
+                 "evaluates — slo.now()/RequestTracker are the clock")
+
+    def scope(self, rel: str) -> bool:
+        return super().scope(rel) and rel.startswith(TIMING_SCOPE) \
+            and rel not in TIMING_ALLOWLIST
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Call):
+            if _is_monotonic_clock(node) and not ctx.marked(node.lineno,
+                                                            LAYER):
+                yield Finding(
+                    "O4", ctx.rel, node.lineno,
+                    "ad-hoc request timing in inference/: route request "
+                    "latency through observability.slo (slo.now() / "
+                    "RequestTracker) or metrics.timer(name) so it feeds "
+                    "the TTFT/TPOT/e2e histograms the SLO policy "
+                    "evaluates; audited user-facing profiling belongs in "
+                    "TIMING_ALLOWLIST (or mark "
+                    "'# observability: ok (<why>)')")

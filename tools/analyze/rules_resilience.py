@@ -1,0 +1,150 @@
+"""R1-R3: the resilience lints, migrated from tools/lint_resilience.py.
+
+The resilience layer (paddle_tpu/distributed/resilience/) owns backoff,
+deadlines, and error classification; these rules keep the rest of the tree
+from regrowing ad-hoc sleep-retry loops and unwatched collective waits.
+Semantics are unchanged from the standalone lint — the old CLI is now a
+shim over this module and its tests pass against it byte-for-byte.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileCtx
+from .registry import Rule, register
+
+LAYER = "resilience"
+EXEMPT = "paddle_tpu/distributed/resilience/"
+
+
+def _is_time_sleep(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "sleep"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _is_path_exists(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "exists"
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "path")
+
+
+def _loop_findings(loop: ast.AST, ctx: FileCtx):
+    """(rule, lineno, message) for one while/for loop body — R1/R2."""
+    sleeps, tries, exists = [], [], []
+    for sub in ast.walk(loop):
+        if sub is loop:
+            continue
+        if isinstance(sub, (ast.While, ast.For, ast.FunctionDef,
+                            ast.AsyncFunctionDef)):
+            # nested loops/functions are visited on their own
+            continue
+        if _is_time_sleep(sub):
+            sleeps.append(sub)
+        elif isinstance(sub, ast.Try):
+            tries.append(sub)
+        elif _is_path_exists(sub):
+            exists.append(sub)
+    if not sleeps:
+        return
+    if any(ctx.marked(s.lineno, LAYER) for s in sleeps):
+        return
+    if tries:
+        yield ("R1", sleeps[0].lineno,
+               "bare retry loop (sleep + try/except): route through "
+               "distributed.resilience.retry.retry_call, or mark the line "
+               "'# resilience: ok (<why>)' after auditing its deadline")
+    elif exists:
+        # polling os.path.exists is the checkpoint-barrier smell
+        yield ("R2", sleeps[0].lineno,
+               "bare file-poll loop (os.path.exists + sleep): use "
+               "distributed.resilience.retry.wait_for for a backoff "
+               "poll with a named deadline error")
+
+
+class _ResilienceRule(Rule):
+    layer = LAYER
+
+    def scope(self, rel: str) -> bool:
+        return rel.startswith("paddle_tpu/") and EXEMPT not in rel
+
+
+@register
+class BareRetryLoop(_ResilienceRule):
+    id = "R1"
+    title = "bare-retry-loop"
+    rationale = ("a while/for body with both time.sleep and try/except is a "
+                 "sleep-until-it-works loop with no deadline or "
+                 "classification — retry.retry_call owns that")
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.While, ast.For):
+            for rule, lineno, msg in _loop_findings(node, ctx):
+                if rule == "R1":
+                    yield Finding(rule, ctx.rel, lineno, msg)
+
+
+@register
+class BarePollLoop(_ResilienceRule):
+    id = "R2"
+    title = "bare-poll-loop"
+    rationale = ("an os.path.exists+sleep poll has no named deadline error "
+                 "— retry.wait_for raises one the recovery layers catch")
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.While, ast.For):
+            for rule, lineno, msg in _loop_findings(node, ctx):
+                if rule == "R2":
+                    yield Finding(rule, ctx.rel, lineno, msg)
+
+
+def _is_watch_call(expr: ast.AST) -> bool:
+    f = getattr(expr, "func", None)
+    name = getattr(f, "id", None) or getattr(f, "attr", None)
+    return name == "watch"
+
+
+@register
+class BareBlockingCollectiveWait(_ResilienceRule):
+    id = "R3"
+    title = "bare-blocking-collective-wait"
+    rationale = ("block_until_ready outside `with watch(...)` in "
+                 "distributed/** bypasses the watchdog AND the elastic "
+                 "deadline layer — one lost peer wedges it forever")
+
+    def scope(self, rel: str) -> bool:
+        return super().scope(rel) and "/distributed/" in "/" + rel
+
+    def check_file(self, ctx: FileCtx):
+        parents: dict = {}
+        for node in ctx.nodes():
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ctx.nodes_of(ast.Call):
+            # both spellings: jax.block_until_ready(x) and the from-import
+            # bare-name call block_until_ready(x)
+            fname = getattr(node.func, "attr", None) \
+                or getattr(node.func, "id", None)
+            if fname != "block_until_ready":
+                continue
+            if ctx.marked(node.lineno, LAYER):
+                continue
+            cur = parents.get(node)
+            watched = False
+            while cur is not None and not watched:
+                if isinstance(cur, ast.With):
+                    watched = any(_is_watch_call(item.context_expr)
+                                  for item in cur.items)
+                cur = parents.get(cur)
+            if not watched:
+                yield Finding(
+                    "R3", ctx.rel, node.lineno,
+                    "bare blocking collective wait (block_until_ready "
+                    "outside `with watch(...)`): route through "
+                    "comm_watchdog.watch + collective._finish_wait so a "
+                    "lost peer raises a named deadline the elastic layer "
+                    "recovers from, or mark '# resilience: ok (<why>)'")

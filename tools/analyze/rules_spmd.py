@@ -1,0 +1,100 @@
+"""A1 spmd-divergent-collective: rank-guarded collectives are deadlocks.
+
+Under SPMD (GSPMD, PAPERS.md) every rank must issue the SAME collectives in
+the SAME order — a collective or barrier lexically guarded by a
+rank/process-index conditional runs on a subset of ranks, and the others
+wait forever at the next matching collective. That is exactly the bug class
+that would wedge the PR-4 re-rendezvous fleet mid-reform, and the MPMD
+pipeline direction multiplies the opportunities (per-stage dispatch means
+more rank-conditional code next to collective calls).
+
+Point-to-point send/recv are deliberately NOT in the collective set —
+rank-guarded p2p is how pipelines work. The audited escape hatch is
+`# spmd: ok (<why>)` on the collective call line (e.g. a collective over a
+sub-group whose membership is exactly the guard).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileCtx, call_name, names_in
+from .registry import Rule, register
+
+# collective/barrier entry points: the repo's collective API plus the jax
+# spellings that reach it. Every one of these is a group-wide rendezvous.
+COLLECTIVE_CALLS = frozenset({
+    "all_reduce", "allreduce", "all_gather", "allgather",
+    "all_gather_object", "all_gather_into_tensor", "all_to_all",
+    "all_to_all_single", "alltoall", "reduce_scatter", "broadcast",
+    "barrier", "psum", "pmean", "pmax", "pmin", "ppermute", "pgather",
+})
+
+# identifiers that make an `if` test a rank condition
+RANKISH = frozenset({
+    "rank", "local_rank", "global_rank", "node_rank", "rank_id",
+    "process_index", "get_rank", "trainer_id", "coordinator_rank",
+    "is_first_rank", "is_first_worker", "is_main_process", "src_rank",
+})
+
+
+def _is_rank_test(test: ast.AST) -> bool:
+    return bool(names_in(test) & RANKISH)
+
+
+@register
+class SpmdDivergentCollective(Rule):
+    id = "A1"
+    layer = "spmd"
+    title = "spmd-divergent-collective"
+    rationale = ("a collective inside `if rank == 0:` runs on a subset of "
+                 "ranks — under SPMD the rest deadlock at the next "
+                 "matching collective (GSPMD invariant)")
+
+    def scope(self, rel: str) -> bool:
+        return rel.startswith("paddle_tpu/distributed/")
+
+    def check_file(self, ctx: FileCtx):
+        parents: dict = {}
+        for node in ctx.nodes():
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ctx.nodes_of(ast.Call):
+            fname = call_name(node)
+            if fname not in COLLECTIVE_CALLS:
+                continue
+            if ctx.marked(node.lineno, self.layer):
+                continue
+            guard = self._rank_guard(node, parents)
+            if guard is not None:
+                cond = ast.unparse(guard).strip()
+                if len(cond) > 60:
+                    cond = cond[:57] + "..."
+                yield Finding(
+                    "A1", ctx.rel, node.lineno,
+                    f"collective `{fname}(...)` guarded by rank "
+                    f"conditional `{cond}`: under SPMD every rank must "
+                    "issue the same collectives in the same order — a "
+                    "rank-subset collective deadlocks the others; hoist "
+                    "the call out of the guard (compute on one rank AFTER "
+                    "the collective instead), use point-to-point "
+                    "send/recv, or mark '# spmd: ok (<why>)' for an "
+                    "audited sub-group collective")
+
+    @staticmethod
+    def _rank_guard(node: ast.AST, parents: dict) -> ast.AST | None:
+        """The innermost enclosing rank-conditional test, if any. Only
+        branches whose EXECUTION depends on the test count — a collective
+        in an `if`'s test expression runs on every rank."""
+        prev, cur = node, parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.If) and _is_rank_test(cur.test):
+                if prev in cur.body or prev in cur.orelse:
+                    return cur.test
+            elif isinstance(cur, ast.IfExp) and _is_rank_test(cur.test):
+                if prev is cur.body or prev is cur.orelse:
+                    return cur.test
+            elif isinstance(cur, ast.While) and _is_rank_test(cur.test):
+                if prev in cur.body:
+                    return cur.test
+            prev, cur = cur, parents.get(cur)
+        return None

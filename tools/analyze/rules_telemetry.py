@@ -1,0 +1,164 @@
+"""A3 telemetry-name-registry: one namespace, no colliding series.
+
+Metric and span names are string literals scattered across eight
+observability call sites; nothing stopped "x" being a counter in one file
+and a gauge in another (the exporter would emit one `# TYPE` line and the
+other series would be rejected or silently mistyped by strict ingesters),
+or a name shadowing the `_bucket`/`_sum`/`_count` exposition series a
+histogram fans out into. This pass collects every name literal and flags:
+
+  * the same name used with CONFLICTING instrument types
+    (counter/gauge/histogram — `metrics.timer(name)` is a histogram);
+  * two distinct names that collide case-insensitively (one of them is a
+    typo, and case-folding ingesters merge them);
+  * two distinct names that render to the SAME Prometheus exposition name
+    (the sanitizer maps every non-alphanumeric to '_': "a.b" == "a_b");
+  * a metric whose exposition name equals another HISTOGRAM's
+    `_bucket`/`_sum`/`_count` series — scrape-time shadowing.
+
+Declarations count too: the `_STANDARD_COUNTERS`/`_GAUGES`/`_HISTOGRAMS`
+tuples in observability/metrics.py pre-register names and are parsed as
+typed uses. Span names live in their own namespace (spans never reach the
+exposition) and are only checked for case collisions among themselves.
+"""
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from .core import Finding, FileCtx, RepoCtx, prom_name
+from .registry import Rule, register
+
+METRICS_REL = "paddle_tpu/observability/metrics.py"
+
+_METRIC_CALLS = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram", "timer": "histogram"}
+_SPAN_CALLS = {"span", "traced", "add_span"}
+_STANDARD_VARS = {"_STANDARD_COUNTERS": "counter",
+                  "_STANDARD_GAUGES": "gauge",
+                  "_STANDARD_HISTOGRAMS": "histogram"}
+_EXPO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@register
+class TelemetryNameRegistry(Rule):
+    id = "A3"
+    layer = "telemetry"
+    title = "telemetry-name-registry"
+    rationale = ("a name used as two instrument types, or colliding with "
+                 "another series after exposition sanitization "
+                 "(case-folds, '.'->'_', histogram _bucket/_sum/_count "
+                 "fan-out), corrupts the scraped timeseries")
+
+    def __init__(self):
+        # kind -> name -> [(rel, lineno)]
+        self._metrics: dict[str, dict[str, list]] = defaultdict(
+            lambda: defaultdict(list))
+        self._spans: dict[str, list] = defaultdict(list)
+
+    def scope(self, rel: str) -> bool:
+        return rel.startswith("paddle_tpu/")
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Call):
+            fname = getattr(node.func, "attr", None) \
+                or getattr(node.func, "id", None)
+            if fname in _METRIC_CALLS and node.args \
+                    and ctx.rel != METRICS_REL:
+                name = ctx.resolve_str_arg(node.args[0])
+                if name is not None \
+                        and not ctx.marked(node.lineno, self.layer):
+                    self._metrics[_METRIC_CALLS[fname]][name].append(
+                        (ctx.rel, node.lineno))
+            elif fname in _SPAN_CALLS and node.args:
+                name = ctx.resolve_str_arg(node.args[0])
+                if name is not None \
+                        and not ctx.marked(node.lineno, self.layer):
+                    self._spans[name].append((ctx.rel, node.lineno))
+        if ctx.rel == METRICS_REL:
+            for node in ctx.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    for t in node.targets:
+                        kind = _STANDARD_VARS.get(getattr(t, "id", ""))
+                        if kind is None:
+                            continue
+                        for elt in node.value.elts:
+                            if isinstance(elt, ast.Constant) \
+                                    and isinstance(elt.value, str):
+                                self._metrics[kind][elt.value].append(
+                                    (ctx.rel, elt.lineno))
+        return ()
+
+    def finalize(self, repo: RepoCtx):
+        # name -> {kind: [(rel, lineno)]}
+        by_name: dict[str, dict[str, list]] = defaultdict(dict)
+        for kind, names in self._metrics.items():
+            for name, sites in names.items():
+                by_name[name][kind] = sites
+
+        def first_site(name):
+            kinds = by_name[name]
+            return sorted(s for sites in kinds.values() for s in sites)[0]
+
+        # 1. conflicting instrument types
+        for name in sorted(by_name):
+            kinds = by_name[name]
+            if len(kinds) > 1:
+                where = "; ".join(
+                    f"{k} at {sorted(v)[0][0]}:{sorted(v)[0][1]}"
+                    for k, v in sorted(kinds.items()))
+                rel, lineno = first_site(name)
+                yield Finding(
+                    "A3", rel, lineno,
+                    f"metric {name!r} used with conflicting instrument "
+                    f"types ({where}): one name, one type — strict "
+                    "ingesters reject or silently mistype the second "
+                    "series")
+
+        # 2. case-insensitive collisions (metrics, then spans)
+        for namespace, label in ((by_name, "metric"),
+                                 ({n: {"span": s} for n, s
+                                   in self._spans.items()}, "span")):
+            folded: dict[str, list[str]] = defaultdict(list)
+            for name in namespace:
+                folded[name.lower()].append(name)
+            for variants in folded.values():
+                if len(variants) > 1:
+                    variants = sorted(variants)
+                    sites = sorted(
+                        s for n in variants
+                        for sites in namespace[n].values() for s in sites)
+                    rel, lineno = sites[0]
+                    yield Finding(
+                        "A3", rel, lineno,
+                        f"{label} names {variants} collide "
+                        "case-insensitively: one is a typo, and "
+                        "case-folding backends merge them")
+
+        # 3. exposition-name collisions + histogram series shadowing
+        expo: dict[str, list[str]] = defaultdict(list)
+        for name in by_name:
+            expo[prom_name(name)].append(name)
+        for variants in expo.values():
+            if len(variants) > 1:
+                variants = sorted(variants)
+                rel, lineno = first_site(variants[0])
+                yield Finding(
+                    "A3", rel, lineno,
+                    f"metric names {variants} render to the same "
+                    f"Prometheus exposition name {prom_name(variants[0])!r}"
+                    " — the scraped series are indistinguishable")
+        hist_names = set(self._metrics.get("histogram", ()))
+        for hist in sorted(hist_names):
+            base = prom_name(hist)
+            for suffix in _EXPO_SUFFIXES:
+                shadowed = expo.get(base + suffix)
+                if shadowed:
+                    rel, lineno = first_site(sorted(shadowed)[0])
+                    yield Finding(
+                        "A3", rel, lineno,
+                        f"metric {sorted(shadowed)[0]!r} shadows histogram "
+                        f"{hist!r}'s exposition series "
+                        f"{base + suffix!r} — scrapers cannot tell them "
+                        "apart")

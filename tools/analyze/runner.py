@@ -1,0 +1,98 @@
+"""The analysis loop: walk once, parse once, run every selected rule."""
+from __future__ import annotations
+
+import os
+import subprocess
+
+from .core import Finding, RepoCtx, walk_repo
+from .registry import Rule, get_rules
+
+
+def run(root: str, rule_ids=None, files=None) -> list[Finding]:
+    """Run the selected rules over `root` (a repo tree or a fixture tree
+    containing paddle_tpu/). `files`: optional explicit repo-relative file
+    list (the --changed mode) — PER-FILE checks are restricted to it, but
+    rules with a cross-file finalize pass (registries, name tables) still
+    visit the whole tree: their invariants are global, and feeding them a
+    subset would fabricate 'unused'/'unregistered' findings. Returns
+    findings sorted by (path, line, rule)."""
+    root = os.path.abspath(root)
+    rules = get_rules(rule_ids)
+    repo = RepoCtx(root)
+    findings: list[Finding] = []
+    seen_syntax: set[str] = set()
+
+    def visit(rels, active_rules):
+        for rel in rels:
+            try:
+                ctx = repo.file(rel)
+            except OSError:
+                continue
+            if ctx is None:
+                continue
+            in_scope = [r for r in active_rules if r.scope(rel)]
+            if not in_scope:
+                continue
+            if ctx.tree is None:
+                if rel not in seen_syntax:
+                    seen_syntax.add(rel)
+                    e = ctx.syntax_error
+                    findings.append(Finding("SYNTAX", rel, e.lineno or 0,
+                                            f"unparseable: {e.msg}"))
+                continue
+            for r in in_scope:
+                findings.extend(r.check_file(ctx))
+
+    if files is None:
+        visit(walk_repo(root), rules)
+    else:
+        changed = sorted(set(files))
+        visit(changed, rules)
+        cross = [r for r in rules
+                 if type(r).finalize is not Rule.finalize]
+        if cross:
+            rest = [rel for rel in walk_repo(root) if rel not in set(changed)]
+            visit(rest, cross)
+    for r in rules:
+        findings.extend(r.finalize(repo))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def changed_files(root: str) -> list[str]:
+    """Repo-relative .py files touched vs HEAD (staged, unstaged, and
+    untracked) — the fast pre-commit scope."""
+    out: set[str] = set()
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=30)
+        status = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    for line in diff.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            out.add(line)
+    for line in status.stdout.splitlines():
+        if len(line) > 3 and line[:2] in ("??", "A ", "AM", " M", "M ", "MM"):
+            p = line[3:].strip()
+            if p.endswith(".py"):
+                out.add(p)
+    walked = set(walk_repo(root))
+    return sorted(out & walked)
+
+
+def code_line(root: str, finding: Finding) -> str:
+    """The stripped source line a finding anchors to (baseline keying)."""
+    try:
+        path = os.path.join(root, *finding.path.split("/"))
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        if 0 < finding.line <= len(lines):
+            return " ".join(lines[finding.line - 1].split())
+    except OSError:
+        pass
+    return ""

@@ -1,235 +1,42 @@
 #!/usr/bin/env python
 """Static check: runtime telemetry goes through paddle_tpu.observability.
 
-PR 2 unified telemetry into one layer (spans / metrics / flight recorder).
-This lint keeps the tree from regrowing the pre-PR-2 archipelago of stderr
-prints and ad-hoc ``time.time()`` deltas — the pattern that made chaos and
-preemption runs un-postmortem-able.
-
-Flagged (AST-based):
-  O1 bare-print      : a ``print(...)`` call in paddle_tpu/. Runtime events
-     belong in ``observability.recorder.record(..., echo=True)`` (the
-     recorder still writes the stderr line AND keeps it for FLIGHT.json).
-  O2 raw-wall-timing : a ``time.time() - x`` / ``x - time.time()``
-     subtraction — ad-hoc duration math on the WALL clock. Durations belong
-     in ``metrics.timer(name)`` / ``spans.span(name)``; wall-clock reads
-     without subtraction (timestamps, deadlines via addition/comparison)
-     are fine.
-  O3 ad-hoc-http      : ``http.server`` (ThreadingHTTPServer & co.) or
-     ``urllib`` use outside the sanctioned transports. Live telemetry is
-     served by ``observability.admin.AdminServer`` and pushed by
-     ``observability.fleet.TelemetryClient`` — a new hand-rolled endpoint
-     splits the observability plane again. Audited non-telemetry HTTP
-     (elastic KV registry, rpc discovery, hub downloads) lives in
-     HTTP_ALLOWLIST with a recorded reason.
-  O4 ad-hoc-request-timing : a ``time.perf_counter()`` / ``time.monotonic()``
-     call inside ``paddle_tpu/inference/``. Request latency there is the
-     SLO substrate's ground truth — timing math that bypasses
-     ``observability.slo`` (``slo.now()`` / ``RequestTracker``) or
-     ``metrics.timer`` drifts away from the TTFT/TPOT/e2e histograms the
-     SLO policy evaluates and the exporter ships. Audited user-facing
-     profiling lives in TIMING_ALLOWLIST with a recorded reason.
-
-Exemptions:
-  * paddle_tpu/observability/ and paddle_tpu/profiler/ (they ARE the layer)
-  * files in ALLOWLIST (O1/O2) — interactive/user-facing printers whose
-    stdout IS the product (model summaries, CLI launchers, build tools) —
-    and HTTP_ALLOWLIST (O3), each with a recorded reason
-  * a line carrying ``# observability: ok (<why>)`` — an audited use (e.g.
-    a wall-clock liveness TTL that looks like timing math). The why is
-    mandatory: a bare marker is itself a finding.
+SHIM — the rules (O1 bare-print, O2 raw-wall-timing, O3 ad-hoc-http, O4
+ad-hoc-request-timing) now live in the unified static-analysis framework
+as plugins (tools/analyze/rules_observability.py — the allowlists with
+their recorded reasons moved there too; run everything with
+`python -m tools.analyze`). This entry point keeps the original CLI
+contract byte-for-byte — same walk scope, same `path:line: [RULE] msg`
+lines, same stderr count, same exit code — so the pre-existing lint tests
+and any muscle memory keep working.
 
 Run: python tools/lint_observability.py [root]   (exit 1 on findings)
-Wired into tier-1 via tests/test_observability.py::TestLint.
+Wired into tier-1 via tests/test_observability.py::TestObservabilityLint.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-EXEMPT_DIRS = (
-    os.path.join("paddle_tpu", "observability"),
-    os.path.join("paddle_tpu", "profiler"),
-)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# user-facing printers: stdout is their product, not runtime telemetry
-ALLOWLIST = {
-    "paddle_tpu/hapi/callbacks.py":        "ProgBarLogger: the training progress bar",
-    "paddle_tpu/hapi/summary.py":          "model summary tables (paddle.summary parity)",
-    "paddle_tpu/amp/debugging.py":         "user-invoked op-list debug printer",
-    "paddle_tpu/optimizer/lr.py":          "LRScheduler(verbose=True) reference parity",
-    "paddle_tpu/distributed/auto_tuner/__init__.py": "interactive tuning progress report",
-    "paddle_tpu/utils/cpp_extension.py":   "build-tool output",
-    "paddle_tpu/distributed/launch/main.py": "CLI launcher stdout",
-}
+from tools.analyze import run  # noqa: E402
 
-# audited request-adjacent timing in inference/ that is NOT SLO ground
-# truth: user-facing profile reports (reference API parity)
-TIMING_ALLOWLIST = {
-    "paddle_tpu/inference/__init__.py":
-        "Predictor/LLMPredictor Config(enable_profile) per-run profile "
-        "report — reference API parity, user-facing, not the SLO substrate",
-}
-
-# the O4 scope: request-serving code, where ad-hoc clocks bypass the
-# request-span/SLO API
-TIMING_SCOPE = "paddle_tpu/inference/"
-
-# audited non-telemetry HTTP: transports the admin/fleet plane builds on,
-# or IO whose payload is data, not runtime telemetry
-HTTP_ALLOWLIST = {
-    "paddle_tpu/distributed/fleet/elastic.py":
-        "KVServer/KVRegistry — the sanctioned registry transport the "
-        "admin/fleet plane mirrors (token-authed, retry-wrapped)",
-    "paddle_tpu/distributed/rpc.py":
-        "rpc worker discovery GET against the elastic registry master",
-    "paddle_tpu/hub.py":
-        "model/file download (paddle.hub parity) — data plane, not telemetry",
-}
-
-MARKER = "# observability: ok ("
-
-
-def _is_print(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print")
-
-
-def _is_time_time(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "time"
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id == "time")
-
-
-def _is_monotonic_clock(node: ast.AST) -> bool:
-    """time.perf_counter() / time.monotonic() — the O4 request-timing ban
-    inside TIMING_SCOPE."""
-    return (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("perf_counter", "monotonic")
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id == "time")
-
-
-# transports only: urllib.parse (pure URL string munging) and the rest of
-# urllib/http stay legal — the rule is about wire IO, not URL strings
-_HTTP_MODULES = ("http.server", "urllib.request", "urllib.error")
-_HTTP_NAMES = ("ThreadingHTTPServer", "HTTPServer", "BaseHTTPRequestHandler")
-
-
-def _http_import(node: ast.AST) -> str | None:
-    """The offending module/name when `node` imports an HTTP transport."""
-    if isinstance(node, ast.Import):
-        for alias in node.names:
-            for mod in _HTTP_MODULES:
-                if alias.name == mod or alias.name.startswith(mod + "."):
-                    return alias.name
-    elif isinstance(node, ast.ImportFrom) and node.module:
-        for mod in _HTTP_MODULES:
-            if node.module == mod or node.module.startswith(mod + "."):
-                return node.module
-        if node.module == "http" and any(a.name == "server"
-                                         for a in node.names):
-            return "http.server"
-        if node.module == "urllib" and any(a.name in ("request", "error")
-                                           for a in node.names):
-            return "urllib." + next(a.name for a in node.names
-                                    if a.name in ("request", "error"))
-    return None
-
-
-def lint_file(path: str, relpath: str | None = None):
-    """relpath (repo-relative, / separators) selects per-rule allowlists;
-    None applies every rule."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        yield ("SYNTAX", e.lineno or 0, f"unparseable: {e.msg}")
-        return
-    lines = src.splitlines()
-    check_print = relpath not in ALLOWLIST
-    check_http = relpath not in HTTP_ALLOWLIST
-    check_timing = (relpath is None or relpath.startswith(TIMING_SCOPE)) \
-        and relpath not in TIMING_ALLOWLIST
-
-    def marked(lineno: int) -> bool:
-        return lineno - 1 < len(lines) and MARKER in lines[lineno - 1]
-
-    for node in ast.walk(tree):
-        if check_print and _is_print(node) and not marked(node.lineno):
-            yield ("O1", node.lineno,
-                   "bare print(): route runtime events through "
-                   "observability.recorder.record(..., echo=True), or mark "
-                   "the line '# observability: ok (<why>)' if stdout is the "
-                   "product")
-        elif check_print and isinstance(node, ast.BinOp) \
-                and isinstance(node.op, ast.Sub):
-            if (_is_time_time(node.left) or _is_time_time(node.right)) \
-                    and not marked(node.lineno):
-                yield ("O2", node.lineno,
-                       "raw time.time() duration math: use "
-                       "observability.metrics.timer(name) / spans.span(name) "
-                       "(or time.perf_counter for a monotonic clock), or "
-                       "mark '# observability: ok (<why>)'")
-        elif check_timing and _is_monotonic_clock(node) \
-                and not marked(node.lineno):
-            yield ("O4", node.lineno,
-                   "ad-hoc request timing in inference/: route request "
-                   "latency through observability.slo (slo.now() / "
-                   "RequestTracker) or metrics.timer(name) so it feeds the "
-                   "TTFT/TPOT/e2e histograms the SLO policy evaluates; "
-                   "audited user-facing profiling belongs in "
-                   "TIMING_ALLOWLIST (or mark "
-                   "'# observability: ok (<why>)')")
-        elif check_http and not marked(getattr(node, "lineno", 0)):
-            offender = _http_import(node)
-            if offender is not None:
-                yield ("O3", node.lineno,
-                       f"ad-hoc HTTP transport ({offender}): serve live "
-                       "telemetry through observability.admin.AdminServer "
-                       "and push through observability.fleet."
-                       "TelemetryClient; audited non-telemetry HTTP belongs "
-                       "in HTTP_ALLOWLIST (or mark the line "
-                       "'# observability: ok (<why>)')")
-            elif isinstance(node, ast.Name) and node.id in _HTTP_NAMES:
-                yield ("O3", node.lineno,
-                       f"ad-hoc HTTP server ({node.id}): extend "
-                       "observability.admin.AdminServer instead (or mark "
-                       "'# observability: ok (<why>)')")
-
-
-def iter_py_files(root: str):
-    pkg = os.path.join(root, "paddle_tpu")
-    for base, dirs, files in os.walk(pkg):
-        rel_base = os.path.relpath(base, root)
-        if any(rel_base == d or rel_base.startswith(d + os.sep)
-               for d in EXEMPT_DIRS):
-            continue
-        for fn in files:
-            if fn.endswith(".py"):
-                yield os.path.join(base, fn)
+RULES = ("O1", "O2", "O3", "O4")
+_LABEL = "observability"
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    root = args[0] if args else \
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = []
-    for path in sorted(iter_py_files(root)):
-        rel = os.path.relpath(path, root).replace(os.sep, "/")
-        for rule, lineno, msg in lint_file(path, rel):
-            findings.append((os.path.relpath(path, root), lineno, rule, msg))
-    for path, lineno, rule, msg in findings:
-        print(f"{path}:{lineno}: [{rule}] {msg}")
+    root = args[0] if args else _REPO
+    findings = run(root, rule_ids=RULES)
+    for f in findings:
+        print(f"{f.path.replace('/', os.sep)}:{f.line}: [{f.rule}] "
+              f"{f.message}")
     if findings:
-        print(f"\n{len(findings)} observability-lint finding(s)",
-              file=sys.stderr)
+        print(f"\n{len(findings)} {_LABEL}-lint finding(s)", file=sys.stderr)
         return 1
     return 0
 

@@ -1,174 +1,41 @@
 #!/usr/bin/env python
 """Static check: no bare retry/poll loops outside the resilience module.
 
-The resilience layer (paddle_tpu/distributed/resilience/) owns backoff,
-deadlines, and error classification. This lint keeps the rest of the tree
-from growing new ad-hoc `time.sleep` retry loops — the pattern that made
-pre-r6 fault handling an archipelago of islands (ISSUE 1).
-
-Flagged (per function, AST-based):
-  R1 bare-retry-loop : a while/for loop whose body contains BOTH a
-     `time.sleep(...)` call AND a try/except — the classic
-     sleep-until-it-works loop. Use resilience.retry.retry_call.
-  R2 bare-poll-loop  : a while loop that polls `os.path.exists` and sleeps —
-     a filesystem wait with no named deadline error. Use
-     resilience.retry.wait_for.
-  R3 bare-blocking-collective-wait : in paddle_tpu/distributed/**, a
-     `block_until_ready(...)` call that is not lexically inside a
-     `with watch(...)` block — a collective/rendezvous wait that bypasses
-     both the comm watchdog AND the elastic deadline layer. One lost peer
-     would wedge it forever (or exit 124) instead of raising the named
-     DeadlineExceeded the re-rendezvous path recovers from. Route through
-     comm_watchdog.watch + collective._finish_wait.
-
-Exemptions:
-  * anything under paddle_tpu/distributed/resilience/ (it IS the layer)
-  * a line carrying the marker comment `# resilience: ok (<why>)` — an
-    audited loop that manages its own deadline and named error. The why is
-    mandatory: a bare marker is itself a finding.
+SHIM — the rules (R1 bare-retry-loop, R2 bare-poll-loop, R3
+bare-blocking-collective-wait) now live in the unified static-analysis
+framework as plugins (tools/analyze/rules_resilience.py; run everything
+with `python -m tools.analyze`). This entry point keeps the original CLI
+contract byte-for-byte — same walk scope, same `path:line: [RULE] msg`
+lines, same stderr count, same exit code — so the pre-existing lint tests
+and any muscle memory keep working.
 
 Run: python tools/lint_resilience.py [root]   (exit 1 on findings)
-Wired into tier-1 via tests/test_resilience.py::test_lint_resilience_clean.
+Wired into tier-1 via tests/test_resilience.py::TestResilienceLint.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-EXEMPT_DIRS = (os.path.join("distributed", "resilience"),)
-MARKER = "# resilience: ok ("
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
+from tools.analyze import run  # noqa: E402
 
-def _is_time_sleep(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "sleep"
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id == "time")
-
-
-def _is_path_exists(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "exists"
-            and isinstance(node.func.value, ast.Attribute)
-            and node.func.value.attr == "path")
-
-
-def _loop_findings(loop: ast.AST, lines: list[str]):
-    """Yield (rule, lineno, message) for one while/for loop body."""
-    sleeps, tries, exists = [], [], []
-    for sub in ast.walk(loop):
-        if sub is loop:
-            continue
-        if isinstance(sub, (ast.While, ast.For, ast.FunctionDef,
-                            ast.AsyncFunctionDef)):
-            # nested loops/functions are visited on their own
-            continue
-        if _is_time_sleep(sub):
-            sleeps.append(sub)
-        elif isinstance(sub, ast.Try):
-            tries.append(sub)
-        elif _is_path_exists(sub):
-            exists.append(sub)
-    if not sleeps:
-        return
-    marked = any(MARKER in lines[s.lineno - 1] for s in sleeps
-                 if s.lineno - 1 < len(lines))
-    if marked:
-        return
-    if tries:
-        yield ("R1", sleeps[0].lineno,
-               "bare retry loop (sleep + try/except): route through "
-               "distributed.resilience.retry.retry_call, or mark the line "
-               "'# resilience: ok (<why>)' after auditing its deadline")
-    elif exists:
-        # polling os.path.exists is the checkpoint-barrier smell
-        yield ("R2", sleeps[0].lineno,
-               "bare file-poll loop (os.path.exists + sleep): use "
-               "distributed.resilience.retry.wait_for for a backoff "
-               "poll with a named deadline error")
-
-
-def _is_watch_call(expr: ast.AST) -> bool:
-    f = getattr(expr, "func", None)
-    name = getattr(f, "id", None) or getattr(f, "attr", None)
-    return name == "watch"
-
-
-def _blocking_wait_findings(tree: ast.AST, lines: list[str]):
-    """R3: block_until_ready outside a `with watch(...)` (elastic paths)."""
-    parents: dict = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        # both spellings: jax.block_until_ready(x) and the from-import
-        # bare-name call block_until_ready(x)
-        fname = getattr(node.func, "attr", None) \
-            or getattr(node.func, "id", None)
-        if fname != "block_until_ready":
-            continue
-        if node.lineno - 1 < len(lines) and MARKER in lines[node.lineno - 1]:
-            continue
-        cur = parents.get(node)
-        watched = False
-        while cur is not None and not watched:
-            if isinstance(cur, ast.With):
-                watched = any(_is_watch_call(item.context_expr)
-                              for item in cur.items)
-            cur = parents.get(cur)
-        if not watched:
-            yield ("R3", node.lineno,
-                   "bare blocking collective wait (block_until_ready "
-                   "outside `with watch(...)`): route through "
-                   "comm_watchdog.watch + collective._finish_wait so a "
-                   "lost peer raises a named deadline the elastic layer "
-                   "recovers from, or mark '# resilience: ok (<why>)'")
-
-
-def lint_file(path: str):
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        yield ("SYNTAX", e.lineno or 0, f"unparseable: {e.msg}")
-        return
-    lines = src.splitlines()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.While, ast.For)):
-            yield from _loop_findings(node, lines)
-    norm = path.replace(os.sep, "/")
-    if "/distributed/" in norm:
-        yield from _blocking_wait_findings(tree, lines)
-
-
-def iter_py_files(root: str):
-    pkg = os.path.join(root, "paddle_tpu")
-    for base, dirs, files in os.walk(pkg):
-        if any(base.endswith(d) or (d + os.sep) in (base + os.sep)
-               for d in EXEMPT_DIRS):
-            continue
-        for fn in files:
-            if fn.endswith(".py"):
-                yield os.path.join(base, fn)
+RULES = ("R1", "R2", "R3")
+_LABEL = "resilience"
 
 
 def main(argv=None) -> int:
-    root = (argv or sys.argv[1:] or ["."])[0] if (argv or sys.argv[1:]) \
-        else os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = []
-    for path in sorted(iter_py_files(root)):
-        for rule, lineno, msg in lint_file(path):
-            findings.append((os.path.relpath(path, root), lineno, rule, msg))
-    for path, lineno, rule, msg in findings:
-        print(f"{path}:{lineno}: [{rule}] {msg}")
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else _REPO
+    findings = run(root, rule_ids=RULES)
+    for f in findings:
+        print(f"{f.path.replace('/', os.sep)}:{f.line}: [{f.rule}] "
+              f"{f.message}")
     if findings:
-        print(f"\n{len(findings)} resilience-lint finding(s)", file=sys.stderr)
+        print(f"\n{len(findings)} {_LABEL}-lint finding(s)", file=sys.stderr)
         return 1
     return 0
 
