@@ -1,16 +1,24 @@
 """Continuous-batching serving throughput (VERDICT r3 next #8 "Done"
 criterion: mixed-length throughput showing >B=1 utilization).
 
-Serves a mixed-prompt-length request set two ways on the real chip:
+Serves a mixed-prompt-length request set under a mixed prefill/decode
+request mix, several ways on the real chip:
   sequential — one llama_generate per request (B=1, the old LLMPredictor
                serving mode);
-  continuous — the slot-pool ContinuousBatcher (inference/serving.py).
+  continuous — the slot-pool ContinuousBatcher (inference/serving.py),
+               timed for BOTH KV layouts (paged gather and dense slots)
+               AND the ragged Pallas-kernel path (`kv_layout="ragged"`,
+               ISSUE 8) — the JSON line carries a `ragged` sub-object
+               (tokens/s, live-length bytes/token, executable count,
+               parity bit vs the gather outputs).
 
     python benchmarks/serving_bench.py [n_requests] [max_batch] [burst]
 
-Prints one JSON line with tokens/s for both and the speedup. Uses the
-r3 850M bench model so the number is comparable to the decode bench
-(352 tok/s B=1 greedy, benchmarks/decode_bench.py).
+Prints one JSON line with tokens/s for every mode and the speedups; the
+line is emitted on EVERY exit path (an exception prints an `error`
+payload first — bench contract, never JSON-less). Uses the r3 850M bench
+model so the number is comparable to the decode bench (352 tok/s B=1
+greedy, benchmarks/decode_bench.py).
 """
 from __future__ import annotations
 
@@ -25,6 +33,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    try:
+        return _main()
+    except BaseException as e:  # bench contract: never exit JSON-less
+        print(json.dumps({
+            "metric": "serving_continuous_batching_tokens_per_sec",
+            "error": f"{type(e).__name__}: {e}"}))
+        return 1
+
+
+def _main():
     n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     max_batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     burst = int(sys.argv[3]) if len(sys.argv) > 3 else 16
@@ -120,11 +138,13 @@ def main():
     # ---- continuous batching (includes its compiles on first run; measure
     # a second pass for steady-state, same as sequential). Both KV layouts
     # are timed: paged (block-table pool, the default) and dense slots.
+    page_size = 64 if on_tpu else 8   # ONE knob: engines + bytes/token math
+
     def serve(kv_layout):
         eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
                                 max_len=max_len, prompt_buckets=buckets,
                                 burst=burst, kv_layout=kv_layout,
-                                page_size=64 if on_tpu else 8)
+                                page_size=page_size)
         rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
         return eng, rids, eng.run()
 
@@ -137,6 +157,26 @@ def main():
     t0 = time.perf_counter()
     _, dense_rids, dense_out = serve("dense")
     dense_s = time.perf_counter() - t0
+
+    # ---- ragged Pallas-kernel path (ISSUE 8): same mixed prefill/decode
+    # request mix, ONE mixed-burst executable instead of the bucket grid
+    from benchmarks.decode_bench import ragged_read_bytes
+    from paddle_tpu.models.llama_paged import llama_ragged_burst
+    serve("ragged")  # compile pass
+    t0 = time.perf_counter()
+    reng, ragged_rids, ragged_out = serve("ragged")
+    ragged_s = time.perf_counter() - t0
+    ragged_vs_paged = sum(ragged_out[r] != out[p]
+                          for r, p in zip(ragged_rids, rids))
+    live_bytes, roofline_bytes = ragged_read_bytes(cfg, reqs, page_size)
+    ragged_obj = {
+        "tokens_per_sec": round(total_new / ragged_s, 1),
+        "kv_read_bytes_per_token": int(live_bytes),
+        "hbm_roofline_bytes_per_token": int(roofline_bytes),
+        "executables": {"ragged_burst": llama_ragged_burst._cache_size()},
+        "kernel_active": bool(reng._ragged),
+        "parity": ragged_vs_paged == 0,
+    }
 
     # With trained weights greedy equality is a HARD assertion (logits
     # peaked, no load-bearing argmax ties); with random weights
@@ -160,6 +200,7 @@ def main():
         "unit": "tokens/s",
         "kv_layout": "paged",
         "slo": slo_obj,
+        "ragged": ragged_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),
         "vs_dense_slots": round(dense_s / cont_s, 2),
         "config": {"requests": n_req, "max_batch": max_batch,
@@ -178,9 +219,10 @@ def main():
     # hard parity gate AFTER the JSON line: the measured throughputs must
     # never be discarded by the failure they diagnose (cf. bench.py
     # _record_latest rationale). Plain `if` — `assert` dies under -O.
-    if train_steps and (mismatch or paged_vs_dense):
-        print(f"# FAIL: {mismatch}/{n_req} paged-vs-sequential and "
-              f"{paged_vs_dense}/{n_req} paged-vs-dense requests diverged "
+    if train_steps and (mismatch or paged_vs_dense or ragged_vs_paged):
+        print(f"# FAIL: {mismatch}/{n_req} paged-vs-sequential, "
+              f"{paged_vs_dense}/{n_req} paged-vs-dense and "
+              f"{ragged_vs_paged}/{n_req} ragged-vs-paged requests diverged "
               f"WITH TRAINED WEIGHTS — a real numerics bug, not a bf16 "
               f"tiebreak", file=sys.stderr)
         return 1

@@ -9,6 +9,14 @@ Physical page 0 is reserved as the SCRATCH page: retired/idle slots point
 their whole block-table row at it so their frozen in-flight writes land
 somewhere no live request reads. ``PageAllocator`` therefore never hands
 out page 0; ``usable`` is ``num_pages - 1``.
+
+Sharding (ISSUE 8): page accounting is UNCHANGED when the device pool is
+GSPMD-sharded along KV heads (``parallel/sharding.py:shard_kv_pool``,
+``P(None, None, "model", None)``) — a page id names the same logical page
+on every shard (each device holds that page's slice of its own heads), so
+the allocator, block tables, and scratch convention stay replicated host
+metadata with no layout awareness. That is the "pool/block-table plumbing
+stays layout-agnostic" half of the GSPMD tentpole.
 """
 from __future__ import annotations
 

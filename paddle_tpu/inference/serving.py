@@ -14,7 +14,17 @@ different prompt lengths and generation budgets share every MXU step
     slot retires on EOS or its length budget and emits padding until the
     host swaps a new request in between bursts.
 
-Two KV layouts share that scheduler:
+Three KV layouts share that scheduler:
+
+  * ``kv_layout="ragged"`` (ISSUE 8) — the paged pool below, read through
+    the Pallas ragged kernel (``ops/ragged_attention.py``) in ONE mixed
+    prefill+decode executable per burst (``llama_ragged_burst``):
+    admissions prefill their ragged-length prompts and join the same
+    launch's decode steps, the block table rides full-width (the kernel
+    DMAs only live pages), and the executable inventory collapses to the
+    {prefill-carrying, decode-only} pair — O(1) in the request mix.
+    ``PADDLE_RAGGED_ATTN=0`` (or an MXU-untileable pool on a real TPU)
+    falls back to the gather-paged path, token-identical either way.
 
   * ``kv_layout="paged"`` (default) — a shared ``[num_pages, page_size,
     KV, hd]`` pool per layer with per-slot block tables
@@ -149,8 +159,22 @@ class ContinuousBatcher:
         self._temp, self._top_k = float(temperature), int(top_k)
         self._key = jax.random.PRNGKey(seed)
 
-        if kv_layout not in ("paged", "dense"):
+        if kv_layout not in ("paged", "dense", "ragged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        # "ragged" = the paged pool read through the Pallas ragged kernel
+        # (ops/ragged_attention.py) in ONE mixed prefill+decode executable.
+        # PADDLE_RAGGED_ATTN=0 (or an un-tileable pool on a real TPU)
+        # falls back to the XLA gather path below — token-identical, just
+        # bucket-bound again — so the flag is a safety valve, not a fork.
+        self._ragged = False
+        self._interpret = True
+        self._mesh = None
+        if kv_layout == "ragged":
+            from ..ops import ragged_attention as _ra
+            self._interpret = jax.default_backend() != "tpu"
+            self._ragged = _ra.enabled() and _ra.supported(
+                self._cfg.head_dim, int(page_size), self._interpret)
+            kv_layout = "paged"
         self._layout = kv_layout
         # Slot state lives HOST-side as numpy and is uploaded per burst
         # call (four tiny [B] arrays + the block table). The alternative —
@@ -184,11 +208,32 @@ class ContinuousBatcher:
             self._page_buckets = pb
             self._cache = init_paged_kv_cache(model_config, num_pages,
                                               self._ps)
+            # GSPMD pool sharding (PADDLE_SERVE_MESH_MODEL): KV heads
+            # spread over the "model" axis so one replica spans a pod
+            # slice. The scheduler stays layout-agnostic — block tables
+            # and slot state remain replicated host metadata; the gather
+            # path partitions automatically, the ragged kernel shard_maps.
+            from ..parallel.sharding import serving_mesh, shard_kv_pool
+            self._mesh = serving_mesh()
+            if self._mesh is not None:
+                kv = self._cfg.num_key_value_heads
+                if kv % self._mesh.size:
+                    raise ValueError(
+                        f"PADDLE_SERVE_MESH_MODEL={self._mesh.size} must "
+                        f"divide num_key_value_heads={kv}")
+                self._cache = shard_kv_pool(self._cache, self._mesh)
             # per-slot block tables (host truth); device table is built per
             # burst. _admit_seq orders slots by admission for preemption.
             self._page_tbl: list[list[int]] = [[] for _ in range(self.B)]
             self._admit_seq = [0] * self.B
             self._seq = 0
+            if self._ragged:
+                # decode-only bursts (the steady state) reuse these
+                # device-resident empty-admission inputs instead of
+                # rebuilding and re-uploading a [B, Tmax] buffer per burst
+                self._no_prompts = jnp.full(
+                    (self.B, self._buckets[-1]), jnp.int32(self.pad_id))
+                self._no_lens = jnp.zeros(self.B, jnp.int32)
         else:
             from ..models.llama_decode import init_kv_cache
             self._cache = init_kv_cache(model_config, self.B, self.S)
@@ -360,22 +405,11 @@ class ContinuousBatcher:
         metrics.counter("serve.preemptions").inc()
         self.slo.on_preempt(req.rid)  # same trace id; e2e clock keeps going
 
-    def _dispatch_burst_paged(self):
-        """Grow block tables to cover this burst's writes, then dispatch
-        the paged burst ASYNCHRONOUSLY. Returns (old_pos, device futures)
-        or None when nothing is active. No host sync here."""
-        from ..models.llama_paged import (llama_paged_decode_burst,
-                                          paged_kv_bytes_per_token)
-        active = [b for b, r in enumerate(self._slot_req) if r is not None]
-        if not active:
-            return None
-        try:
-            chaos.hit("serve.burst")
-        except chaos.ChaosError:
-            self._retire_all_active("chaos serve.burst")
-            return None
-        # page growth, preempting youngest-first when the pool is dry (a
-        # lone slot always fits: add_request rejected anything that can't)
+    def _grow_for_burst(self, active: list) -> list:
+        """Page growth for every slot in `active` to cover this burst's
+        writes, preempting youngest-first when the pool runs dry (a lone
+        slot always fits: add_request rejected anything that can't).
+        Returns the surviving active list (possibly empty)."""
         while True:
             grown = True
             for b in list(active):
@@ -394,10 +428,26 @@ class ContinuousBatcher:
                 active.remove(victim)
                 grown = False
                 break
-            if grown:
-                break
-            if not active:
-                return None
+            if grown or not active:
+                return active
+
+    def _dispatch_burst_paged(self):
+        """Grow block tables to cover this burst's writes, then dispatch
+        the paged burst ASYNCHRONOUSLY. Returns (old_pos, device futures)
+        or None when nothing is active. No host sync here."""
+        from ..models.llama_paged import (llama_paged_decode_burst,
+                                          paged_kv_bytes_per_token)
+        active = [b for b, r in enumerate(self._slot_req) if r is not None]
+        if not active:
+            return None
+        try:
+            chaos.hit("serve.burst")
+        except chaos.ChaosError:
+            self._retire_all_active("chaos serve.burst")
+            return None
+        active = self._grow_for_burst(active)
+        if not active:
+            return None
         metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
 
         width = max(len(self._page_tbl[b]) for b in active)
@@ -478,6 +528,28 @@ class ContinuousBatcher:
         metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
         return staged
 
+    def _drain_burst(self, old_pos, done, emitted, skip=frozenset()) -> int:
+        """The ONE burst drain loop (dense, gather-paged and ragged steps
+        all end here): extend each live slot's output by its
+        ``pos - old_pos`` scan emissions, report them to the SLO tracker,
+        and finish+retire slots the device marked done. ``skip`` holds
+        slots whose readback is stale this step (gather path: slots staged
+        while the burst was in flight). Callers have already copied the
+        device slot state back into self._pos/_tok/_done. Returns the
+        token count drained."""
+        total = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None or slot in skip:
+                continue
+            n_new = int(self._pos[slot] - old_pos[slot])
+            req.out.extend(int(t) for t in emitted[:n_new, slot])
+            total += n_new
+            self.slo.on_tokens(req.rid, n_new)
+            if done[slot]:
+                self._finish(req)
+                self._retire_slot(slot)
+        return total
+
     def _sync_merge_paged(self, inflight, staged) -> int:
         """THE one blocking point per step: a single device_get covering
         the burst readback and every staged first token, then pure host
@@ -495,19 +567,11 @@ class ContinuousBatcher:
             self._pos = np.array(pos)    # device_get views are read-only;
             self._tok = np.array(tok)    # admissions write these in place
             self._done = np.array(done)
-            emitted = np.asarray(emitted)
-            for slot, req in enumerate(self._slot_req):
-                # slots staged THIS step were frozen (done) for the burst:
-                # their n_new is 0 and their done flag is stale — skip
-                if req is None or slot in staged_slots:
-                    continue
-                n_new = int(self._pos[slot] - old_pos[slot])
-                req.out.extend(int(t) for t in emitted[:n_new, slot])
-                emitted_total += n_new
-                self.slo.on_tokens(req.rid, n_new)
-                if done[slot]:
-                    self._finish(req)
-                    self._retire_slot(slot)
+            # slots staged THIS step were frozen (done) for the burst:
+            # their n_new is 0 and their done flag is stale — skip
+            emitted_total += self._drain_burst(old_pos, done,
+                                               np.asarray(emitted),
+                                               skip=staged_slots)
         for (req, slot, tlen, _), first in zip(staged, firsts):
             first = int(first)
             req.out.append(first)
@@ -528,6 +592,156 @@ class ContinuousBatcher:
             sum(r is not None for r in self._slot_req))
         return emitted_total
 
+    # ----------------------------------------------------- ragged (ISSUE 8)
+    def _admit_ragged(self):
+        """Pop + allocate + stage admissions for the MIXED burst. No
+        bucketing: pages are reserved for the ACTUAL prompt length and the
+        prompt rides into the burst as a (token row, length) pair — the
+        prefill happens inside the same executable as the decode steps, so
+        a freshly admitted request's first token lands this very burst."""
+        staged = []  # (req, slot, tlen)
+        stalled = False
+        while self._queue and None in self._slot_req:
+            req = self._queue[0]
+            tlen = len(req.prompt)
+            need = pages_for(tlen, self._ps)
+            if self._alloc.free_pages < need:
+                stalled = True  # stays queued; pages free as slots retire
+                break
+            self._queue.popleft()
+            try:
+                chaos.hit("serve.admit")
+            except chaos.ChaosError:
+                self.stats["chaos_retired"] += 1
+                metrics.counter("serve.chaos_retired").inc()
+                # partial (empty) output, queue moves on
+                self._finish(req, reason="chaos serve.admit")
+                continue
+            self.slo.on_admit(req.rid)
+            slot = self._slot_req.index(None)
+            self._page_tbl[slot] = self._alloc.alloc(need)
+            self._slot_req[slot] = req
+            self._admit_seq[slot] = self._seq = self._seq + 1
+            # host slot state for the burst: the device's prefill phase
+            # re-derives pos/tok/done for staged slots (where(is_new, ...))
+            # — pos=tlen here is the growth loop's and the merge's truth
+            self._pos[slot] = tlen
+            self._tok[slot] = self.pad_id
+            self._done[slot] = False
+            self._limit[slot] = min(tlen + req.max_new_tokens - 1,
+                                    self.S - 1)
+            self.stats["prefills"] += 1
+            staged.append((req, slot, tlen))
+        if stalled:
+            self.stats["admission_stalls"] += 1
+            metrics.counter("serve.admission_stalls").inc()
+        return staged
+
+    def _dispatch_ragged(self, staged):
+        """ONE async launch covering this burst's admissions (ragged
+        prefill) AND every decoding slot (llama_ragged_burst). The block
+        table is always full width — the kernel reads live pages only, so
+        there is no page bucket and no prompt bucket to compile against.
+        Returns (old_pos, device futures) or None when nothing is active."""
+        from ..models.llama_paged import (llama_ragged_burst,
+                                          paged_kv_bytes_per_token)
+        active = [b for b, r in enumerate(self._slot_req) if r is not None]
+        if not active:
+            return None
+        try:
+            chaos.hit("serve.burst")
+        except chaos.ChaosError:
+            self._retire_all_active("chaos serve.burst")
+            staged.clear()
+            return None
+        active = self._grow_for_burst(active)
+        # growth may have preempted a just-staged slot back to the queue
+        staged[:] = [s for s in staged if self._slot_req[s[1]] is s[0]]
+        if not active:
+            return None
+        metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+        # bytes/token follow LIVE context on the ragged path (the ISSUE-8
+        # over-reporting fix): mean over active slots of their live pages
+        live_bytes = [paged_kv_bytes_per_token(
+            self._cfg, 0, self._ps, live_tokens=int(self._pos[b]) + 1)
+            for b in active]
+        metrics.gauge("serve.kv_read_mb_per_tok").set(
+            sum(live_bytes) / len(live_bytes) / 1e6)
+
+        P = pages_for(self.S, self._ps)          # full width, always
+        bt = np.full((self.B, P), SCRATCH_PAGE, np.int32)
+        for b in active:
+            ids = self._page_tbl[b]
+            bt[b, :len(ids)] = ids
+        if staged:
+            t_max = self._buckets[-1]            # the ONE static width
+            new_tokens = np.full((self.B, t_max), self.pad_id, np.int32)
+            new_lens = np.zeros(self.B, np.int32)
+            for req, slot, tlen in staged:
+                new_tokens[slot, :tlen] = req.prompt
+                new_lens[slot] = tlen
+            new_tokens, new_lens = jnp.asarray(new_tokens), \
+                jnp.asarray(new_lens)
+        else:
+            new_tokens, new_lens = self._no_prompts, self._no_lens
+
+        old_pos = self._pos.copy()
+        self._key, sub = jax.random.split(self._key)
+        (self._cache, pos_d, tok_d, done_d, emitted_d, firsts_d) = \
+            llama_ragged_burst(
+                self._params, self._cache, jnp.asarray(bt),
+                jnp.asarray(self._pos), jnp.asarray(self._tok),
+                jnp.asarray(self._done), jnp.asarray(self._limit),
+                new_tokens, new_lens,
+                jnp.int32(self.eos_id), sub, config=self._cfg,
+                n=self.burst, has_prefill=bool(staged),
+                temperature=self._temp, top_k=self._top_k,
+                pad_id=self.pad_id, dequant=self._dequant,
+                interpret=self._interpret, mesh=self._mesh)
+        self.stats["bursts"] += 1
+        self.stats["decode_steps"] += self.burst
+        return old_pos, pos_d, tok_d, done_d, emitted_d, firsts_d
+
+    def _sync_merge_ragged(self, inflight, staged) -> int:
+        """The one blocking point of a ragged step: read back the merged
+        burst (slot state + scan emissions + prefill first tokens), then
+        pure host bookkeeping."""
+        if inflight is None:
+            return 0
+        old_pos = inflight[0]
+        pos, tok, done, emitted, firsts = jax.device_get(inflight[1:])
+        self._pos = np.array(pos)    # device_get views are read-only;
+        self._tok = np.array(tok)    # admissions write these in place
+        self._done = np.array(done)
+        emitted_total = 0
+        for req, slot, _ in staged:
+            # the prefill token, sampled inside the same burst; the drain
+            # below appends this slot's scan emissions AFTER it
+            req.out.append(int(firsts[slot]))
+            emitted_total += 1
+            self.slo.on_first_token(req.rid)
+        emitted_total += self._drain_burst(old_pos, done,
+                                           np.asarray(emitted))
+        metrics.counter("serve.tokens").inc(emitted_total)
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"],
+            sum(r is not None for r in self._slot_req))
+        return emitted_total
+
+    def _step_ragged(self):
+        """One ragged scheduling iteration: admissions join the SAME
+        launch as the decode steps (prefill-to-first-token inside one
+        executable — lower TTFT than the overlap schedule's next-burst
+        landing), and the single blocking readback follows the dispatch."""
+        t0 = _slo.now()
+        staged = self._admit_ragged()
+        inflight = self._dispatch_ragged(staged)
+        emitted = self._sync_merge_ragged(inflight, staged)
+        dt = _slo.now() - t0
+        metrics.histogram("serve.burst_time_s").observe(dt)
+        if emitted and dt > 0:
+            metrics.gauge("serve.tokens_per_s").set(emitted / dt)
+
     # ------------------------------------------------------------- decode
     def step(self):
         """One scheduling iteration.
@@ -536,7 +750,9 @@ class ContinuousBatcher:
         scheduling while the device runs → block once on the combined
         readback. Dense (legacy order): admit synchronously, then burst.
         """
-        if self._layout == "paged":
+        if self._ragged:
+            self._step_ragged()
+        elif self._layout == "paged":
             t0 = _slo.now()  # the sanctioned request-timing clock (lint O4)
             inflight = self._dispatch_burst_paged()
             staged = self._admit_paged()
@@ -586,17 +802,7 @@ class ContinuousBatcher:
         self._pos = np.array(pos)    # device_get views are read-only;
         self._tok = np.array(tok)    # admissions write these in place
         self._done = np.array(done)
-        emitted_total = 0
-        for slot, req in enumerate(self._slot_req):
-            if req is None:
-                continue
-            n_new = int(self._pos[slot] - old_pos[slot])
-            req.out.extend(int(t) for t in np.asarray(emitted)[:n_new, slot])
-            emitted_total += n_new
-            self.slo.on_tokens(req.rid, n_new)
-            if done[slot]:
-                self._finish(req)
-                self._retire_slot(slot)
+        emitted_total = self._drain_burst(old_pos, done, np.asarray(emitted))
         dt = _slo.now() - t0
         metrics.histogram("serve.burst_time_s").observe(dt)
         metrics.counter("serve.tokens").inc(emitted_total)
@@ -636,6 +842,9 @@ class ContinuousBatcher:
         (queue composition, slot occupancy) without a device sync."""
         return {
             "layout": self._layout,
+            "ragged": self._ragged,
+            "sharded_devices": (self._mesh.size if self._mesh is not None
+                                else 1),
             "queue_depth": len(self._queue),
             "active_slots": sum(r is not None for r in self._slot_req),
             "max_batch": self.B,

@@ -36,7 +36,16 @@ Sharding note (GSPMD, arxiv 2105.04663): the pool keeps KV-heads as a
 leading-free trailing axis exactly like the dense cache, so a
 ``NamedSharding(mesh, P(None, None, "model", None))`` shards pages across
 model-parallel chips unchanged; the block table is replicated host
-metadata.
+metadata (``parallel/sharding.py:shard_kv_pool`` applies it; the serving
+engine reads ``PADDLE_SERVE_MESH_MODEL``).
+
+Ragged kernel (ISSUE 8): ``llama_ragged_burst`` below replaces the
+``jnp.take`` gather with the Pallas ragged kernel
+(``ops/ragged_attention.py``) and folds ragged-length prompt prefill into
+the SAME executable as the decode scan — the bucket grid (and its
+executable inventory) disappears; bytes/token follow live context. The
+gather entry points stay as the fallback (PADDLE_RAGGED_ATTN=0) and
+equivalence baseline.
 """
 from __future__ import annotations
 
@@ -50,7 +59,8 @@ from .llama import LlamaConfig, _rmsnorm, _rope, lm_head_logits, \
 from .llama_decode import _cached_attention_slots, _mlp, _qkv, _sample
 
 __all__ = ["init_paged_kv_cache", "llama_paged_prefill_slot",
-           "llama_paged_decode_burst", "paged_kv_bytes_per_token"]
+           "llama_paged_decode_burst", "llama_ragged_burst",
+           "paged_kv_bytes_per_token"]
 
 
 def init_paged_kv_cache(config: LlamaConfig, num_pages: int, page_size: int):
@@ -73,12 +83,24 @@ def init_paged_kv_cache(config: LlamaConfig, num_pages: int, page_size: int):
 
 
 def paged_kv_bytes_per_token(config: LlamaConfig, pages: int,
-                             page_size: int) -> int:
-    """Decode-attention K+V bytes gathered per emitted token per slot when
-    the block table is `pages` wide — the bandwidth the page buckets are
-    sized against (dense reads the same expression with
-    pages*page_size == max_len, always)."""
+                             page_size: int,
+                             live_tokens: int | None = None) -> int:
+    """Decode-attention K+V bytes read per emitted token per slot.
+
+    Gather path: the read is `pages` (the page-count BUCKET of the widest
+    active context) × page_size rows — pass the bucket width (dense reads
+    the same expression with pages*page_size == max_len, always).
+
+    Ragged kernel path: the per-page DMA loop stops at the slot's LIVE
+    pages, so bytes follow the live context, not the bucket — pass
+    ``live_tokens`` and `pages` is ignored in favor of
+    ``ceil(live_tokens / page_size)`` (the ISSUE-8 over-reporting fix:
+    decode_bench must not bill the ragged path at bucket width)."""
     c = config
+    if live_tokens is not None:
+        live_tokens = int(live_tokens)
+        pages = 0 if live_tokens <= 0 \
+            else (live_tokens - 1) // int(page_size) + 1
     return int(2 * c.num_hidden_layers * pages * page_size
                * c.num_key_value_heads * c.head_dim
                * jnp.dtype(c.dtype).itemsize)
@@ -230,3 +252,195 @@ def llama_paged_decode_burst(params, cache, block_table, pos, tok, done,
     (cache, pos, tok, done, _), emitted = jax.lax.scan(
         step, (cache, pos, tok, done, key), None, length=n)
     return cache, pos, tok, done, emitted
+
+
+# ------------------------------------------------------------------ ragged
+# ISSUE 8 tentpole: the same paged pool read through the Pallas ragged
+# kernel (ops/ragged_attention.py) instead of the XLA block-table gather.
+# Raggedness moves from SHAPES (page buckets, prompt buckets — one
+# executable each) into scalar-prefetched lengths, so ONE executable per
+# {prefill-carrying, decode-only} covers every request mix.
+
+
+def _ragged_attn(q, kp, vp, block_table, q_lens, kv_lens, *, page_size,
+                 interpret, mesh):
+    """Dispatch the ragged kernel, shard_map'd over the "model" axis when
+    the pool is GSPMD-sharded along KV heads: kernel programs are
+    independent per (slot, kv-head), so each shard runs the SAME kernel
+    over its local heads — no collective, no re-gather of the pool."""
+    from ..ops.ragged_attention import ragged_paged_attention
+    if mesh is None:
+        return ragged_paged_attention(q, kp, vp, block_table, q_lens,
+                                      kv_lens, page_size=page_size,
+                                      interpret=interpret)
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jax_compat import shard_map
+
+    def local(q_, kp_, vp_, bt_, ql_, kl_):
+        return ragged_paged_attention(q_, kp_, vp_, bt_, ql_, kl_,
+                                      page_size=page_size,
+                                      interpret=interpret)
+
+    axis = mesh.axis_names[0]
+    heads = P(None, None, axis, None)
+    return shard_map(
+        local, mesh,
+        in_specs=(heads, heads, heads, P(None, None), P(None), P(None)),
+        out_specs=heads)(q, kp, vp, block_table, q_lens, kv_lens)
+
+
+def _ragged_decode_step_slots(params, cache, block_table, pos, tok,
+                              config: LlamaConfig, interpret: bool,
+                              mesh=None):
+    """_paged_decode_step_slots with the gather replaced by the ragged
+    kernel: K/V writes keep the per-lane dynamic_update_slice discipline;
+    the read DMAs only each slot's ceil((pos+1)/page_size) live pages."""
+    c = config
+    layer_p, other = split_layer_params(params)
+    B = tok.shape[0]
+    ps = cache["k"][0].shape[1]
+    x = jnp.take(other["embed_tokens"], tok[:, None], axis=0).astype(c.dtype)
+    positions = pos[:, None].astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    page_of = pos32 // jnp.int32(ps)
+    row_of = pos32 % jnp.int32(ps)
+    z = jnp.int32(0)
+    one = jnp.ones((B,), jnp.int32)
+
+    ks, vs = list(cache["k"]), list(cache["v"])
+    for l in range(c.num_hidden_layers):
+        lp = jax.tree.map(lambda a: a[l], layer_p)
+        h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv(h, lp, c)
+        q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+        kp, vp = ks[l], vs[l]
+        ku, vu = k[:, 0], v[:, 0]
+        for b in range(B):
+            at = (block_table[b, page_of[b]], row_of[b], z, z)
+            kp = jax.lax.dynamic_update_slice(kp, ku[b][None, None], at)
+            vp = jax.lax.dynamic_update_slice(vp, vu[b][None, None], at)
+        ks[l], vs[l] = kp, vp
+        att = _ragged_attn(q, kp, vp, block_table, one, pos32 + 1,
+                           page_size=int(ps), interpret=interpret,
+                           mesh=mesh)
+        y = x + (att.reshape(B, 1, -1) @ lp["wo"])
+        x = _mlp(y, lp, c)
+
+    return lm_head_logits(x[:, 0, :], other, c), \
+        {"k": tuple(ks), "v": tuple(vs)}
+
+
+def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
+                          config: LlamaConfig, interpret: bool, mesh=None):
+    """Ragged prompt forward for EVERY newly admitted slot at once.
+
+    new_tokens [B, Tmax] (Tmax = the engine's widest prompt bucket, the
+    ONE static width), new_lens [B] (0 = slot not prefilling — its lanes
+    are dead compute, not corruption). Per layer: K/V rows land in the
+    slot's pages (non-prefilling slots' writes are redirected to the
+    scratch page so a decoding neighbour's context is never touched),
+    then the ragged kernel reads them back causally (q_len = kv_len =
+    new_lens) — the same paged read path decode uses, per the RPA paper.
+    Returns (last-position logits [B, V], cache)."""
+    from ..inference.paging import SCRATCH_PAGE
+
+    c = config
+    layer_p, other = split_layer_params(params)
+    B, Tmax = new_tokens.shape
+    ps = int(cache["k"][0].shape[1])
+    t_pages = (Tmax - 1) // ps + 1
+    pad = t_pages * ps - Tmax
+    is_new = new_lens > 0
+    # prefill slots write through their block table; everyone else (and
+    # table rows past the slot's allocation, already SCRATCH) to scratch
+    wt = jnp.where(is_new[:, None], block_table[:, :t_pages],
+                   jnp.int32(SCRATCH_PAGE))
+    x = jnp.take(other["embed_tokens"], new_tokens, axis=0).astype(c.dtype)
+    positions = jnp.broadcast_to(
+        jnp.arange(Tmax, dtype=jnp.int32)[None, :], (B, Tmax))
+    z = jnp.int32(0)
+    lens32 = new_lens.astype(jnp.int32)
+
+    ks, vs = list(cache["k"]), list(cache["v"])
+    for l in range(c.num_hidden_layers):
+        lp = jax.tree.map(lambda a: a[l], layer_p)
+        h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv(h, lp, c)
+        q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+        kp, vp = ks[l], vs[l]
+        krows = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vrows = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        for b in range(B):
+            for j in range(t_pages):
+                at = (wt[b, j], z, z, z)
+                kp = jax.lax.dynamic_update_slice(
+                    kp, krows[b, j * ps:(j + 1) * ps][None], at)
+                vp = jax.lax.dynamic_update_slice(
+                    vp, vrows[b, j * ps:(j + 1) * ps][None], at)
+        ks[l], vs[l] = kp, vp
+        att = _ragged_attn(q, kp, vp, block_table, lens32, lens32,
+                           page_size=ps, interpret=interpret, mesh=mesh)
+        y = x + (att.reshape(B, Tmax, -1) @ lp["wo"])
+        x = _mlp(y, lp, c)
+
+    last = x[jnp.arange(B), jnp.maximum(lens32 - 1, 0)]       # [B, D]
+    return lm_head_logits(last, other, c), {"k": tuple(ks), "v": tuple(vs)}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "n", "has_prefill", "temperature", "top_k", "pad_id",
+    "dequant", "interpret", "mesh"), donate_argnums=(1,))
+def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
+                       new_tokens, new_lens, eos_id, key,
+                       config: LlamaConfig, n: int, has_prefill: bool,
+                       temperature: float = 0.0, top_k: int = 0,
+                       pad_id: int = 0, dequant=None, interpret: bool = True,
+                       mesh=None):
+    """ONE executable for a mixed prefill+decode burst (ISSUE 8).
+
+    Same contract as llama_paged_decode_burst plus the admission inputs:
+    slots with ``new_lens[b] > 0`` first prefill their prompt (ragged —
+    any length ≤ Tmax in the same launch), sample their first token and
+    join the n decode steps alongside the already-decoding slots. The
+    block table is always FULL WIDTH (slot_max_pages): the ragged kernel
+    reads only live pages, so no page bucketing and no prompt bucketing —
+    the executable inventory is exactly {prefill-carrying, decode-only},
+    O(1) in the request mix (pinned by tests/test_ragged_attention.py).
+
+    Returns (cache, pos, tok, done, emitted [n, B], firsts [B]) — firsts
+    holds each newly admitted slot's prefill token (pad_id elsewhere);
+    scan emissions for those slots start AFTER it.
+    """
+    p = dequant(params) if dequant is not None else params
+    B = tok.shape[0]
+    firsts = jnp.full((B,), jnp.int32(pad_id))
+    if has_prefill:
+        key, sub = jax.random.split(key)
+        logits, cache = _ragged_prefill_phase(
+            p, cache, block_table, new_tokens, new_lens, config, interpret,
+            mesh)
+        first = _sample(logits, temperature, top_k, sub)
+        is_new = new_lens > 0
+        firsts = jnp.where(is_new, first, firsts)
+        tok = jnp.where(is_new, first, tok)
+        pos = jnp.where(is_new, new_lens.astype(pos.dtype), pos)
+        done = jnp.where(is_new, (first == eos_id) | (pos >= limit), done)
+
+    def step(carry, _):
+        cache, pos, tok, done, key = carry
+        pp = dequant(params) if dequant is not None else params
+        logits, cache = _ragged_decode_step_slots(pp, cache, block_table,
+                                                  pos, tok, config,
+                                                  interpret, mesh)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, temperature, top_k, sub)
+        emit = jnp.where(done, jnp.int32(pad_id), nxt)
+        new_pos = jnp.where(done, pos, pos + 1)
+        new_tok = jnp.where(done, tok, nxt)
+        new_done = done | (nxt == eos_id) | (new_pos >= limit)
+        return (cache, new_pos, new_tok, new_done, key), emit
+
+    (cache, pos, tok, done, _), emitted = jax.lax.scan(
+        step, (cache, pos, tok, done, key), None, length=n)
+    return cache, pos, tok, done, emitted, firsts

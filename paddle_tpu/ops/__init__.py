@@ -1,4 +1,5 @@
 """paddle_tpu.ops — Pallas TPU kernels (flash attention, ring attention,
-MoE dispatch). The analog of the reference's hand-written CUDA kernels in
-phi/kernels/{gpu,fusion}; everything else is XLA-generated."""
-from . import flash_attention  # noqa: F401
+ragged paged attention, MoE dispatch). The analog of the reference's
+hand-written CUDA kernels in phi/kernels/{gpu,fusion}; everything else is
+XLA-generated."""
+from . import flash_attention, ragged_attention  # noqa: F401

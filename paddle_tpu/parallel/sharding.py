@@ -15,7 +15,64 @@ from __future__ import annotations
 from ..distributed.api import ShardingStage1, ShardingStage2, ShardingStage3, shard_optimizer
 from ..distributed.process_mesh import get_mesh
 
-__all__ = ["group_sharded_parallel"]
+__all__ = ["group_sharded_parallel", "kv_pool_pspec", "serving_mesh",
+           "shard_kv_pool", "ENV_SERVE_MESH"]
+
+ENV_SERVE_MESH = "PADDLE_SERVE_MESH_MODEL"
+
+# ------------------------------------------------------- serving KV pool
+# GSPMD page-pool sharding (ISSUE 8): the paged KV pool keeps KV heads as
+# its third axis ([num_pages, page_size, KV, hd]), so one NamedSharding
+# spreads a serving replica's cache across a pod slice with NO layout
+# change — each chip holds every page's slice of ITS heads, the block
+# table stays replicated host metadata, and both the XLA gather path
+# (GSPMD partitions the take+einsum automatically) and the Pallas ragged
+# kernel (shard_map'd per shard — programs are independent per
+# (slot, kv-head)) read only local bytes.
+
+
+def kv_pool_pspec(axis: str = "model"):
+    """The page-pool partition spec: P(None, None, "model", None) —
+    pages and rows replicated in layout, KV heads sharded (GSPMD,
+    arxiv 2105.04663)."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, None, axis, None)
+
+
+def serving_mesh(n: int | None = None, axis: str = "model"):
+    """A 1-D serving mesh over the first `n` local devices (None: the
+    PADDLE_SERVE_MESH_MODEL env knob). Returns None when n <= 1 — the
+    single-chip engine takes no sharding code path at all."""
+    import jax
+    import numpy as np
+
+    from ..utils import env_flags
+    if n is None:
+        n = env_flags.get_int(ENV_SERVE_MESH)
+    n = int(n)
+    if n <= 1:
+        return None
+    devs = jax.devices()
+    if len(devs) < n:
+        raise ValueError(
+            f"{ENV_SERVE_MESH}={n} but only {len(devs)} devices visible")
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def shard_kv_pool(cache, mesh, axis: str = "model"):
+    """device_put every per-layer pool buffer with the KV-head sharding.
+    The buffers are donated through the serving jits, so the placement
+    sticks for the engine's lifetime."""
+    import jax
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, kv_pool_pspec(axis))
+
+    def put(a):
+        return jax.device_put(a, sh)
+
+    return {"k": tuple(put(a) for a in cache["k"]),
+            "v": tuple(put(a) for a in cache["v"])}
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,

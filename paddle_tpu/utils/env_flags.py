@@ -224,6 +224,15 @@ declare("PADDLE_TRIGGER_MAX_CAPTURES", "3",
 declare("PADDLE_TRIGGER_XPLANE_STEPS", "4",
         "steps per trigger-armed XPlane window")
 
+# ------------------------------------------------------------ paged serving
+
+declare("PADDLE_RAGGED_ATTN", "1",
+        "'0' falls back from the ragged Pallas kernel (kv_layout='ragged') "
+        "to the XLA block-table gather — token-identical, bucket-bound")
+declare("PADDLE_SERVE_MESH_MODEL", "0",
+        "shard the serving KV page pool over this many devices along the "
+        "'model' mesh axis (GSPMD; 0/1 = single-chip)")
+
 # ------------------------------------------------------------------- misc
 
 declare("PADDLE_EXTENSION_DIR", "<tempdir>/paddle_tpu_extensions",

@@ -911,3 +911,38 @@ class TestLockRaceRegressions:
     # (the whole-repo A5 cleanliness assertion rides the shared pass in
     # TestTelemetryNameRegistry.
     # test_repo_names_clean_and_standard_declarations_parsed)
+
+
+# ------------------------------------------------------- pre-commit wiring
+
+class TestPreCommitWiring:
+    """ROADMAP tooling item (closed, ISSUE 8): `python -m tools.analyze
+    --changed` is wired into a COMMITTED pre-commit config, and that exact
+    hook command exits clean on the repo itself — findings land before the
+    suite runs, and the config cannot silently drift from the CLI."""
+
+    CONFIG = os.path.join(REPO, ".pre-commit-config.yaml")
+
+    def test_committed_config_wires_the_changed_pass(self):
+        assert os.path.exists(self.CONFIG), \
+            ".pre-commit-config.yaml must be committed at the repo root"
+        src = open(self.CONFIG).read()
+        # string-contract asserts (no yaml dep in the container): the hook
+        # is the diff-scoped analyzer, run as-is against this interpreter
+        assert "python -m tools.analyze --changed" in src
+        assert "language: system" in src
+        assert "pass_filenames: false" in src
+        assert "id: paddle-analyze" in src
+
+    def test_hook_command_is_clean_on_the_repo(self):
+        """Run the exact committed hook entry (fresh interpreter, repo
+        root): a dirty working tree must analyze clean, else every commit
+        in this repo would be blocked."""
+        entry = next(ln.split("entry:", 1)[1].strip()
+                     for ln in open(self.CONFIG)
+                     if ln.strip().startswith("entry:"))
+        assert entry.startswith("python -m tools.analyze")
+        r = subprocess.run([sys.executable, *entry.split()[1:]],
+                           capture_output=True, text=True, cwd=REPO,
+                           timeout=180)
+        assert r.returncode == 0, r.stdout + r.stderr
