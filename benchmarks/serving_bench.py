@@ -42,6 +42,118 @@ def main():
         return 1
 
 
+def _fleet_drill(n_replicas: int) -> dict:
+    """ISSUE 9: N replica PROCESSES + router under a heavy-tail request
+    mix — SIGKILL one replica mid-drill, client honors retry-after on
+    admission rejections, everything accepted must complete. Runs the
+    CPU-smoke model on every backend (replicas are separate processes; N
+    copies of the TPU bench model contending for one chip would measure
+    OOM, not the fleet), so the numbers are about SCHEDULING: rejections,
+    retries, failovers, per-replica TTFT."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.inference.admission import (AdmissionPolicy,
+                                                AdmissionReject)
+    from paddle_tpu.inference.router import ServingFleet
+
+    spec = {
+        "config": {"vocab_size": 256, "hidden_size": 64,
+                   "intermediate_size": 128, "num_hidden_layers": 2,
+                   "num_attention_heads": 4, "num_key_value_heads": 2,
+                   "max_position_embeddings": 128, "dtype": "float32"},
+        "seed": 3,
+        "batcher": {"max_batch": 3, "max_len": 96,
+                    "prompt_buckets": [8, 16, 32], "burst": 4,
+                    "page_size": 8},
+    }
+    n_req = int(os.environ.get("FLEET_DRILL_REQUESTS", "18"))
+    rng = np.random.RandomState(11)
+    # heavy tail: mostly short prompts/budgets, a fat tail of long ones
+    lens = rng.choice([4, 6, 9, 14, 24], n_req, p=[.35, .3, .2, .1, .05])
+    budgets = rng.choice([4, 6, 10, 24], n_req, p=[.4, .3, .2, .1])
+    reqs = [(rng.randint(1, 256, int(n)).tolist(), int(m))
+            for n, m in zip(lens, budgets)]
+
+    import shutil
+
+    root = tempfile.mkdtemp(prefix="fleet_bench_")
+    fleet = ServingFleet(
+        n_replicas, spec, root=root, ttl=1.2,
+        env={"JAX_PLATFORMS": "cpu", "PADDLE_ADMIT_MAX_QUEUE": "4",
+             "PADDLE_CHAOS": ""})
+    t_up0 = _time.perf_counter()
+    try:
+        fleet.start(timeout=180)
+        warmup_s = _time.perf_counter() - t_up0
+        # the router must see the SAME cap the replicas enforce (their
+        # env sets PADDLE_ADMIT_MAX_QUEUE=4): a looser router policy
+        # would burn a doomed round trip + 429 per dispatch to a loaded
+        # replica and distort the least-loaded ordering
+        router = fleet.router(admission=AdmissionPolicy(max_queue=4))
+        rejected = 0
+        rids = []
+        t0 = _time.perf_counter()
+        kill_at = n_req // 2
+        for i, (p, m) in enumerate(reqs):
+            if i == kill_at:
+                fleet.kill(f"r{n_replicas - 1}")   # mid-drill SIGKILL
+            # a well-behaved client honors retry-after — but bounded: a
+            # fleet that loses its LAST replica rejects no_replicas
+            # forever, and an unbounded retry loop would hang the bench
+            # instead of landing the failure in fleet_serve.error (a
+            # hang has no exit for the JSON-line contract to cover)
+            submit_deadline = _time.perf_counter() + 150.0
+            while True:
+                try:
+                    rids.append(router.submit(p, m))
+                    break
+                except AdmissionReject as e:
+                    rejected += 1
+                    if _time.perf_counter() > submit_deadline:
+                        raise TimeoutError(
+                            f"fleet drill: request {i} still rejected "
+                            f"({e.reason}) after 150s of honoring "
+                            "retry-after") from e
+                    _time.sleep(min(e.retry_after_s, 1.0))
+        out = router.wait(timeout=180)
+        drill_s = _time.perf_counter() - t0
+        total_tokens = sum(len(v) for v in out.values())
+
+        # per-replica TTFT distributions off each survivor's /snapshot
+        # (the PR-5/6 observability plane read fleet-wide)
+        per_replica = {}
+        for rid_, snap in router.replica_snapshots().items():
+            ttft = ((snap.get("extra", {}).get("serve", {}) or {})
+                    .get("slo", {}).get("ttft", {}))
+            per_replica[rid_] = {"ttft_p50": ttft.get("p50"),
+                                 "ttft_p95": ttft.get("p95"),
+                                 "count": ttft.get("count", 0)}
+        s = router.summary()
+        return {
+            "replicas": n_replicas,
+            "requests": n_req,
+            # only reason=="complete" counts: router.wait() also returns
+            # requests absorbed as terminal errors (empty tokens), and
+            # completed==requests must not mask one of those
+            "completed": sum(
+                1 for rid in out
+                if (router.result(rid) or {}).get("reason") == "complete"),
+            "rejected": rejected,
+            "retried": s["retried"],
+            "failovers": s["failovers"],
+            "killed": f"serve.r{n_replicas - 1}",
+            "tokens_per_sec": round(total_tokens / drill_s, 1),
+            "warmup_s": round(warmup_s, 2),
+            "per_replica": per_replica,
+        }
+    finally:
+        fleet.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _main():
     n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     max_batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -194,12 +306,26 @@ def _main():
     from paddle_tpu.observability import slo as _slo
     slo_obj = _slo.bench_payload()
 
+    # multi-replica heavy-tail traffic drill (ISSUE 9, ROADMAP-named):
+    # PADDLE_SERVE_REPLICAS >= 2 spawns a replica fleet + router, runs a
+    # heavy-tail request mix with a retry-after-honoring client, SIGKILLs
+    # one replica mid-drill, and reports the fleet_serve sub-object. A
+    # drill failure lands as fleet_serve.error — the JSON line survives.
+    n_replicas = int(os.environ.get("PADDLE_SERVE_REPLICAS", "0") or 0)
+    fleet_obj = None
+    if n_replicas >= 2:
+        try:
+            fleet_obj = _fleet_drill(n_replicas)
+        except BaseException as e:
+            fleet_obj = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": round(total_new / cont_s, 1),
         "unit": "tokens/s",
         "kv_layout": "paged",
         "slo": slo_obj,
+        "fleet_serve": fleet_obj,
         "ragged": ragged_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),
         "vs_dense_slots": round(dense_s / cont_s, 2),

@@ -135,6 +135,19 @@ class FileRegistry:
         except OSError:
             pass
 
+    def info(self, node_id: str) -> dict | None:
+        """The node's last heartbeat info payload, None when the lease has
+        lapsed (same TTL contract as alive_nodes) — how the serving router
+        learns a replica's endpoint from its lease."""
+        try:
+            with open(os.path.join(self.dir, f"{node_id}.hb")) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if time.time() - rec.get("ts", 0) > self.ttl:  # observability: ok (wall-clock liveness TTL, not perf timing)
+            return None
+        return rec.get("info") or {}
+
     # ---- durable KV (re-rendezvous barrier state; no TTL) ----
     def _kv_path(self, key: str) -> str:
         return os.path.join(self.dir, "kv__" + key.replace(os.sep, "_"))
@@ -400,6 +413,20 @@ class KVRegistry:
             urllib.request.urlopen(req, timeout=self.timeout).read()
         except Exception:
             pass
+
+    def info(self, node_id: str) -> dict | None:
+        """The node's last heartbeat info payload via GET /info/<node>
+        (404 = lease lapsed). Mirrors FileRegistry.info for the router."""
+        try:
+            out = self._kv_req(f"/info/{node_id}", op=f"kv.info {node_id}")
+        except Exception:
+            return None
+        if out is None:
+            return None
+        try:
+            return json.loads(out)
+        except ValueError:
+            return None
 
     # ---- durable KV (re-rendezvous barrier state) ----
     def _kv_req(self, path: str, method: str = "GET", data: bytes | None = None,

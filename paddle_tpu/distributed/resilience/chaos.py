@@ -59,6 +59,14 @@ SITES: dict[str, str] = {
     "rpc.send":        "before any wire IO of an rpc call (retry-safe)",
     "serve.admit":     "before a serving request is admitted to a slot",
     "serve.burst":     "before a serving decode burst is dispatched",
+    "serve.reject":    "before an admission rejection is returned (fault "
+                       "degrades the retry-after hint to the floor; the "
+                       "rejection stands)",
+    "serve.replica_dead": "before a dead replica's in-flight request is "
+                          "re-enqueued by the router (fault defers the "
+                          "failover one tick, never loses it)",
+    "serve.route":     "before the router sends a request to a replica "
+                       "(fault leaves it pending for the next tick)",
     "telemetry.export": "before an external metric-sink push",
     "telemetry.push":  "before a fleet telemetry report is sent",
 }

@@ -32,7 +32,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
            "PrecisionType", "LLMPredictor", "ContinuousBatcher",
-           "PredictorPool", "PageAllocator"]
+           "PredictorPool", "PageAllocator", "AdmissionPolicy",
+           "AdmissionReject", "Router", "ServingFleet", "ReplicaServer"]
 
 
 class PrecisionType:
@@ -313,5 +314,8 @@ class LLMPredictor:
                 "avg_ms": 1e3 * sum(ts) / len(ts)}
 
 
+from .admission import AdmissionPolicy, AdmissionReject  # noqa: E402
 from .paging import PageAllocator  # noqa: E402
+from .replica import ReplicaServer  # noqa: E402
+from .router import Router, ServingFleet  # noqa: E402
 from .serving import ContinuousBatcher, PredictorPool  # noqa: E402

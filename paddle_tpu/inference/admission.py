@@ -1,0 +1,185 @@
+"""SLO-aware admission control: reject-with-retry-after, never queue unbounded.
+
+The ROADMAP's serving-fleet item ends with "the ADMISSION decision consuming
+[the PR-6 SLO substrate] is what remains" — this module is that decision.
+An ``AdmissionPolicy`` looks at the live queue depth and the per-request
+latency histograms (``slo.queue_wait_s`` / ``slo.e2e_s`` p95, the exact-
+bucket histograms PR 6 landed) and answers one question per arriving
+request: admit, or reject with a **computed** ``retry_after_s`` hint.
+
+Rejection is the robustness primitive: a serving process under offered load
+beyond its capacity must bound its queue (bounded TTFT for what it DID
+accept) and push the excess back to the client/router with an honest
+estimate of when capacity frees — never grow the queue without bound and
+never wedge. Three thresholds, all env-tunable (``PADDLE_ADMIT_*``):
+
+  * ``max_queue``     — hard cap on queued-not-yet-admitted requests
+                        (default ``4 × max_batch``; the knob of last resort)
+  * ``queue_p95_s``   — measured queue-wait p95 above this target rejects
+                        (queueing delay is already client-visible)
+  * ``e2e_p95_s``     — measured end-to-end p95 above this target rejects
+
+``retry_after_s`` is computed from the same substrate: the queue's depth in
+units of the engine's concurrency, times the measured per-request service
+time (e2e p50), floored at ``PADDLE_ADMIT_RETRY_AFTER_S`` — "your request
+would wait roughly this long; come back then".
+
+The policy is **pure decision**: it never mutates the scheduler. The
+``ContinuousBatcher`` consults it at ``add_request`` when constructed with
+``admission=``, the replica server consults it at its HTTP ``/enqueue``
+boundary, and the router consults it fleet-wide; all three reject through
+:func:`reject`, the ONE place the ``serve.reject`` chaos site lives (a
+fault there degrades the retry-after hint to the floor — the rejection
+itself always stands, so a chaos run serves the same token stream as a
+fault-free one).
+"""
+from __future__ import annotations
+
+from ..distributed.resilience import chaos
+from ..observability import metrics
+from ..utils import env_flags
+
+__all__ = ["AdmissionPolicy", "AdmissionReject", "reject",
+           "retry_after_floor", "slo_hists"]
+
+# declared (defaults + docs) in utils/env_flags.py — read through
+# env_flags.get_float so the declared default is the ONLY default
+ENV_MAX_QUEUE = "PADDLE_ADMIT_MAX_QUEUE"
+ENV_QUEUE_P95 = "PADDLE_ADMIT_QUEUE_P95_S"
+ENV_E2E_P95 = "PADDLE_ADMIT_E2E_P95_S"
+ENV_RETRY_AFTER = "PADDLE_ADMIT_RETRY_AFTER_S"
+
+_QUEUE_HIST = "slo.queue_wait_s"
+_E2E_HIST = "slo.e2e_s"
+
+
+class AdmissionReject(Exception):
+    """Admission refused. ``retry_after_s`` is the computed backoff hint a
+    well-behaved client honors before resubmitting; ``reason`` names the
+    threshold that tripped (``queue_full`` / ``queue_p95`` / ``e2e_p95`` /
+    ``draining`` / ``no_replicas``)."""
+
+    def __init__(self, retry_after_s: float, reason: str):
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+        super().__init__(
+            f"admission rejected ({reason}): retry after "
+            f"{self.retry_after_s:.3f}s")
+
+
+def retry_after_floor() -> float:
+    """The minimum / fallback retry-after hint (PADDLE_ADMIT_RETRY_AFTER_S)."""
+    return max(0.001, env_flags.get_float(ENV_RETRY_AFTER))
+
+
+def reject(reason: str, retry_after_s: float):
+    """The ONE rejection exit: count it, honor the ``serve.reject`` chaos
+    site, raise. A chaos fault here degrades the COMPUTED hint to the floor
+    (the client backs off a default amount instead of the estimate) — it
+    never converts a rejection into an admit or a wedge, so chaos-on serving
+    stays token-identical to fault-free."""
+    try:
+        chaos.hit("serve.reject")
+    except chaos.ChaosError:
+        retry_after_s = retry_after_floor()
+    metrics.counter("serve.rejected").inc()
+    raise AdmissionReject(retry_after_s, reason)
+
+
+def slo_hists() -> dict:
+    """The local process's SLO histogram stats, shaped for
+    :meth:`AdmissionPolicy.decide` — {hist name: {p50, p95, count}}. The
+    router builds the same shape from a replica's remote ``/snapshot``.
+    Reads ONLY the two consumed histograms — a full metrics.snapshot()
+    would sort every registered histogram's reservoir under the registry
+    locks each time. Enqueue boundaries pass the FUNCTION itself as
+    ``hists=`` (decide/retry_after accept a callable and evaluate it at
+    most once, only when actually consumed), so the common
+    admit-with-default-policy path costs zero reservoir sorts."""
+    return {n: metrics.histogram(n).stats() for n in (_QUEUE_HIST, _E2E_HIST)}
+
+
+class AdmissionPolicy:
+    """policy = AdmissionPolicy(); policy.check(queue_depth, max_batch)
+
+    Explicit constructor args override the env; ``None`` falls back to the
+    ``PADDLE_ADMIT_*`` env var. ``max_queue=0`` means the ``4 × max_batch``
+    default; latency thresholds unset mean that dimension never rejects.
+    """
+
+    def __init__(self, max_queue: int | None = None,
+                 queue_p95_s: float | None = None,
+                 e2e_p95_s: float | None = None):
+        self.max_queue = int(env_flags.get_float(ENV_MAX_QUEUE)
+                             if max_queue is None else max_queue)
+        self.queue_p95_s = (env_flags.get_float(ENV_QUEUE_P95)
+                            if queue_p95_s is None else float(queue_p95_s))
+        self.e2e_p95_s = (env_flags.get_float(ENV_E2E_P95)
+                          if e2e_p95_s is None else float(e2e_p95_s))
+
+    def max_queue_for(self, max_batch: int) -> int:
+        """The effective queue cap for an engine with ``max_batch`` slots."""
+        return self.max_queue if self.max_queue > 0 else 4 * max(1, max_batch)
+
+    def retry_after(self, queue_depth: int, max_batch: int,
+                    hists=None) -> float:
+        """Estimated seconds until capacity frees: queue depth in units of
+        the engine's concurrency × measured per-request e2e p50, floored.
+        ``hists`` is the :func:`slo_hists` dict or a callable producing it
+        (evaluated here, on the reject path only)."""
+        if callable(hists):
+            hists = hists()
+        service = None
+        if hists:
+            service = (hists.get(_E2E_HIST) or {}).get("p50")
+        if not service or service <= 0:
+            return retry_after_floor()
+        waves = (queue_depth + 1) / max(1, max_batch)
+        return max(retry_after_floor(), waves * float(service))
+
+    def decide(self, queue_depth: int, max_batch: int,
+               hists=None) -> dict | None:
+        """None to admit, else {reason, retry_after_s}. Pure; no metrics,
+        no raise — :func:`reject` / :meth:`check` own the side effects.
+        ``hists`` may be the :func:`slo_hists` dict or a callable producing
+        it: a callable is evaluated AT MOST ONCE and only when a decision
+        actually consumes it (a latency threshold to test, or a rejection's
+        retry-after to compute) — the common admit path never pays the
+        reservoir sorts.
+
+        The latency thresholds only apply while work is QUEUED: rejected
+        requests are never measured (on_reject drops the record), so the
+        histogram window that tripped a threshold refreshes only through
+        served work — if an idle engine (queue_depth == 0) could reject on
+        a p95 frozen above target by a past burst, no new sample would
+        ever enter the window and the rejection would latch forever. An
+        empty queue means the arriving request is served immediately, so
+        historical latency is moot: admit, let its retirement refresh the
+        window."""
+        cache: dict = {}
+
+        def resolve():
+            if "v" not in cache:
+                cache["v"] = hists() if callable(hists) else hists
+            return cache["v"]
+
+        ra = lambda: self.retry_after(queue_depth, max_batch, resolve())  # noqa: E731
+        if queue_depth >= self.max_queue_for(max_batch):
+            return {"reason": "queue_full", "retry_after_s": ra()}
+        if hists is not None and queue_depth > 0 \
+                and (self.queue_p95_s > 0 or self.e2e_p95_s > 0):
+            hv = resolve() or {}
+            qp95 = (hv.get(_QUEUE_HIST) or {}).get("p95")
+            if self.queue_p95_s > 0 and qp95 and qp95 > self.queue_p95_s:
+                return {"reason": "queue_p95", "retry_after_s": ra()}
+            ep95 = (hv.get(_E2E_HIST) or {}).get("p95")
+            if self.e2e_p95_s > 0 and ep95 and ep95 > self.e2e_p95_s:
+                return {"reason": "e2e_p95", "retry_after_s": ra()}
+        return None
+
+    def check(self, queue_depth: int, max_batch: int, hists=None):
+        """Raise :class:`AdmissionReject` (through :func:`reject`) when
+        :meth:`decide` says no; otherwise return None."""
+        d = self.decide(queue_depth, max_batch, hists)
+        if d is not None:
+            reject(d["reason"], d["retry_after_s"])

@@ -1,0 +1,521 @@
+"""One serving replica: a ContinuousBatcher behind HTTP, held by a lease.
+
+The fleet runtime (ISSUE 9) runs N of these — each its own PROCESS
+(``python -m paddle_tpu.inference.replica``), each optionally
+GSPMD-sharded across its own devices — behind ``inference/router.py``.
+A replica is three things bolted onto one batcher:
+
+  * **an HTTP face** — the sanctioned AdminServer (lint O3) extended with
+    POST ``/enqueue`` (body ``{rid, prompt, max_new_tokens, trace_id,
+    force}``; 200 admits, 429 carries the computed ``retry_after_s``),
+    GET ``/results?since=N`` (finished outputs after cursor N — the router
+    polls, nothing pushes), POST ``/drain``, and the readiness ``/health``
+    (ready / draining / queue depth / free pages — the one probe endpoint
+    a router or external LB needs);
+  * **a lease** — a heartbeat under ``serve.<id>`` into the SAME elastic
+    registry (FileRegistry / KVServer) training uses for membership, TTL'd
+    so a SIGKILL'd replica leaves the routing table within one TTL with no
+    extra machinery;
+  * **a serve loop** — the ONE thread that owns the batcher (the scheduler
+    is not thread-safe by design); HTTP handler threads only touch the
+    intake/results buffers under ``self._lk``, and the loop moves intake →
+    ``add_request`` → ``step()`` → results between bursts.
+
+Admission happens at the HTTP boundary (AdmissionPolicy against intake +
+queue depth and the local SLO histograms) so a 429 is computed WITHOUT
+waiting for the serve loop; ``force`` (router failover re-enqueues of
+already-accepted work) bypasses the policy — the batcher's newest-first
+shed valve bounds the queue even then.
+
+Drain protocol: ``/drain`` (or SIGTERM) → finish every accepted request,
+429 new admits with retry-after, deregister the lease, keep answering
+``/results`` until the router has collected everything, exit 0. Past
+``PADDLE_DRAIN_GRACE_S`` the still-queued remainder is shed (reason
+"shed" — the router re-routes it); in-flight slots always run to their
+budget.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from collections import deque
+
+from ..distributed.fleet.elastic import FileRegistry, KVRegistry
+from ..observability import metrics, recorder as _recorder, slo as _slo
+from ..observability.admin import AdminServer
+from ..utils import env_flags
+from .admission import AdmissionPolicy, AdmissionReject, \
+    reject as _admission_reject, retry_after_floor, slo_hists
+from .serving import ContinuousBatcher
+
+__all__ = ["ReplicaServer", "REPLICA_PREFIX", "build_batcher", "main"]
+
+# registry node ids of serving replicas: "serve.<replica name>" — the
+# router discovers the fleet by this prefix in the shared alive set
+REPLICA_PREFIX = "serve."
+
+# declared (defaults + docs) in utils/env_flags.py
+ENV_TTL = "PADDLE_SERVE_TTL"
+ENV_HEARTBEAT = "PADDLE_SERVE_HEARTBEAT_S"
+ENV_DRAIN_GRACE = "PADDLE_DRAIN_GRACE_S"
+ENV_RESULTS_KEEP = "PADDLE_SERVE_RESULTS_KEEP"
+
+
+class ReplicaServer:
+    """rep = ReplicaServer(batcher, registry, "r0").start(); rep.endpoint
+
+    Owns the batcher's serve loop, the admin HTTP face, and the lease
+    heartbeat. ``stop()`` kills it hard (tests); ``begin_drain()`` runs
+    the drain protocol and lets the loop exit clean."""
+
+    def __init__(self, batcher: ContinuousBatcher, registry, name: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_s: float | None = None,
+                 drain_grace_s: float | None = None):
+        self._b = batcher
+        self._registry = registry
+        self.replica_id = (name if name.startswith(REPLICA_PREFIX)
+                           else REPLICA_PREFIX + name)
+        ttl = getattr(registry, "ttl", env_flags.get_float(ENV_TTL))
+        self._hb_s = (heartbeat_s if heartbeat_s is not None
+                      else max(0.05, env_flags.get_float(ENV_HEARTBEAT)
+                               or ttl / 4.0))
+        self._drain_grace = (drain_grace_s if drain_grace_s is not None
+                             else env_flags.get_float(ENV_DRAIN_GRACE))
+        self._lk = threading.Lock()
+        # (rid, prompt, mnt, trace_id, force, router-namespace)
+        self._intake: deque = deque()
+        # finished results, cursor-addressed: the wire cursor for
+        # _results[i] is _results_base + i. The prefix every poller has
+        # had PADDLE_SERVE_RESULTS_KEEP results' worth of polls to collect
+        # is truncated (base advances) so a replica serving steady traffic
+        # for days holds a BOUNDED result tail, not every token it ever
+        # emitted; a draining replica never truncates (its drained answer
+        # promises the slice is complete)
+        self._results: list[dict] = []
+        self._results_base = 0
+        self._results_keep = int(env_flags.get_float(ENV_RESULTS_KEEP))
+        self._active: set = set()       # (router ns, rid) queued/in flight
+        self._draining = False
+        self._drain_t0: float | None = None
+        self._drained_flag = False  # set by the serve loop AFTER its final
+        #                             _collect(), so /results never reports
+        #                             drained with a result still unpushed
+        self._stop = threading.Event()
+        self.crash: BaseException | None = None  # serve-loop death, if any
+        self._rid_map: dict[int, tuple] = {}  # local rid -> (router rid, tid)
+        self._admin = AdminServer(
+            port=port, host=host,
+            extra={"serve": batcher.admin_summary, "replica": self.summary},
+            health=self._health,
+            get_routes={"/results": self._h_results},
+            post_routes={"/enqueue": self._h_enqueue,
+                         "/drain": self._h_drain})
+        self.port = self._admin.port
+        self.endpoint = f"http://{host}:{self.port}"
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ReplicaServer":
+        # first heartbeat is synchronous: the lease exists before start()
+        # returns, so a spawner can wait on the registry, not on logs
+        self._registry.heartbeat(self.replica_id, self._lease_info())
+        self._admin.start()
+        for fn in (self._beat, self._loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def begin_drain(self):
+        with self._lk:
+            if not self._draining:
+                self._draining = True
+                self._drain_t0 = _slo.now()
+        self._b.begin_drain()
+
+    def join(self, timeout: float | None = None) -> bool:
+        """Wait for the serve loop to exit (drain complete or stop())."""
+        self._threads[1].join(timeout)
+        return not self._threads[1].is_alive()
+
+    def stop(self):
+        """Hard stop (tests/teardown): no drain, lease left to lapse."""
+        self._stop.set()
+        self.join(5.0)
+        self._admin.stop()
+
+    def _lease_info(self) -> dict:
+        return {"endpoint": self.endpoint, "pid": os.getpid(),
+                "max_batch": self._b.B}
+
+    # ------------------------------------------------------- HTTP handlers
+    def _health(self) -> dict:
+        doc = self._b.health_summary()
+        with self._lk:
+            doc["queue_depth"] += len(self._intake)
+            doc["draining"] = doc["draining"] or self._draining
+            doc["ready"] = doc["ready"] and not self._draining
+        doc["replica"] = self.replica_id
+        return doc
+
+    def summary(self) -> dict:
+        with self._lk:
+            return {"replica": self.replica_id, "endpoint": self.endpoint,
+                    "intake": len(self._intake),
+                    "results": len(self._results),
+                    "draining": self._draining}
+
+    def _h_enqueue(self, body: dict):
+        """POST /enqueue — the admission boundary. Decided HERE, in the
+        handler thread, against intake+queue depth and the local SLO
+        histograms; the serve loop is never waited on, so a 429 costs one
+        round trip even mid-burst."""
+        try:
+            rid = int(body["rid"])
+            prompt = [int(t) for t in body["prompt"]]
+            mnt = int(body.get("max_new_tokens", 32))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"ok": False, "reason": f"bad request: {e}"}
+        tid = body.get("trace_id")
+        force = bool(body.get("force"))
+        rtr = body.get("router")
+        try:
+            # never-admissible requests (over-budget, impossible page
+            # demand) are refused HERE with a 400 — BEFORE any retryable
+            # rejection (accepting one would turn the serve loop's
+            # add_request ValueError into a silent empty result, and a
+            # 429 would have an honoring client resubmit the impossible
+            # request forever); reads only immutable engine config
+            self._b.check_admissible(prompt, mnt)
+        except ValueError as e:
+            return 400, {"ok": False, "reason": f"invalid: {e}"}
+        pol = self._b.admission
+        # the slo_hists FUNCTION, not its result: decide() evaluates it
+        # at most once and only when a decision consumes it (configured
+        # latency threshold, or a rejection's retry-after), so the common
+        # admit costs zero reservoir sorts; when it IS consumed the sorts
+        # run under _lk, acceptable because rejection is not the
+        # steady-state path and the two reservoirs are bounded
+        hists = (slo_hists if pol is not None and not force else None)
+        with self._lk:
+            if rtr is not None and (rtr, rid) in self._active:
+                # idempotent accept: a send whose response was lost after
+                # the enqueue landed is retried by the router — while the
+                # first copy is still queued/in flight the retry must NOT
+                # start a second generation. Only namespaced (router)
+                # senders get dedup: a bare client's rids carry no
+                # cross-send identity
+                return 200, {"ok": True, "rid": rid, "dedup": True,
+                             "replica": self.replica_id}
+            if self._draining and (not force or self._drained_flag):
+                # force (router failover of already-accepted work) is
+                # honored during drain — same contract as add_request —
+                # but only while the serve loop is still alive to run it
+                # (_drained_flag flips atomically with the loop's exit
+                # decision under this lock, so an accept here is GUARANTEED
+                # to be seen by the loop's next drained check)
+                return self._reject_429("draining", retry_after_floor())
+            if pol is not None and not force:
+                depth = len(self._intake) + self._b.health_summary()[
+                    "queue_depth"]
+                d = pol.decide(depth, self._b.B, hists=hists)
+                if d is not None:
+                    return self._reject_429(d["reason"],
+                                            d["retry_after_s"])
+            self._intake.append((rid, prompt, mnt, tid, force, rtr))
+            self._active.add((rtr, rid))
+        return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
+
+    def _reject_429(self, reason: str, retry_after_s: float):
+        """Route the HTTP rejection through admission.reject — the ONE
+        rejection exit — so the serve.reject chaos site and the
+        serve.rejected counter cover this boundary too; the raise is
+        translated back to the wire 429 here."""
+        metrics.counter("serve.replica.rejected").inc()
+        try:
+            _admission_reject(reason, retry_after_s)
+        except AdmissionReject as e:
+            return 429, {"ok": False, "reason": e.reason,
+                         "retry_after_s": e.retry_after_s}
+
+    def _h_results(self, query: dict):
+        """GET /results?since=N — finished outputs after cursor N.
+        Cursors are monotone over the replica's lifetime; the retained
+        list may have a truncated prefix (bounded retention), so position
+        N lives at list index N - base. A ``since`` behind the base gets
+        the oldest retained results plus the base, so a lagging poller
+        can SEE it missed some instead of silently resyncing."""
+        try:
+            since = int(query.get("since", ["0"])[0])
+        except ValueError:
+            since = 0
+        with self._lk:
+            # drained is read in the SAME lock snapshot as the results
+            # slice: the serve loop only sets the flag after its final
+            # _collect(), so drained=true implies this slice is complete
+            # (a router deletes a drained handle — a result published
+            # after a drained answer would be lost forever; truncation is
+            # disabled while draining for the same reason)
+            base = self._results_base
+            out = self._results[max(0, since - base):]
+            cursor = base + len(self._results)
+            draining = self._draining
+            drained = self._drained_flag
+        return 200, {"results": out, "cursor": cursor, "base": base,
+                     "draining": draining, "drained": drained,
+                     "replica": self.replica_id}
+
+    def _h_drain(self, body: dict):
+        self.begin_drain()
+        return 200, {"ok": True, "draining": True,
+                     "pending": self._b.pending}
+
+    @property
+    def drained(self) -> bool:
+        """ONE definition of drained, shared with /results: the flag the
+        serve loop sets only AFTER its final collect. Deriving it from
+        intake/pending here would re-open the hardened race (True in the
+        window between the last step() and _collect(), with the final
+        result still unpublished)."""
+        with self._lk:
+            return self._drained_flag
+
+    # ---------------------------------------------------------- serve loop
+    def _beat(self):
+        info = self._lease_info()
+        while not self._stop.wait(self._hb_s):
+            with self._lk:
+                if self._draining:
+                    return  # the loop deregisters; stop renewing the lease
+            try:
+                self._registry.heartbeat(self.replica_id, info)
+                with self._lk:
+                    draining = self._draining
+                if draining:
+                    # drain began while that heartbeat was in flight —
+                    # it may have landed AFTER the serve loop's leave()
+                    # and resurrected the lease (the drained replica
+                    # would then absorb routing attempts for a full
+                    # TTL). Deregister again; leave is idempotent.
+                    try:
+                        self._registry.leave(self.replica_id)
+                    except Exception:
+                        pass
+                    return
+            except Exception as e:
+                # a registry blip must not kill serving; the TTL is the
+                # arbiter — if blips outlast it, the router fails us over
+                _recorder.record("serve.replica.heartbeat_error",
+                                 replica=self.replica_id,
+                                 error=f"{type(e).__name__}: {e}")
+
+    def _loop(self):
+        try:
+            self._run_loop()
+        except Exception as e:
+            # the serve loop dying must NOT leave a zombie: the heartbeat
+            # thread would keep renewing the lease and the HTTP face would
+            # keep accepting, so the router would route to a replica that
+            # can never serve and failover would never fire. Tear down the
+            # failure-detector inputs instead — deregister, stop the admin
+            # (unreachable /results is what lets the router declare death
+            # and fail our in-flight work over), and stop the heartbeat.
+            _recorder.record("serve.replica.loop_crash", echo=True,
+                             message=f"[serve] replica {self.replica_id} "
+                                     f"serve loop died: "
+                                     f"{type(e).__name__}: {e}",
+                             replica=self.replica_id,
+                             error=f"{type(e).__name__}: {e}")
+            self.crash = e      # main() turns this into a nonzero exit
+            self._stop.set()
+            try:
+                self._registry.leave(self.replica_id)
+            except Exception:
+                pass
+            try:
+                self._admin.stop()
+            except Exception:
+                pass
+            # no re-raise: the flight record above (echo=True) already
+            # carries the story to stderr/logs; an unhandled daemon-thread
+            # exception would only add noise on top of the teardown
+
+    def _run_loop(self):
+        deregistered = False
+        while not self._stop.is_set():
+            with self._lk:
+                moved = list(self._intake)
+                self._intake.clear()
+                draining = self._draining
+                drain_t0 = self._drain_t0
+            for rid, prompt, mnt, tid, force, rtr in moved:
+                try:
+                    # admission already happened at the HTTP boundary —
+                    # force=True here so the policy isn't double-applied
+                    local = self._b.add_request(prompt, mnt, trace_id=tid,
+                                                force=True)
+                except Exception as e:
+                    self._push_result(rid, tid, rtr, [],
+                                      f"error: {type(e).__name__}: {e}")
+                    continue
+                self._rid_map[local] = (rid, tid, rtr)
+            if draining and not deregistered:
+                # reject-new is already live (the handler checks); now
+                # leave the routing table so the router stops choosing us
+                try:
+                    self._registry.leave(self.replica_id)
+                except Exception:
+                    pass
+                deregistered = True
+            if draining and drain_t0 is not None \
+                    and _slo.now() - drain_t0 > self._drain_grace:
+                # grace exceeded: shed the still-QUEUED remainder (the
+                # router re-routes it); in-flight slots run to budget
+                self._b.shed_newest(
+                    self._b.health_summary()["queue_depth"])
+            if self._b.pending:
+                self._b.step()
+            self._collect()
+            if draining:
+                # atomic exit decision: the drained check and the flag
+                # flip share one lock acquisition with /enqueue's accept,
+                # so a force re-enqueue either lands BEFORE this check
+                # (intake non-empty → the loop keeps serving) or is
+                # rejected AFTER the flag flips — never accepted into a
+                # loop that already decided to exit
+                with self._lk:
+                    if not self._intake and self._b.pending == 0:
+                        self._drained_flag = True
+                        break
+            if not self._b.pending:
+                self._stop.wait(0.003)  # idle: don't spin the scheduler
+        with self._lk:
+            clean = self._draining
+        if clean:
+            _recorder.record("serve.replica.drained", echo=True,
+                             message=f"[serve] replica {self.replica_id} "
+                                     "drained clean",
+                             replica=self.replica_id)
+
+    def _push_result(self, rid, tid, rtr, tokens, reason):
+        with self._lk:
+            # the (router, rid) key leaves the active set in the same
+            # lock acquisition that publishes the result: a shed request
+            # re-routed back here must be accepted again, not deduped
+            self._active.discard((rtr, rid))
+            self._results.append({"rid": rid, "trace_id": tid,
+                                  "router": rtr, "tokens": list(tokens),
+                                  "reason": reason})
+            keep = self._results_keep
+            if keep > 0 and not self._draining \
+                    and len(self._results) > keep:
+                # bound the retained tail: a router polls every tick, so
+                # lagging `keep` whole results behind means it long ago
+                # declared us dead (or is gone); its loss is a timeout on
+                # ITS side, not unbounded RSS on ours
+                drop = len(self._results) - keep
+                del self._results[:drop]
+                self._results_base += drop
+
+    def _collect(self):
+        for local, req in self._b.take_finished().items():
+            rid, tid, rtr = self._rid_map.pop(local,
+                                              (local, req.trace_id, None))
+            self._push_result(rid, tid, rtr, req.out, req.reason)
+            # completed means SERVED to budget: a shed (never served,
+            # re-routed elsewhere) or an error result counted here would
+            # make fleet-summed completions exceed the request count
+            # exactly during the degradation events the counter is meant
+            # to illuminate
+            if req.reason == "complete":
+                metrics.counter("serve.replica.completed").inc()
+
+
+# ------------------------------------------------------------ process entry
+
+def build_batcher(spec: dict) -> ContinuousBatcher:
+    """A batcher from a JSON-able spec: {"config": {LlamaConfig kwargs,
+    "dtype": "float32"}, "seed": 0, "batcher": {ContinuousBatcher kwargs}}.
+    Every replica of a fleet builds from the SAME spec, so weights are
+    identical across replicas and a failover retry at temperature=0 is
+    token-identical to the first attempt."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig, llama_init_params
+
+    ckw = dict(spec.get("config") or {})
+    if "dtype" in ckw:
+        ckw["dtype"] = jnp.dtype(ckw["dtype"])
+    cfg = LlamaConfig(**ckw)
+    params = llama_init_params(cfg, jax.random.PRNGKey(int(spec.get("seed",
+                                                                    0))))
+    bkw = dict(spec.get("batcher") or {})
+    bkw.setdefault("temperature", 0.0)
+    if isinstance(bkw.get("prompt_buckets"), list):
+        bkw["prompt_buckets"] = tuple(bkw["prompt_buckets"])
+    return ContinuousBatcher(cfg, params, admission=AdmissionPolicy(),
+                             **bkw)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="serving replica process (ISSUE 9 fleet runtime)")
+    p.add_argument("--name", required=True,
+                   help="replica name (lease id = serve.<name>)")
+    p.add_argument("--spec", required=True,
+                   help="model/batcher spec JSON, or @/path/to/spec.json")
+    p.add_argument("--registry-root", default="",
+                   help="FileRegistry root directory")
+    p.add_argument("--registry-endpoint", default="",
+                   help="KVServer endpoint (host:port) instead of a root dir")
+    p.add_argument("--job-id", default=os.environ.get("PADDLE_JOB_ID",
+                                                      "default"))
+    p.add_argument("--ttl", type=float,
+                   default=env_flags.get_float(ENV_TTL))
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+
+    raw = args.spec
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    spec = json.loads(raw)
+
+    if args.registry_endpoint:
+        registry = KVRegistry(args.registry_endpoint, ttl=args.ttl)
+    elif args.registry_root:
+        registry = FileRegistry(args.registry_root, args.job_id,
+                                ttl=args.ttl)
+    else:
+        p.error("--registry-root or --registry-endpoint required")
+
+    batcher = build_batcher(spec)
+    rep = ReplicaServer(batcher, registry, args.name, host=args.host,
+                        port=args.port)
+    signal.signal(signal.SIGTERM, lambda *a: rep.begin_drain())
+    rep.start()
+    # one machine-readable line for the spawner, then serve until drained
+    print(json.dumps({"replica": rep.replica_id,  # observability: ok (spawner handshake line on stdout, not runtime telemetry)
+                      "endpoint": rep.endpoint,
+                      "pid": os.getpid()}), flush=True)
+    while not rep.join(timeout=60.0):
+        pass
+    # linger so the router can collect the final /results page, then exit
+    rep._stop.wait(max(1.0, args.ttl))
+    rep._admin.stop()
+    # a crashed serve loop must NOT exit 0: rc=0 is the drain protocol's
+    # "finished clean" signal — a supervisor with restart-on-failure
+    # (systemd/k8s) would treat a crash as a deliberate exit and never
+    # restart it, silently losing fleet capacity
+    return 0 if rep.crash is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,735 @@
+"""Serving-fleet router: lease-based health, least-loaded routing, failover.
+
+ISSUE 9 tentpole. One process is a throughput ceiling AND a single point
+of failure; this router puts N ``ReplicaServer`` processes (each a
+``ContinuousBatcher``, each optionally GSPMD-sharded) behind one submit()
+surface with three robustness guarantees:
+
+  * **health is a lease** — replicas heartbeat ``serve.<name>`` into the
+    SAME elastic registry (FileRegistry / KVServer) the training fleet
+    uses; the router's routing table is the TTL'd alive set, so a
+    SIGKILL'd replica leaves the table within one TTL with no extra
+    failure detector. Before declaring a missing lease dead the router
+    makes one final ``/results`` poll: a DRAINED replica (deliberate
+    deregister) is collected and removed clean — only an UNREACHABLE one
+    is failed over.
+  * **admission is a decision, not a queue** — submit() consults each
+    candidate's readiness probe (``/health``: queue depth, draining) and
+    the fleet AdmissionPolicy; when nobody can take the request it
+    rejects with a computed ``retry_after_s`` (``AdmissionReject``)
+    instead of queueing unboundedly. The router's own ``_pending`` holds
+    ONLY already-accepted work (failover re-enqueues and replica sheds) —
+    bounded by what was admitted, never by offered load.
+  * **failover keeps the trace** — a request in flight on a dead replica
+    is re-enqueued on a healthy one carrying the SAME trace id
+    (``slo.on_enqueue(trace_id=...)`` on the far side) and ``force=True``
+    (accepted work must land); at temperature=0 the retried output is
+    token-identical, so a mid-decode SIGKILL is invisible in the token
+    stream. Retire stays exactly-once per request: the first result wins,
+    late duplicates from a falsely-suspected replica are dropped and
+    counted.
+
+Chaos sites (the fleet extension of the chaos==fault-free discipline):
+``serve.route`` fails one routing send (the request stays pending and
+routes next tick), ``serve.replica_dead`` fails one failover re-enqueue
+(deferred to the next tick, never lost), ``serve.reject`` degrades a
+rejection's computed retry-after to the floor (the rejection stands) —
+a chaos-on drill serves byte-identical tokens to a fault-free one.
+
+Threading contract: the Router is SINGLE-THREADED by design — submit /
+tick / wait / drain are called from one client thread (the replicas are
+the concurrency). Metrics: ``serve.fleet.*`` counters/gauges; the
+router's own RequestTracker (source="router") fills the slo.* histograms
+with FLEET-level queue/e2e measurements and keeps trace ids.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..distributed.fleet.elastic import FileRegistry
+from ..distributed.resilience import chaos
+from ..distributed.resilience.retry import classify
+from ..observability import metrics, recorder as _recorder, slo as _slo
+from ..observability.admin import job_token
+from .admission import AdmissionPolicy, AdmissionReject, reject as _reject, \
+    retry_after_floor, slo_hists
+from .replica import REPLICA_PREFIX
+
+__all__ = ["Router", "RoutedRequest", "ServingFleet", "AdmissionReject"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclass
+class RoutedRequest:
+    rid: int
+    prompt: list
+    max_new_tokens: int
+    trace_id: int
+    replica: str | None = None   # where it is in flight (None = pending)
+    attempts: int = 0
+    retried: bool = False        # went through failover/shed at least once
+    retry_hint: float = 0.0      # max computed retry_after_s seen in 429
+    #                              bodies this pass — a saturated fleet's
+    #                              rejection propagates the replicas' own
+    #                              estimate instead of the floor
+    last_faulted: str | None = None  # replica whose send faulted mid-wire
+    #                                  (AMBIGUOUS: may have landed) — the
+    #                                  re-dispatch must try it FIRST so
+    #                                  its (router, rid) dedup can absorb
+
+
+@dataclass
+class _Handle:
+    """Routing-table entry for one live replica."""
+    id: str
+    endpoint: str
+    max_batch: int = 1
+    queue_depth: int = 0
+    active: int = 0
+    draining: bool = False
+    ready: bool = True
+    cursor: int = 0              # /results read position
+    last_probe: float = field(default_factory=_slo.now)
+
+    @property
+    def load(self) -> float:
+        return (self.queue_depth + self.active) / max(1, self.max_batch)
+
+
+class Router:
+    """router = Router(registry); rid = router.submit(prompt, 16)
+
+    `registry`: the FileRegistry/KVRegistry the replicas lease into.
+    `admission`: the fleet AdmissionPolicy (env-built when None).
+    """
+
+    def __init__(self, registry, admission: AdmissionPolicy | None = None,
+                 http_timeout_s: float | None = None,
+                 probe_interval_s: float = 0.05):
+        self._registry = registry
+        self._admission = admission or AdmissionPolicy()
+        # probes are serial and submit() refreshes inline, so one wedged
+        # replica (SIGSTOP, GC pause — socket accepts, reads block) must
+        # not stall routing for longer than the lease that will bury it:
+        # bound the timeout by the TTL unless the caller says otherwise
+        ttl = float(getattr(registry, "ttl", 5.0))
+        self._timeout = (max(1.0, ttl / 2.0) if http_timeout_s is None
+                         else float(http_timeout_s))
+        self._probe_s = float(probe_interval_s)
+        self._handles: dict[str, _Handle] = {}
+        self._pending: deque[RoutedRequest] = deque()
+        self._inflight: dict[int, RoutedRequest] = {}
+        self._orphans: deque[int] = deque()  # failover deferred by chaos
+        self._done: dict[int, dict] = {}
+        self._requests: dict[int, RoutedRequest] = {}
+        self._next_rid = 0
+        # rid NAMESPACE: rids are router-local, but /results is one
+        # shared per-replica list — every send carries this id and
+        # _absorb ignores records stamped by OTHER routers, so N routers
+        # over the same lease set cannot deliver each other's tokens
+        self._rid_ns = uuid.uuid4().hex[:12]
+        self._last_refresh = -1e9
+        self._last_collect = -1e9
+        self._last_info_check = -1e9
+        # fleet-level SLO story: enqueue at submit, admit at routing,
+        # preempt at failover, retire exactly-once at the first result —
+        # trace ids issued HERE flow to every replica attempt
+        self.slo = _slo.RequestTracker(source="router")
+        metrics.gauge("serve.fleet.replicas")
+        for c in ("routed", "rejected", "retried", "failovers",
+                  "route_faults", "dup_results"):
+            metrics.counter(f"serve.fleet.{c}")
+
+    # --------------------------------------------------------------- HTTP
+    def _headers(self, post: bool) -> dict:
+        h = {"Content-Type": "application/json"} if post else {}
+        if post:
+            h["X-Paddle-Job-Token"] = job_token()
+        tok = os.environ.get("PADDLE_ADMIN_READ_TOKEN", "")
+        if tok:
+            h["X-Paddle-Admin-Token"] = tok
+        return h
+
+    def _get(self, endpoint: str, path: str) -> dict | None:
+        """GET json, None on any transport fault (the lease decides life,
+        not one dropped poll). Non-transient errors propagate — a bug in
+        OUR code must not masquerade as a dead replica. That includes an
+        HTTP status error (403/404/500): a status line IS reachability
+        proof, so it must surface loudly (a read-auth misconfig or a
+        handler bug), never read as a dead replica and trigger a failover
+        that runs the same work twice. HTTPError subclasses OSError, so it
+        must be re-raised BEFORE the transient classification."""
+        try:
+            req = urllib.request.Request(endpoint + path,
+                                         headers=self._headers(False))
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError:
+            raise
+        except Exception as e:
+            if _transient_send(e):
+                return None
+            raise
+
+    def _post(self, endpoint: str, path: str, obj: dict) -> tuple[int, dict]:
+        """POST json -> (status, body). 4xx statuses are ANSWERS (429 =
+        admission data); transport faults return (0, {}) and the caller's
+        retry/tick discipline owns recovery — the resilience classify()
+        split applied to routed sends."""
+        data = json.dumps(obj).encode()
+        try:
+            req = urllib.request.Request(endpoint + path, data=data,
+                                         headers=self._headers(True),
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {}
+            return e.code, body
+        except Exception as e:
+            if _transient_send(e):
+                return 0, {}
+            raise
+
+    # ---------------------------------------------------------- discovery
+    def refresh(self, force: bool = False):
+        """Sync the routing table with the lease set and re-probe health.
+        Dead-replica handling lives here: lease gone + final poll
+        unreachable → fail its in-flight work over."""
+        now = _slo.now()
+        if not force and now - self._last_refresh < self._probe_s:
+            return
+        self._last_refresh = now
+        alive = {n for n in self._registry.alive_nodes()
+                 if n.startswith(REPLICA_PREFIX)}
+        # same-name restart within TTL: a supervisor relaunched a replica
+        # under the same lease id before the lease ever lapsed, so the
+        # alive set never dropped it — but the process (and its port) is
+        # NEW. Without a re-read the handle's endpoint goes permanently
+        # stale: every send fails transient, the live lease blocks
+        # _mark_dead, and the requests park forever. An endpoint change
+        # IS the death certificate of the old process — fail its
+        # in-flight work over and re-join the fresh one (new handle ⇒
+        # results cursor restarts at 0). Throttled to ttl/4: info() is a
+        # second registry read per replica that alive_nodes() just paid,
+        # and the lease-based detector itself only promises one TTL.
+        ttl = float(getattr(self._registry, "ttl", 1.0) or 1.0)
+        if force or now - self._last_info_check >= max(self._probe_s,
+                                                       ttl / 4.0):
+            self._last_info_check = now
+            for rid in sorted(alive & set(self._handles)):
+                ep = (self._registry.info(rid) or {}).get("endpoint")
+                if ep and ep != self._handles[rid].endpoint:
+                    self._mark_dead(self._handles[rid])
+        for rid in sorted(alive - set(self._handles)):
+            info = self._registry.info(rid) or {}
+            ep = info.get("endpoint")
+            if not ep:
+                continue  # lease without an endpoint: not routable yet
+            self._handles[rid] = _Handle(id=rid, endpoint=ep,
+                                         max_batch=int(info.get("max_batch",
+                                                                1)))
+            _recorder.record("serve.route_table", replica=rid, event="join",
+                             endpoint=ep)
+        for rid in sorted(set(self._handles) - alive):
+            h = self._handles[rid]
+            # final poll before the verdict: drained replicas deregister
+            # on purpose and keep answering until collected
+            res = self._collect_one(h)
+            if res is None:
+                self._mark_dead(h)        # unreachable: lease was truth
+            elif res.get("drained"):
+                del self._handles[rid]    # clean exit, results collected
+                _recorder.record("serve.route_table", replica=rid,
+                                 event="drained")
+            # else: reachable but lease lapsed (registry blip / slow beat)
+            # — keep routing to it; the next refresh re-checks
+        for h in self._handles.values():
+            doc = self._get(h.endpoint, "/health")
+            if doc:
+                h.queue_depth = int(doc.get("queue_depth", h.queue_depth))
+                h.active = int(doc.get("active_slots", h.active))
+                h.max_batch = int(doc.get("max_batch", h.max_batch))
+                h.draining = bool(doc.get("draining"))
+                h.ready = bool(doc.get("ready", True))
+                h.last_probe = now
+        metrics.gauge("serve.fleet.replicas").set(len(self._handles))
+
+    def _mark_dead(self, h: _Handle):
+        del self._handles[h.id]
+        for q in self._pending:
+            if q.last_faulted == h.id:
+                # the dedup probe is meaningless once the replica's
+                # results can never be collected — and a stale marker
+                # would hold tick() in unthrottled /results polling for
+                # the whole saturation window
+                q.last_faulted = None
+        orphans = [rid for rid, q in self._inflight.items()
+                   if q.replica == h.id]
+        _recorder.record(
+            "serve.replica_dead", echo=True,
+            message=f"[serve] replica {h.id} lease expired and unreachable"
+                    f" — failing over {len(orphans)} in-flight request(s)",
+            replica=h.id, inflight=len(orphans))
+        self._orphans.extend(orphans)
+
+    def _failover(self):
+        """Re-enqueue every orphaned request (same trace id) on the
+        pending queue. Chaos site serve.replica_dead defers ONE request to
+        the next tick — deferred, never lost."""
+        for _ in range(len(self._orphans)):
+            rid = self._orphans.popleft()
+            req = self._inflight.get(rid)
+            if req is None or rid in self._done:
+                continue  # already delivered before the lease lapsed
+            try:
+                chaos.hit("serve.replica_dead")
+            except chaos.ChaosError:
+                self._orphans.append(rid)   # deferred; retried next tick
+                continue
+            del self._inflight[rid]
+            req.replica = None
+            req.retried = True
+            self.slo.on_preempt(rid)  # queue-wait resumes, trace id kept
+            self._pending.appendleft(req)
+            metrics.counter("serve.fleet.failovers").inc()
+
+    # ------------------------------------------------------------- routing
+    def _candidates(self, include_draining: bool = False) -> list[_Handle]:
+        # draining replicas sort LAST: only forced (already-accepted)
+        # work may land there, and only when no healthy replica can take
+        # it — the replica side honors force=True during drain for
+        # exactly this case (accepted work must not strand when every
+        # survivor is draining). A draining replica's /health reports
+        # ready=False BY DESIGN (new admits must not route there), so the
+        # forced path ignores readiness entirely: ready=False (draining,
+        # a transiently failing health callable, a missed probe) must
+        # never strand accepted work — the send itself is the probe that
+        # matters, and a 429/fault answer just parks it for the next tick
+        return sorted((h for h in self._handles.values()
+                       if (include_draining
+                           or (h.ready and not h.draining))),
+                      key=lambda h: (h.draining, h.load))
+
+    def _try_route(self, req: RoutedRequest, force: bool) -> str:
+        """One routing attempt over the candidate list, least-loaded
+        first. Returns "routed" (a replica accepted), "fault" (a chaos/
+        transport fault interrupted the send — the request is ACCEPTED
+        work that must stay pending and route next tick), or "declined"
+        (every candidate is saturated: an admission answer)."""
+        faulted = False
+        cands = self._candidates(include_draining=force)
+        if req.last_faulted:
+            # an earlier send to this replica faulted mid-wire and may
+            # have landed: retry it first (stable sort keeps least-loaded
+            # order among the rest) so its dedup answers instead of a
+            # second replica starting a duplicate generation — and it
+            # must be REACHED even when the candidate filter (draining)
+            # or the saturation gate below would skip it: a dedup probe
+            # is one cheap round trip, a skipped one is a full duplicate
+            # generation burned exactly when the fleet is saturated
+            lf = self._handles.get(req.last_faulted)
+            if lf is not None and lf not in cands:
+                cands.insert(0, lf)
+            else:
+                cands.sort(key=lambda c: c.id != req.last_faulted)
+        for h in cands:
+            if not force and h.id != req.last_faulted and \
+                    h.queue_depth >= self._admission.max_queue_for(
+                        h.max_batch):
+                continue  # saturated: don't bounce off its 429
+            try:
+                chaos.hit("serve.route")
+            except chaos.ChaosError:
+                metrics.counter("serve.fleet.route_faults").inc()
+                faulted = True
+                break           # stays pending; routed next tick
+            code, body = self._post(h.endpoint, "/enqueue", {
+                "rid": req.rid, "prompt": req.prompt,
+                "max_new_tokens": req.max_new_tokens,
+                "trace_id": req.trace_id, "force": force,
+                "router": self._rid_ns})
+            req.attempts += 1
+            if code == 200 and body.get("ok"):
+                req.replica = h.id
+                req.last_faulted = None
+                self._inflight[req.rid] = req
+                h.queue_depth += 1      # optimistic; next probe corrects
+                self.slo.on_admit(req.rid)
+                metrics.counter("serve.fleet.routed").inc()
+                return "routed"
+            if code == 400:
+                # the replica refused the request as never-admissible
+                # (over-budget, impossible page demand) — that's a caller
+                # error, not capacity: surface it loudly like the direct
+                # batcher's add_request ValueError, never an empty result
+                raise ValueError(
+                    f"replica {h.id} refused request {req.rid}: "
+                    f"{body.get('reason', 'invalid')}")
+            if code == 429:
+                h.queue_depth = max(h.queue_depth,
+                                    self._admission.max_queue_for(
+                                        h.max_batch))
+                try:
+                    req.retry_hint = max(req.retry_hint,
+                                         float(body.get("retry_after_s")
+                                               or 0.0))
+                except (TypeError, ValueError):
+                    pass
+                if body.get("reason") == "draining":
+                    h.draining = True
+                continue
+            if code == 0:
+                # transport fault: AMBIGUOUS — the enqueue may have landed
+                # before the response was lost (a handler stall past the
+                # timeout). Posting the same rid to the next candidate in
+                # this same pass could run the generation twice, so stop
+                # the pass: the request parks pending, the next tick
+                # collects results FIRST (surfacing a landed send),
+                # re-tries THIS replica first (dedup), and the lease owns
+                # the life-or-death verdict
+                req.last_faulted = h.id
+                faulted = True
+                break
+            # any OTHER status (403 auth misconfig, 500 handler bug) is
+            # the POST twin of _get's contract: a status line is
+            # reachability PROOF, so it must surface loudly — falling
+            # through to "declined" would report a broken fleet as
+            # saturated and retry-storm an honoring client forever
+            raise RuntimeError(
+                f"replica {h.id} answered unexpected HTTP {code} at "
+                f"/enqueue ({body.get('reason') or body.get('error') or 'no body'})"
+                f" — auth misconfig or handler bug, not capacity")
+        return "fault" if faulted else "declined"
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        """Route one request or reject-with-retry-after. The ONLY entry
+        that can refuse work: everything past here completes (failover,
+        shed-retry and drain re-routing are internal, and a send
+        interrupted by a fault stays pending — accepted work is never
+        converted into a rejection)."""
+        self.refresh()
+        req = RoutedRequest(self._next_rid, [int(t) for t in prompt_ids],
+                            int(max_new_tokens), trace_id=0)
+        self._next_rid += 1
+        req.trace_id = self.slo.on_enqueue(req.rid)
+        cand = self._candidates()
+        if not cand:
+            self.slo.on_reject(req.rid)
+            metrics.counter("serve.fleet.rejected").inc()
+            _reject("no_replicas", retry_after_floor())
+        try:
+            status = self._try_route(req, force=False)
+        except (ValueError, RuntimeError):
+            # never-admissible (replica 400) or a loud non-capacity HTTP
+            # status (403/500): the request never entered the system —
+            # drop its trace record, then surface the error
+            self.slo.on_reject(req.rid)
+            raise
+        if status == "declined":
+            # every candidate is saturated: the fleet is at capacity —
+            # push back with a REAL estimate, not the floor: the max
+            # retry_after_s the replicas' 429 bodies computed this pass,
+            # or (when every candidate was skipped on known depth and no
+            # 429 was ever issued) the hint computed from the least-loaded
+            # candidate's depth and the router's OWN fleet-level e2e p50
+            # (its RequestTracker fills the local slo.* histograms)
+            self.slo.on_reject(req.rid)
+            h = cand[0]
+            metrics.counter("serve.fleet.rejected").inc()
+            _reject("fleet_saturated",
+                    max(req.retry_hint,
+                        self._admission.retry_after(h.queue_depth,
+                                                    h.max_batch,
+                                                    hists=slo_hists)))
+        self._requests[req.rid] = req
+        if status == "fault":
+            self._pending.append(req)   # accepted; routes on a later tick
+        return req.rid
+
+    # ------------------------------------------------------------- results
+    def _collect_one(self, h: _Handle) -> dict | None:
+        """Drain one replica's /results cursor. Returns the raw response
+        (None on transport fault)."""
+        doc = self._get(h.endpoint, f"/results?since={h.cursor}")
+        if doc is None:
+            return None
+        h.cursor = int(doc.get("cursor", h.cursor))
+        for res in doc.get("results", []):
+            self._absorb(res)
+        return doc
+
+    def _absorb(self, res: dict):
+        if res.get("router") != self._rid_ns:
+            # another sender's record — a second router's, or a direct
+            # client's (router=None). Every send THIS router makes is
+            # stamped with its namespace, so an unstamped record can never
+            # be ours: without the exact match a bare client reusing a
+            # small integer rid would have its tokens delivered as this
+            # router's result for the same rid
+            return
+        rid = res.get("rid")
+        req = self._requests.get(rid)
+        if req is None or rid in self._done:
+            # a late duplicate may still hold an _inflight entry (the rid
+            # was re-routed after its first result won) — release it so
+            # summary()/inflight accounting can't leak
+            self._inflight.pop(rid, None)
+            metrics.counter("serve.fleet.dup_results").inc()
+            return
+        reason = res.get("reason", "complete")
+        if reason == "shed":
+            # replica load-shed it: accepted work, so it re-routes under
+            # the same trace id instead of surfacing a failure
+            if self._inflight.pop(rid, None) is not None:
+                req.replica = None
+                req.retried = True
+                self.slo.on_preempt(rid)
+                self._pending.appendleft(req)
+                metrics.counter("serve.fleet.retried").inc()
+            return
+        self._inflight.pop(rid, None)
+        self._done[rid] = res
+        n = len(res.get("tokens") or [])
+        if n:
+            self.slo.on_first_token(rid)
+            self.slo.on_tokens(rid, n)
+        self.slo.on_retire(rid, n_tokens=n, reason=reason)
+
+    # ---------------------------------------------------------------- tick
+    def tick(self):
+        """One maintenance pass: leases + health, failover, result
+        collection, pending dispatch. wait() calls this in its loop; a
+        server embedding the router calls it on its own cadence.
+        Collection runs BEFORE dispatch (and the dispatch loop skips
+        already-done rids): a request parked in _pending by a send fault
+        may in fact have been accepted by the replica — its result must
+        not race a redundant second dispatch. While the first attempt is
+        still GENERATING, the replica's (router, rid) active-dedup on
+        /enqueue is what absorbs the re-send (idempotent 200); this
+        ordering covers the already-finished tail. Collection is
+        throttled to the probe interval so wait()'s tight loop doesn't
+        hammer every replica with an HTTP poll per 4 ms pass."""
+        self.refresh()
+        self._failover()
+        now = _slo.now()
+        if any(r.last_faulted for r in self._pending) \
+                or now - self._last_collect >= self._probe_s:
+            # unthrottled only while a FAULT-PARKED dispatch is pending:
+            # the done-guard below suppresses a duplicate dispatch only
+            # if the first (fault-parked but actually-landed) send's
+            # result has been collected first. Capacity-parked requests
+            # were never accepted anywhere — no result can exist, and
+            # polling every replica per 4 ms wait() pass exactly while
+            # the fleet is saturated would be pure load
+            self._last_collect = now
+            for h in list(self._handles.values()):
+                self._collect_one(h)
+        for _ in range(len(self._pending)):
+            req = self._pending.popleft()
+            if req.rid in self._done:
+                continue  # fault-parked send actually landed; don't rerun
+            try:
+                status = self._try_route(req, force=req.retried)
+            except ValueError as e:
+                # a fault-parked request turned out never-admissible (the
+                # replica answered 400; submit() never validated it because
+                # every first send faulted). There is no caller to throw
+                # to — absorb it as a terminal error result so wait()
+                # finishes and result() carries the reason, instead of the
+                # rid vanishing and stranding wait() forever.
+                self._inflight.pop(req.rid, None)
+                self._done[req.rid] = {"rid": req.rid, "tokens": [],
+                                       "reason": f"error: {e}",
+                                       "trace_id": req.trace_id}
+                self.slo.on_retire(req.rid, n_tokens=0, reason="error")
+                continue
+            except RuntimeError:
+                # loud non-capacity HTTP status (auth misconfig / handler
+                # bug): surface it, but re-park the request first — it is
+                # accepted work and must survive for the retry after the
+                # operator fixes the fleet
+                self._pending.appendleft(req)
+                raise
+            if status == "fault":
+                # the ambiguous-send invariant is PER-REQUEST: this one
+                # parks (its dedup probe retries next tick, appended so
+                # this pass cannot re-pop it) but a wedged replica must
+                # not head-of-line block every other pending request from
+                # reaching healthy replicas for up to one TTL
+                self._pending.append(req)
+                continue
+            if status != "routed":
+                self._pending.appendleft(req)
+                break  # declined: capacity is fleet-wide; retry next tick
+
+    def wait(self, rids=None, timeout: float = 120.0) -> dict:
+        """Block until every rid (default: all submitted) is done; returns
+        {rid: [tokens]}. Raises TimeoutError listing the stragglers."""
+        want = set(self._requests if rids is None else rids)
+        deadline = _slo.now() + timeout
+        while want - set(self._done):
+            if _slo.now() > deadline:
+                missing = sorted(want - set(self._done))
+                raise TimeoutError(
+                    f"router.wait: {len(missing)} request(s) not done "
+                    f"after {timeout}s: {missing[:8]}")
+            self.tick()
+            time.sleep(0.004)
+        return {rid: self._done[rid].get("tokens", []) for rid in want}
+
+    def result(self, rid: int) -> dict | None:
+        """Full result record (tokens, reason, trace_id) or None."""
+        return self._done.get(rid)
+
+    # ---------------------------------------------------------------- drain
+    def drain(self, replica_id: str) -> bool:
+        """Ask one replica to drain (finish admitted, reject new,
+        deregister, exit clean). Routing skips it immediately."""
+        h = self._handles.get(replica_id)
+        if h is None:
+            return False
+        code, _ = self._post(h.endpoint, "/drain", {})
+        if code == 200:
+            h.draining = True
+            return True
+        return False
+
+    def replica_snapshots(self) -> dict:
+        """{replica id: its admin /snapshot} over the current routing
+        table — the PUBLIC read of per-replica telemetry (benches report
+        per-replica TTFT from it). Unreachable replicas are omitted."""
+        out = {}
+        for h in list(self._handles.values()):
+            snap = self._get(h.endpoint, "/snapshot")
+            if snap is not None:
+                out[h.id] = snap
+        return out
+
+    def summary(self) -> dict:
+        c = metrics.counter_values()
+        return {"replicas": sorted(self._handles),
+                "pending": len(self._pending),
+                "inflight": len(self._inflight),
+                "done": len(self._done),
+                "routed": c.get("serve.fleet.routed", 0),
+                "rejected": c.get("serve.fleet.rejected", 0),
+                "retried": c.get("serve.fleet.retried", 0),
+                "failovers": c.get("serve.fleet.failovers", 0)}
+
+
+def _transient_send(e: Exception) -> bool:
+    """Routed-send classification — resilience.retry.classify applied to
+    the router's HTTP sends: connection refused/reset, timeouts and wire
+    noise are transient (the LEASE, not one exception, decides whether a
+    replica is dead); a truncated JSON body is the same wire noise.
+    Everything else (a TypeError in our own code) must surface."""
+    return isinstance(e, json.JSONDecodeError) or classify(e)
+
+
+# ----------------------------------------------------------- fleet spawner
+
+class ServingFleet:
+    """Spawn N replica PROCESSES over one FileRegistry and route to them.
+
+        fleet = ServingFleet(3, spec, root=tmpdir).start()
+        router = fleet.router()
+        rid = router.submit(prompt, 16); router.wait()
+        fleet.shutdown()
+
+    The kill drill's and serving_bench's harness: every replica builds
+    identical weights from `spec` (see replica.build_batcher), logs to
+    <root>/<name>.log, and is reaped on shutdown. ``kill()`` SIGKILLs one
+    replica (death is detected by lease expiry, nothing is told)."""
+
+    def __init__(self, n: int, spec: dict, root: str,
+                 job_id: str = "serve-fleet", ttl: float = 1.5,
+                 host: str = "127.0.0.1", env: dict | None = None):
+        self.spec = dict(spec)
+        self.root, self.job_id, self.ttl, self.host = root, job_id, ttl, host
+        self.registry = FileRegistry(root, job_id, ttl=ttl)
+        self._env = {**os.environ, **(env or {})}
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._logs: dict[str, str] = {}
+        self._names = [f"r{i}" for i in range(n)]
+
+    def start(self, timeout: float = 60.0) -> "ServingFleet":
+        for name in self._names:
+            self.spawn(name)
+        self.wait_ready(len(self._names), timeout=timeout)
+        return self
+
+    def spawn(self, name: str) -> subprocess.Popen:
+        log_path = os.path.join(self.root, f"{name}.log")
+        self._logs[name] = log_path
+        log = open(log_path, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.inference.replica",
+             "--name", name, "--spec", json.dumps(self.spec),
+             "--registry-root", self.root, "--job-id", self.job_id,
+             "--ttl", str(self.ttl), "--host", self.host],
+            stdout=log, stderr=subprocess.STDOUT, cwd=_REPO_ROOT,
+            env=self._env)
+        log.close()  # the child holds the fd
+        self._procs[name] = proc
+        return proc
+
+    def wait_ready(self, n: int, timeout: float = 60.0):
+        """Until n leases are present. A replica dying during warmup fails
+        fast with its log tail instead of a timeout."""
+        deadline = _slo.now() + timeout
+        while True:
+            alive = [x for x in self.registry.alive_nodes()
+                     if x.startswith(REPLICA_PREFIX)]
+            if len(alive) >= n:
+                return
+            for name, p in self._procs.items():
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {name} died during warmup "
+                        f"(rc={p.returncode}):\n{self.log_tail(name)}")
+            if _slo.now() > deadline:
+                raise TimeoutError(
+                    f"fleet not ready: {len(alive)}/{n} leases after "
+                    f"{timeout}s")
+            time.sleep(0.05)
+
+    def log_tail(self, name: str, nbytes: int = 3000) -> str:
+        try:
+            with open(self._logs[name]) as f:
+                return f.read()[-nbytes:]
+        except OSError:
+            return "<no log>"
+
+    def router(self, **kw) -> Router:
+        return Router(self.registry, **kw)
+
+    def kill(self, name: str, sig: int = 9):
+        self._procs[name].send_signal(sig)
+
+    def replica_id(self, name: str) -> str:
+        return REPLICA_PREFIX + name
+
+    def shutdown(self):
+        for p in self._procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in self._procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass

@@ -90,6 +90,8 @@ from ..distributed.resilience import chaos
 from ..observability import (exporters as _exporters, fleet as _fleet,
                              metrics, slo as _slo, triggers as _triggers,
                              xplane as _xplane)
+from .admission import AdmissionPolicy, reject as _admission_reject, \
+    retry_after_floor, slo_hists
 from .paging import (PageAllocator, SCRATCH_PAGE, default_page_buckets,
                      pages_for)
 
@@ -103,6 +105,8 @@ class ServedRequest:
     max_new_tokens: int
     out: list = dataclasses.field(default_factory=list)
     done: bool = False
+    reason: str = "complete"   # how it retired (complete/shed/chaos ...)
+    trace_id: int | None = None
 
 
 class ContinuousBatcher:
@@ -126,7 +130,7 @@ class ContinuousBatcher:
                  precision: str | None = None, kv_layout: str = "paged",
                  page_size: int = 16, num_pages: int | None = None,
                  page_buckets: Sequence[int] | None = None,
-                 slo_policy=None):
+                 slo_policy=None, admission: AdmissionPolicy | None = None):
         self._dequant = None
         if precision in ("int8", "weight_only_int8"):
             # int8 weight-only serving: weights live quantized in HBM and
@@ -242,6 +246,13 @@ class ContinuousBatcher:
         self._finished: dict[int, ServedRequest] = {}
         self._next_rid = 0
         self._admin = None  # live admin endpoint (start_admin)
+        # SLO-aware admission (ISSUE 9): when a policy is installed,
+        # add_request rejects-with-retry-after instead of queueing without
+        # bound, and step() sheds newest-queued down to the cap if the
+        # queue ever exceeds it anyway (forced failover admits). None =
+        # the historical unbounded-queue behavior, unchanged.
+        self._admission = admission
+        self._draining = False
         self.stats = {"bursts": 0, "decode_steps": 0, "prefills": 0,
                       "admission_stalls": 0, "preemptions": 0,
                       "chaos_retired": 0, "max_concurrent": 0,
@@ -265,11 +276,49 @@ class ContinuousBatcher:
                           else None)
 
     # ------------------------------------------------------------- intake
-    def add_request(self, prompt_ids, max_new_tokens: int = 32) -> int:
+    def add_request(self, prompt_ids, max_new_tokens: int = 32,
+                    trace_id: int | None = None, force: bool = False) -> int:
         """Enqueue one request. Budget violations are rejected HERE, at
         enqueue time — an over-budget request must never be admitted and
         then silently truncated (or, paged, wedge the queue forever waiting
-        for pages that cannot exist)."""
+        for pages that cannot exist). With an ``admission=`` policy
+        installed, overload is rejected here too (AdmissionReject with a
+        computed retry_after_s) unless ``force`` (router failover: already-
+        accepted work must land somewhere). ``trace_id`` lets a router
+        carry ONE trace id across replica retries."""
+        # validation BEFORE admission: a never-admissible request must
+        # fail loudly (ValueError) even while draining or over cap — a
+        # retryable reject would have an honoring client resubmit the
+        # impossible request forever
+        prompt, max_new_tokens = self.check_admissible(prompt_ids,
+                                                       max_new_tokens)
+        if self._draining and not force:
+            # drain protocol: finish what was admitted, reject new admits
+            _admission_reject("draining", retry_after_floor())
+        if self._admission is not None and not force:
+            # the FUNCTION, not its result: decide() evaluates it only on
+            # the reject/threshold path, so a plain admit costs no
+            # histogram reservoir sorts on this intake hot path
+            self._admission.check(len(self._queue), self.B,
+                                  hists=slo_hists)
+        rid = self._next_rid
+        self._next_rid += 1
+        req = ServedRequest(rid, prompt, max_new_tokens)
+        self._queue.append(req)
+        metrics.counter("serve.requests").inc()
+        # trace id issued (or adopted from the router); queue-wait starts
+        req.trace_id = self.slo.on_enqueue(rid, trace_id=trace_id)
+        return rid
+
+    def check_admissible(self, prompt_ids,
+                         max_new_tokens: int = 32) -> tuple[list, int]:
+        """Raise ValueError when this request could NEVER be admitted
+        (empty prompt, sub-1 budget, over-bucket/over-budget, a page
+        demand beyond the pool); returns the parsed (prompt, budget).
+        The enqueue-time validation add_request applies, also callable
+        from an HTTP boundary (the replica's /enqueue answers 400) so an
+        impossible request is refused LOUDLY instead of becoming a silent
+        empty result on the serve loop."""
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -295,12 +344,7 @@ class ContinuousBatcher:
                     f"request needs {worst} pages but the pool only has "
                     f"{self._alloc.usable} usable — it could never be "
                     "admitted (grow num_pages or shrink the request)")
-        rid = self._next_rid
-        self._next_rid += 1
-        self._queue.append(ServedRequest(rid, prompt, max_new_tokens))
-        metrics.counter("serve.requests").inc()
-        self.slo.on_enqueue(rid)  # trace id issued; queue-wait clock starts
-        return rid
+        return prompt, max_new_tokens
 
     def _bucket_len(self, n: int) -> int:
         return next(b for b in self._buckets if b >= n)
@@ -308,7 +352,18 @@ class ContinuousBatcher:
     # ----------------------------------------------------------- shared
     def _finish(self, req: ServedRequest, reason: str = "complete") -> None:
         req.done = True
+        req.reason = reason
         self._finished[req.rid] = req
+        if reason == "shed":
+            # a shed request was never SERVED here — measuring its
+            # lifetime would pollute the very histograms admission reads
+            # (overload sheds are ~0s, dragging the e2e p50 the
+            # retry-after hint uses toward the floor; drain-grace sheds
+            # are long unserved waits, firing slo.breach for requests
+            # this engine never ran). Drop the record unmeasured; the
+            # router's fleet-level tracker owns the request's real story.
+            self.slo.on_reject(req.rid)
+            return
         # the ONE retire point: histograms fill + SLO policy evaluates
         # exactly once per request, whatever path ended it
         self.slo.on_retire(req.rid, n_tokens=len(req.out), reason=reason)
@@ -750,6 +805,12 @@ class ContinuousBatcher:
         scheduling while the device runs → block once on the combined
         readback. Dense (legacy order): admit synchronously, then burst.
         """
+        if self._admission is not None:
+            # graceful degradation under forced overload (router failover
+            # can push past the cap): shed newest-queued first, never wedge
+            cap = self._admission.max_queue_for(self.B)
+            if len(self._queue) > cap:
+                self.shed_newest(len(self._queue) - cap)
         if self._ragged:
             self._step_ragged()
         elif self._layout == "paged":
@@ -809,6 +870,71 @@ class ContinuousBatcher:
         if emitted_total and dt > 0:
             metrics.gauge("serve.tokens_per_s").set(emitted_total / dt)
 
+    # ----------------------------------------------- drain + shed (ISSUE 9)
+    def begin_drain(self):
+        """Start the drain protocol: everything already accepted (queued +
+        in a slot) runs to completion; NEW add_request calls reject with
+        retry-after. Idempotent; ``drained`` flips true when the last
+        accepted request retires."""
+        if not self._draining:
+            self._draining = True
+            metrics.counter("serve.drains").inc()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def admission(self) -> AdmissionPolicy | None:
+        """The installed admission policy (None = unbounded queueing) —
+        the public read the replica HTTP boundary decides with."""
+        return self._admission
+
+    @property
+    def drained(self) -> bool:
+        return self._draining and self.pending == 0
+
+    def shed_newest(self, n: int = 1) -> list[ServedRequest]:
+        """Load-shed up to `n` QUEUED requests, newest-queued first (the
+        oldest have waited longest and preempted requests sit at the queue
+        front — both keep their place). Each shed request retires with
+        reason="shed" and empty output; a router re-routes it under the
+        same trace id, a direct client treats it like a rejection. The
+        graceful-degradation valve: the queue bounds, the scheduler never
+        wedges."""
+        shed = []
+        while n > 0 and self._queue:
+            req = self._queue.pop()   # newest-queued first
+            req.out = []
+            self.stats["shed"] = self.stats.get("shed", 0) + 1
+            metrics.counter("serve.shed").inc()
+            self._finish(req, reason="shed")
+            shed.append(req)
+            n -= 1
+        return shed
+
+    def take_finished(self) -> dict[int, ServedRequest]:
+        """Drain the finished-request table (rid -> ServedRequest). The
+        replica server calls this per step to ship results out while the
+        engine keeps serving; run() uses it for its final report."""
+        out, self._finished = self._finished, {}
+        return out
+
+    def health_summary(self) -> dict:
+        """The routing-readiness probe body (admin /health, ISSUE 9
+        satellite): everything a router or external LB needs for ONE
+        admit-or-not decision — no device sync, a few host reads."""
+        return {
+            "ready": not self._draining,
+            "draining": self._draining,
+            "queue_depth": len(self._queue),
+            "active_slots": sum(r is not None for r in self._slot_req),
+            "max_batch": self.B,
+            "free_pages": (self._alloc.free_pages
+                           if self._layout == "paged" else None),
+            "pending": self.pending,
+        }
+
     # ------------------------------------------------------------- admin
     def start_admin(self, port: int = 0, host: str = "0.0.0.0"):
         """Serve the live admin endpoint next to the scheduler: /metrics
@@ -820,7 +946,8 @@ class ContinuousBatcher:
         if self._admin is None:
             from ..observability.admin import AdminServer
             self._admin = AdminServer(port=port, host=host,
-                                      extra={"serve": self.admin_summary})
+                                      extra={"serve": self.admin_summary},
+                                      health=self.health_summary)
             self._admin.start()
         return self._admin
 
@@ -848,6 +975,7 @@ class ContinuousBatcher:
             "queue_depth": len(self._queue),
             "active_slots": sum(r is not None for r in self._slot_req),
             "max_batch": self.B,
+            "draining": self._draining,
             "pages_in_use": self.pages_in_use,
             "free_pages": (self._alloc.free_pages
                            if self._layout == "paged" else None),
@@ -868,9 +996,7 @@ class ContinuousBatcher:
         """Drain the queue; returns {rid: [generated token ids]}."""
         while self.pending:
             self.step()
-        out = {rid: req.out for rid, req in self._finished.items()}
-        self._finished = {}
-        return out
+        return {rid: req.out for rid, req in self.take_finished().items()}
 
 
 class PredictorPool:

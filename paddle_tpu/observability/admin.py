@@ -125,12 +125,25 @@ class AdminServer:
     `aggregator`: a ``fleet.TelemetryAggregator`` (or None for a
     process-local endpoint — serving uses this). `extra`: {name: callable}
     evaluated per /snapshot request and merged under "extra" (the serving
-    scheduler exposes queue/slot state this way)."""
+    scheduler exposes queue/slot state this way). `health`: a callable
+    whose dict is merged into /health — the ISSUE-9 readiness contract:
+    a router or external LB reads ONE probe (ready/draining/queue depth/
+    free pages) instead of a bare 200. `get_routes` / `post_routes`:
+    {path: handler} extension points so new endpoints (the serving
+    replica's /enqueue, /results, /drain) extend THIS server instead of
+    growing ad-hoc ones (lint O3). A GET handler is called with the parsed
+    query dict, a POST handler with the decoded JSON body (token-authed,
+    same job-token discipline as /push); both return (status, json-able)."""
 
     def __init__(self, port: int = 0, aggregator=None, extra: dict | None = None,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", health=None,
+                 get_routes: dict | None = None,
+                 post_routes: dict | None = None):
         self.aggregator = aggregator
         self.extra = dict(extra or {})
+        self.health = health
+        self.get_routes = dict(get_routes or {})
+        self.post_routes = dict(post_routes or {})
         ref = self
 
         class H(BaseHTTPRequestHandler):
@@ -171,7 +184,23 @@ class AdminServer:
                     doc = {"ok": True, "pid": os.getpid(), "time": time.time()}
                     if agg is not None:
                         doc["ranks"] = len(agg.ranks())
+                    if ref.health is not None:
+                        # readiness merge: liveness (ok) stays true while
+                        # the probe callable degrades to an error string —
+                        # a broken probe must read as NOT ready, not a 500
+                        try:
+                            doc.update(ref.health() or {})
+                        except Exception as e:
+                            doc["ready"] = False
+                            doc["health_error"] = f"{type(e).__name__}: {e}"
                     return self._json(doc)
+                if route in ref.get_routes:
+                    try:
+                        code, obj = ref.get_routes[route](query)
+                    except Exception as e:
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 500)
+                    return self._json(obj, code)
                 if route == "/metrics":
                     text = render_prometheus(metrics.snapshot())
                     return self._send(200, text.encode(),
@@ -216,15 +245,27 @@ class AdminServer:
                 self._send(404)
 
             def do_POST(self):
-                if self.path != "/push":
+                route = urlsplit(self.path).path
+                if route != "/push" and route not in ref.post_routes:
                     return self._send(404)
                 tok = self.headers.get("X-Paddle-Job-Token", "")
                 if not hmac.compare_digest(tok, job_token()):
                     return self._send(403)
-                if ref.aggregator is None:
-                    return self._send(503)
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) if n else b""
+                if route in ref.post_routes:
+                    try:
+                        payload = json.loads(body) if body else {}
+                    except ValueError:
+                        return self._send(400)
+                    try:
+                        code, obj = ref.post_routes[route](payload)
+                    except Exception as e:
+                        return self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 500)
+                    return self._json(obj, code)
+                if ref.aggregator is None:
+                    return self._send(503)
                 try:
                     report = json.loads(body)
                 except ValueError:

@@ -164,12 +164,24 @@ class RequestTracker:
         metrics.counter(COUNTER_BREACH)
 
     # ---------------------------------------------------------- lifecycle
-    def on_enqueue(self, rid: int) -> int:
+    def on_enqueue(self, rid: int, trace_id: int | None = None) -> int:
+        """Start a request's lifecycle. ``trace_id`` lets an upstream
+        router stamp ITS id on the replica-local record, so a request
+        retried on another replica after a failover keeps ONE trace id
+        across the fleet (process-unique ids are only issued when none is
+        given)."""
         t = now()
-        tid = next(_trace_ids)
+        tid = next(_trace_ids) if trace_id is None else int(trace_id)
         with self._lk:
             self._recs[rid] = _Rec(tid, t)
         return tid
+
+    def on_reject(self, rid: int):
+        """An admission rejection after on_enqueue: the request never
+        entered the system — drop its record WITHOUT a retire measurement
+        (retire stays exactly-once per accepted request)."""
+        with self._lk:
+            self._recs.pop(rid, None)
 
     def on_admit(self, rid: int):
         t = now()

@@ -233,6 +233,35 @@ declare("PADDLE_SERVE_MESH_MODEL", "0",
         "shard the serving KV page pool over this many devices along the "
         "'model' mesh axis (GSPMD; 0/1 = single-chip)")
 
+# ------------------------------------------------------------ serving fleet
+
+declare("PADDLE_SERVE_REPLICAS", "0",
+        "serving replica count for the fleet drill in "
+        "benchmarks/serving_bench.py (0/1 = single-process bench only)")
+declare("PADDLE_SERVE_TTL", "5",
+        "serving replica lease TTL in seconds — a dead replica leaves the "
+        "routing table within one TTL")
+declare("PADDLE_SERVE_HEARTBEAT_S", "",
+        "replica lease heartbeat interval (default: TTL / 4)")
+declare("PADDLE_ADMIT_MAX_QUEUE", "0",
+        "admission cap on queued-not-admitted requests per replica "
+        "(0 = 4 x max_batch); beyond it requests reject with retry-after")
+declare("PADDLE_ADMIT_QUEUE_P95_S", "",
+        "admission rejects while measured queue-wait p95 exceeds this "
+        "target in seconds (empty = queue latency never rejects)")
+declare("PADDLE_ADMIT_E2E_P95_S", "",
+        "admission rejects while measured request e2e p95 exceeds this "
+        "target in seconds (empty = e2e latency never rejects)")
+declare("PADDLE_ADMIT_RETRY_AFTER_S", "0.25",
+        "floor / fallback retry_after_s hint on admission rejections")
+declare("PADDLE_DRAIN_GRACE_S", "30",
+        "drain grace in seconds: past it a draining replica sheds its "
+        "still-queued remainder (in-flight slots always run to budget)")
+declare("PADDLE_SERVE_RESULTS_KEEP", "4096",
+        "finished results retained per replica for /results polling "
+        "(prefix truncated past it, cursors stay monotone; 0 = unbounded; "
+        "draining replicas never truncate)")
+
 # ------------------------------------------------------------------- misc
 
 declare("PADDLE_EXTENSION_DIR", "<tempdir>/paddle_tpu_extensions",

@@ -237,6 +237,100 @@ class TestReRendezvous:
             (ref.generation, ref.rank, ref.world, ref.hosts)
 
 
+# ----------------------------------------------------- scale-UP join (e2e)
+
+class TestScaleUpJoin:
+    """ROADMAP PR-4 carry-over, exercised by ISSUE 9 because restarted
+    serving replicas re-enroll through the same path: a NEW (or restarted)
+    node joins a LIVE fleet end-to-end — it proposes the next generation,
+    the running survivors notice via behind_generation() (the launcher's
+    trigger) and re-enter the barrier, and everyone converges on one
+    bigger world with contiguous ranks."""
+
+    @staticmethod
+    def _supervise(mgr, out, key, stop):
+        """A launcher stand-in: heartbeat + watch the generation counter;
+        re-enter the barrier whenever the fleet moved on without us."""
+        while not stop.is_set():
+            if mgr.behind_generation():
+                out[key] = mgr.re_rendezvous(reason="behind-generation",
+                                             join_window=0.3)
+            time.sleep(0.02)
+
+    def test_new_node_joins_live_fleet(self, tmp_path):
+        a, b = _mgr("node-a", tmp_path), _mgr("node-b", tmp_path)
+        first = {}
+        tb = threading.Thread(target=lambda: first.__setitem__(
+            "b", b.re_rendezvous(join_window=0.3)))
+        tb.start()
+        first["a"] = a.re_rendezvous(join_window=0.3)
+        tb.join(10)
+        assert first["a"].world == first["b"].world == 2  # the LIVE fleet
+
+        out, stop, c = {}, threading.Event(), None
+        sup = [threading.Thread(target=self._supervise, args=(m, out, k, stop))
+               for k, m in (("a", a), ("b", b))]
+        for t in sup:
+            t.start()
+        try:
+            # the newcomer: adopts the current generation on start() (so it
+            # is not fenced), then forces the fleet to re-form around it
+            c = _mgr("node-c", tmp_path)
+            c.start()
+            assert c.generation == first["a"].generation
+            rc = c.re_rendezvous(reason="scale-up", join_window=0.5)
+            deadline = time.time() + 10
+            while len(out) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for t in sup:
+                t.join(5)
+            if c is not None:
+                c.stop()
+        assert len(out) == 2, f"survivors never rejoined: {out}"
+        ra, rb = out["a"], out["b"]
+        assert ra.generation == rb.generation == rc.generation
+        assert ra.hosts == rb.hosts == rc.hosts == \
+            ["node-a", "node-b", "node-c"]
+        assert sorted((ra.rank, rb.rank, rc.rank)) == [0, 1, 2]
+        assert rc.world == 3
+
+    def test_restarted_node_rejoins_through_same_path(self, tmp_path):
+        """A node that died and came back (same id, fresh process state —
+        generation 0) must adopt the fleet generation at start() and
+        re-enroll instead of being fenced forever."""
+        a, b = _mgr("node-a", tmp_path), _mgr("node-b", tmp_path)
+        out = {}
+        tb = threading.Thread(target=lambda: out.__setitem__(
+            "b", b.re_rendezvous(join_window=0.3)))
+        tb.start()
+        ra = a.re_rendezvous(join_window=0.3)
+        tb.join(10)
+        gen0 = ra.generation
+
+        # node-b "dies" and restarts as a FRESH manager (generation 0)
+        b.stop()
+        b2 = _mgr("node-b", tmp_path)
+        b2.start()
+        assert b2.generation == gen0  # adopted, not fenced at 0
+        out2, stop = {}, threading.Event()
+        sup = threading.Thread(target=self._supervise,
+                               args=(a, out2, "a", stop))
+        sup.start()
+        try:
+            rb2 = b2.re_rendezvous(reason="restart", join_window=0.5)
+            deadline = time.time() + 10
+            while "a" not in out2 and time.time() < deadline:
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            sup.join(5)
+            b2.stop()
+        assert out2["a"].generation == rb2.generation > gen0
+        assert out2["a"].hosts == rb2.hosts == ["node-a", "node-b"]
+
+
 # ------------------------------------------------------- generation fencing
 
 @pytest.fixture

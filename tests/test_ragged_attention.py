@@ -298,12 +298,16 @@ class TestRaggedBenchContract:
         hard parity gate covers the ragged path (rc 0 == no divergence)."""
         from benchmarks import serving_bench
         monkeypatch.setenv("SERVING_TRAIN_STEPS", "0")
+        monkeypatch.delenv("PADDLE_SERVE_REPLICAS", raising=False)
         monkeypatch.setattr(sys, "argv", ["serving_bench.py", "2", "3", "4"])
         rc = serving_bench.main()
         out = capsys.readouterr().out
         line = next(ln for ln in out.splitlines() if ln.startswith("{"))
         doc = json.loads(line)
         assert rc == 0
+        # single-process run: the ISSUE-9 fleet sub-object is null (the
+        # populated schema is pinned in tests/test_serving_fleet.py)
+        assert doc["fleet_serve"] is None
         r = doc["ragged"]
         assert set(r) >= {"tokens_per_sec", "kv_read_bytes_per_token",
                           "hbm_roofline_bytes_per_token", "executables",

@@ -1,0 +1,1166 @@
+"""Fault-tolerant serving fleet (ISSUE 9 tentpole).
+
+The contracts under test:
+  * ADMISSION — an AdmissionPolicy (queue depth + SLO p95) rejects with a
+    COMPUTED retry_after_s at all three boundaries (batcher, replica HTTP,
+    router); the queue stays bounded and a retry-after-honoring client
+    eventually completes everything (overload drill).
+  * HEALTH — /health answers routing readiness (ready/draining/queue
+    depth/free pages), and a replica's life is its registry LEASE: a
+    SIGKILL'd replica leaves the routing table within one TTL.
+  * FAILOVER — a replica killed mid-decode has its in-flight requests
+    re-enqueued on healthy replicas with the SAME trace id; at
+    temperature=0 the retried output is token-identical (kill drill), and
+    retire/slo fire exactly once per request.
+  * DRAIN — a draining replica finishes everything accepted, rejects new
+    admits with retry-after, deregisters, and is collected clean (no
+    failover fires for a deliberate exit).
+  * CHAOS — serve.route / serve.replica_dead / serve.reject faults
+    degrade to a deferral (or a floored hint), never to a lost request:
+    chaos-on serving is token-identical to fault-free.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import elastic as el
+from paddle_tpu.distributed.resilience import chaos
+from paddle_tpu.inference import (AdmissionPolicy, AdmissionReject,
+                                  ContinuousBatcher, Router, ServingFleet)
+from paddle_tpu.inference.admission import retry_after_floor
+from paddle_tpu.inference.replica import ReplicaServer
+from paddle_tpu.inference.router import RoutedRequest
+from paddle_tpu.models.llama import LlamaConfig, llama_init_params
+from paddle_tpu.models.llama_decode import llama_generate
+from paddle_tpu.observability import metrics
+
+# ONE model for the whole file: every replica (in-process or subprocess)
+# builds the same weights from SPEC, so cross-replica token identity is
+# exact at temperature=0
+SPEC = {
+    "config": {"vocab_size": 256, "hidden_size": 64,
+               "intermediate_size": 128, "num_hidden_layers": 2,
+               "num_attention_heads": 4, "num_key_value_heads": 2,
+               "max_position_embeddings": 128, "dtype": "float32"},
+    "seed": 3,
+    "batcher": {"max_batch": 3, "max_len": 96, "prompt_buckets": [8, 16, 32],
+                "burst": 4, "page_size": 8},
+}
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, max_position_embeddings=128)
+    params = llama_init_params(cfg, jax.random.PRNGKey(3))
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    base = dict(SPEC["batcher"])
+    base["prompt_buckets"] = tuple(base["prompt_buckets"])
+    base.update(kw)
+    return ContinuousBatcher(cfg, params, **base)
+
+
+def _reference(cfg, params, prompt, n):
+    import jax.numpy as jnp
+    toks = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    out = llama_generate(params, toks, cfg, n, temperature=0.0)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _prompts(n, seed=0, lo=4, hi=20):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, int(m)).tolist()
+            for m in rng.randint(lo, hi, n)]
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+class _Replicas:
+    """In-process replica harness: N ReplicaServers over one FileRegistry
+    (threads, not processes — cheap; the subprocess path is the drill)."""
+
+    def __init__(self, tmp_path, cfg, params, n=2, ttl=2.0, **engine_kw):
+        self.registry = el.FileRegistry(str(tmp_path), "fleet", ttl=ttl)
+        admission = engine_kw.pop("admission", None)
+        self.reps = []
+        for i in range(n):
+            eng = _engine(cfg, params,
+                          admission=admission or AdmissionPolicy(),
+                          **engine_kw)
+            self.reps.append(ReplicaServer(eng, self.registry,
+                                           f"r{i}").start())
+
+    def stop(self):
+        for rep in self.reps:
+            rep.stop()
+
+
+# --------------------------------------------------------- admission policy
+
+class TestAdmissionPolicy:
+    def test_queue_cap_default_and_override(self):
+        p = AdmissionPolicy()
+        assert p.max_queue_for(4) == 16   # 4 x max_batch default
+        assert AdmissionPolicy(max_queue=2).max_queue_for(4) == 2
+
+    def test_decide_queue_full_and_retry_after_math(self):
+        p = AdmissionPolicy(max_queue=2)
+        assert p.decide(0, 4) is None
+        d = p.decide(2, 4)
+        assert d["reason"] == "queue_full"
+        assert d["retry_after_s"] >= retry_after_floor()
+        # with a measured e2e p50, the hint is depth-in-waves x service
+        hists = {"slo.e2e_s": {"p50": 2.0, "p95": 3.0}}
+        assert p.retry_after(7, 4, hists) == pytest.approx(2 * 2.0)
+
+    def test_latency_p95_thresholds(self):
+        hists = {"slo.queue_wait_s": {"p95": 0.5}, "slo.e2e_s": {"p95": 4.0}}
+        assert AdmissionPolicy(max_queue=100, queue_p95_s=1.0) \
+            .decide(1, 4, hists) is None
+        d = AdmissionPolicy(max_queue=100, queue_p95_s=0.2) \
+            .decide(1, 4, hists)
+        assert d["reason"] == "queue_p95"
+        d = AdmissionPolicy(max_queue=100, e2e_p95_s=1.0).decide(1, 4, hists)
+        assert d["reason"] == "e2e_p95"
+
+    def test_latency_p95_cannot_latch_on_idle_engine(self):
+        """Rejected requests are never measured, so a p95 window frozen
+        above target by a past burst would reject FOREVER if the latency
+        thresholds applied to an idle engine — with queue_depth == 0 the
+        arriving request is served immediately and its retirement is what
+        refreshes the window, so it must admit."""
+        hists = {"slo.queue_wait_s": {"p95": 9.0}, "slo.e2e_s": {"p95": 9.0}}
+        p = AdmissionPolicy(max_queue=100, queue_p95_s=0.1, e2e_p95_s=0.1)
+        assert p.decide(0, 4, hists) is None      # idle: always admit
+        assert p.decide(1, 4, hists) is not None  # queued work: reject
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_ADMIT_MAX_QUEUE", "3")
+        monkeypatch.setenv("PADDLE_ADMIT_RETRY_AFTER_S", "0.75")
+        p = AdmissionPolicy()
+        assert p.max_queue_for(10) == 3
+        assert retry_after_floor() == 0.75
+        with pytest.raises(AdmissionReject) as ei:
+            p.check(3, 10)
+        assert ei.value.retry_after_s == 0.75
+
+    def test_check_raises_through_reject(self):
+        before = metrics.counter("serve.rejected").value
+        with pytest.raises(AdmissionReject) as ei:
+            AdmissionPolicy(max_queue=1).check(5, 1)
+        assert ei.value.reason == "queue_full"
+        assert metrics.counter("serve.rejected").value == before + 1
+
+
+# ------------------------------------------------- batcher-level admission
+
+class TestBatcherAdmission:
+    def test_reject_at_cap_with_retry_after(self, small_model):
+        cfg, params = small_model
+        eng = _engine(cfg, params, admission=AdmissionPolicy(max_queue=2))
+        for p in _prompts(2, seed=1):
+            eng.add_request(p, 4)
+        with pytest.raises(AdmissionReject) as ei:
+            eng.add_request(_prompts(1, seed=2)[0], 4)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s > 0
+        # force bypasses the policy (router failover path)
+        rid = eng.add_request(_prompts(1, seed=3)[0], 4, force=True)
+        out = eng.run()
+        assert len(out) == 3 and rid in out
+
+    def test_trace_id_passthrough(self, small_model):
+        cfg, params = small_model
+        eng = _engine(cfg, params)
+        rid = eng.add_request([1, 2, 3], 2, trace_id=777123)
+        assert eng.slo.trace_id(rid) == 777123
+        eng.run()
+
+    def test_shed_newest_first(self, small_model):
+        cfg, params = small_model
+        eng = _engine(cfg, params)
+        rids = [eng.add_request(p, 4) for p in _prompts(3, seed=4)]
+        shed = eng.shed_newest(2)
+        assert [r.rid for r in shed] == [rids[2], rids[1]]  # newest first
+        assert all(r.reason == "shed" and r.out == [] for r in shed)
+        out = eng.run()
+        assert set(out) == set(rids)  # shed ones finished (empty output)
+        assert out[rids[0]] != []
+
+    def test_overload_step_sheds_down_to_cap(self, small_model):
+        cfg, params = small_model
+        eng = _engine(cfg, params, admission=AdmissionPolicy(max_queue=2))
+        for p in _prompts(5, seed=5):  # force past the cap
+            eng.add_request(p, 4, force=True)
+        eng.step()
+        fins = eng.take_finished()
+        assert sum(1 for r in fins.values() if r.reason == "shed") == 3
+        while eng.pending:
+            eng.step()
+
+    def test_drain_finishes_admitted_rejects_new(self, small_model):
+        cfg, params = small_model
+        eng = _engine(cfg, params)
+        rids = [eng.add_request(p, 4) for p in _prompts(3, seed=6)]
+        eng.begin_drain()
+        assert eng.draining and not eng.drained
+        with pytest.raises(AdmissionReject) as ei:
+            eng.add_request([5, 6], 4)
+        assert ei.value.reason == "draining"
+        out = eng.run()
+        assert set(out) == set(rids) and all(out[r] for r in rids)
+        assert eng.drained
+
+
+# ------------------------------------------- /health readiness (satellite)
+
+class TestHealthReadiness:
+    def test_health_reports_routing_readiness(self, small_model):
+        cfg, params = small_model
+        eng = _engine(cfg, params)
+        eng.add_request([1, 2, 3, 4], 4)
+        admin = eng.start_admin(host="127.0.0.1")
+        try:
+            doc = _get_json(f"http://127.0.0.1:{admin.port}/health")
+            assert doc["ok"] is True and doc["ready"] is True
+            assert doc["queue_depth"] == 1
+            assert doc["active_slots"] == 0
+            assert doc["max_batch"] == SPEC["batcher"]["max_batch"]
+            assert doc["free_pages"] is not None and doc["free_pages"] > 0
+            assert doc["draining"] is False
+            eng.begin_drain()
+            doc = _get_json(f"http://127.0.0.1:{admin.port}/health")
+            assert doc["ready"] is False and doc["draining"] is True
+        finally:
+            eng.stop_admin()
+        eng.run()
+
+
+# ----------------------------------------------------- replica HTTP face
+
+class TestReplicaServer:
+    def test_enqueue_results_cursor_and_lease(self, small_model, tmp_path):
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        rep = h.reps[0]
+        try:
+            assert rep.replica_id in h.registry.alive_nodes()
+            assert (h.registry.info(rep.replica_id) or {}).get("endpoint") \
+                == rep.endpoint
+            router = Router(h.registry)
+            prompts = _prompts(3, seed=7)
+            rids = [router.submit(p, 5) for p in prompts]
+            out = router.wait(timeout=60)
+            for rid, p in zip(rids, prompts):
+                assert out[rid] == _reference(cfg, params, p, 5)
+            # cursor semantics: a fresh poll from 0 returns everything,
+            # from the cursor returns nothing new
+            doc = _get_json(f"{rep.endpoint}/results?since=0")
+            assert len(doc["results"]) == 3
+            doc2 = _get_json(f"{rep.endpoint}/results?since={doc['cursor']}")
+            assert doc2["results"] == []
+        finally:
+            h.stop()
+
+    def test_replica_429_computed_retry_after(self, small_model, tmp_path):
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1,
+                      admission=AdmissionPolicy(max_queue=1))
+        rep = h.reps[0]
+        try:
+            body = json.dumps({"rid": 0, "prompt": [1, 2, 3],
+                               "max_new_tokens": 40}).encode()
+            from paddle_tpu.observability.admin import job_token
+            codes = []
+            for rid in range(8):
+                req = urllib.request.Request(
+                    f"{rep.endpoint}/enqueue", data=body, method="POST",
+                    headers={"X-Paddle-Job-Token": job_token()})
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        codes.append(r.status)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+                    doc = json.loads(e.read())
+                    assert doc["retry_after_s"] >= retry_after_floor() \
+                        or doc["retry_after_s"] > 0
+            assert 429 in codes  # flooded past intake+queue cap
+        finally:
+            h.stop()
+
+    def test_drain_protocol_clean_exit(self, small_model, tmp_path):
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        rep = h.reps[0]
+        failovers0 = metrics.counter("serve.fleet.failovers").value
+        try:
+            router = Router(h.registry)
+            prompts = _prompts(4, seed=8)
+            rids = [router.submit(p, 6) for p in prompts]
+            assert router.drain(rep.replica_id)
+            # accepted work finishes; results are collected clean — the
+            # deliberate exit must NOT read as a death (no failover)
+            out = router.wait(timeout=60)
+            assert set(out) == set(rids) and all(out[r] for r in rids)
+            deadline = time.time() + 15
+            while not rep.drained and time.time() < deadline:
+                time.sleep(0.05)
+            assert rep.drained
+            assert rep.replica_id not in h.registry.alive_nodes()
+            # the routing table forgets it cleanly once the lease lapses
+            deadline = time.time() + 15
+            while "serve.r0" in router.summary()["replicas"] \
+                    and time.time() < deadline:
+                router.tick()
+                time.sleep(0.05)
+            assert "serve.r0" not in router.summary()["replicas"]
+            assert metrics.counter("serve.fleet.failovers").value \
+                == failovers0, "a deliberate drain fired failover"
+            # new admits reject: the fleet is empty
+            with pytest.raises(AdmissionReject) as ei:
+                router.submit([1, 2, 3], 4)
+            assert ei.value.reason == "no_replicas"
+        finally:
+            h.stop()
+
+
+# ------------------------------------------------------------- the router
+
+class TestRouter:
+    def test_least_loaded_routing_spreads_work(self, small_model, tmp_path):
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=2)
+        try:
+            router = Router(h.registry)
+            prompts = _prompts(8, seed=9)
+            rids = [router.submit(p, 5) for p in prompts]
+            out = router.wait(timeout=60)
+            assert len(out) == 8
+            served = {rep.replica_id:
+                      _get_json(f"{rep.endpoint}/results?since=0")["results"]
+                      for rep in h.reps}
+            assert all(len(v) > 0 for v in served.values()), \
+                f"one replica served everything: " \
+                f"{ {k: len(v) for k, v in served.items()} }"
+            for rid, p in zip(rids, prompts):
+                assert out[rid] == _reference(cfg, params, p, 5)
+        finally:
+            h.stop()
+
+    def test_no_replicas_rejects_with_retry_after(self, tmp_path):
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        with pytest.raises(AdmissionReject) as ei:
+            router.submit([1, 2, 3], 4)
+        assert ei.value.reason == "no_replicas"
+        assert ei.value.retry_after_s > 0
+
+    def test_fleet_level_slo_retire_exactly_once(self, small_model,
+                                                 tmp_path):
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            rids = [router.submit(p, 4) for p in _prompts(3, seed=10)]
+            router.wait(timeout=60)
+            assert router.slo.summary()["inflight"] == 0  # all retired
+            # retire is exactly-once: every rid done once, dups counted 0
+            assert sorted(router._done) == sorted(rids)
+        finally:
+            h.stop()
+
+
+# --------------------------------------------- review-hardening regressions
+
+class TestReviewHardening:
+    """Pins for review-found bugs: each of these was a real failure mode
+    in the first fleet implementation."""
+
+    def test_results_drained_only_after_final_collect(self, small_model,
+                                                      tmp_path):
+        """drained=true may only be answered once every result is IN the
+        response (the router deletes a drained handle — a result published
+        after a drained answer would be lost forever). There is ONE
+        definition of drained — the flag the serve loop sets only AFTER
+        its final collect — backing BOTH the property and the HTTP
+        answer; a second racy pending==0 predicate would say True in the
+        window between the last step() and the final collect."""
+        cfg, params = small_model
+        registry = el.FileRegistry(str(tmp_path), "fleet", ttl=2.0)
+        eng = _engine(cfg, params, admission=AdmissionPolicy())
+        rep = ReplicaServer(eng, registry, "rx")
+        rep._admin.start()   # admin up, serve LOOP deliberately not running
+        try:
+            rep.begin_drain()
+            # no work exists, but the serve loop never ran its final
+            # collect — NEITHER surface may report drained
+            assert not rep.drained
+            doc = _get_json(f"{rep.endpoint}/results?since=0")
+            assert doc["drained"] is False
+        finally:
+            rep._admin.stop()
+        # end-to-end: whenever a LIVE replica answers drained=true, that
+        # same response carries the complete result set
+        h = _Replicas(tmp_path / "live", cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            rids = [router.submit(p, 5) for p in _prompts(2, seed=21)]
+            assert router.drain(h.reps[0].replica_id)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                doc = _get_json(f"{h.reps[0].endpoint}/results?since=0")
+                if doc["drained"]:
+                    assert len(doc["results"]) == len(rids)
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("replica never reported drained")
+        finally:
+            h.stop()
+
+    def test_never_admissible_answers_400_not_empty_result(self,
+                                                           small_model,
+                                                           tmp_path):
+        """An impossible request (budget over max_len) must be refused
+        LOUDLY at the /enqueue boundary — accepting it would turn the
+        serve loop's add_request ValueError into a silent empty result
+        that wait() reports as success."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            from paddle_tpu.observability.admin import job_token
+            body = json.dumps({"rid": 0, "prompt": [1, 2, 3],
+                               "max_new_tokens": 10_000}).encode()
+            req = urllib.request.Request(
+                f"{h.reps[0].endpoint}/enqueue", data=body, method="POST",
+                headers={"X-Paddle-Job-Token": job_token()})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+            assert "invalid" in json.loads(ei.value.read())["reason"]
+            # and through the router: loud ValueError, no trace-record leak
+            router = Router(h.registry)
+            with pytest.raises(ValueError, match="refused"):
+                router.submit([1, 2, 3], 10_000)
+            assert router.slo.summary()["inflight"] == 0
+        finally:
+            h.stop()
+
+    def test_tick_skips_pending_already_done(self, tmp_path):
+        """A send parked in _pending by a transport fault may in fact have
+        been accepted by the replica; once its result lands in _done, a
+        later tick must NOT dispatch it again (duplicate generation + a
+        permanent _inflight leak)."""
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        req = RoutedRequest(rid=0, prompt=[1, 2], max_new_tokens=4,
+                            trace_id=7)
+        router._requests[0] = req
+        router._pending.append(req)
+        router._done[0] = {"rid": 0, "tokens": [5], "reason": "complete"}
+        routed0 = metrics.counter("serve.fleet.routed").value
+        router.tick()
+        assert not router._pending
+        assert metrics.counter("serve.fleet.routed").value == routed0
+
+    def test_loop_crash_is_not_a_zombie(self, small_model, tmp_path):
+        """A serve loop that dies unexpectedly must tear down its own
+        failure-detector inputs (lease + HTTP face) — otherwise the
+        heartbeat keeps the lease alive, the router keeps routing to a
+        replica that can never serve, and failover never fires."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=2)
+        crasher = h.reps[0]
+        try:
+            def boom():
+                raise RuntimeError("injected serve-loop crash")
+            crasher._b.step = boom
+            router = Router(h.registry)
+            # route one request at the crasher directly (bypass balancing)
+            router.refresh(force=True)
+            survivors = [r for r in h.reps if r is not crasher]
+            for rep in survivors:
+                router._handles[rep.replica_id].queue_depth = 99
+            p = _prompts(1, seed=33)[0]
+            rid = router.submit(p, 5)
+            for rep in survivors:  # restore honest load for failover
+                router._handles[rep.replica_id].queue_depth = 0
+            # the crashed replica must leave the alive set by itself
+            deadline = time.time() + 20
+            while crasher.replica_id in h.registry.alive_nodes() \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert crasher.replica_id not in h.registry.alive_nodes()
+            # and its accepted request must complete on a survivor
+            out = router.wait([rid], timeout=60)
+            assert out[rid] == _reference(cfg, params, p, 5)
+            assert metrics.counter("serve.fleet.failovers").value >= 1
+            # the crash is recorded: main() exits nonzero off this flag
+            # (rc=0 is the drain protocol's "finished clean" — a crash
+            # reading as clean would stop a restart-on-failure supervisor
+            # from ever restarting the replica); survivors stay clean
+            assert isinstance(crasher.crash, RuntimeError)
+            assert all(r.crash is None for r in survivors)
+        finally:
+            h.stop()
+
+    def test_tick_absorbs_never_admissible_pending_as_error(self,
+                                                            small_model,
+                                                            tmp_path):
+        """A fault-parked request later answered 400 (never-admissible,
+        hidden from submit() by send faults) must become a terminal error
+        result — not raise out of tick()/wait() with the rid stranded."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            req = RoutedRequest(rid=0, prompt=[1, 2, 3],
+                                max_new_tokens=10_000, trace_id=9)
+            router._requests[0] = req
+            router._pending.append(req)   # as if parked by a send fault
+            router.tick()
+            res = router.result(0)
+            assert res is not None and res["tokens"] == []
+            assert res["reason"].startswith("error:")
+            assert router.wait([0], timeout=10) == {0: []}
+        finally:
+            h.stop()
+
+    def test_two_routers_share_a_fleet_without_crosstalk(self, small_model,
+                                                         tmp_path):
+        """rids are router-local and /results is one shared list: every
+        record carries the sending router's namespace, and a router
+        ignores foreign records — N frontends over one lease set must
+        never deliver each other's tokens (both submit their rid 0
+        here)."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            ra, rb = Router(h.registry), Router(h.registry)
+            pa, pb = _prompts(2, seed=41)
+            dup0 = metrics.counter("serve.fleet.dup_results").value
+            rid_a = ra.submit(pa, 5)
+            rid_b = rb.submit(pb, 5)
+            assert rid_a == rid_b == 0   # colliding rid namespace
+            out_a = ra.wait(timeout=60)
+            out_b = rb.wait(timeout=60)
+            assert out_a[rid_a] == _reference(cfg, params, pa, 5)
+            assert out_b[rid_b] == _reference(cfg, params, pb, 5)
+            assert metrics.counter("serve.fleet.dup_results").value == dup0
+        finally:
+            h.stop()
+
+    def test_absorb_ignores_unstamped_direct_client_records(self, tmp_path):
+        """A replica can serve a router and bare direct HTTP clients at
+        once; a direct client's record carries router=None and may reuse
+        a small integer rid. The namespace filter must be an EXACT match
+        — every send the router makes is stamped, so an unstamped record
+        can never be its own — or the foreign tokens would be delivered
+        as this router's result for the colliding rid."""
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        req = RoutedRequest(rid=0, prompt=[1, 2], max_new_tokens=4,
+                            trace_id=router.slo.on_enqueue(0))
+        router._requests[0] = req
+        router._inflight[0] = req
+        router._absorb({"rid": 0, "router": None, "tokens": [9, 9],
+                        "reason": "complete"})
+        assert 0 not in router._done       # foreign record: not ours
+        assert 0 in router._inflight       # ours still in flight
+        router._absorb({"rid": 0, "router": router._rid_ns,
+                        "tokens": [5], "reason": "complete"})
+        assert router._done[0]["tokens"] == [5]
+
+    def test_results_retention_bounded_with_monotone_cursors(
+            self, small_model, tmp_path, monkeypatch):
+        """A replica serving steady traffic for days must hold a BOUNDED
+        finished-result tail, not every token it ever emitted. Truncation
+        advances a base offset so wire cursors stay monotone; a poller
+        behind the base receives the base and can SEE it missed results;
+        a draining replica never truncates (its drained answer promises
+        the slice is complete)."""
+        monkeypatch.setenv("PADDLE_SERVE_RESULTS_KEEP", "3")
+        cfg, params = small_model
+        registry = el.FileRegistry(str(tmp_path), "fleet", ttl=2.0)
+        rep = ReplicaServer(_engine(cfg, params), registry, "rk")
+        try:
+            for i in range(5):
+                rep._push_result(i, i, "ns", [i], "complete")
+            assert len(rep._results) == 3          # bounded tail
+            code, doc = rep._h_results({"since": ["3"]})
+            assert code == 200
+            assert doc["base"] == 2 and doc["cursor"] == 5
+            assert [r["rid"] for r in doc["results"]] == [3, 4]
+            _, doc0 = rep._h_results({"since": ["0"]})   # lagging poller
+            assert [r["rid"] for r in doc0["results"]] == [2, 3, 4]
+            assert doc0["base"] == 2               # the gap is visible
+            rep._draining = True                   # drain: cap suspended
+            for i in range(5, 9):
+                rep._push_result(i, i, "ns", [i], "complete")
+            assert [r["rid"] for r in rep._results] == list(range(2, 9))
+        finally:
+            rep._admin._httpd.server_close()
+
+    def test_enqueue_idempotent_while_active(self, small_model, tmp_path):
+        """A landed send whose response was lost is retried by the router
+        — while the first copy is queued/in flight, the retry must be an
+        idempotent 200 (dedup), not a second generation."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        rep = h.reps[0]
+        try:
+            from paddle_tpu.observability.admin import job_token
+            body = json.dumps({"rid": 5, "prompt": [1, 2, 3],
+                               "max_new_tokens": 4, "router": "rtrA",
+                               "trace_id": 1}).encode()
+            docs = []
+            for _ in range(2):
+                req = urllib.request.Request(
+                    f"{rep.endpoint}/enqueue", data=body, method="POST",
+                    headers={"X-Paddle-Job-Token": job_token()})
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    docs.append(json.loads(r.read()))
+            assert docs[0]["ok"] and docs[1]["ok"]
+            assert docs[1].get("dedup") is True
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                res = _get_json(f"{rep.endpoint}/results?since=0")["results"]
+                if res:
+                    break
+                time.sleep(0.05)
+            assert len(res) == 1   # ONE generation, not two
+            assert res[0]["rid"] == 5 and res[0]["router"] == "rtrA"
+        finally:
+            h.stop()
+
+    def test_force_enqueue_honored_while_draining(self, small_model,
+                                                  tmp_path):
+        """Failover re-enqueues (force=True) of already-accepted work are
+        honored during drain — same contract as add_request — so accepted
+        requests cannot strand when the only live replicas are
+        draining."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        rep = h.reps[0]
+        try:
+            from paddle_tpu.observability.admin import job_token
+            # keep the serve loop busy through the drain window so the
+            # force POST deterministically arrives while it is alive
+            long_body = json.dumps({"rid": 8, "prompt": [1, 2, 3, 4],
+                                    "max_new_tokens": 60,
+                                    "router": "rtrF",
+                                    "trace_id": 3}).encode()
+            req0 = urllib.request.Request(
+                f"{rep.endpoint}/enqueue", data=long_body, method="POST",
+                headers={"X-Paddle-Job-Token": job_token()})
+            with urllib.request.urlopen(req0, timeout=5) as r:
+                assert r.status == 200
+            rep.begin_drain()
+            p = _prompts(1, seed=43)[0]
+            for force, want in ((False, 429), (True, 200)):
+                body = json.dumps({"rid": 9, "prompt": p,
+                                   "max_new_tokens": 4, "force": force,
+                                   "router": "rtrF",
+                                   "trace_id": 2}).encode()
+                req = urllib.request.Request(
+                    f"{rep.endpoint}/enqueue", data=body, method="POST",
+                    headers={"X-Paddle-Job-Token": job_token()})
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        assert r.status == want
+                except urllib.error.HTTPError as e:
+                    assert e.code == want
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                res = _get_json(f"{rep.endpoint}/results?since=0")["results"]
+                if len(res) >= 2:
+                    break
+                time.sleep(0.05)
+            forced = next(r for r in res if r["rid"] == 9)
+            assert forced["tokens"] == _reference(cfg, params, p, 4)
+        finally:
+            h.stop()
+
+    def test_shed_does_not_pollute_slo_histograms(self, small_model):
+        """Shed requests were never served — their lifetimes must not
+        land in the e2e/queue histograms the admission policy reads
+        (overload sheds ~0s would drag the retry-after estimate to the
+        floor; drain-grace sheds would fire breaches for unserved
+        work)."""
+        cfg, params = small_model
+        eng = _engine(cfg, params)
+        for p in _prompts(3, seed=44):
+            eng.add_request(p, 4)
+        e2e0 = metrics.histogram("slo.e2e_s").stats()["count"]
+        eng.shed_newest(3)
+        assert metrics.histogram("slo.e2e_s").stats()["count"] == e2e0
+        assert all(r.reason == "shed" for r in eng.take_finished().values())
+
+    def test_get_surfaces_http_status_errors(self, small_model, tmp_path):
+        """An HTTP status line IS reachability proof: _get must raise on
+        403/404/500 (read-auth misconfig, handler bug) instead of
+        classifying it transient — HTTPError subclasses OSError, and a
+        swallowed status error reads as a dead replica and double-runs
+        its in-flight work via failover."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            router.refresh(force=True)
+            handle = router._handles[h.reps[0].replica_id]
+            with pytest.raises(urllib.error.HTTPError):
+                router._get(handle.endpoint, "/no-such-route")
+        finally:
+            h.stop()
+
+    def test_forced_work_routes_to_draining_replica(self, small_model,
+                                                    tmp_path):
+        """A draining replica reports ready=False by design; forced
+        re-enqueues (failover/shed of already-accepted work) must still
+        be able to land there when no healthy replica exists — gating the
+        forced path on ready would strand accepted work in _pending
+        forever while the last survivor drains."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        rep = h.reps[0]
+        try:
+            router = Router(h.registry)
+            router.refresh(force=True)
+            # slow the serve loop + give it work: an EMPTY replica drains
+            # (and leaves the table) instantly, and this pin needs a
+            # window where the replica is draining-but-still-serving
+            orig_step = rep._b.step
+            rep._b.step = lambda: (time.sleep(0.15), orig_step())
+            rid0 = router.submit(_prompts(1, seed=54)[0], 30)
+            rep.begin_drain()          # default 30s grace: loop stays alive
+            deadline = time.time() + 10
+            while not router._handles[rep.replica_id].draining \
+                    and time.time() < deadline:
+                router.refresh(force=True)
+                time.sleep(0.05)
+            handle = router._handles[rep.replica_id]
+            assert handle.draining and not handle.ready
+            assert router._candidates() == []                  # new admits: no
+            assert router._candidates(include_draining=True) == [handle]
+            p = _prompts(1, seed=55)[0]
+            req = RoutedRequest(rid=7, prompt=p, max_new_tokens=5,
+                                trace_id=router.slo.on_enqueue(7),
+                                retried=True)
+            router._requests[7] = req
+            assert router._try_route(req, force=True) == "routed"
+            out = router.wait([7, rid0], timeout=60)
+            assert out[7] == _reference(cfg, params, p, 5)
+        finally:
+            h.stop()
+
+    def test_fleet_saturated_reject_propagates_replica_hint(self, tmp_path):
+        """A saturated fleet's rejection must carry the replicas' own
+        computed retry_after_s from their 429 bodies — not degrade to the
+        floor (0.25s) and produce a retry storm at floor cadence while
+        the real wait is e2e-p50 × queued waves."""
+        from paddle_tpu.inference.router import _Handle
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        router.refresh = lambda *a, **k: None   # keep the crafted table
+        router._handles["serve.rx"] = _Handle(
+            id="serve.rx", endpoint="http://127.0.0.1:1", max_batch=2)
+        router._post = lambda *a, **k: (429, {
+            "ok": False, "reason": "queue_full", "retry_after_s": 7.5})
+        with pytest.raises(AdmissionReject) as ei:
+            router.submit([1, 2, 3], 4)
+        assert ei.value.reason == "fleet_saturated"
+        assert ei.value.retry_after_s == pytest.approx(7.5)
+
+    def test_unexpected_enqueue_status_raises_not_saturated(self, tmp_path):
+        """403/500 from /enqueue is reachability PROOF of a broken fleet
+        (auth misconfig, handler bug) — the POST twin of _get's HTTPError
+        contract. Falling through to 'declined' would report it as
+        fleet_saturated and retry-storm an honoring client forever while
+        the real error never surfaces."""
+        from paddle_tpu.inference.router import _Handle
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        router.refresh = lambda *a, **k: None
+        router._handles["serve.rx"] = _Handle(
+            id="serve.rx", endpoint="http://127.0.0.1:1", max_batch=2)
+        router._post = lambda *a, **k: (403, {})
+        with pytest.raises(RuntimeError, match="HTTP 403"):
+            router.submit([1, 2, 3], 4)
+        assert router.slo.summary()["inflight"] == 0   # record dropped
+
+    def test_same_name_restart_within_ttl_rejoins_fresh_endpoint(
+            self, small_model, tmp_path):
+        """A supervisor restarting a replica under the SAME name within
+        the TTL keeps the lease alive continuously, so the alive set
+        never drops it — the router must notice the endpoint change
+        (the old process's death certificate), fail its in-flight work
+        over, and re-join the fresh process instead of retrying the dead
+        port forever behind a live lease."""
+        cfg, params = small_model
+        registry = el.FileRegistry(str(tmp_path), "fleet", ttl=2.0)
+        old = ReplicaServer(_engine(cfg, params,
+                                    admission=AdmissionPolicy()),
+                            registry, "r0").start()
+        new = None
+        try:
+            router = Router(registry)
+            orig_step = old._b.step
+            old._b.step = lambda: (time.sleep(0.2), orig_step())
+            p = _prompts(1, seed=56)[0]
+            failovers0 = metrics.counter("serve.fleet.failovers").value
+            rid = router.submit(p, 20)
+            old.stop()      # hard kill; lease left to lapse (still live)
+            new = ReplicaServer(_engine(cfg, params,
+                                        admission=AdmissionPolicy()),
+                                registry, "r0").start()
+            assert new.endpoint != old.endpoint
+            router.refresh(force=True)
+            assert router._handles["serve.r0"].endpoint == new.endpoint
+            out = router.wait([rid], timeout=60)
+            assert out[rid] == _reference(cfg, params, p, 20)
+            assert metrics.counter("serve.fleet.failovers").value \
+                > failovers0
+        finally:
+            old.stop()
+            if new is not None:
+                new.stop()
+
+    def test_fault_parked_dedup_probe_bypasses_saturation_gate(
+            self, tmp_path):
+        """A fault-parked send may have LANDED on last_faulted; the
+        re-dispatch must probe THAT replica even when it reads saturated
+        or draining — skipping the probe would post the rid to another
+        replica and burn a full duplicate generation exactly when the
+        fleet has no slack (the dedup probe is one cheap round trip)."""
+        from paddle_tpu.inference.router import _Handle
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        router.refresh = lambda *a, **k: None
+        h = _Handle(id="serve.rf", endpoint="http://127.0.0.1:1",
+                    max_batch=2, queue_depth=99)      # reads saturated
+        router._handles["serve.rf"] = h
+        posts = []
+        router._post = lambda ep, path, body: (
+            posts.append(body) or (200, {"ok": True, "dedup": True}))
+        req = RoutedRequest(rid=3, prompt=[1, 2], max_new_tokens=4,
+                            trace_id=router.slo.on_enqueue(3),
+                            last_faulted="serve.rf")
+        router._requests[3] = req
+        assert router._try_route(req, force=False) == "routed"
+        assert len(posts) == 1        # the probe reached the replica
+        # and when the replica is DRAINING (filtered out of candidates):
+        h.draining, h.ready = True, False
+        req2 = RoutedRequest(rid=4, prompt=[1], max_new_tokens=4,
+                             trace_id=router.slo.on_enqueue(4),
+                             last_faulted="serve.rf")
+        router._requests[4] = req2
+        assert router._try_route(req2, force=False) == "routed"
+        assert len(posts) == 2
+
+    def test_heartbeat_race_cannot_resurrect_left_lease(self, small_model,
+                                                        tmp_path):
+        """_beat checks draining, releases the lock, then heartbeats —
+        if the drain protocol's deregister lands in that window the
+        in-flight heartbeat rewrites the lease AFTER leave() and the
+        drained replica haunts every routing table for a full TTL. The
+        post-heartbeat re-check must bury it again."""
+        cfg, params = small_model
+        registry = el.FileRegistry(str(tmp_path), "fleet", ttl=5.0)
+        rep = None
+        orig_hb, state = registry.heartbeat, {"n": 0}
+
+        def racing_hb(node, info):
+            state["n"] += 1
+            if state["n"] == 2:
+                # drain + the serve loop's deregister land while THIS
+                # heartbeat is in flight: the write below arrives AFTER
+                # the leave — the resurrection race
+                rep.begin_drain()
+                registry.leave(node)
+            return orig_hb(node, info)
+
+        registry.heartbeat = racing_hb
+        rep = ReplicaServer(_engine(cfg, params), registry, "rh",
+                            heartbeat_s=0.05)
+        try:
+            rep.start()
+            deadline = time.time() + 10
+            while state["n"] < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            time.sleep(0.3)     # the post-heartbeat re-check runs
+            assert "serve.rh" not in registry.alive_nodes()
+        finally:
+            rep.stop()
+
+    def test_forced_path_ignores_transient_not_ready(self, tmp_path):
+        """ready=False WITHOUT draining (failing health callable, missed
+        probe) must not strand forced re-enqueues either: the forced
+        path ignores readiness entirely — the send itself is the probe
+        that matters, and accepted work must land somewhere."""
+        from paddle_tpu.inference.router import _Handle
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        h = _Handle(id="serve.nr", endpoint="http://127.0.0.1:1",
+                    max_batch=2, ready=False)
+        router._handles["serve.nr"] = h
+        assert router._candidates() == []
+        assert router._candidates(include_draining=True) == [h]
+
+    def test_mark_dead_clears_stale_fault_markers(self, tmp_path):
+        """A pending request's last_faulted must die with the replica it
+        names: the dedup probe is meaningless once those results can
+        never be collected, and a stale marker holds tick() in
+        unthrottled /results polling (the any(last_faulted) fast path)
+        for the whole saturation window."""
+        from paddle_tpu.inference.router import _Handle
+        router = Router(el.FileRegistry(str(tmp_path), "empty", ttl=1.0))
+        h = _Handle(id="serve.rd", endpoint="http://127.0.0.1:1")
+        router._handles["serve.rd"] = h
+        req = RoutedRequest(rid=1, prompt=[1], max_new_tokens=2,
+                            trace_id=1, last_faulted="serve.rd")
+        router._requests[1] = req
+        router._pending.append(req)
+        router._mark_dead(h)
+        assert req.last_faulted is None
+
+    def test_admit_path_never_sorts_histograms(self):
+        """The intake hot path: decide() takes the slo_hists FUNCTION and
+        must not evaluate it on a plain admit (two reservoir sorts per
+        enqueue for nothing); on a decision that consumes it, it runs
+        exactly once (memoized across threshold test + retry-after)."""
+        calls = []
+
+        def hists():
+            calls.append(1)
+            return {"slo.queue_wait_s": {"p95": 9.0, "count": 5},
+                    "slo.e2e_s": {"p50": 2.0, "p95": 9.0, "count": 5}}
+
+        p = AdmissionPolicy(max_queue=4)
+        assert p.decide(0, 2, hists=hists) is None
+        assert p.decide(3, 2, hists=hists) is None
+        assert calls == []                     # admit: never evaluated
+        d = p.decide(4, 2, hists=hists)        # queue_full: consumed once
+        assert d["reason"] == "queue_full"
+        assert d["retry_after_s"] == pytest.approx((4 + 1) / 2 * 2.0)
+        assert len(calls) == 1
+        calls.clear()
+        lat = AdmissionPolicy(max_queue=100, queue_p95_s=0.5)
+        d = lat.decide(1, 2, hists=hists)      # threshold + ra: one sort
+        assert d["reason"] == "queue_p95" and len(calls) == 1
+
+
+# ------------------------------------------------- overload drill (accept)
+
+class TestOverloadDrill:
+    def test_offered_load_beyond_capacity_bounded_and_complete(
+            self, small_model, tmp_path):
+        """Acceptance: offered load > fleet capacity → admission rejects
+        with retry_after_s, queue depth stays bounded, and a client that
+        honors retry-after eventually completes every request."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=2,
+                      admission=AdmissionPolicy(max_queue=2))
+        try:
+            router = Router(h.registry,
+                            admission=AdmissionPolicy(max_queue=2))
+            prompts = _prompts(14, seed=11, lo=4, hi=10)
+            rejected, rids = 0, []
+            max_depth = 0
+            for p in prompts:
+                while True:
+                    for rep in h.reps:  # bounded-queue invariant, live
+                        max_depth = max(max_depth,
+                                        rep._health()["queue_depth"])
+                    try:
+                        rids.append(router.submit(p, 6))
+                        break
+                    except AdmissionReject as e:
+                        rejected += 1
+                        assert e.retry_after_s > 0
+                        time.sleep(min(e.retry_after_s, 0.2))
+            out = router.wait(timeout=120)
+            assert len(out) == 14 and all(out[r] for r in rids)
+            assert rejected > 0, "drill never saturated the fleet"
+            # bounded: cap + max_batch slack per replica, never unbounded
+            cap = AdmissionPolicy(max_queue=2).max_queue_for(3)
+            assert max_depth <= cap + SPEC["batcher"]["max_batch"] + 1
+            assert metrics.counter("serve.fleet.rejected").value >= 1
+        finally:
+            h.stop()
+
+
+# -------------------------------------------------- chaos sites (A2 pass)
+
+class TestChaosSites:
+    def test_serve_route_fault_defers_not_loses(self, small_model,
+                                                tmp_path):
+        """serve.route: the faulted send leaves the request PENDING; the
+        next tick routes it — same tokens as fault-free."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            p = _prompts(1, seed=12)[0]
+            with chaos.inject("serve.route:1"):
+                rid = router.submit(p, 5)     # send faulted → pending
+                assert router.summary()["pending"] == 1
+                out = router.wait(timeout=60)
+            assert out[rid] == _reference(cfg, params, p, 5)
+            assert metrics.counter("serve.fleet.route_faults").value >= 1
+        finally:
+            h.stop()
+
+    def test_serve_reject_fault_degrades_hint_not_verdict(self, tmp_path):
+        """serve.reject: under chaos the rejection STANDS, only the
+        computed retry-after hint degrades to the floor."""
+        from paddle_tpu.inference.admission import reject as _reject
+        with chaos.inject("serve.reject:1"):
+            with pytest.raises(AdmissionReject) as ei:
+                _reject("queue_full", 9.5)     # faulted: hint floored
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s == retry_after_floor() != 9.5
+        with pytest.raises(AdmissionReject) as ei2:
+            _reject("queue_full", 9.5)         # fault-free: hint kept
+        assert ei2.value.retry_after_s == 9.5
+        # and at the router surface the rejection still raises under chaos
+        router = Router(el.FileRegistry(str(tmp_path), "empty2", ttl=1.0))
+        with chaos.inject("serve.reject:1"):
+            with pytest.raises(AdmissionReject) as ei3:
+                router.submit([1, 2, 3], 4)
+        assert ei3.value.reason == "no_replicas"
+
+    def test_serve_replica_dead_fault_defers_failover(self, tmp_path):
+        """serve.replica_dead: the faulted failover re-enqueue is deferred
+        one tick, never lost (unit-level: orphan bookkeeping only)."""
+        router = Router(el.FileRegistry(str(tmp_path), "empty3", ttl=1.0))
+        req = RoutedRequest(rid=0, prompt=[1, 2], max_new_tokens=4,
+                            trace_id=41, replica="serve.gone")
+        router._requests[0] = req
+        router._inflight[0] = req
+        router._orphans.append(0)
+        with chaos.inject("serve.replica_dead:1"):
+            router._failover()                  # fault: deferred
+            assert list(router._orphans) == [0]
+            assert 0 in router._inflight
+            router._failover()                  # next tick: re-enqueued
+        assert not router._orphans
+        assert [r.rid for r in router._pending] == [0]
+        assert router._pending[0].trace_id == 41  # SAME trace id
+        assert router._pending[0].retried
+        assert 0 not in router._inflight
+
+
+# ------------------------------------------------ kill drill (acceptance)
+
+class TestServingFleetKillDrill:
+    """ISSUE 9 acceptance: 3 replica PROCESSES + router under a heavy-tail
+    mix, SIGKILL one mid-decode, CHAOS ON at the router (serve.route +
+    serve.replica_dead + serve.reject) — every accepted request completes,
+    retried requests keep their trace id, outputs are token-identical to
+    the fault-free per-request reference (chaos==fault-free extended to
+    the fleet), and retire/breach fire exactly once per request."""
+
+    N_REQ = 14
+
+    def test_kill_one_of_three_token_identical(self, small_model, tmp_path,
+                                               monkeypatch):
+        cfg, params = small_model
+        rng = np.random.RandomState(13)
+        lens = rng.choice([4, 6, 9, 14, 24], self.N_REQ,
+                          p=[.35, .3, .2, .1, .05])          # heavy tail
+        budgets = rng.choice([3, 5, 8, 16], self.N_REQ, p=[.4, .3, .2, .1])
+        reqs = [(rng.randint(1, 256, int(n)).tolist(), int(m))
+                for n, m in zip(lens, budgets)]
+
+        # every request breaches e2e (target 1µs) → breach-exactly-once is
+        # countable at the router tracker
+        monkeypatch.setenv("PADDLE_SLO_E2E_S", "0.000001")
+        breach0 = metrics.counter("slo.breach").value
+        dup0 = metrics.counter("serve.fleet.dup_results").value
+        fleet = ServingFleet(
+            3, SPEC, root=str(tmp_path), ttl=1.2,
+            env={"JAX_PLATFORMS": "cpu", "PADDLE_CHAOS": "",
+                 "PADDLE_SLO_E2E_S": ""})   # chaos/slo scoped to router
+        try:
+            fleet.start(timeout=180)
+            router = fleet.router()
+            with chaos.inject(
+                    "serve.route:3,serve.replica_dead:1,serve.reject:1"):
+                rids = []
+                for p, m in reqs:
+                    while True:
+                        try:
+                            rids.append(router.submit(p, m))
+                            break
+                        except AdmissionReject as e:
+                            time.sleep(min(e.retry_after_s, 0.3))
+                time.sleep(0.2)       # decode is in flight fleet-wide
+                fleet.kill("r2")      # SIGKILL mid-decode
+                out = router.wait(timeout=180)
+
+            # 1) every accepted request completed, token-identical to the
+            #    fault-free reference (chaos-on + kill == fault-free)
+            assert len(out) == self.N_REQ
+            for rid, (p, m) in zip(rids, reqs):
+                assert out[rid] == _reference(cfg, params, p, m), \
+                    f"rid {rid} diverged after failover/chaos"
+
+            # 2) the kill really exercised failover, and retried requests
+            #    kept their trace id END-TO-END (the replica-reported
+            #    trace id equals the router-issued one)
+            s = router.summary()
+            assert s["failovers"] >= 1, \
+                f"SIGKILL produced no failover: {s}"
+            retried = [r for r in router._requests.values() if r.retried]
+            assert retried
+            for req in retried:
+                res = router.result(req.rid)
+                assert res["trace_id"] == req.trace_id
+            assert metrics.counter("serve.fleet.dup_results").value == dup0
+
+            # 3) retire + breach exactly once per request
+            assert router.slo.summary()["inflight"] == 0
+            assert metrics.counter("slo.breach").value - breach0 == \
+                self.N_REQ
+            # dead replica left the routing table (within one TTL)
+            assert "serve.r2" not in router.summary()["replicas"]
+        finally:
+            fleet.shutdown()
+
+
+# ------------------------------------------- serving_bench fleet sub-object
+
+class TestFleetBenchContract:
+    def test_fleet_serve_subobject_schema(self, monkeypatch, capsys):
+        """PADDLE_SERVE_REPLICAS=2 → the JSON line gains fleet_serve with
+        replicas/rejected/retried/failovers/per-replica TTFT — and the
+        line exists even though a replica was SIGKILLed mid-drill."""
+        import sys as _sys
+
+        from benchmarks import serving_bench
+        monkeypatch.setenv("SERVING_TRAIN_STEPS", "0")
+        monkeypatch.setenv("PADDLE_SERVE_REPLICAS", "2")
+        monkeypatch.setenv("FLEET_DRILL_REQUESTS", "8")
+        monkeypatch.setattr(_sys, "argv", ["serving_bench.py", "2", "3", "4"])
+        rc = serving_bench.main()
+        line = [ln for ln in capsys.readouterr().out.splitlines()
+                if ln.startswith("{")][-1]
+        doc = json.loads(line)
+        assert rc == 0, doc
+        fs = doc["fleet_serve"]
+        assert fs and "error" not in fs, fs
+        assert fs["replicas"] == 2
+        assert fs["completed"] == fs["requests"] == 8
+        assert fs["failovers"] >= 1          # the mid-drill SIGKILL
+        assert fs["killed"] == "serve.r1"
+        for k in ("rejected", "retried", "tokens_per_sec", "per_replica"):
+            assert k in fs
+        for stats in fs["per_replica"].values():
+            assert set(stats) == {"ttft_p50", "ttft_p95", "count"}
+        # single-process absence (fleet_serve None) is asserted on the
+        # already-paid-for bench run in test_ragged_attention.py

@@ -32,7 +32,12 @@ from .core import Finding, FileCtx
 from .registry import Rule, register
 
 SCOPE_DIRS = ("paddle_tpu/observability/",)
-SCOPE_FILES = ("paddle_tpu/inference/serving.py",)
+SCOPE_FILES = ("paddle_tpu/inference/serving.py",
+               # the fleet runtime (ISSUE 9): replica handler threads vs
+               # the serve loop, router vs nothing (single-threaded by
+               # contract) — both audited like the telemetry plane
+               "paddle_tpu/inference/replica.py",
+               "paddle_tpu/inference/router.py")
 
 _LOCKNAME = re.compile(r"lock|(^|_)lk($|_)|(^|_)cv($|_)|mutex")
 _MUTATORS = frozenset({

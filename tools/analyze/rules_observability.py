@@ -50,6 +50,10 @@ HTTP_ALLOWLIST = {
         "rpc worker discovery GET against the elastic registry master",
     "paddle_tpu/hub.py":
         "model/file download (paddle.hub parity) — data plane, not telemetry",
+    "paddle_tpu/inference/router.py":
+        "serving-fleet router CLIENT of replica AdminServers (/enqueue, "
+        "/results, /health, /drain) — request data plane, token-authed, "
+        "lease-gated; the replica SERVER side extends AdminServer",
 }
 
 
