@@ -123,6 +123,34 @@ def _fleet_payload() -> dict | None:
     }
 
 
+def _quant_payload(n_params: int | None = None) -> dict | None:
+    """The ``quant`` sub-object (ISSUE 10): present only when
+    PADDLE_QUANT_ALLREDUCE selects a quantized gradient-sync wire —
+    reports the bytes each rank would put on the wire for one allreduce
+    of the step's gradients next to the fp32 sync it replaces, plus the
+    fallback/call counters (a chaos-degraded call shows up here). Never
+    raises (bench JSON contract)."""
+    try:
+        mode = os.environ.get("PADDLE_QUANT_ALLREDUCE", "")
+        if not mode or mode.strip().lower() in ("0", "off", "false"):
+            return None
+        from paddle_tpu.quant import allreduce as qar
+        m = qar.mode_from_env()
+        if m is None:
+            return None
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or "1")
+        snap = _metrics_payload() or {}
+        counters = snap.get("counters", {})
+        out = {"allreduce": qar.wire_bytes(int(n_params or 0),
+                                           max(2, world), m),
+               "calls": int(counters.get("quant.allreduce_calls", 0)),
+               "fallbacks": int(
+                   counters.get("quant.allreduce_fallbacks", 0))}
+        return out
+    except Exception:
+        return None
+
+
 def _error_payload(msg: str) -> dict:
     err = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -137,6 +165,9 @@ def _error_payload(msg: str) -> dict:
     slo = _slo_payload()
     if slo is not None:
         err["slo"] = slo
+    quant = _quant_payload()
+    if quant is not None:
+        err["quant"] = quant
     # surface the last committed success so an outage at bench time still
     # points the reader at a real number
     try:
@@ -419,6 +450,9 @@ def main() -> int:
     slo = _slo_payload()
     if slo is not None:
         result["slo"] = slo
+    quant = _quant_payload(n_params)
+    if quant is not None:
+        result["quant"] = quant
     if on_tpu:
         # non-default sizes record to their own file: the canonical 850M
         # BENCH_latest.json must not be clobbered by a 2b scale-proof run

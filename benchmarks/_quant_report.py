@@ -1,0 +1,47 @@
+"""The `quant` bench sub-object, shared by decode_bench and serving_bench
+(ISSUE 10): one definition of the kv_dtype choice, the bytes/token and
+capacity-vs-bf16 accounting, and the token-agreement rate — two benches
+reporting the same claim must not drift apart."""
+from __future__ import annotations
+
+import dataclasses
+
+
+def bench_kv_dtype() -> str:
+    """The kv_dtype the benches compare against full precision:
+    PADDLE_SERVE_KV_DTYPE when it names a quantized mode, else int8 (an
+    "off" spelling means the OPERATOR disabled quantized serving — the
+    bench still measures the comparison, that is its job)."""
+    from paddle_tpu.quant.codec import normalize_kv_dtype
+    from paddle_tpu.utils import env_flags
+    return normalize_kv_dtype(env_flags.get("PADDLE_SERVE_KV_DTYPE")) \
+        or "int8"
+
+
+def kv_quant_subobject(cfg, page_size: int, pages: int, kv_dt: str,
+                       base_outs, quant_outs, **extra) -> dict:
+    """kv_dtype, read bytes/token at `pages` width vs bf16 pages, the
+    pages-per-HBM-budget capacity ratio, and the greedy token-agreement
+    rate of `quant_outs` vs `base_outs` (parallel lists of token lists).
+    `extra` keys (e.g. tokens_per_sec) ride along verbatim."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama_paged import (page_bytes,
+                                               paged_kv_bytes_per_token)
+    total = max(1, sum(len(o) for o in base_outs))
+    agree = sum(int(a == b) for qo, bo in zip(quant_outs, base_outs)
+                for a, b in zip(qo, bo))
+    bf16_cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+    return {
+        "kv_dtype": kv_dt,
+        "kv_read_bytes_per_token": paged_kv_bytes_per_token(
+            cfg, pages, page_size, kv_dtype=kv_dt),
+        "kv_read_bytes_per_token_bf16": paged_kv_bytes_per_token(
+            bf16_cfg, pages, page_size),
+        # pages (== live tokens) one HBM budget buys, quantized vs bf16
+        "capacity_ratio_vs_bf16": round(
+            page_bytes(bf16_cfg, page_size)
+            / page_bytes(cfg, page_size, kv_dtype=kv_dt), 3),
+        "token_agreement": round(agree / total, 4),
+        **extra,
+    }

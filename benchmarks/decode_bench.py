@@ -154,11 +154,14 @@ def _paged_main(args, ragged: bool = False) -> dict:
                             rng.choice(budgets, n_req))]
     total_new = sum(m for _, m in reqs)
 
-    def serve(layout="paged"):
+    def serve(layout="paged", kv_dtype=""):
+        # kv_dtype="" pins the baseline passes to full-precision pages
+        # even when PADDLE_SERVE_KV_DTYPE is set fleet-wide — the quant
+        # sub-object below is a COMPARISON, not a global override
         eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
                                 max_len=max_len, prompt_buckets=buckets,
                                 burst=burst, kv_layout=layout,
-                                page_size=page_size)
+                                page_size=page_size, kv_dtype=kv_dtype)
         rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
         out = eng.run()
         return eng, [out[r] for r in rids]
@@ -195,6 +198,16 @@ def _paged_main(args, ragged: bool = False) -> dict:
         },
         "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
     }
+
+    # ---- quantized KV pages (ISSUE 10): same workload with int8/fp8
+    # pages — the sub-object the capacity claim is audited from:
+    # bytes/token vs bf16 pages, pages-per-budget capacity ratio, and
+    # the greedy token-agreement rate vs the full-precision serve.
+    from benchmarks._quant_report import bench_kv_dtype, kv_quant_subobject
+    kv_dt = bench_kv_dtype()
+    _, quant_out = serve(kv_dtype=kv_dt)
+    payload["quant"] = kv_quant_subobject(cfg, page_size, worst_bucket,
+                                          kv_dt, gather_out, quant_out)
     if not ragged:
         return payload
 

@@ -252,11 +252,14 @@ def _main():
     # are timed: paged (block-table pool, the default) and dense slots.
     page_size = 64 if on_tpu else 8   # ONE knob: engines + bytes/token math
 
-    def serve(kv_layout):
+    def serve(kv_layout, kv_dtype=""):
+        # kv_dtype="" pins the baseline passes to full-precision pages
+        # even under a fleet-wide PADDLE_SERVE_KV_DTYPE (dense ignores it)
+        kw = {} if kv_layout == "dense" else {"kv_dtype": kv_dtype}
         eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
                                 max_len=max_len, prompt_buckets=buckets,
                                 burst=burst, kv_layout=kv_layout,
-                                page_size=page_size)
+                                page_size=page_size, **kw)
         rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
         return eng, rids, eng.run()
 
@@ -289,6 +292,23 @@ def _main():
         "kernel_active": bool(reng._ragged),
         "parity": ragged_vs_paged == 0,
     }
+
+    # ---- quantized KV pages (ISSUE 10): the same workload once more with
+    # int8/fp8 pages through the gather path — the `quant` sub-object
+    # reports what the quantized pool buys (bytes/token + capacity at an
+    # equal HBM budget vs bf16 pages) and what it costs (greedy token
+    # agreement vs the full-precision paged serve).
+    from benchmarks._quant_report import bench_kv_dtype, kv_quant_subobject
+    kv_dt = bench_kv_dtype()
+    serve("paged", kv_dtype=kv_dt)  # compile pass
+    t0 = time.perf_counter()
+    _, quant_rids, quant_out = serve("paged", kv_dtype=kv_dt)
+    quant_s = time.perf_counter() - t0
+    dense_pages = (max_len - 1) // page_size + 1
+    quant_obj = kv_quant_subobject(
+        cfg, page_size, dense_pages, kv_dt,
+        [out[r] for r in rids], [quant_out[r] for r in quant_rids],
+        tokens_per_sec=round(total_new / quant_s, 1))
 
     # With trained weights greedy equality is a HARD assertion (logits
     # peaked, no load-bearing argmax ties); with random weights
@@ -327,6 +347,7 @@ def _main():
         "slo": slo_obj,
         "fleet_serve": fleet_obj,
         "ragged": ragged_obj,
+        "quant": quant_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),
         "vs_dense_slots": round(dense_s / cont_s, 2),
         "config": {"requests": n_req, "max_batch": max_batch,

@@ -209,8 +209,59 @@ class _Task:
         return True
 
 
+def _quant_allreduce_fn(tensor, op, g):
+    """The EQuARX opt-in (ISSUE 10): when ``PADDLE_QUANT_ALLREDUCE=int8|
+    fp8``, return the quantized reducer for this call, else None (the fp
+    path below stays byte-for-byte the pre-quant code).
+
+    Gates: SUM/AVG only (MAX/MIN/PROD have no accumulation to protect),
+    float payloads, >1 rank, and at least one quantization block per rank
+    — a barrier's scalar or a tiny metric sync pays scale overhead for no
+    wire win and stays full-precision. Eager DistTensors with explicit
+    placements keep the reshard path (GSPMD already owns their wire).
+    Chaos site ``quant.allreduce``: an injected fault DEGRADES this call
+    to the full-precision reducer (a fault may cost bandwidth, never
+    correctness); under a jitted step the hit lands once per trace — the
+    per-call discipline is exercised by re-traced shard_map drills
+    (tests/test_quant.py)."""
+    import os as _os
+    if not _os.environ.get("PADDLE_QUANT_ALLREDUCE"):
+        return None  # fast path: one env read, bitwise-identical behavior
+    from ..quant import allreduce as _qar
+    mode = _qar.mode_from_env()
+    if mode is None or g.nranks <= 1 or op not in (ReduceOp.SUM,
+                                                   ReduceOp.AVG):
+        return None
+    if not _is_tracer(tensor) and getattr(tensor, "_dist", None) is not None:
+        return None
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if not jnp.issubdtype(jnp.result_type(v), jnp.floating):
+        return None
+    block = _qar.block_from_env()
+    if v.size < g.nranks * block:
+        return None
+    from .resilience import chaos
+    try:
+        chaos.hit("quant.allreduce")
+    except chaos.ChaosError:
+        _metrics.counter("quant.allreduce_fallbacks").inc()
+        return None  # degrade to full precision, never to wrong numbers
+    _metrics.counter("quant.allreduce_calls").inc()
+    average = op == ReduceOp.AVG
+    return lambda x: _qar.quantized_all_reduce(
+        x, g.axis_name, g.nranks, mode, block=block, average=average)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _group(group)
+    qfn = _quant_allreduce_fn(tensor, op, g)
+    if qfn is not None:
+        if _is_tracer(tensor):
+            tensor._value = qfn(tensor._value)
+            return _Task()
+        out = _run_spmd(qfn, tensor, g)
+        tensor._value = out._value
+        return _Task()
     red = _REDUCERS[op]
     if _is_tracer(tensor):
         tensor._value = red(tensor._value, g.axis_name)

@@ -54,6 +54,9 @@ SITES: dict[str, str] = {
     "data.next":       "before a data-loader batch reaches the trainer",
     "elastic.enroll":  "before a re-rendezvous enrollment write",
     "kv.heartbeat":    "before an elastic KV heartbeat PUT",
+    "quant.allreduce": "before a quantized allreduce takes the low-precision "
+                       "wire (fault degrades that call to the full-precision "
+                       "reducer — precision goes UP, numbers never wrong)",
     "rendezvous":      "before distributed rendezvous / parallel-env init",
     "rpc.rendezvous":  "one discovery poll of init_rpc's accumulating loop",
     "rpc.send":        "before any wire IO of an rpc call (retry-safe)",

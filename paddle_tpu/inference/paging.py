@@ -23,7 +23,7 @@ from __future__ import annotations
 from typing import Sequence
 
 __all__ = ["PageAllocator", "SCRATCH_PAGE", "default_page_buckets",
-           "pages_for"]
+           "pages_for", "pages_for_budget"]
 
 SCRATCH_PAGE = 0
 
@@ -33,6 +33,17 @@ def pages_for(n_positions: int, page_size: int) -> int:
     if n_positions <= 0:
         return 0
     return (int(n_positions) - 1) // int(page_size) + 1
+
+
+def pages_for_budget(hbm_bytes: int, bytes_per_page: int) -> int:
+    """Pool size (page COUNT, scratch page included) an HBM byte budget
+    buys at ``bytes_per_page`` (``models/llama_paged.py:page_bytes`` —
+    which is where quantized pages pay off: int8/fp8 pages cost ~half the
+    bf16 bytes, so the same budget buys ~2× the pages and admission,
+    which is gated by free pages, admits ~2× the live tokens; ISSUE 10).
+    Floors at 2 — one scratch page plus one usable page is the smallest
+    pool the allocator accepts."""
+    return max(2, int(hbm_bytes) // max(1, int(bytes_per_page)))
 
 
 def default_page_buckets(max_pages: int) -> tuple:

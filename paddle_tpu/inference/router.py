@@ -131,7 +131,25 @@ class Router:
         self._pending: deque[RoutedRequest] = deque()
         self._inflight: dict[int, RoutedRequest] = {}
         self._orphans: deque[int] = deque()  # failover deferred by chaos
+        # finished-result retention (ISSUE 10 satellite, the PR-9 ROADMAP
+        # follow-up): _done holds UNDELIVERED results only. result() ACKS
+        # — the record is handed over exactly once and leaves the table —
+        # and anything never acked is evicted oldest-first past the same
+        # PADDLE_SERVE_RESULTS_KEEP bound the replica side enforces, so a
+        # long-lived frontend's memory follows its backlog, not its
+        # lifetime. Retired rids (acked or evicted) are remembered as a
+        # WATERMARK + exception set, not a growing set: rids are a dense
+        # monotone sequence, so "every rid < _retired_floor is finished,
+        # plus the out-of-order stragglers in _retired" compacts to O(gap)
+        # — late-duplicate detection and wait() membership survive the
+        # record itself being gone, at bounded memory over any lifetime.
         self._done: dict[int, dict] = {}
+        self._retired: set[int] = set()
+        self._retired_floor = 0
+        self._retired_count = 0
+        from ..utils import env_flags
+        from .replica import ENV_RESULTS_KEEP  # ONE knob for both sides
+        self._done_keep = int(env_flags.get_float(ENV_RESULTS_KEEP))
         self._requests: dict[int, RoutedRequest] = {}
         self._next_rid = 0
         # rid NAMESPACE: rids are router-local, but /results is one
@@ -147,9 +165,34 @@ class Router:
         # trace ids issued HERE flow to every replica attempt
         self.slo = _slo.RequestTracker(source="router")
         metrics.gauge("serve.fleet.replicas")
-        for c in ("routed", "rejected", "retried", "failovers",
-                  "route_faults", "dup_results"):
+        # instance-scoped fleet counters (ISSUE 10 satellite, the PR-9
+        # ROADMAP follow-up): summary() reads THESE, so two routers in
+        # one process report their own routing story, not each other's.
+        # The process-global serve.fleet.* counters keep incrementing as
+        # the fleet-wide aggregate (bench/monitor back-compat), and each
+        # instance also exports its own values as gauges suffixed with
+        # its router id — the registry has no label support, so the id
+        # rides in the name (serve.fleet.<name>.r_<router_id>).
+        self._fleet_counts = {c: 0 for c in (
+            "routed", "rejected", "retried", "failovers", "route_faults",
+            "dup_results", "results_evicted")}
+        for c in self._fleet_counts:
             metrics.counter(f"serve.fleet.{c}")
+
+    @property
+    def router_id(self) -> str:
+        """The instance id stamping this router's sends, results and
+        per-instance metric exports."""
+        return self._rid_ns
+
+    def _count(self, name: str) -> None:
+        """One fleet-counter event: instance tally (what summary()
+        reports), process-global aggregate, and the router-id-labeled
+        gauge export."""
+        self._fleet_counts[name] += 1
+        metrics.counter(f"serve.fleet.{name}").inc()
+        metrics.gauge(f"serve.fleet.{name}.r_{self._rid_ns}").set(
+            self._fleet_counts[name])
 
     # --------------------------------------------------------------- HTTP
     def _headers(self, post: bool) -> dict:
@@ -294,7 +337,7 @@ class Router:
         for _ in range(len(self._orphans)):
             rid = self._orphans.popleft()
             req = self._inflight.get(rid)
-            if req is None or rid in self._done:
+            if req is None or self._finished(rid):
                 continue  # already delivered before the lease lapsed
             try:
                 chaos.hit("serve.replica_dead")
@@ -306,7 +349,7 @@ class Router:
             req.retried = True
             self.slo.on_preempt(rid)  # queue-wait resumes, trace id kept
             self._pending.appendleft(req)
-            metrics.counter("serve.fleet.failovers").inc()
+            self._count("failovers")
 
     # ------------------------------------------------------------- routing
     def _candidates(self, include_draining: bool = False) -> list[_Handle]:
@@ -355,7 +398,7 @@ class Router:
             try:
                 chaos.hit("serve.route")
             except chaos.ChaosError:
-                metrics.counter("serve.fleet.route_faults").inc()
+                self._count("route_faults")
                 faulted = True
                 break           # stays pending; routed next tick
             code, body = self._post(h.endpoint, "/enqueue", {
@@ -370,7 +413,7 @@ class Router:
                 self._inflight[req.rid] = req
                 h.queue_depth += 1      # optimistic; next probe corrects
                 self.slo.on_admit(req.rid)
-                metrics.counter("serve.fleet.routed").inc()
+                self._count("routed")
                 return "routed"
             if code == 400:
                 # the replica refused the request as never-admissible
@@ -430,7 +473,11 @@ class Router:
         cand = self._candidates()
         if not cand:
             self.slo.on_reject(req.rid)
-            metrics.counter("serve.fleet.rejected").inc()
+            self._count("rejected")
+            # the burned rid is retired (uncounted) on EVERY refusal exit:
+            # the watermark must advance past it, or one rejection leaves
+            # every later retired rid stranded in the exception set
+            self._retire_rid(req.rid, count=False)
             _reject("no_replicas", retry_after_floor())
         try:
             status = self._try_route(req, force=False)
@@ -439,6 +486,7 @@ class Router:
             # status (403/500): the request never entered the system —
             # drop its trace record, then surface the error
             self.slo.on_reject(req.rid)
+            self._retire_rid(req.rid, count=False)
             raise
         if status == "declined":
             # every candidate is saturated: the fleet is at capacity —
@@ -450,7 +498,8 @@ class Router:
             # (its RequestTracker fills the local slo.* histograms)
             self.slo.on_reject(req.rid)
             h = cand[0]
-            metrics.counter("serve.fleet.rejected").inc()
+            self._count("rejected")
+            self._retire_rid(req.rid, count=False)
             _reject("fleet_saturated",
                     max(req.retry_hint,
                         self._admission.retry_after(h.queue_depth,
@@ -473,6 +522,52 @@ class Router:
             self._absorb(res)
         return doc
 
+    def _finished(self, rid) -> bool:
+        """Has this rid ever produced a terminal result? True even after
+        the record itself was acked/evicted — the guard every
+        duplicate-suppression check needs."""
+        return rid in self._done or rid in self._retired \
+            or (isinstance(rid, int) and rid < self._retired_floor)
+
+    def _retire_rid(self, rid: int, count: bool = True) -> None:
+        """Mark a rid finished-and-record-gone, compacting the watermark:
+        contiguous retirements from the floor collapse into it, so the
+        exception set holds only the out-of-order gap. EVERY allocated
+        rid must eventually come through here — a rejected submit burns
+        its rid too (count=False: not a finished request, but a hole the
+        floor must advance past, or the set grows forever after one
+        overload rejection)."""
+        if rid < self._retired_floor or rid in self._retired:
+            return
+        self._retired.add(rid)
+        if count:
+            self._retired_count += 1
+        while self._retired_floor in self._retired:
+            self._retired.discard(self._retired_floor)
+            self._retired_floor += 1
+
+    def _record_done(self, rid: int, res: dict) -> None:
+        """Publish a terminal result and enforce the retention bound:
+        past PADDLE_SERVE_RESULTS_KEEP undelivered records the OLDEST are
+        evicted (their rids stay retired so dup detection and wait()
+        membership survive) — the frontend mirror of the replica-side
+        results bound. Eviction means a wait()-only client that never
+        result()-acked will read [] for that rid: the loss is DELIBERATE
+        (bounded memory beats unbounded hoarding for an absent consumer)
+        and observable — counted per instance and flight-recorded."""
+        self._done[rid] = res
+        keep = self._done_keep
+        if keep > 0:
+            while len(self._done) > keep:
+                old_rid = next(iter(self._done))
+                del self._done[old_rid]
+                self._retire_rid(old_rid)
+                self._requests.pop(old_rid, None)
+                self._count("results_evicted")
+                _recorder.record(
+                    "serve.fleet.result_evicted", rid=old_rid,
+                    keep=keep, router=self._rid_ns)
+
     def _absorb(self, res: dict):
         if res.get("router") != self._rid_ns:
             # another sender's record — a second router's, or a direct
@@ -484,12 +579,12 @@ class Router:
             return
         rid = res.get("rid")
         req = self._requests.get(rid)
-        if req is None or rid in self._done:
+        if req is None or self._finished(rid):
             # a late duplicate may still hold an _inflight entry (the rid
             # was re-routed after its first result won) — release it so
             # summary()/inflight accounting can't leak
             self._inflight.pop(rid, None)
-            metrics.counter("serve.fleet.dup_results").inc()
+            self._count("dup_results")
             return
         reason = res.get("reason", "complete")
         if reason == "shed":
@@ -500,10 +595,10 @@ class Router:
                 req.retried = True
                 self.slo.on_preempt(rid)
                 self._pending.appendleft(req)
-                metrics.counter("serve.fleet.retried").inc()
+                self._count("retried")
             return
         self._inflight.pop(rid, None)
-        self._done[rid] = res
+        self._record_done(rid, res)
         n = len(res.get("tokens") or [])
         if n:
             self.slo.on_first_token(rid)
@@ -541,7 +636,7 @@ class Router:
                 self._collect_one(h)
         for _ in range(len(self._pending)):
             req = self._pending.popleft()
-            if req.rid in self._done:
+            if self._finished(req.rid):
                 continue  # fault-parked send actually landed; don't rerun
             try:
                 status = self._try_route(req, force=req.retried)
@@ -553,9 +648,9 @@ class Router:
                 # finishes and result() carries the reason, instead of the
                 # rid vanishing and stranding wait() forever.
                 self._inflight.pop(req.rid, None)
-                self._done[req.rid] = {"rid": req.rid, "tokens": [],
-                                       "reason": f"error: {e}",
-                                       "trace_id": req.trace_id}
+                self._record_done(req.rid, {"rid": req.rid, "tokens": [],
+                                            "reason": f"error: {e}",
+                                            "trace_id": req.trace_id})
                 self.slo.on_retire(req.rid, n_tokens=0, reason="error")
                 continue
             except RuntimeError:
@@ -579,22 +674,35 @@ class Router:
 
     def wait(self, rids=None, timeout: float = 120.0) -> dict:
         """Block until every rid (default: all submitted) is done; returns
-        {rid: [tokens]}. Raises TimeoutError listing the stragglers."""
+        {rid: [tokens]}. Raises TimeoutError listing the stragglers.
+        Does NOT ack: the records stay readable until ``result()`` takes
+        them (a rid already acked/evicted counts as done and returns []
+        here — its record was handed over or aged out)."""
         want = set(self._requests if rids is None else rids)
         deadline = _slo.now() + timeout
-        while want - set(self._done):
+        while any(not self._finished(r) for r in want):
             if _slo.now() > deadline:
-                missing = sorted(want - set(self._done))
+                missing = sorted(r for r in want if not self._finished(r))
                 raise TimeoutError(
                     f"router.wait: {len(missing)} request(s) not done "
                     f"after {timeout}s: {missing[:8]}")
             self.tick()
             time.sleep(0.004)
-        return {rid: self._done[rid].get("tokens", []) for rid in want}
+        return {rid: self._done.get(rid, {}).get("tokens", [])
+                for rid in want}
 
     def result(self, rid: int) -> dict | None:
-        """Full result record (tokens, reason, trace_id) or None."""
-        return self._done.get(rid)
+        """Full result record (tokens, reason, trace_id) or None — and
+        the ACK (ISSUE 10 satellite): the record is handed over exactly
+        once and leaves the table, so a long-lived frontend's ``_done``
+        holds only never-delivered results (those are bounded by
+        PADDLE_SERVE_RESULTS_KEEP eviction in ``_record_done``). A second
+        read, or a read after eviction, returns None."""
+        rec = self._done.pop(rid, None)
+        if rec is not None:
+            self._retire_rid(rid)
+            self._requests.pop(rid, None)
+        return rec
 
     # ---------------------------------------------------------------- drain
     def drain(self, replica_id: str) -> bool:
@@ -621,15 +729,26 @@ class Router:
         return out
 
     def summary(self) -> dict:
-        c = metrics.counter_values()
+        """THIS router's story: the counters are instance-scoped (ISSUE
+        10 satellite), so two routers sharing a process — or a lease set
+        — never read each other's routed/rejected/failover numbers.
+        ``done`` counts every request that ever finished here;
+        ``done_held`` is the undelivered records currently retained."""
         return {"replicas": sorted(self._handles),
+                "router_id": self._rid_ns,
                 "pending": len(self._pending),
                 "inflight": len(self._inflight),
-                "done": len(self._done),
-                "routed": c.get("serve.fleet.routed", 0),
-                "rejected": c.get("serve.fleet.rejected", 0),
-                "retried": c.get("serve.fleet.retried", 0),
-                "failovers": c.get("serve.fleet.failovers", 0)}
+                "done": len(self._done) + self._retired_count,
+                "done_held": len(self._done),
+                **dict(self._fleet_counts)}
+
+    def close(self) -> None:
+        """Release this instance's registry exports (the per-router
+        serve.fleet.<c>.r_<id> gauges). The registry is process-global:
+        a frontend loop that recreates routers without close() would
+        accumulate dead routers' gauges in every snapshot forever."""
+        for c in self._fleet_counts:
+            metrics.remove_gauge(f"serve.fleet.{c}.r_{self._rid_ns}")
 
 
 def _transient_send(e: Exception) -> bool:

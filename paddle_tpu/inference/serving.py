@@ -130,7 +130,9 @@ class ContinuousBatcher:
                  precision: str | None = None, kv_layout: str = "paged",
                  page_size: int = 16, num_pages: int | None = None,
                  page_buckets: Sequence[int] | None = None,
-                 slo_policy=None, admission: AdmissionPolicy | None = None):
+                 slo_policy=None, admission: AdmissionPolicy | None = None,
+                 kv_dtype: str | None = None,
+                 pool_hbm_bytes: int | None = None):
         self._dequant = None
         if precision in ("int8", "weight_only_int8"):
             # int8 weight-only serving: weights live quantized in HBM and
@@ -165,6 +167,33 @@ class ContinuousBatcher:
 
         if kv_layout not in ("paged", "dense", "ragged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        # quantized KV pages (ISSUE 10): kv_dtype "int8"/"fp8" stores the
+        # page pool through the paddle_tpu.quant block codecs (payload +
+        # per-(row, head) scales); both read paths dequantize. Explicit
+        # argument wins; None consults PADDLE_SERVE_KV_DTYPE; ""/"bf16"
+        # mean "pages in the model dtype" — the pre-quant layout, byte-
+        # for-byte (no scale pools exist, no quant branch traces).
+        if kv_dtype is None and kv_layout != "dense":
+            # the dense slot cache is the full-precision baseline: it
+            # ignores the env knob (a fleet-wide PADDLE_SERVE_KV_DTYPE
+            # must not break the dense equivalence passes) and rejects
+            # only an EXPLICIT request below
+            from ..utils import env_flags
+            kv_dtype = env_flags.get("PADDLE_SERVE_KV_DTYPE")
+        from ..quant.codec import normalize_kv_dtype
+        kv_dtype = normalize_kv_dtype(kv_dtype)
+        if kv_dtype is not None and kv_layout == "dense":
+            # only reachable with an explicit argument — env-derived
+            # dtypes were never consulted for the dense baseline above
+            raise ValueError("kv_dtype quantization needs the paged pool "
+                             "(kv_layout='paged' or 'ragged'); the dense "
+                             "slot cache is the full-precision baseline")
+        if pool_hbm_bytes is not None and kv_layout == "dense":
+            raise ValueError("pool_hbm_bytes sizes the paged page pool; "
+                             "the dense slot cache is sized by "
+                             "max_batch × max_len — a silently ignored "
+                             "budget would hide a misconfiguration")
+        self._kv_dtype = kv_dtype
         # "ragged" = the paged pool read through the Pallas ragged kernel
         # (ops/ragged_attention.py) in ONE mixed prefill+decode executable.
         # PADDLE_RAGGED_ATTN=0 (or an un-tileable pool on a real TPU)
@@ -177,7 +206,8 @@ class ContinuousBatcher:
             from ..ops import ragged_attention as _ra
             self._interpret = jax.default_backend() != "tpu"
             self._ragged = _ra.enabled() and _ra.supported(
-                self._cfg.head_dim, int(page_size), self._interpret)
+                self._cfg.head_dim, int(page_size), self._interpret,
+                kv_dtype=self._kv_dtype)
             kv_layout = "paged"
         self._layout = kv_layout
         # Slot state lives HOST-side as numpy and is uploaded per burst
@@ -193,12 +223,25 @@ class ContinuousBatcher:
         self._slot_req: list[ServedRequest | None] = [None] * self.B
 
         if self._layout == "paged":
-            from ..models.llama_paged import init_paged_kv_cache
+            from ..models.llama_paged import init_paged_kv_cache, page_bytes
             self._ps = int(page_size)
             if self._ps < 1:
                 raise ValueError("page_size must be >= 1")
             slot_max_pages = pages_for(self.S, self._ps)
-            if num_pages is None:
+            if pool_hbm_bytes is not None:
+                # explicit HBM budget: the pool is however many pages the
+                # bytes buy at this kv_dtype — the knob the quantized-page
+                # capacity win is spent through (int8/fp8 pages cost ~half
+                # the bf16 bytes, so the same budget admits ~2× the live
+                # tokens; pinned by tests/test_quant.py)
+                if num_pages is not None:
+                    raise ValueError(
+                        "pass num_pages or pool_hbm_bytes, not both")
+                from .paging import pages_for_budget
+                num_pages = pages_for_budget(
+                    pool_hbm_bytes,
+                    page_bytes(model_config, self._ps, self._kv_dtype))
+            elif num_pages is None:
                 # capacity parity with the dense layout (+1 scratch); size
                 # DOWN for real memory savings — admission degrades to
                 # queueing, never to a crash
@@ -211,7 +254,8 @@ class ContinuousBatcher:
                 pb = tuple(sorted(set(pb) | {slot_max_pages}))
             self._page_buckets = pb
             self._cache = init_paged_kv_cache(model_config, num_pages,
-                                              self._ps)
+                                              self._ps,
+                                              kv_dtype=self._kv_dtype)
             # GSPMD pool sharding (PADDLE_SERVE_MESH_MODEL): KV heads
             # spread over the "model" axis so one replica spans a pod
             # slice. The scheduler stays layout-agnostic — block tables
@@ -511,7 +555,8 @@ class ContinuousBatcher:
             self.stats["page_buckets_used"] = sorted(
                 self.stats["page_buckets_used"] + [P])
         metrics.gauge("serve.kv_read_mb_per_tok").set(
-            paged_kv_bytes_per_token(self._cfg, P, self._ps) / 1e6)
+            paged_kv_bytes_per_token(self._cfg, P, self._ps,
+                                     kv_dtype=self._kv_dtype) / 1e6)
         bt = np.full((self.B, P), SCRATCH_PAGE, np.int32)
         for b in active:
             ids = self._page_tbl[b]
@@ -526,7 +571,8 @@ class ContinuousBatcher:
                 jnp.asarray(self._done), jnp.asarray(self._limit),
                 jnp.int32(self.eos_id), sub, config=self._cfg, n=self.burst,
                 temperature=self._temp, top_k=self._top_k,
-                pad_id=self.pad_id, dequant=self._dequant)
+                pad_id=self.pad_id, dequant=self._dequant,
+                kv_dtype=self._kv_dtype)
         self.stats["bursts"] += 1
         self.stats["decode_steps"] += self.burst
         return old_pos, pos_d, tok_d, done_d, emitted_d
@@ -566,7 +612,8 @@ class ContinuousBatcher:
                 self._params, self._cache, jnp.asarray(toks),
                 jnp.asarray(np.asarray(pages, np.int32)), jnp.int32(tlen),
                 sub, config=self._cfg, temperature=self._temp,
-                top_k=self._top_k, dequant=self._dequant)
+                top_k=self._top_k, dequant=self._dequant,
+                kv_dtype=self._kv_dtype)
             # pages past the real prompt hold only bucket-pad garbage the
             # mask never exposes — return them right away; the pre-burst
             # growth path re-allocates the decode page when it's needed
@@ -718,7 +765,8 @@ class ContinuousBatcher:
         # bytes/token follow LIVE context on the ragged path (the ISSUE-8
         # over-reporting fix): mean over active slots of their live pages
         live_bytes = [paged_kv_bytes_per_token(
-            self._cfg, 0, self._ps, live_tokens=int(self._pos[b]) + 1)
+            self._cfg, 0, self._ps, live_tokens=int(self._pos[b]) + 1,
+            kv_dtype=self._kv_dtype)
             for b in active]
         metrics.gauge("serve.kv_read_mb_per_tok").set(
             sum(live_bytes) / len(live_bytes) / 1e6)
@@ -752,7 +800,8 @@ class ContinuousBatcher:
                 n=self.burst, has_prefill=bool(staged),
                 temperature=self._temp, top_k=self._top_k,
                 pad_id=self.pad_id, dequant=self._dequant,
-                interpret=self._interpret, mesh=self._mesh)
+                interpret=self._interpret, mesh=self._mesh,
+                kv_dtype=self._kv_dtype)
         self.stats["bursts"] += 1
         self.stats["decode_steps"] += self.burst
         return old_pos, pos_d, tok_d, done_d, emitted_d, firsts_d
@@ -969,6 +1018,7 @@ class ContinuousBatcher:
         (queue composition, slot occupancy) without a device sync."""
         return {
             "layout": self._layout,
+            "kv_dtype": self._kv_dtype or "native",
             "ragged": self._ragged,
             "sharded_devices": (self._mesh.size if self._mesh is not None
                                 else 1),

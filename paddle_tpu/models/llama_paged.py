@@ -60,31 +60,96 @@ from .llama_decode import _cached_attention_slots, _mlp, _qkv, _sample
 
 __all__ = ["init_paged_kv_cache", "llama_paged_prefill_slot",
            "llama_paged_decode_burst", "llama_ragged_burst",
-           "paged_kv_bytes_per_token"]
+           "paged_kv_bytes_per_token", "page_bytes"]
 
 
-def init_paged_kv_cache(config: LlamaConfig, num_pages: int, page_size: int):
+# ------------------------------------------------- quantized pages (ISSUE 10)
+# kv_dtype = "int8" | "fp8" stores pages through the paddle_tpu.quant block
+# codecs: the payload pools keep the [num_pages, page_size, KV, hd] layout
+# in the wire dtype and a per-(row, kv-head) float32 scale rides in
+# parallel [num_pages, page_size, KV] pools (block = the head_dim vector).
+# Writes quantize (prefill rows and per-step decode rows alike); BOTH read
+# paths dequantize — the XLA gather right after its jnp.take, the Pallas
+# ragged kernel per streamed page inside its double-buffered DMA loop
+# (ops/ragged_attention.py). kv_dtype=None is byte-for-byte the pre-quant
+# code: no scale pools exist and no branch below runs.
+
+
+def _kv_encode(rows, kv_dtype: str):
+    """rows [..., KV, hd] float -> (payload wire dtype, scale [..., KV])."""
+    from ..quant.codec import quantize_lastdim
+    return quantize_lastdim(rows, kv_dtype)
+
+
+def _kv_decode(payload, scale, out_dtype):
+    from ..quant.codec import dequantize_lastdim
+    return dequantize_lastdim(payload, scale, out_dtype)
+
+
+def init_paged_kv_cache(config: LlamaConfig, num_pages: int, page_size: int,
+                        kv_dtype: str | None = None):
     """Shared page pool: PER-LAYER tuples of [num_pages, page_size, KV, hd].
 
     Per-layer buffers for the same reason as the dense cache
     (llama_decode.init_kv_cache): XLA only updates a carried/donated leaf
     in place when it is a whole buffer. Page 0 is scratch (see module
     docstring) — the usable pool is ``num_pages - 1`` pages.
+
+    ``kv_dtype`` (ISSUE 10): "int8"/"fp8" store the pools in the wire
+    dtype and add per-(row, head) f32 scale pools under "k_scale" /
+    "v_scale" — the page id indexes payload and scale together, so the
+    host allocator/block tables stay layout-agnostic.
     """
     c = config
     shape = (int(num_pages), int(page_size), c.num_key_value_heads,
              c.head_dim)
+    if kv_dtype is None:
+        return {
+            "k": tuple(jnp.zeros(shape, c.dtype)
+                       for _ in range(c.num_hidden_layers)),
+            "v": tuple(jnp.zeros(shape, c.dtype)
+                       for _ in range(c.num_hidden_layers)),
+        }
+    from ..quant.codec import SCALE_DTYPE, wire_dtype
+    wire = wire_dtype(kv_dtype)
+    sshape = shape[:-1]
     return {
-        "k": tuple(jnp.zeros(shape, c.dtype)
+        "k": tuple(jnp.zeros(shape, wire)
                    for _ in range(c.num_hidden_layers)),
-        "v": tuple(jnp.zeros(shape, c.dtype)
+        "v": tuple(jnp.zeros(shape, wire)
                    for _ in range(c.num_hidden_layers)),
+        "k_scale": tuple(jnp.zeros(sshape, SCALE_DTYPE)
+                         for _ in range(c.num_hidden_layers)),
+        "v_scale": tuple(jnp.zeros(sshape, SCALE_DTYPE)
+                         for _ in range(c.num_hidden_layers)),
     }
+
+
+def _kv_row_head_bytes(config: LlamaConfig, kv_dtype: str | None) -> int:
+    """Bytes ONE (row, kv-head) K-or-V block occupies: head_dim payload
+    elements plus, quantized, its f32 block scale."""
+    if kv_dtype is None:
+        return int(config.head_dim) * jnp.dtype(config.dtype).itemsize
+    from ..quant.codec import scale_itemsize, wire_itemsize
+    return int(config.head_dim) * wire_itemsize(kv_dtype) + scale_itemsize()
+
+
+def page_bytes(config: LlamaConfig, page_size: int,
+               kv_dtype: str | None = None) -> int:
+    """HBM bytes one PAGE ID costs (K+V across all layers, scales
+    included) — the unit the pool budget is spent in. The serving
+    engine's ``pool_hbm_bytes=`` sizing divides by this, which is how an
+    int8/fp8 pool admits ~2× the live tokens of a bf16 pool at the same
+    budget (pinned by tests/test_quant.py)."""
+    c = config
+    return int(2 * c.num_hidden_layers * int(page_size)
+               * c.num_key_value_heads * _kv_row_head_bytes(c, kv_dtype))
 
 
 def paged_kv_bytes_per_token(config: LlamaConfig, pages: int,
                              page_size: int,
-                             live_tokens: int | None = None) -> int:
+                             live_tokens: int | None = None,
+                             kv_dtype: str | None = None) -> int:
     """Decode-attention K+V bytes read per emitted token per slot.
 
     Gather path: the read is `pages` (the page-count BUCKET of the widest
@@ -95,19 +160,21 @@ def paged_kv_bytes_per_token(config: LlamaConfig, pages: int,
     pages, so bytes follow the live context, not the bucket — pass
     ``live_tokens`` and `pages` is ignored in favor of
     ``ceil(live_tokens / page_size)`` (the ISSUE-8 over-reporting fix:
-    decode_bench must not bill the ragged path at bucket width)."""
+    decode_bench must not bill the ragged path at bucket width).
+
+    ``kv_dtype`` (ISSUE 10): quantized pages bill wire-dtype payload plus
+    the per-(row, head) scale reads — roughly half the bf16 bill."""
     c = config
     if live_tokens is not None:
         live_tokens = int(live_tokens)
         pages = 0 if live_tokens <= 0 \
             else (live_tokens - 1) // int(page_size) + 1
     return int(2 * c.num_hidden_layers * pages * page_size
-               * c.num_key_value_heads * c.head_dim
-               * jnp.dtype(c.dtype).itemsize)
+               * c.num_key_value_heads * _kv_row_head_bytes(c, kv_dtype))
 
 
 def _paged_decode_step_slots(params, cache, block_table, pos, tok,
-                             config: LlamaConfig):
+                             config: LlamaConfig, kv_dtype: str | None = None):
     """One single-token step over all slots, K/V through the block table.
 
     block_table [B, P] int32; pos/tok [B]. Slot b writes this token's K/V
@@ -116,6 +183,10 @@ def _paged_decode_step_slots(params, cache, block_table, pos, tok,
     under the same ``row <= pos`` mask as the dense path. Layers unrolled,
     per-layer pool buffers, per-lane dynamic_update_slice — the measured
     in-place discipline of llama_decode_step_slots carries over verbatim.
+
+    ``kv_dtype``: writes quantize the fresh K/V row (payload + per-head
+    scale land together), the gather dequantizes payload×scale right
+    after the two jnp.takes — same attention arithmetic downstream.
     """
     c = config
     layer_p, other = split_layer_params(params)
@@ -128,7 +199,10 @@ def _paged_decode_step_slots(params, cache, block_table, pos, tok,
     row_of = pos32 % ps              # [B] row within that page
     z = jnp.int32(0)
 
+    quant = kv_dtype is not None
     ks, vs = list(cache["k"]), list(cache["v"])
+    kss = list(cache["k_scale"]) if quant else None
+    vss = list(cache["v_scale"]) if quant else None
     for l in range(c.num_hidden_layers):
         lp = jax.tree.map(lambda a: a[l], layer_p)
         h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
@@ -136,32 +210,49 @@ def _paged_decode_step_slots(params, cache, block_table, pos, tok,
         q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
         kp, vp = ks[l], vs[l]
         ku, vu = k[:, 0], v[:, 0]
+        if quant:
+            ku, ksr = _kv_encode(ku, kv_dtype)   # [B, KV, hd] + [B, KV]
+            vu, vsr = _kv_encode(vu, kv_dtype)
+            ksp, vsp = kss[l], vss[l]
         for b in range(B):
             at = (block_table[b, page_of[b]], row_of[b], z, z)
             kp = jax.lax.dynamic_update_slice(kp, ku[b][None, None], at)
             vp = jax.lax.dynamic_update_slice(vp, vu[b][None, None], at)
+            if quant:
+                ats = (block_table[b, page_of[b]], row_of[b], z)
+                ksp = jax.lax.dynamic_update_slice(
+                    ksp, ksr[b][None, None], ats)
+                vsp = jax.lax.dynamic_update_slice(
+                    vsp, vsr[b][None, None], ats)
         ks[l], vs[l] = kp, vp
+        if quant:
+            kss[l], vss[l] = ksp, vsp
         # gather the slot's pages into a [B, P*ps, KV, hd] view — THIS is
         # the read whose bytes scale with the page bucket instead of S_max
-        kc = jnp.take(kp, block_table, axis=0).reshape(
-            B, -1, c.num_key_value_heads, c.head_dim)
-        vc = jnp.take(vp, block_table, axis=0).reshape(
-            B, -1, c.num_key_value_heads, c.head_dim)
+        kc = jnp.take(kp, block_table, axis=0)
+        vc = jnp.take(vp, block_table, axis=0)
+        if quant:
+            kc = _kv_decode(kc, jnp.take(ksp, block_table, axis=0), c.dtype)
+            vc = _kv_decode(vc, jnp.take(vsp, block_table, axis=0), c.dtype)
+        kc = kc.reshape(B, -1, c.num_key_value_heads, c.head_dim)
+        vc = vc.reshape(B, -1, c.num_key_value_heads, c.head_dim)
         att = _cached_attention_slots(q, kc, vc, pos, c)
         y = x + (att.reshape(B, 1, -1) @ lp["wo"])
         x = _mlp(y, lp, c)
 
-    return lm_head_logits(x[:, 0, :], other, c), \
-        {"k": tuple(ks), "v": tuple(vs)}
+    out = {"k": tuple(ks), "v": tuple(vs)}
+    if quant:
+        out["k_scale"], out["v_scale"] = tuple(kss), tuple(vss)
+    return lm_head_logits(x[:, 0, :], other, c), out
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "config", "temperature", "top_k", "dequant"),
+    "config", "temperature", "top_k", "dequant", "kv_dtype"),
     donate_argnums=(1,))
 def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
                              config: LlamaConfig,
                              temperature: float = 0.0, top_k: int = 0,
-                             dequant=None):
+                             dequant=None, kv_dtype: str | None = None):
     """Prefill ONE request's prompt into its allocated pages.
 
     tokens [Tb] int32 padded to a bucket length; page_ids [ceil(Tb/ps)]
@@ -172,6 +263,11 @@ def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
     later owner rewrites before its mask ever exposes them). Samples the
     first generated token at tlen-1 and returns (first_token, cache).
     One executable per prompt bucket, like llama_prefill_slot.
+
+    ``kv_dtype``: the prompt forward runs in full precision (the first
+    token is sampled from exact activations — the standard quantized-KV
+    deployment shape); only the CACHE WRITES quantize, so quantization
+    error enters at the first decode read, never the prefill compute.
     """
     c = config
     if dequant is not None:
@@ -198,11 +294,18 @@ def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
 
     x, (ks, vs) = jax.lax.scan(body, x, layer_p)  # ks [L, 1, T, KV, hd]
 
+    quant = kv_dtype is not None
     z = jnp.int32(0)
     kl, vl = list(cache["k"]), list(cache["v"])
+    ksl = list(cache["k_scale"]) if quant else None
+    vsl = list(cache["v_scale"]) if quant else None
     for l in range(c.num_hidden_layers):
         krows = jnp.pad(ks[l][0], ((0, pad), (0, 0), (0, 0)))
         vrows = jnp.pad(vs[l][0], ((0, pad), (0, 0), (0, 0)))
+        if quant:
+            krows, ksrows = _kv_encode(krows, kv_dtype)  # + [T+pad, KV]
+            vrows, vsrows = _kv_encode(vrows, kv_dtype)
+            ksp, vsp = ksl[l], vsl[l]
         kp, vp = kl[l], vl[l]
         for j in range(n_pages):
             at = (page_ids[j], z, z, z)
@@ -210,8 +313,18 @@ def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
                 kp, krows[j * ps:(j + 1) * ps][None], at)
             vp = jax.lax.dynamic_update_slice(
                 vp, vrows[j * ps:(j + 1) * ps][None], at)
+            if quant:
+                ats = (page_ids[j], z, z)
+                ksp = jax.lax.dynamic_update_slice(
+                    ksp, ksrows[j * ps:(j + 1) * ps][None], ats)
+                vsp = jax.lax.dynamic_update_slice(
+                    vsp, vsrows[j * ps:(j + 1) * ps][None], ats)
         kl[l], vl[l] = kp, vp
+        if quant:
+            ksl[l], vsl[l] = ksp, vsp
     cache = {"k": tuple(kl), "v": tuple(vl)}
+    if quant:
+        cache["k_scale"], cache["v_scale"] = tuple(ksl), tuple(vsl)
 
     last = jax.lax.dynamic_slice_in_dim(x[0], tlen - 1, 1, axis=0)  # [1, D]
     logits = lm_head_logits(last, other, c)
@@ -220,12 +333,13 @@ def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "config", "n", "temperature", "top_k", "pad_id", "dequant"),
+    "config", "n", "temperature", "top_k", "pad_id", "dequant", "kv_dtype"),
     donate_argnums=(1,))
 def llama_paged_decode_burst(params, cache, block_table, pos, tok, done,
                              limit, eos_id, key, config: LlamaConfig,
                              n: int, temperature: float = 0.0,
-                             top_k: int = 0, pad_id: int = 0, dequant=None):
+                             top_k: int = 0, pad_id: int = 0, dequant=None,
+                             kv_dtype: str | None = None):
     """n scanned paged-decode steps — the paged serving hot loop.
 
     Same contract as llama_decode_burst plus block_table [B, P]: a slot
@@ -240,7 +354,8 @@ def llama_paged_decode_burst(params, cache, block_table, pos, tok, done,
         cache, pos, tok, done, key = carry
         p = dequant(params) if dequant is not None else params
         logits, cache = _paged_decode_step_slots(p, cache, block_table,
-                                                 pos, tok, config)
+                                                 pos, tok, config,
+                                                 kv_dtype=kv_dtype)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, temperature, top_k, sub)
         emit = jnp.where(done, jnp.int32(pad_id), nxt)
@@ -263,39 +378,59 @@ def llama_paged_decode_burst(params, cache, block_table, pos, tok, done,
 
 
 def _ragged_attn(q, kp, vp, block_table, q_lens, kv_lens, *, page_size,
-                 interpret, mesh):
+                 interpret, mesh, ksc=None, vsc=None):
     """Dispatch the ragged kernel, shard_map'd over the "model" axis when
     the pool is GSPMD-sharded along KV heads: kernel programs are
     independent per (slot, kv-head), so each shard runs the SAME kernel
-    over its local heads — no collective, no re-gather of the pool."""
+    over its local heads — no collective, no re-gather of the pool.
+    ``ksc``/``vsc`` (ISSUE 10): quantized pools' per-(page, row, head)
+    scale pools, sharded along the SAME head axis — each chip streams only
+    its own heads' scales next to its own heads' pages."""
     from ..ops.ragged_attention import ragged_paged_attention
     if mesh is None:
         return ragged_paged_attention(q, kp, vp, block_table, q_lens,
                                       kv_lens, page_size=page_size,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      k_scale=ksc, v_scale=vsc)
     from jax.sharding import PartitionSpec as P
 
     from ..utils.jax_compat import shard_map
 
-    def local(q_, kp_, vp_, bt_, ql_, kl_):
-        return ragged_paged_attention(q_, kp_, vp_, bt_, ql_, kl_,
-                                      page_size=page_size,
-                                      interpret=interpret)
-
     axis = mesh.axis_names[0]
     heads = P(None, None, axis, None)
+    scales = P(None, None, axis)
+    if ksc is None:
+        def local(q_, kp_, vp_, bt_, ql_, kl_):
+            return ragged_paged_attention(q_, kp_, vp_, bt_, ql_, kl_,
+                                          page_size=page_size,
+                                          interpret=interpret)
+
+        return shard_map(
+            local, mesh,
+            in_specs=(heads, heads, heads, P(None, None), P(None), P(None)),
+            out_specs=heads)(q, kp, vp, block_table, q_lens, kv_lens)
+
+    def local_q(q_, kp_, vp_, ks_, vs_, bt_, ql_, kl_):
+        return ragged_paged_attention(q_, kp_, vp_, bt_, ql_, kl_,
+                                      page_size=page_size,
+                                      interpret=interpret,
+                                      k_scale=ks_, v_scale=vs_)
+
     return shard_map(
-        local, mesh,
-        in_specs=(heads, heads, heads, P(None, None), P(None), P(None)),
-        out_specs=heads)(q, kp, vp, block_table, q_lens, kv_lens)
+        local_q, mesh,
+        in_specs=(heads, heads, heads, scales, scales, P(None, None),
+                  P(None), P(None)),
+        out_specs=heads)(q, kp, vp, ksc, vsc, block_table, q_lens, kv_lens)
 
 
 def _ragged_decode_step_slots(params, cache, block_table, pos, tok,
                               config: LlamaConfig, interpret: bool,
-                              mesh=None):
+                              mesh=None, kv_dtype: str | None = None):
     """_paged_decode_step_slots with the gather replaced by the ragged
     kernel: K/V writes keep the per-lane dynamic_update_slice discipline;
-    the read DMAs only each slot's ceil((pos+1)/page_size) live pages."""
+    the read DMAs only each slot's ceil((pos+1)/page_size) live pages.
+    ``kv_dtype``: rows quantize on write; the kernel dequantizes each
+    streamed page inside its DMA loop (ops/ragged_attention.py)."""
     c = config
     layer_p, other = split_layer_params(params)
     B = tok.shape[0]
@@ -308,7 +443,10 @@ def _ragged_decode_step_slots(params, cache, block_table, pos, tok,
     z = jnp.int32(0)
     one = jnp.ones((B,), jnp.int32)
 
+    quant = kv_dtype is not None
     ks, vs = list(cache["k"]), list(cache["v"])
+    kss = list(cache["k_scale"]) if quant else None
+    vss = list(cache["v_scale"]) if quant else None
     for l in range(c.num_hidden_layers):
         lp = jax.tree.map(lambda a: a[l], layer_p)
         h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
@@ -316,23 +454,40 @@ def _ragged_decode_step_slots(params, cache, block_table, pos, tok,
         q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
         kp, vp = ks[l], vs[l]
         ku, vu = k[:, 0], v[:, 0]
+        if quant:
+            ku, ksr = _kv_encode(ku, kv_dtype)
+            vu, vsr = _kv_encode(vu, kv_dtype)
+            ksp, vsp = kss[l], vss[l]
         for b in range(B):
             at = (block_table[b, page_of[b]], row_of[b], z, z)
             kp = jax.lax.dynamic_update_slice(kp, ku[b][None, None], at)
             vp = jax.lax.dynamic_update_slice(vp, vu[b][None, None], at)
+            if quant:
+                ats = (block_table[b, page_of[b]], row_of[b], z)
+                ksp = jax.lax.dynamic_update_slice(
+                    ksp, ksr[b][None, None], ats)
+                vsp = jax.lax.dynamic_update_slice(
+                    vsp, vsr[b][None, None], ats)
         ks[l], vs[l] = kp, vp
+        if quant:
+            kss[l], vss[l] = ksp, vsp
         att = _ragged_attn(q, kp, vp, block_table, one, pos32 + 1,
                            page_size=int(ps), interpret=interpret,
-                           mesh=mesh)
+                           mesh=mesh,
+                           ksc=ksp if quant else None,
+                           vsc=vsp if quant else None)
         y = x + (att.reshape(B, 1, -1) @ lp["wo"])
         x = _mlp(y, lp, c)
 
-    return lm_head_logits(x[:, 0, :], other, c), \
-        {"k": tuple(ks), "v": tuple(vs)}
+    out = {"k": tuple(ks), "v": tuple(vs)}
+    if quant:
+        out["k_scale"], out["v_scale"] = tuple(kss), tuple(vss)
+    return lm_head_logits(x[:, 0, :], other, c), out
 
 
 def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
-                          config: LlamaConfig, interpret: bool, mesh=None):
+                          config: LlamaConfig, interpret: bool, mesh=None,
+                          kv_dtype: str | None = None):
     """Ragged prompt forward for EVERY newly admitted slot at once.
 
     new_tokens [B, Tmax] (Tmax = the engine's widest prompt bucket, the
@@ -362,7 +517,10 @@ def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
     z = jnp.int32(0)
     lens32 = new_lens.astype(jnp.int32)
 
+    quant = kv_dtype is not None
     ks, vs = list(cache["k"]), list(cache["v"])
+    kss = list(cache["k_scale"]) if quant else None
+    vss = list(cache["v_scale"]) if quant else None
     for l in range(c.num_hidden_layers):
         lp = jax.tree.map(lambda a: a[l], layer_p)
         h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
@@ -371,6 +529,10 @@ def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
         kp, vp = ks[l], vs[l]
         krows = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         vrows = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if quant:
+            krows, ksrows = _kv_encode(krows, kv_dtype)  # + [B, T+pad, KV]
+            vrows, vsrows = _kv_encode(vrows, kv_dtype)
+            ksp, vsp = kss[l], vss[l]
         for b in range(B):
             for j in range(t_pages):
                 at = (wt[b, j], z, z, z)
@@ -378,25 +540,38 @@ def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
                     kp, krows[b, j * ps:(j + 1) * ps][None], at)
                 vp = jax.lax.dynamic_update_slice(
                     vp, vrows[b, j * ps:(j + 1) * ps][None], at)
+                if quant:
+                    ats = (wt[b, j], z, z)
+                    ksp = jax.lax.dynamic_update_slice(
+                        ksp, ksrows[b, j * ps:(j + 1) * ps][None], ats)
+                    vsp = jax.lax.dynamic_update_slice(
+                        vsp, vsrows[b, j * ps:(j + 1) * ps][None], ats)
         ks[l], vs[l] = kp, vp
+        if quant:
+            kss[l], vss[l] = ksp, vsp
         att = _ragged_attn(q, kp, vp, block_table, lens32, lens32,
-                           page_size=ps, interpret=interpret, mesh=mesh)
+                           page_size=ps, interpret=interpret, mesh=mesh,
+                           ksc=ksp if quant else None,
+                           vsc=vsp if quant else None)
         y = x + (att.reshape(B, Tmax, -1) @ lp["wo"])
         x = _mlp(y, lp, c)
 
     last = x[jnp.arange(B), jnp.maximum(lens32 - 1, 0)]       # [B, D]
-    return lm_head_logits(last, other, c), {"k": tuple(ks), "v": tuple(vs)}
+    cache = {"k": tuple(ks), "v": tuple(vs)}
+    if quant:
+        cache["k_scale"], cache["v_scale"] = tuple(kss), tuple(vss)
+    return lm_head_logits(last, other, c), cache
 
 
 @functools.partial(jax.jit, static_argnames=(
     "config", "n", "has_prefill", "temperature", "top_k", "pad_id",
-    "dequant", "interpret", "mesh"), donate_argnums=(1,))
+    "dequant", "interpret", "mesh", "kv_dtype"), donate_argnums=(1,))
 def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
                        new_tokens, new_lens, eos_id, key,
                        config: LlamaConfig, n: int, has_prefill: bool,
                        temperature: float = 0.0, top_k: int = 0,
                        pad_id: int = 0, dequant=None, interpret: bool = True,
-                       mesh=None):
+                       mesh=None, kv_dtype: str | None = None):
     """ONE executable for a mixed prefill+decode burst (ISSUE 8).
 
     Same contract as llama_paged_decode_burst plus the admission inputs:
@@ -419,7 +594,7 @@ def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
         key, sub = jax.random.split(key)
         logits, cache = _ragged_prefill_phase(
             p, cache, block_table, new_tokens, new_lens, config, interpret,
-            mesh)
+            mesh, kv_dtype=kv_dtype)
         first = _sample(logits, temperature, top_k, sub)
         is_new = new_lens > 0
         firsts = jnp.where(is_new, first, firsts)
@@ -432,7 +607,8 @@ def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
         pp = dequant(params) if dequant is not None else params
         logits, cache = _ragged_decode_step_slots(pp, cache, block_table,
                                                   pos, tok, config,
-                                                  interpret, mesh)
+                                                  interpret, mesh,
+                                                  kv_dtype=kv_dtype)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, temperature, top_k, sub)
         emit = jnp.where(done, jnp.int32(pad_id), nxt)

@@ -32,7 +32,7 @@ import time
 from collections import deque
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
-           "snapshot", "counter_values", "timer", "set_sink",
+           "remove_gauge", "snapshot", "counter_values", "timer", "set_sink",
            "maybe_emit_step", "reset", "DEFAULT_BUCKETS"]
 
 ENV_SINK = "PADDLE_METRICS_SINK"
@@ -201,6 +201,16 @@ def histogram(name: str) -> Histogram:
         if h is None:
             h = _histograms[name] = Histogram(name)
         return h
+
+
+def remove_gauge(name: str) -> None:
+    """Drop one gauge from the registry. For PER-INSTANCE exports (e.g. a
+    Router's ``serve.fleet.<c>.r_<id>`` gauges): the registry is
+    process-global and append-only otherwise, so an instance that dies
+    without removing its gauges leaves stale series in every snapshot
+    and export forever."""
+    with _lock:
+        _gauges.pop(name, None)
 
 
 class timer:

@@ -70,11 +70,19 @@ def enabled() -> bool:
     return env_flags.get_bool(ENV_RAGGED_ATTN)
 
 
-def supported(head_dim: int, page_size: int, interpret: bool) -> bool:
+def supported(head_dim: int, page_size: int, interpret: bool,
+              kv_dtype: str | None = None) -> bool:
     """Can this (pool, config) run the kernel? Interpret mode always can;
-    the compiled TPU path needs MXU-tileable blocks."""
+    the compiled TPU path needs MXU-tileable blocks. Quantized pools
+    (``kv_dtype`` int8/fp8, ISSUE 10) are interpret-only for now: the
+    per-page [page_size] scale-slice DMAs have been validated in
+    interpret mode but not against Mosaic's tiling on a real TPU window —
+    callers fall back to the XLA gather path there (which dequantizes the
+    same pool, token-identically)."""
     if interpret:
         return True
+    if kv_dtype is not None:
+        return False
     return head_dim % _LANE == 0 and page_size % _SUBLANE == 0
 
 
@@ -183,9 +191,124 @@ def _kernel_body(bt_ref, qlen_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _kernel_body_quant(bt_ref, qlen_ref, kvlen_ref, q_ref, kp_ref, vp_ref,
+                       ksp_ref, vsp_ref, o_ref, kbuf, ksbuf, vtmp, vsbuf,
+                       vbuf, lbuf, ksem, kssem, vsem, vssem, *, page_size,
+                       max_pages, groups, q_max, scale):
+    """The quantized-pool variant of ``_kernel_body`` (ISSUE 10).
+
+    The payload pools are int8/fp8 and per-(page, row, head) f32 scale
+    pools ride alongside (``ksp_ref``/``vsp_ref``, [num_pages, ps, KV]).
+    Each streamed page is DEQUANTIZED inside the double-buffered DMA loop:
+    page j's payload and its [ps] scale slice land together, and the f32
+    ``payload × scale`` product feeds the same logits tile / masked
+    softmax as the unquantized kernel. V pages stream through their own
+    double buffer (``vtmp``) and land dequantized-f32 in the contiguous
+    ``vbuf`` run, so the post-softmax ``probs @ V`` consumes exact f32 —
+    the arithmetic the XLA gather path gets from dequantizing right after
+    its ``jnp.take`` (token-identical on CPU, pinned by
+    tests/test_quant.py)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    k = pl.program_id(1)
+    ps = page_size
+    span = q_max * groups
+    rows_total = max_pages * ps
+    q_len = qlen_ref[b]
+    kv_len = kvlen_ref[b]
+    n_pages = (kv_len + jnp.int32(ps - 1)) // jnp.int32(ps)
+
+    @pl.when(q_len == 0)
+    def _skip():
+        o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
+
+    @pl.when(q_len > 0)
+    def _run():
+        q = q_ref[0, 0].astype(jnp.float32)          # [span, hd]
+
+        def kdma(j, slot):
+            return pltpu.make_async_copy(
+                kp_ref.at[bt_ref[b, j], :, k, :], kbuf.at[slot],
+                ksem.at[slot])
+
+        def ksdma(j, slot):
+            return pltpu.make_async_copy(
+                ksp_ref.at[bt_ref[b, j], :, k], ksbuf.at[slot],
+                kssem.at[slot])
+
+        def vdma(j, slot):
+            return pltpu.make_async_copy(
+                vp_ref.at[bt_ref[b, j], :, k, :], vtmp.at[slot],
+                vsem.at[slot])
+
+        def vsdma(j, slot):
+            return pltpu.make_async_copy(
+                vsp_ref.at[bt_ref[b, j], :, k], vsbuf.at[slot],
+                vssem.at[slot])
+
+        for dma in (kdma, ksdma, vdma, vsdma):
+            dma(jnp.int32(0), jnp.int32(0)).start()
+
+        def page_step(j, _):
+            slot = jax.lax.rem(j, jnp.int32(2))
+            nxt = jax.lax.rem(j + jnp.int32(1), jnp.int32(2))
+
+            @pl.when(j + jnp.int32(1) < n_pages)
+            def _prefetch():                         # double buffer: j+1
+                for dma in (kdma, ksdma, vdma, vsdma):
+                    dma(j + jnp.int32(1), nxt).start()
+
+            kdma(j, slot).wait()
+            ksdma(j, slot).wait()
+            # per-page dequantize INSIDE the DMA loop, mirroring the
+            # gather path's arithmetic EXACTLY: payload × scale in f32,
+            # rounded to the model dtype (the gather's _kv_decode(...,
+            # c.dtype) after its jnp.take), then f32 for the logits dot —
+            # for a bf16 model both paths round identically, so gather
+            # and kernel stay token-identical for ANY model dtype
+            kpage = (kbuf[slot].astype(jnp.float32)
+                     * ksbuf[slot][:, None]).astype(q_ref.dtype) \
+                .astype(jnp.float32)                 # [ps, hd]
+            lbuf[:, pl.ds(j * jnp.int32(ps), ps)] = jax.lax.dot_general(
+                q, kpage, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            vdma(j, slot).wait()
+            vsdma(j, slot).wait()
+            vbuf[pl.ds(j * jnp.int32(ps), ps), :] = \
+                (vtmp[slot].astype(jnp.float32)
+                 * vsbuf[slot][:, None]).astype(vbuf.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, n_pages, page_step, 0)
+
+        def zero_tail(j, _):
+            vbuf[pl.ds(j * jnp.int32(ps), ps), :] = jnp.zeros(
+                (ps, vbuf.shape[1]), vbuf.dtype)
+            return 0
+
+        jax.lax.fori_loop(n_pages, jnp.int32(max_pages), zero_tail, 0)
+
+        cols = jax.lax.broadcasted_iota(jnp.int32, (span, rows_total), 1)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (span, rows_total),
+                                        0) // jnp.int32(groups)
+        valid = (cols < kv_len) & (cols <= kv_len - q_len + qpos)
+        logits = jnp.where(valid, lbuf[:], jnp.float32(-1e30))
+        # probs round to the model dtype like the unquantized kernel (and
+        # the gather path's softmax(...).astype(q.dtype)) — vbuf already
+        # holds model-dtype dequantized rows, so the value product is the
+        # same arithmetic the gather einsum runs
+        probs = jax.nn.softmax(logits, axis=-1).astype(vbuf.dtype)
+        out = jax.lax.dot_general(probs, vbuf[:], (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def ragged_paged_attention(q, k_pool, v_pool, block_table, q_lens, kv_lens,
-                           *, page_size: int, interpret: bool = True):
+                           *, page_size: int, interpret: bool = True,
+                           k_scale=None, v_scale=None):
     """Ragged paged attention over a shared page pool.
 
     q           [B, Qmax, H, hd] — per-slot query rows; slot b uses rows
@@ -196,6 +319,9 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_lens, kv_lens,
     block_table [B, Pmax] int32 — logical→physical page map per slot.
     q_lens      [B] int32 — 0 skips the slot (zeros out).
     kv_lens     [B] int32 — live context rows (attend rows < kv_lens[b]).
+    k/v_scale   (ISSUE 10) [num_pages, page_size, KV] f32 — per-block
+                scales of an int8/fp8 pool; both given = quantized pools,
+                dequantized per streamed page inside the DMA loop.
 
     Returns [B, Qmax, H, hd] in q.dtype. All raggedness is carried by the
     scalar-prefetched q_lens/kv_lens/block_table — the compiled program
@@ -211,32 +337,62 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_lens, kv_lens,
     groups = H // KV
     span = q_max * groups
     scale = np.float32(1.0) / np.sqrt(np.float32(hd))
+    if (k_scale is None) != (v_scale is None):
+        # both-or-neither: one missing scale would either consume raw
+        # int8 payloads as numbers (garbage, silently) or die opaquely
+        # inside the jit — make the contract loud instead
+        raise ValueError("quantized pools need BOTH k_scale and v_scale "
+                         "(got exactly one)")
+    quant = k_scale is not None
 
     # [B, Qmax, H, hd] -> [B, KV, Qmax*groups, hd]; row = qpos*g + gi
     # keeps the gather path's head mapping h = k*g + gi bit-for-bit
     qh = q.reshape(B, q_max, KV, groups, hd).transpose(0, 2, 1, 3, 4) \
         .reshape(B, KV, span, hd)
 
+    body = _kernel_body_quant if quant else _kernel_body
     kernel = functools.partial(
-        _kernel_body, page_size=ps, max_pages=max_pages, groups=groups,
+        body, page_size=ps, max_pages=max_pages, groups=groups,
         q_max=q_max, scale=scale)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, KV),
-        in_specs=[
-            pl.BlockSpec((1, 1, span, hd), lambda b, k, *_: (b, k, _i0, _i0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM;
-            pl.BlockSpec(memory_space=pltpu.ANY),   # live pages are DMA'd
-        ],
-        out_specs=pl.BlockSpec((1, 1, span, hd),
-                               lambda b, k, *_: (b, k, _i0, _i0)),
-        scratch_shapes=[
+    in_specs = [
+        pl.BlockSpec((1, 1, span, hd), lambda b, k, *_: (b, k, _i0, _i0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM;
+        pl.BlockSpec(memory_space=pltpu.ANY),   # live pages are DMA'd
+    ]
+    if quant:
+        scratch = [
+            pltpu.VMEM((2, ps, hd), k_pool.dtype),           # K payload dbuf
+            pltpu.VMEM((2, ps), jnp.float32),                # K scale dbuf
+            pltpu.VMEM((2, ps, hd), v_pool.dtype),           # V payload dbuf
+            pltpu.VMEM((2, ps), jnp.float32),                # V scale dbuf
+            pltpu.VMEM((max_pages * ps, hd), q.dtype),       # V dequant run
+            #              (model dtype: rounds like the gather's decode)
+            pltpu.VMEM((span, max_pages * ps), jnp.float32),  # logits
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),   # K scales
+                     pl.BlockSpec(memory_space=pltpu.ANY)]   # V scales
+        operands = (qh, k_pool, v_pool, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+    else:
+        scratch = [
             pltpu.VMEM((2, ps, hd), k_pool.dtype),          # K double buffer
             pltpu.VMEM((max_pages * ps, hd), v_pool.dtype),  # V, contiguous
             pltpu.VMEM((span, max_pages * ps), jnp.float32),  # logits
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
-        ],
+        ]
+        operands = (qh, k_pool, v_pool)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KV),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, span, hd),
+                               lambda b, k, *_: (b, k, _i0, _i0)),
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
@@ -245,7 +401,7 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_lens, kv_lens,
                          _compiler_params(("parallel", "parallel"))),
         interpret=interpret,
     )(block_table.astype(jnp.int32), q_lens.astype(jnp.int32),
-      kv_lens.astype(jnp.int32), qh, k_pool, v_pool)
+      kv_lens.astype(jnp.int32), *operands)
 
     return out.reshape(B, KV, q_max, groups, hd).transpose(0, 2, 1, 3, 4) \
         .reshape(B, q_max, H, hd)

@@ -15,8 +15,8 @@ from __future__ import annotations
 from ..distributed.api import ShardingStage1, ShardingStage2, ShardingStage3, shard_optimizer
 from ..distributed.process_mesh import get_mesh
 
-__all__ = ["group_sharded_parallel", "kv_pool_pspec", "serving_mesh",
-           "shard_kv_pool", "ENV_SERVE_MESH"]
+__all__ = ["group_sharded_parallel", "kv_pool_pspec", "kv_scale_pspec",
+           "serving_mesh", "shard_kv_pool", "ENV_SERVE_MESH"]
 
 ENV_SERVE_MESH = "PADDLE_SERVE_MESH_MODEL"
 
@@ -37,6 +37,15 @@ def kv_pool_pspec(axis: str = "model"):
     arxiv 2105.04663)."""
     from jax.sharding import PartitionSpec as P
     return P(None, None, axis, None)
+
+
+def kv_scale_pspec(axis: str = "model"):
+    """Quantized pools' per-(page, row, head) scale spec (ISSUE 10):
+    [num_pages, page_size, KV] shards its KV axis with the payload pages
+    — a scale lives on the same chip as the page rows it describes, so
+    neither read path ever crosses a shard for a dequantize."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, None, axis)
 
 
 def serving_mesh(n: int | None = None, axis: str = "model"):
@@ -63,7 +72,8 @@ def serving_mesh(n: int | None = None, axis: str = "model"):
 def shard_kv_pool(cache, mesh, axis: str = "model"):
     """device_put every per-layer pool buffer with the KV-head sharding.
     The buffers are donated through the serving jits, so the placement
-    sticks for the engine's lifetime."""
+    sticks for the engine's lifetime. Quantized pools (ISSUE 10) carry
+    "k_scale"/"v_scale" leaves that shard along the same head axis."""
     import jax
     from jax.sharding import NamedSharding
     sh = NamedSharding(mesh, kv_pool_pspec(axis))
@@ -71,8 +81,15 @@ def shard_kv_pool(cache, mesh, axis: str = "model"):
     def put(a):
         return jax.device_put(a, sh)
 
-    return {"k": tuple(put(a) for a in cache["k"]),
-            "v": tuple(put(a) for a in cache["v"])}
+    out = {"k": tuple(put(a) for a in cache["k"]),
+           "v": tuple(put(a) for a in cache["v"])}
+    if "k_scale" in cache:
+        ssh = NamedSharding(mesh, kv_scale_pspec(axis))
+        out["k_scale"] = tuple(jax.device_put(a, ssh)
+                               for a in cache["k_scale"])
+        out["v_scale"] = tuple(jax.device_put(a, ssh)
+                               for a in cache["v_scale"])
+    return out
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,

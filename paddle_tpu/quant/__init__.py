@@ -1,0 +1,28 @@
+"""paddle_tpu.quant — block-wise quantized numerics (ISSUE 10).
+
+One numerics subsystem, two consumers:
+
+  * **quantized allreduce** (``allreduce.py``) — the EQuARX shape
+    (arxiv 2506.17615) behind ``distributed/collective.py::all_reduce``,
+    opt-in via ``PADDLE_QUANT_ALLREDUCE=int8|fp8`` (default off);
+  * **quantized KV-cache pages** — ``inference/serving.py`` /
+    ``models/llama_paged.py`` store int8/fp8 pages + per-(row, head)
+    scales via the same ``codec.py`` block codecs, opt-in via
+    ``kv_dtype=`` / ``PADDLE_SERVE_KV_DTYPE``.
+
+Distinct from ``paddle_tpu.quantization`` (the reference-parity QAT/PTQ
+API surface and weight-only serving quantization): that package is about
+MODEL weights/activations; this one is about RUNTIME payloads — wire
+traffic and cache residency.
+"""
+from __future__ import annotations
+
+from .allreduce import (ENV_QUANT_ALLREDUCE, ENV_QUANT_BLOCK, block_from_env,
+                        mode_from_env, quantized_all_reduce, wire_bytes)
+from .codec import (MODES, dequantize_lastdim, quantize_lastdim, wire_dtype,
+                    wire_itemsize)
+
+__all__ = ["MODES", "quantize_lastdim", "dequantize_lastdim", "wire_dtype",
+           "wire_itemsize", "quantized_all_reduce", "wire_bytes",
+           "mode_from_env", "block_from_env", "ENV_QUANT_ALLREDUCE",
+           "ENV_QUANT_BLOCK"]

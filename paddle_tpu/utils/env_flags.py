@@ -224,6 +224,17 @@ declare("PADDLE_TRIGGER_MAX_CAPTURES", "3",
 declare("PADDLE_TRIGGER_XPLANE_STEPS", "4",
         "steps per trigger-armed XPlane window")
 
+# ------------------------------------------------------- quantized numerics
+
+declare("PADDLE_QUANT_ALLREDUCE", "0",
+        "block-wise quantized allreduce wire format for gradient sync "
+        "('int8' | 'fp8'; 0/off = full-precision collectives, the default)")
+declare("PADDLE_QUANT_BLOCK", "256",
+        "block size (elements per scale) for the quantized allreduce wire")
+declare("PADDLE_SERVE_KV_DTYPE", "",
+        "paged KV-cache page dtype ('int8' | 'fp8' store quantized pages "
+        "+ per-row scales; ''/bf16 = pages in the model dtype, default)")
+
 # ------------------------------------------------------------ paged serving
 
 declare("PADDLE_RAGGED_ATTN", "1",

@@ -292,6 +292,15 @@ class TestRaggedBenchContract:
         assert r["hbm_roofline_bytes_per_token"] <= \
             r["kv_read_bytes_per_token"]
         assert r["executables"]["ragged_burst_delta"] <= 2
+        # ISSUE 10: the quant sub-object rides the same JSON line
+        q = payload["quant"]
+        assert set(q) >= {"kv_dtype", "kv_read_bytes_per_token",
+                          "kv_read_bytes_per_token_bf16",
+                          "capacity_ratio_vs_bf16", "token_agreement"}
+        assert q["kv_read_bytes_per_token"] < \
+            q["kv_read_bytes_per_token_bf16"]
+        assert q["capacity_ratio_vs_bf16"] > 1.0
+        assert 0.0 <= q["token_agreement"] <= 1.0
 
     def test_serving_bench_ragged_subobject(self, monkeypatch, capsys):
         """serving_bench's JSON line carries the ragged sub-object and the
@@ -313,6 +322,14 @@ class TestRaggedBenchContract:
                           "hbm_roofline_bytes_per_token", "executables",
                           "kernel_active", "parity"}
         assert r["kernel_active"] is True and r["parity"] is True
+        # ISSUE 10: quant sub-object (kv_dtype, bytes vs bf16, capacity
+        # ratio, agreement rate) always present on the serving line
+        q = doc["quant"]
+        assert set(q) >= {"kv_dtype", "tokens_per_sec",
+                          "kv_read_bytes_per_token",
+                          "kv_read_bytes_per_token_bf16",
+                          "capacity_ratio_vs_bf16", "token_agreement"}
+        assert q["capacity_ratio_vs_bf16"] > 1.0
 
     def test_serving_bench_never_jsonless(self, monkeypatch, capsys):
         """An exploding bench still prints a machine-readable error line

@@ -1164,3 +1164,132 @@ class TestFleetBenchContract:
             assert set(stats) == {"ttft_p50", "ttft_p95", "count"}
         # single-process absence (fleet_serve None) is asserted on the
         # already-paid-for bench run in test_ragged_attention.py
+
+
+# ------------------------------------- router retention + per-router story
+class TestRouterRetentionAndInstanceCounters:
+    """ISSUE 10 satellites (the two PR-9 ROADMAP follow-ups): the router
+    frontend's finished-result table is BOUNDED (ack-on-result() +
+    oldest-first eviction past PADDLE_SERVE_RESULTS_KEEP, mirroring the
+    replica side), and the serve.fleet.* story in Router.summary() is
+    instance-scoped — two routers in one process report their own
+    numbers."""
+
+    def test_done_bounded_ack_and_eviction(self, small_model, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVE_RESULTS_KEEP", "3")
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            rids = []
+            for p in _prompts(7, seed=51, lo=4, hi=8):
+                rid = router.submit(p, 4)
+                router.wait([rid], timeout=60)
+                rids.append(rid)
+            # a long-lived frontend retains only the keep-bound, however
+            # many results flowed through; the full count stays auditable
+            assert len(router._done) <= 3
+            s = router.summary()
+            assert s["done"] == 7 and s["done_held"] <= 3
+            # ack-on-result(): handed over exactly once
+            rec = router.result(rids[-1])
+            assert rec is not None and rec["reason"] == "complete"
+            assert router.result(rids[-1]) is None
+            assert len(router._done) <= 2
+            # an evicted rid still COUNTS as finished: result() is None
+            # (aged out) but wait() returns immediately instead of
+            # spinning on a rid that will never re-appear — and the
+            # deliberate loss is OBSERVABLE, not silent
+            assert router.result(rids[0]) is None
+            assert router.wait([rids[0]], timeout=5) == {rids[0]: []}
+            assert s["results_evicted"] >= 1
+            # retired rids compact into the watermark (dense monotone
+            # sequence), so retention memory is O(out-of-order gap),
+            # not O(requests ever served)
+            assert len(router._retired) <= 2
+            assert router._retired_floor >= 4
+        finally:
+            h.stop()
+
+    def test_rejected_submit_does_not_wedge_watermark(self, small_model,
+                                                      tmp_path):
+        """A rejection burns a rid that never finishes: it must be
+        retired (uncounted) on the refusal exit, or the compaction floor
+        stalls behind it and every later retired rid accumulates in the
+        exception set forever — the unbounded growth the watermark
+        exists to prevent."""
+        cfg, params = small_model
+        # reject first: an empty lease set refuses rid 0
+        empty = Router(el.FileRegistry(str(tmp_path / "none"), "e", ttl=1.0))
+        with pytest.raises(AdmissionReject):
+            empty.submit([1, 2, 3], 4)
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            for p in _prompts(3, seed=61, lo=4, hi=8):
+                rid = router.submit(p, 4)
+                router.wait([rid], timeout=60)
+                assert router.result(rid) is not None
+            # the healthy router's floor tracks its acked rids exactly
+            assert router._retired_floor == 3
+            assert len(router._retired) == 0
+            # and the rejected router's burned rid moved its floor too
+            assert empty._retired_floor >= 1
+            assert len(empty._retired) == 0
+            assert empty.summary()["done"] == 0   # a reject is not a done
+        finally:
+            h.stop()
+
+    def test_ack_keeps_dup_detection(self, small_model, tmp_path):
+        """result() must not forget the rid ever existed: a late
+        duplicate record arriving AFTER the ack is still dropped (and
+        counted), never delivered as a fresh result."""
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            router = Router(h.registry)
+            rid = router.submit(_prompts(1, seed=52)[0], 4)
+            router.wait([rid], timeout=60)
+            assert router.result(rid) is not None
+            before = router.summary()["dup_results"]
+            router._absorb({"router": router.router_id, "rid": rid,
+                            "tokens": [1, 2], "reason": "complete"})
+            assert router.summary()["dup_results"] == before + 1
+            assert router.result(rid) is None
+        finally:
+            h.stop()
+
+    def test_two_routers_instance_scoped_counters(self, small_model,
+                                                  tmp_path):
+        cfg, params = small_model
+        h = _Replicas(tmp_path, cfg, params, n=1)
+        try:
+            ra, rb = Router(h.registry), Router(h.registry)
+            global0 = metrics.counter("serve.fleet.routed").value
+            pa, pb = _prompts(3, seed=53), _prompts(3, seed=54)
+            ra_rids = [ra.submit(p, 4) for p in pa[:2]]
+            rb_rid = rb.submit(pb[0], 4)
+            ra.wait(ra_rids, timeout=60)
+            rb.wait([rb_rid], timeout=60)
+            # each summary tells ITS OWN routing story...
+            assert ra.summary()["routed"] == 2
+            assert rb.summary()["routed"] == 1
+            assert ra.summary()["router_id"] != rb.summary()["router_id"]
+            # ...the process-global counter stays the fleet-wide total...
+            assert metrics.counter("serve.fleet.routed").value \
+                == global0 + 3
+            # ...and each instance exports its tally under its router id
+            assert metrics.gauge(
+                f"serve.fleet.routed.r_{ra.router_id}").value == 2
+            assert metrics.gauge(
+                f"serve.fleet.routed.r_{rb.router_id}").value == 1
+            # close() releases the per-instance exports — a frontend
+            # loop recreating routers must not leak dead gauges
+            ra.close()
+            assert f"serve.fleet.routed.r_{ra.router_id}" \
+                not in metrics.snapshot()["gauges"]
+            assert f"serve.fleet.routed.r_{rb.router_id}" \
+                in metrics.snapshot()["gauges"]
+        finally:
+            h.stop()
