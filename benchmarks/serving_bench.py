@@ -154,6 +154,131 @@ def _fleet_drill(n_replicas: int) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _disagg_drill(n_prefill: int, n_decode: int) -> dict:
+    """ISSUE 11: a MIXED fleet — prefill-pool + decode-pool subprocess
+    replicas behind a DisaggRouter, quantized (int8) KV pages on the
+    transfer wire, one prefill replica SIGKILLed mid-drill. Reports what
+    disaggregation is for: per-POOL latency (the prefill pool's TTFT no
+    longer competes with the decode pool's TPOT), the transfer bill
+    (bytes/request, transfer_s, quantized-vs-f32 wire ratio) and the
+    per-stage failover story."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.inference.admission import AdmissionReject
+    from paddle_tpu.inference.disagg.transfer import wire_ratio_vs_f32
+    from paddle_tpu.inference.router import ServingFleet
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.observability import metrics
+
+    # head_dim 32 (128 / 4): the quantized wire ratio is a deployment
+    # number only at deployment-ish head dims — at hd 16 the f32 scale
+    # per (row, head) would eat the payload win
+    spec = {
+        "config": {"vocab_size": 256, "hidden_size": 128,
+                   "intermediate_size": 256, "num_hidden_layers": 2,
+                   "num_attention_heads": 4, "num_key_value_heads": 2,
+                   "max_position_embeddings": 128, "dtype": "float32"},
+        "seed": 3,
+        "batcher": {"max_batch": 3, "max_len": 96,
+                    "prompt_buckets": [8, 16, 32], "burst": 4,
+                    "page_size": 8, "kv_dtype": "int8"},
+    }
+    cfg = LlamaConfig(**{**spec["config"], "dtype": np.float32})
+    n_req = int(os.environ.get("FLEET_DRILL_REQUESTS", "14"))
+    rng = np.random.RandomState(13)
+    lens = rng.choice([4, 6, 9, 14, 24], n_req, p=[.35, .3, .2, .1, .05])
+    budgets = rng.choice([4, 6, 10, 24], n_req, p=[.4, .3, .2, .1])
+    reqs = [(rng.randint(1, 256, int(n)).tolist(), int(m))
+            for n, m in zip(lens, budgets)]
+
+    root = tempfile.mkdtemp(prefix="disagg_bench_")
+    fleet = ServingFleet(
+        n_prefill + n_decode, spec, root=root, ttl=1.2,
+        n_prefill=n_prefill,
+        env={"JAX_PLATFORMS": "cpu", "PADDLE_ADMIT_MAX_QUEUE": "6",
+             "PADDLE_CHAOS": ""})
+    xfer0 = metrics.histogram("slo.transfer_s").stats()["count"]
+    t_up0 = _time.perf_counter()
+    try:
+        fleet.start(timeout=180)
+        warmup_s = _time.perf_counter() - t_up0
+        router = fleet.router()
+        rejected = 0
+        rids = []
+        t0 = _time.perf_counter()
+        kill_at = n_req // 2
+        for i, (p, m) in enumerate(reqs):
+            if i == kill_at:
+                fleet.kill("r0")            # a PREFILL replica, mid-drill
+            submit_deadline = _time.perf_counter() + 150.0
+            while True:
+                try:
+                    rids.append(router.submit(p, m))
+                    break
+                except AdmissionReject as e:
+                    rejected += 1
+                    if _time.perf_counter() > submit_deadline:
+                        raise TimeoutError(
+                            f"disagg drill: request {i} still rejected "
+                            f"({e.reason}) after 150s") from e
+                    _time.sleep(min(e.retry_after_s, 1.0))
+        out = router.wait(timeout=180)
+        drill_s = _time.perf_counter() - t0
+        total_tokens = sum(len(v) for v in out.values())
+
+        per_pool: dict = {"prefill": {}, "decode": {}}
+        for rid_, snap in router.replica_snapshots().items():
+            extra = snap.get("extra", {}) or {}
+            role = (extra.get("replica", {}) or {}).get("role", "unified")
+            slo = (extra.get("serve", {}) or {}).get("slo", {})
+            per_pool.setdefault(role, {})[rid_] = {
+                "ttft_p50": (slo.get("ttft") or {}).get("p50"),
+                "ttft_p95": (slo.get("ttft") or {}).get("p95"),
+                "tpot_p50": (slo.get("tpot") or {}).get("p50"),
+                "tpot_p95": (slo.get("tpot") or {}).get("p95"),
+            }
+        xs = metrics.histogram("slo.transfer_s").stats()
+        s = router.summary()
+        return {
+            "prefill_replicas": n_prefill,
+            "decode_replicas": n_decode,
+            "requests": n_req,
+            "completed": sum(
+                1 for rid in out
+                if (router.result(rid) or {}).get("reason") == "complete"),
+            "rejected": rejected,
+            "killed": "serve.r0",
+            "tokens_per_sec": round(total_tokens / drill_s, 1),
+            "warmup_s": round(warmup_s, 2),
+            "per_pool": per_pool,
+            "transfer": {
+                "requests": s["transfers"],
+                "bytes_per_request": (
+                    round(router.xfer_bytes_total / s["transfers"])
+                    if s["transfers"] else None),
+                "transfer_s_p50": xs["p50"] if xs["count"] > xfer0 else None,
+                "transfer_s_p95": xs["p95"] if xs["count"] > xfer0 else None,
+                "wire_ratio_vs_f32": round(wire_ratio_vs_f32(
+                    cfg, spec["batcher"]["page_size"], "int8",
+                    os.environ.get("PADDLE_SERVE_KV_SCALE_GRAN") or "row"),
+                    4),
+            },
+            "failovers": {
+                "prefill": s["failovers_prefill"],
+                "decode": s["failovers_decode"],
+                "transfer_faults": s["xfer_faults"],
+                "reprefills": s["reprefills"],
+            },
+        }
+    finally:
+        fleet.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _main():
     n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     max_batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -339,6 +464,21 @@ def _main():
         except BaseException as e:
             fleet_obj = {"error": f"{type(e).__name__}: {e}"}
 
+    # disaggregated prefill/decode drill (ISSUE 11): PADDLE_SERVE_DISAGG=1
+    # spawns a mixed fleet (PADDLE_SERVE_PREFILL_REPLICAS prefill +
+    # max(2, PADDLE_SERVE_REPLICAS - prefill) decode) behind a
+    # DisaggRouter and reports the disagg sub-object; null otherwise. A
+    # drill failure lands as disagg.error — the JSON line survives.
+    disagg_obj = None
+    if (os.environ.get("PADDLE_SERVE_DISAGG", "") or "0") not in ("", "0"):
+        n_pre = max(2, int(os.environ.get("PADDLE_SERVE_PREFILL_REPLICAS",
+                                          "2") or 2))
+        n_dec = max(2, n_replicas - n_pre)
+        try:
+            disagg_obj = _disagg_drill(n_pre, n_dec)
+        except BaseException as e:
+            disagg_obj = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": round(total_new / cont_s, 1),
@@ -346,6 +486,7 @@ def _main():
         "kv_layout": "paged",
         "slo": slo_obj,
         "fleet_serve": fleet_obj,
+        "disagg": disagg_obj,
         "ragged": ragged_obj,
         "quant": quant_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),

@@ -62,6 +62,13 @@ SITES: dict[str, str] = {
     "rpc.send":        "before any wire IO of an rpc call (retry-safe)",
     "serve.admit":     "before a serving request is admitted to a slot",
     "serve.burst":     "before a serving decode burst is dispatched",
+    "serve.page_xfer": "before the router ships a prefilled request's KV "
+                       "pages to a decode replica (fault drops the blob — "
+                       "the request re-prefills, never lost)",
+    "serve.prefill_dead": "before a dead prefill replica's in-flight "
+                          "prompt pass is re-enqueued by the router "
+                          "(fault defers the re-prefill one tick, never "
+                          "loses it)",
     "serve.reject":    "before an admission rejection is returned (fault "
                        "degrades the retry-after hint to the floor; the "
                        "rejection stands)",

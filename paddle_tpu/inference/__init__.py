@@ -33,7 +33,8 @@ from ..core.tensor import Tensor
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
            "PrecisionType", "LLMPredictor", "ContinuousBatcher",
            "PredictorPool", "PageAllocator", "AdmissionPolicy",
-           "AdmissionReject", "Router", "ServingFleet", "ReplicaServer"]
+           "AdmissionReject", "Router", "ServingFleet", "ReplicaServer",
+           "DisaggRouter"]
 
 
 class PrecisionType:
@@ -318,4 +319,5 @@ from .admission import AdmissionPolicy, AdmissionReject  # noqa: E402
 from .paging import PageAllocator  # noqa: E402
 from .replica import ReplicaServer  # noqa: E402
 from .router import Router, ServingFleet  # noqa: E402
+from .disagg import DisaggRouter  # noqa: E402
 from .serving import ContinuousBatcher, PredictorPool  # noqa: E402

@@ -57,7 +57,7 @@ class AdmissionReject(Exception):
     """Admission refused. ``retry_after_s`` is the computed backoff hint a
     well-behaved client honors before resubmitting; ``reason`` names the
     threshold that tripped (``queue_full`` / ``queue_p95`` / ``e2e_p95`` /
-    ``draining`` / ``no_replicas``)."""
+    ``pool_pressure`` / ``draining`` / ``no_replicas``)."""
 
     def __init__(self, retry_after_s: float, reason: str):
         self.retry_after_s = float(retry_after_s)
@@ -136,6 +136,32 @@ class AdmissionPolicy:
             return retry_after_floor()
         waves = (queue_depth + 1) / max(1, max_batch)
         return max(retry_after_floor(), waves * float(service))
+
+    def decide_pages(self, free_pages: int | None, pages_needed: int,
+                     hists=None) -> dict | None:
+        """The SECOND admission dimension (ISSUE 11, disaggregated
+        serving): decode-pool PAGE pressure, distinct from queue depth. A
+        transferred request arrives with its whole context's pages — if
+        the pool (minus pages already promised to queued transfers)
+        cannot hold them, admitting would only park it in the queue while
+        the pages it needs are held by live decode streams.
+
+        None to admit, else ``{"reason": "pool_pressure", retry_after_s}``
+        with its OWN hint arithmetic: pages free when requests retire, so
+        the estimate is one service time (measured e2e p50) — one wave of
+        retirements — not the queue dimension's depth-in-waves × p50 (a
+        page-starved pool usually has a SHORT queue; depth says nothing
+        about when pages free). ``free_pages`` None (dense pool) never
+        rejects on this dimension."""
+        if free_pages is None or int(free_pages) >= int(pages_needed):
+            return None
+        if callable(hists):
+            hists = hists()
+        service = ((hists or {}).get(_E2E_HIST) or {}).get("p50")
+        hint = (float(service) if service and service > 0
+                else retry_after_floor())
+        return {"reason": "pool_pressure",
+                "retry_after_s": max(retry_after_floor(), hint)}
 
     def decide(self, queue_depth: int, max_batch: int,
                hists=None) -> dict | None:

@@ -1,0 +1,25 @@
+"""Disaggregated prefill/decode serving (ISSUE 11 tentpole).
+
+The replica fleet splits into two specialized pools:
+
+  * **prefill replicas** run the compute-bound prompt pass only — a
+    request admitted with ``prefill_only=True`` retires right after its
+    first token with reason ``"prefilled"`` and its live KV pages parked
+    for export;
+  * **decode replicas** never prefill — they install transferred pages
+    into their own pool (``/kv_transfer``) and stream tokens from them.
+
+``transfer.py`` is the wire format (pages serialized in the pool's wire
+dtype via ``quant/codec.py`` — int8/fp8 payload + f32 block scales, f32
+fallback for unquantized pools); ``coordinator.py`` is the
+``DisaggRouter`` that owns the two-stage request lifecycle under ONE
+trace id (route-to-prefill → transfer → route-to-decode → stream) with
+failover at every stage. See the README "Disaggregated serving" section
+for the stage diagram and the failover matrix.
+"""
+from .coordinator import DisaggRouter
+from .transfer import (install_pages, serialize_pages, wire_breakdown,
+                       wire_ratio_vs_f32)
+
+__all__ = ["DisaggRouter", "serialize_pages", "install_pages",
+           "wire_breakdown", "wire_ratio_vs_f32"]

@@ -1,0 +1,354 @@
+"""DisaggRouter: the two-stage request lifecycle over specialized pools.
+
+ISSUE 11 tentpole. The base ``Router`` owns ONE stage: route, collect,
+fail over. Disaggregation splits serving into a prompt pass on the
+prefill pool and token streaming on the decode pool, with the KV pages
+crossing the wire in between — so the lifecycle becomes a small state
+machine, still under ONE trace id:
+
+    submit ──► stage "prefill"  — routed to a role="prefill" (or
+               unified) replica with ``prefill_only=True``; the replica
+               runs the prompt pass, samples the first token, and its
+               /results record comes back reason="prefilled" CARRYING the
+               exported page blob (transfer.py wire format).
+           ──► stage "transfer" — the router POSTs the blob to a
+               role="decode" replica's ``/kv_transfer`` (the page-
+               transfer endpoint), gated by the pool-pressure admission
+               dimension (free pages minus promised transfers).
+           ──► stage "decode"   — the decode replica installed the pages
+               and streams; its terminal result retires the request.
+
+Failover exists at EVERY stage, and always lands on "re-prefill" —
+pages are reconstructible from the prompt (token-identical at temp=0,
+the same parity discipline every serving PR has pinned), so nothing the
+fleet can lose is unrecoverable:
+
+  * prefill replica dies mid-pass        → re-route the prompt
+    (chaos site ``serve.prefill_dead`` defers it one tick, never loses);
+  * transfer faults (chaos
+    ``serve.page_xfer``) or the prefilled
+    result comes back blob-less          → re-prefill;
+  * transfer POST is transport-ambiguous → retry THAT replica first next
+    tick — its (router, rid) dedup absorbs a landed install;
+  * decode replica dies / sheds after
+    handoff                              → its pool (and the pages) died
+    with it: re-prefill on the prefill pool.
+
+Per-stage latency lands in the ``slo.prefill_pool_s`` /
+``slo.transfer_s`` / ``slo.decode_pool_s`` histograms and
+``req.prefill_pool`` / ``req.transfer`` / ``req.decode_pool`` spans
+(observability.slo.STAGES) — TTFT is the prefill-result arrival, which
+is exactly what disaggregation is supposed to protect from decode
+batching.
+
+HTTP stays in the base class's ``_get``/``_post`` (lint O3: router.py is
+the audited urllib client); this module adds no transport of its own.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from ...distributed.resilience import chaos
+from ...observability import metrics, recorder as _recorder, slo as _slo
+from ...utils import env_flags
+from ..router import Router, RoutedRequest
+
+__all__ = ["DisaggRouter"]
+
+ENV_XFER_TIMEOUT = "PADDLE_SERVE_XFER_TIMEOUT_S"
+
+# per-stage fleet counters added on top of the base set — same _count
+# discipline (instance tally + process-global counter + per-router gauge)
+_STAGE_COUNTS = ("transfers", "xfer_faults", "reprefills",
+                 "failovers_prefill", "failovers_decode")
+
+
+class DisaggRouter(Router):
+    """router = DisaggRouter(registry); rid = router.submit(prompt, 16)
+
+    Same public surface as ``Router`` (submit / tick / wait / result /
+    drain / summary) — a client cannot tell it is talking to a
+    disaggregated fleet except through the per-stage telemetry."""
+
+    def __init__(self, registry, xfer_timeout_s: float | None = None,
+                 **kw):
+        super().__init__(registry, **kw)
+        self._xfer: deque[int] = deque()   # rids parked between pools
+        self._xfer_timeout = (float(xfer_timeout_s)
+                              if xfer_timeout_s is not None
+                              else env_flags.get_float(ENV_XFER_TIMEOUT))
+        # declined-transfer backoff: a saturated decode pool must not be
+        # re-POSTed whole KV blobs every 4 ms wait() pass — nothing can
+        # change the answer until the next health probe refreshes the
+        # handles anyway, so declines pause the transfer lane until then
+        self._xfer_next_try = -1e9
+        self.xfer_bytes_total = 0          # raw wire bytes shipped
+        for c in _STAGE_COUNTS:
+            self._fleet_counts[c] = 0
+            metrics.counter(f"serve.fleet.{c}")
+
+    # -------------------------------------------------------- stage hooks
+    def _route_role(self, req: RoutedRequest) -> str | None:
+        # every _try_route dispatch is the prompt stage (decode entry is
+        # /kv_transfer, which _try_transfer owns)
+        return "prefill"
+
+    def _enqueue_body(self, req: RoutedRequest, force: bool) -> dict:
+        body = super()._enqueue_body(req, force)
+        body["prefill_only"] = True
+        return body
+
+    def _failover_site(self, req: RoutedRequest) -> str:
+        return ("serve.prefill_dead" if req.stage == "prefill"
+                else "serve.replica_dead")
+
+    def _on_failover(self, req: RoutedRequest) -> None:
+        if req.stage == "decode":
+            # the pages died with the replica's pool; the prompt did not
+            self._count("failovers_decode")
+            req.stage = "prefill"
+            req.kv = None
+        else:
+            self._count("failovers_prefill")
+        req.t_stage = _slo.now()
+
+    def _mark_dead(self, h):
+        # a transfer-parked request's dedup marker naming the dead decode
+        # replica is as meaningless as a pending one's (base invariant)
+        for rid in self._xfer:
+            req = self._requests.get(rid)
+            if req is not None and req.last_faulted == h.id:
+                req.last_faulted = None
+        super()._mark_dead(h)
+
+    # ------------------------------------------------------------ results
+    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        rid = super().submit(prompt_ids, max_new_tokens)
+        req = self._requests.get(rid)
+        if req is not None and not req.t_stage:
+            req.t_stage = _slo.now()   # the prefill_pool stage clock
+        return rid
+
+    def _reprefill(self, req: RoutedRequest) -> None:
+        """Send a request back to stage one: pages are reconstructible
+        from the prompt, so every unrecoverable mid-flight loss converges
+        here. Same trace id; the fleet-level queue-wait clock resumes."""
+        req.kv = None
+        req.stage = "prefill"
+        req.replica = None
+        req.retried = True
+        req.last_faulted = None
+        req.t_stage = _slo.now()
+        self._inflight.pop(req.rid, None)
+        self.slo.on_preempt(req.rid)
+        self._pending.appendleft(req)
+        self._count("reprefills")
+
+    def _absorb(self, res: dict):
+        if res.get("router") != self._rid_ns:
+            return super()._absorb(res)   # foreign record: base ignores
+        rid = res.get("rid")
+        req = self._requests.get(rid)
+        reason = res.get("reason", "complete")
+        if reason == "prefilled":
+            if req is None or self._finished(rid) \
+                    or req.stage != "prefill":
+                # late duplicate (a falsely-suspected prefill replica's
+                # result arriving after the re-prefill already advanced).
+                # Release the inflight entry ONLY for a finished request:
+                # a stage-advanced live one still tracks its CURRENT
+                # attempt there (popping it would blind the dead-replica
+                # sweep to a later decode-replica death — request lost)
+                if req is None or self._finished(rid):
+                    self._inflight.pop(rid, None)
+                self._count("dup_results")
+                return
+            self._inflight.pop(rid, None)
+            # a lease blip may have re-pended this request (failover)
+            # while the FIRST attempt's result was in flight — the early
+            # result wins, so the re-pended copy must leave the dispatch
+            # queue or it would burn a duplicate prompt pass
+            try:
+                self._pending.remove(req)
+            except ValueError:
+                pass
+            kv = res.get("kv")
+            if not kv:
+                # a prefilled result MUST carry the pages; without them
+                # (replica export raced a crash) the prompt is all we
+                # have — re-prefill, never lose
+                _recorder.record("serve.disagg.blobless_prefill",
+                                 rid=rid, router=self._rid_ns)
+                self._reprefill(req)
+                return
+            now = _slo.now()
+            # TTFT is REAL now: the first token exists (it rides the
+            # blob); the decode pool only adds TPOT after it
+            self.slo.on_first_token(rid)
+            self.slo.on_stage(rid, "prefill_pool", req.t_stage, now)
+            req.t_stage = now
+            req.kv = kv
+            req.stage = "transfer"
+            req.replica = None
+            req.last_faulted = None
+            self._xfer.append(rid)
+            return
+        if req is not None and not self._finished(rid) \
+                and req.stage == "decode":
+            if reason == "shed":
+                # a decode replica shed transferred work — the installed
+                # pages are gone with the shed, so the base re-pend must
+                # re-enter at stage one
+                req.stage = "prefill"
+                req.kv = None
+                req.t_stage = _slo.now()
+                self._count("reprefills")
+            else:
+                self.slo.on_stage(rid, "decode_pool", req.t_stage,
+                                  _slo.now())
+        super()._absorb(res)
+
+    # ----------------------------------------------------------- transfer
+    def tick(self):
+        super().tick()
+        self._transfer_tick()
+
+    def _transfer_tick(self):
+        """Ship every transfer-parked request to the decode pool (stage
+        two of the lifecycle, run after the base tick so freshly
+        collected prefill results transfer THIS pass)."""
+        now = _slo.now()
+        for _ in range(len(self._xfer)):
+            rid = self._xfer.popleft()
+            req = self._requests.get(rid)
+            if req is None or self._finished(rid) \
+                    or req.stage != "transfer":
+                continue
+            if now < self._xfer_next_try and not req.last_faulted:
+                # declined last pass and no probe has refreshed the
+                # handles since: the answer cannot have changed — park
+                # without re-shipping the blob. A fault-parked request is
+                # exempt: its retry is the dedup probe that resolves an
+                # AMBIGUOUS send, and next-tick is that contract.
+                self._xfer.append(rid)
+                continue
+            try:
+                chaos.hit("serve.page_xfer")
+            except chaos.ChaosError:
+                # faulted transfer: the blob is suspect — drop it and
+                # re-prefill (deferred work, never lost work)
+                self._count("xfer_faults")
+                self._reprefill(req)
+                continue
+            try:
+                status = self._try_transfer(req)
+            except ValueError as e:
+                # the decode replica answered 400: the blob cannot fit
+                # its pool (spec drift) — a terminal error result, the
+                # same contract as tick()'s never-admissible absorb. The
+                # blob is dropped WITH the request (every other exit
+                # nulls req.kv too — a wait()-only client must not hold
+                # thousands of dead blobs until ack/eviction)
+                req.kv = None
+                self._record_done(req.rid, {"rid": req.rid, "tokens": [],
+                                            "reason": f"error: {e}",
+                                            "trace_id": req.trace_id})
+                self.slo.on_retire(req.rid, n_tokens=0, reason="error")
+                continue
+            except RuntimeError:
+                # loud non-capacity HTTP status: re-park (accepted work
+                # survives the operator fixing the fleet), then surface
+                self._xfer.appendleft(rid)
+                raise
+            if status != "routed":
+                # fault (ambiguous send: dedup retries that replica next
+                # tick) or declined (decode pool saturated: pages free as
+                # streams retire) — the blob stays in hand either way
+                if status == "declined":
+                    self._xfer_next_try = now + self._probe_s
+                self._xfer.append(rid)
+
+    def _try_transfer(self, req: RoutedRequest) -> str:
+        """One transfer attempt over the decode candidates, least-loaded
+        first — the stage-two twin of _try_route, with the POOL-pressure
+        gate where stage one gates on queue depth."""
+        faulted = False
+        n_pages = int(req.kv.get("n_pages", 0))
+        cands = self._candidates(include_draining=req.retried,
+                                 role="decode")
+        if req.last_faulted:
+            lf = self._handles.get(req.last_faulted)
+            if lf is not None and lf not in cands:
+                cands.insert(0, lf)
+            else:
+                cands.sort(key=lambda c: c.id != req.last_faulted)
+        for h in cands:
+            if h.id != req.last_faulted and h.free_pages is not None \
+                    and h.free_pages - h.queued_kv_pages < n_pages:
+                continue   # page-starved: don't bounce off its 429
+            code, body = self._post(
+                h.endpoint, "/kv_transfer",
+                {"rid": req.rid, "prompt": req.prompt,
+                 "max_new_tokens": req.max_new_tokens,
+                 "trace_id": req.trace_id, "force": req.retried,
+                 "router": self._rid_ns, "kv": req.kv},
+                timeout=self._xfer_timeout)
+            req.attempts += 1
+            if code == 200 and body.get("ok"):
+                now = _slo.now()
+                self.slo.on_stage(req.rid, "transfer", req.t_stage, now)
+                req.t_stage = now
+                req.replica = h.id
+                req.stage = "decode"
+                self.xfer_bytes_total += int(req.kv.get("wire_bytes", 0))
+                req.kv = None   # delivered; the router holds no copy
+                req.last_faulted = None
+                self._inflight[req.rid] = req
+                # optimistic load accounting (next probe corrects): the
+                # installed request occupies queue+pages NOW, so a burst
+                # of transfers in one tick spreads over the pool instead
+                # of piling onto the one stale least-loaded handle
+                h.queued_kv_pages += n_pages
+                h.queue_depth += 1
+                self._count("transfers")
+                return "routed"
+            if code == 400:
+                raise ValueError(
+                    f"decode replica {h.id} refused transfer {req.rid}: "
+                    f"{body.get('reason', 'invalid')}")
+            if code == 429:
+                try:
+                    req.retry_hint = max(req.retry_hint,
+                                         float(body.get("retry_after_s")
+                                               or 0.0))
+                except (TypeError, ValueError):
+                    pass
+                if body.get("reason") == "pool_pressure" \
+                        and h.free_pages is not None:
+                    h.free_pages = min(h.free_pages, n_pages - 1)
+                if body.get("reason") == "draining":
+                    h.draining = True
+                continue
+            if code == 0:
+                # ambiguous: the install may have landed — park and
+                # retry THIS replica first next tick (its dedup answers)
+                req.last_faulted = h.id
+                faulted = True
+                break
+            raise RuntimeError(
+                f"decode replica {h.id} answered unexpected HTTP {code} "
+                f"at /kv_transfer "
+                f"({body.get('reason') or body.get('error') or 'no body'})"
+                f" — auth misconfig or handler bug, not capacity")
+        return "fault" if faulted else "declined"
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> dict:
+        s = super().summary()
+        s["transferring"] = len(self._xfer)
+        s["xfer_bytes_total"] = self.xfer_bytes_total
+        s["stages"] = {
+            rid: self._requests[rid].stage
+            for rid in list(self._inflight)
+            if rid in self._requests}
+        return s

@@ -52,7 +52,8 @@ from .admission import AdmissionPolicy, AdmissionReject, \
     reject as _admission_reject, retry_after_floor, slo_hists
 from .serving import ContinuousBatcher
 
-__all__ = ["ReplicaServer", "REPLICA_PREFIX", "build_batcher", "main"]
+__all__ = ["ReplicaServer", "REPLICA_PREFIX", "ROLES", "build_batcher",
+           "main"]
 
 # registry node ids of serving replicas: "serve.<replica name>" — the
 # router discovers the fleet by this prefix in the shared alive set
@@ -63,6 +64,27 @@ ENV_TTL = "PADDLE_SERVE_TTL"
 ENV_HEARTBEAT = "PADDLE_SERVE_HEARTBEAT_S"
 ENV_DRAIN_GRACE = "PADDLE_DRAIN_GRACE_S"
 ENV_RESULTS_KEEP = "PADDLE_SERVE_RESULTS_KEEP"
+ENV_ROLE = "PADDLE_SERVE_ROLE"
+
+# replica roles (ISSUE 11): advertised in the lease payload and /health so
+# the router's candidate selection can filter by stage. "unified" is the
+# pre-disagg replica (prefills AND decodes) — every single-pool deployment
+# keeps it implicitly, so routing behavior is unchanged with the flag
+# unset. "prefill" runs prompt passes and exports pages; "decode" installs
+# transferred pages and streams tokens.
+ROLES = ("unified", "prefill", "decode")
+
+
+def normalize_role(raw) -> str:
+    """''/None mean "unified"; anything else must name a role — a typo'd
+    PADDLE_SERVE_ROLE must not silently deploy a unified replica into a
+    pool the router believes is specialized."""
+    v = (raw or "").strip().lower()
+    if not v:
+        return "unified"
+    if v not in ROLES:
+        raise ValueError(f"unknown replica role {v!r} (one of {ROLES})")
+    return v
 
 
 class ReplicaServer:
@@ -75,9 +97,12 @@ class ReplicaServer:
     def __init__(self, batcher: ContinuousBatcher, registry, name: str,
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_s: float | None = None,
-                 drain_grace_s: float | None = None):
+                 drain_grace_s: float | None = None,
+                 role: str | None = None):
         self._b = batcher
         self._registry = registry
+        self.role = normalize_role(role if role is not None
+                                   else env_flags.get(ENV_ROLE))
         self.replica_id = (name if name.startswith(REPLICA_PREFIX)
                            else REPLICA_PREFIX + name)
         ttl = getattr(registry, "ttl", env_flags.get_float(ENV_TTL))
@@ -114,6 +139,7 @@ class ReplicaServer:
             health=self._health,
             get_routes={"/results": self._h_results},
             post_routes={"/enqueue": self._h_enqueue,
+                         "/kv_transfer": self._h_kv_transfer,
                          "/drain": self._h_drain})
         self.port = self._admin.port
         self.endpoint = f"http://{host}:{self.port}"
@@ -151,7 +177,7 @@ class ReplicaServer:
 
     def _lease_info(self) -> dict:
         return {"endpoint": self.endpoint, "pid": os.getpid(),
-                "max_batch": self._b.B}
+                "max_batch": self._b.B, "role": self.role}
 
     # ------------------------------------------------------- HTTP handlers
     def _health(self) -> dict:
@@ -161,11 +187,13 @@ class ReplicaServer:
             doc["draining"] = doc["draining"] or self._draining
             doc["ready"] = doc["ready"] and not self._draining
         doc["replica"] = self.replica_id
+        doc["role"] = self.role
         return doc
 
     def summary(self) -> dict:
         with self._lk:
             return {"replica": self.replica_id, "endpoint": self.endpoint,
+                    "role": self.role,
                     "intake": len(self._intake),
                     "results": len(self._results),
                     "draining": self._draining}
@@ -184,6 +212,7 @@ class ReplicaServer:
         tid = body.get("trace_id")
         force = bool(body.get("force"))
         rtr = body.get("router")
+        po = bool(body.get("prefill_only"))
         try:
             # never-admissible requests (over-budget, impossible page
             # demand) are refused HERE with a 400 — BEFORE any retryable
@@ -227,7 +256,88 @@ class ReplicaServer:
                 if d is not None:
                     return self._reject_429(d["reason"],
                                             d["retry_after_s"])
-            self._intake.append((rid, prompt, mnt, tid, force, rtr))
+            self._intake.append((rid, prompt, mnt, tid, force, rtr, po,
+                                 None))
+            self._active.add((rtr, rid))
+        return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
+
+    def _h_kv_transfer(self, body: dict):
+        """POST /kv_transfer — the disagg page-transfer boundary (ISSUE
+        11): a prefilled request arrives WITH its KV pages (the wire blob
+        disagg.transfer serialized) and enters the queue as a kv_import
+        admit — no prefill ever runs here. Admission gains the SECOND
+        pressure dimension: besides queue depth, the pool itself — free
+        pages minus pages already promised to queued transfers must cover
+        this request's live pages, else 429 ``pool_pressure`` with the
+        page-turnover retry hint (admission.decide_pages)."""
+        try:
+            rid = int(body["rid"])
+            prompt = [int(t) for t in body["prompt"]]
+            mnt = int(body.get("max_new_tokens", 32))
+            kv = dict(body["kv"])
+            int(kv["tlen"]), int(kv["first"])  # shape of a transfer blob
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"ok": False, "reason": f"bad transfer: {e}"}
+        tid = body.get("trace_id")
+        force = bool(body.get("force"))
+        rtr = body.get("router")
+        if self.role == "prefill":
+            # a misdirected transfer (stale role view, misconfigured
+            # router) is refused AT the wire like every other
+            # never-installable request — accepting it would only retire
+            # as a terminal error on the serve loop (a prefill replica
+            # forces prefill_only on every admit, which excludes
+            # kv_import)
+            return 400, {"ok": False,
+                         "reason": "invalid: this replica is the PREFILL "
+                                   "pool — transfers install on decode/"
+                                   "unified replicas"}
+        try:
+            self._b.check_admissible(prompt, mnt)
+            # geometry/byte-count validation HERE, with a 400 — a drifted
+            # or truncated blob must be refused at the wire, not crash
+            # the serve loop (and with it every other in-flight request)
+            # at install time
+            need = self._b.check_kv_blob(kv)
+            if int(kv["tlen"]) != len(prompt):
+                raise ValueError(
+                    f"blob holds {kv['tlen']} prompt positions, request "
+                    f"prompt has {len(prompt)}")
+        except ValueError as e:
+            return 400, {"ok": False, "reason": f"invalid: {e}"}
+        pol = self._b.admission
+        hists = (slo_hists if pol is not None and not force else None)
+        with self._lk:
+            if rtr is not None and (rtr, rid) in self._active:
+                # idempotent accept — the ambiguous-send dedup contract
+                # /enqueue keeps, extended to the transfer boundary (a
+                # re-POSTed blob must not install twice)
+                return 200, {"ok": True, "rid": rid, "dedup": True,
+                             "replica": self.replica_id}
+            if self._draining and (not force or self._drained_flag):
+                return self._reject_429("draining", retry_after_floor())
+            if pol is not None and not force:
+                health = self._b.health_summary()
+                depth = len(self._intake) + health["queue_depth"]
+                d = pol.decide(depth, self._b.B, hists=hists)
+                if d is None and health["free_pages"] is not None:
+                    # pages already promised: the batcher queue's tally
+                    # PLUS blobs still sitting in OUR intake (the queue
+                    # dimension counts intake the same way) — two routers
+                    # posting into one step must not both pass on the
+                    # same free-page snapshot
+                    from .paging import pages_for
+                    intake_kv = sum(
+                        pages_for(len(e[1]), self._b.page_size)
+                        for e in self._intake if e[7] is not None)
+                    free = (health["free_pages"]
+                            - health["queued_kv_pages"] - intake_kv)
+                    d = pol.decide_pages(free, need, hists=hists)
+                if d is not None:
+                    return self._reject_429(d["reason"],
+                                            d["retry_after_s"])
+            self._intake.append((rid, prompt, mnt, tid, force, rtr, False,
+                                 kv))
             self._active.add((rtr, rid))
         return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
 
@@ -353,12 +463,17 @@ class ReplicaServer:
                 self._intake.clear()
                 draining = self._draining
                 drain_t0 = self._drain_t0
-            for rid, prompt, mnt, tid, force, rtr in moved:
+            for rid, prompt, mnt, tid, force, rtr, po, kv in moved:
                 try:
                     # admission already happened at the HTTP boundary —
-                    # force=True here so the policy isn't double-applied
-                    local = self._b.add_request(prompt, mnt, trace_id=tid,
-                                                force=True)
+                    # force=True here so the policy isn't double-applied.
+                    # A prefill replica treats EVERY admit as prefill_only
+                    # (its pool exists to run prompt passes, not to hold
+                    # decode streams a router never asked it for).
+                    local = self._b.add_request(
+                        prompt, mnt, trace_id=tid, force=True,
+                        prefill_only=po or self.role == "prefill",
+                        kv_import=kv)
                 except Exception as e:
                     self._push_result(rid, tid, rtr, [],
                                       f"error: {type(e).__name__}: {e}")
@@ -402,15 +517,21 @@ class ReplicaServer:
                                      "drained clean",
                              replica=self.replica_id)
 
-    def _push_result(self, rid, tid, rtr, tokens, reason):
+    def _push_result(self, rid, tid, rtr, tokens, reason, kv=None):
         with self._lk:
             # the (router, rid) key leaves the active set in the same
             # lock acquisition that publishes the result: a shed request
             # re-routed back here must be accepted again, not deduped
             self._active.discard((rtr, rid))
-            self._results.append({"rid": rid, "trace_id": tid,
-                                  "router": rtr, "tokens": list(tokens),
-                                  "reason": reason})
+            rec = {"rid": rid, "trace_id": tid, "router": rtr,
+                   "tokens": list(tokens), "reason": reason}
+            if kv is not None:
+                # a prefilled request's exported pages ride OUT on the
+                # result the router was polling for anyway — the transfer
+                # needs no extra replica round trip, and the pool pages
+                # were freed the moment this blob was serialized
+                rec["kv"] = kv
+            self._results.append(rec)
             keep = self._results_keep
             if keep > 0 and not self._draining \
                     and len(self._results) > keep:
@@ -426,7 +547,22 @@ class ReplicaServer:
         for local, req in self._b.take_finished().items():
             rid, tid, rtr = self._rid_map.pop(local,
                                               (local, req.trace_id, None))
-            self._push_result(rid, tid, rtr, req.out, req.reason)
+            kv = None
+            if req.reason == "prefilled":
+                # serialize-and-free on THE thread that owns the batcher;
+                # an export failure degrades to a shed (the router
+                # re-routes it under the same trace id — re-prefilled,
+                # never lost, never a half-written blob)
+                try:
+                    kv = self._b.export_kv(local)
+                except Exception as e:
+                    _recorder.record("serve.replica.export_error",
+                                     replica=self.replica_id, rid=rid,
+                                     error=f"{type(e).__name__}: {e}")
+                    self._b.drop_parked(local)
+                    self._push_result(rid, tid, rtr, [], "shed")
+                    continue
+            self._push_result(rid, tid, rtr, req.out, req.reason, kv=kv)
             # completed means SERVED to budget: a shed (never served,
             # re-routed elsewhere) or an error result counted here would
             # make fleet-summed completions exceed the request count
@@ -480,6 +616,9 @@ def main(argv=None) -> int:
                    default=env_flags.get_float(ENV_TTL))
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--role", default=env_flags.get(ENV_ROLE),
+                   help="replica role: prefill | decode | unified "
+                        "(default PADDLE_SERVE_ROLE, else unified)")
     args = p.parse_args(argv)
 
     raw = args.spec
@@ -498,12 +637,13 @@ def main(argv=None) -> int:
 
     batcher = build_batcher(spec)
     rep = ReplicaServer(batcher, registry, args.name, host=args.host,
-                        port=args.port)
+                        port=args.port, role=args.role)
     signal.signal(signal.SIGTERM, lambda *a: rep.begin_drain())
     rep.start()
     # one machine-readable line for the spawner, then serve until drained
     print(json.dumps({"replica": rep.replica_id,  # observability: ok (spawner handshake line on stdout, not runtime telemetry)
                       "endpoint": rep.endpoint,
+                      "role": rep.role,
                       "pid": os.getpid()}), flush=True)
     while not rep.join(timeout=60.0):
         pass

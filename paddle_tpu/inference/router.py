@@ -87,6 +87,14 @@ class RoutedRequest:
     #                                  (AMBIGUOUS: may have landed) — the
     #                                  re-dispatch must try it FIRST so
     #                                  its (router, rid) dedup can absorb
+    # disaggregated lifecycle (ISSUE 11, DisaggRouter only — the base
+    # router never reads these): which stage the request is in
+    # ("prefill" → "transfer" → "decode"), the exported page blob while
+    # the router holds it in flight between pools, and the running
+    # stage's start time for the per-stage slo histograms
+    stage: str = "prefill"
+    kv: dict | None = None
+    t_stage: float = 0.0
 
 
 @dataclass
@@ -100,6 +108,9 @@ class _Handle:
     draining: bool = False
     ready: bool = True
     cursor: int = 0              # /results read position
+    role: str = "unified"        # lease-advertised pool (ISSUE 11)
+    free_pages: int | None = None    # decode-pool pressure (from /health)
+    queued_kv_pages: int = 0         # pages promised to queued transfers
     last_probe: float = field(default_factory=_slo.now)
 
     @property
@@ -225,17 +236,20 @@ class Router:
                 return None
             raise
 
-    def _post(self, endpoint: str, path: str, obj: dict) -> tuple[int, dict]:
+    def _post(self, endpoint: str, path: str, obj: dict,
+              timeout: float | None = None) -> tuple[int, dict]:
         """POST json -> (status, body). 4xx statuses are ANSWERS (429 =
         admission data); transport faults return (0, {}) and the caller's
         retry/tick discipline owns recovery — the resilience classify()
-        split applied to routed sends."""
+        split applied to routed sends. ``timeout`` overrides the probe
+        timeout (a KV-page transfer ships megabytes, not a health doc)."""
         data = json.dumps(obj).encode()
         try:
             req = urllib.request.Request(endpoint + path, data=data,
                                          headers=self._headers(True),
                                          method="POST")
-            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self._timeout) as r:
                 return r.status, json.loads(r.read() or b"{}")
         except urllib.error.HTTPError as e:
             try:
@@ -283,11 +297,12 @@ class Router:
             ep = info.get("endpoint")
             if not ep:
                 continue  # lease without an endpoint: not routable yet
-            self._handles[rid] = _Handle(id=rid, endpoint=ep,
-                                         max_batch=int(info.get("max_batch",
-                                                                1)))
+            self._handles[rid] = _Handle(
+                id=rid, endpoint=ep,
+                max_batch=int(info.get("max_batch", 1)),
+                role=str(info.get("role") or "unified"))
             _recorder.record("serve.route_table", replica=rid, event="join",
-                             endpoint=ep)
+                             endpoint=ep, role=self._handles[rid].role)
         for rid in sorted(set(self._handles) - alive):
             h = self._handles[rid]
             # final poll before the verdict: drained replicas deregister
@@ -309,6 +324,11 @@ class Router:
                 h.max_batch = int(doc.get("max_batch", h.max_batch))
                 h.draining = bool(doc.get("draining"))
                 h.ready = bool(doc.get("ready", True))
+                if doc.get("role"):
+                    h.role = str(doc["role"])
+                fp = doc.get("free_pages")
+                h.free_pages = None if fp is None else int(fp)
+                h.queued_kv_pages = int(doc.get("queued_kv_pages", 0) or 0)
                 h.last_probe = now
         metrics.gauge("serve.fleet.replicas").set(len(self._handles))
 
@@ -340,19 +360,31 @@ class Router:
             if req is None or self._finished(rid):
                 continue  # already delivered before the lease lapsed
             try:
-                chaos.hit("serve.replica_dead")
+                # literal sites (rule A2): the hook picks WHICH of the two
+                # registered failover sites guards this request's stage
+                if self._failover_site(req) == "serve.prefill_dead":
+                    chaos.hit("serve.prefill_dead")
+                else:
+                    chaos.hit("serve.replica_dead")
             except chaos.ChaosError:
                 self._orphans.append(rid)   # deferred; retried next tick
                 continue
             del self._inflight[rid]
             req.replica = None
             req.retried = True
+            self._on_failover(req)
             self.slo.on_preempt(rid)  # queue-wait resumes, trace id kept
             self._pending.appendleft(req)
             self._count("failovers")
 
+    def _on_failover(self, req: RoutedRequest) -> None:
+        """Hook between un-inflighting and re-pending a failed-over
+        request — the DisaggRouter resets a decode-stage request to
+        re-prefill here (its pages died with the replica's pool)."""
+
     # ------------------------------------------------------------- routing
-    def _candidates(self, include_draining: bool = False) -> list[_Handle]:
+    def _candidates(self, include_draining: bool = False,
+                    role: str | None = None) -> list[_Handle]:
         # draining replicas sort LAST: only forced (already-accepted)
         # work may land there, and only when no healthy replica can take
         # it — the replica side honors force=True during drain for
@@ -362,11 +394,35 @@ class Router:
         # forced path ignores readiness entirely: ready=False (draining,
         # a transiently failing health callable, a missed probe) must
         # never strand accepted work — the send itself is the probe that
-        # matters, and a 429/fault answer just parks it for the next tick
+        # matters, and a 429/fault answer just parks it for the next tick.
+        # `role` (ISSUE 11): a disagg stage targets its specialized pool;
+        # "unified" replicas serve either stage; role=None (every non-
+        # disagg caller) keeps the pre-role behavior byte-identical.
         return sorted((h for h in self._handles.values()
                        if (include_draining
-                           or (h.ready and not h.draining))),
+                           or (h.ready and not h.draining))
+                       and (role is None or h.role == role
+                            or h.role == "unified")),
                       key=lambda h: (h.draining, h.load))
+
+    def _route_role(self, req: RoutedRequest) -> str | None:
+        """The pool req's current stage targets — None (any replica) for
+        the base router; the DisaggRouter answers per stage."""
+        return None
+
+    def _enqueue_body(self, req: RoutedRequest, force: bool) -> dict:
+        """The /enqueue POST body — the DisaggRouter stamps prefill_only
+        on stage-1 sends."""
+        return {"rid": req.rid, "prompt": req.prompt,
+                "max_new_tokens": req.max_new_tokens,
+                "trace_id": req.trace_id, "force": force,
+                "router": self._rid_ns}
+
+    def _failover_site(self, req: RoutedRequest) -> str:
+        """The chaos site guarding this request's failover re-enqueue —
+        the DisaggRouter distinguishes a dead PREFILL replica
+        (serve.prefill_dead) from a dead decode/unified one."""
+        return "serve.replica_dead"
 
     def _try_route(self, req: RoutedRequest, force: bool) -> str:
         """One routing attempt over the candidate list, least-loaded
@@ -375,7 +431,8 @@ class Router:
         work that must stay pending and route next tick), or "declined"
         (every candidate is saturated: an admission answer)."""
         faulted = False
-        cands = self._candidates(include_draining=force)
+        cands = self._candidates(include_draining=force,
+                                 role=self._route_role(req))
         if req.last_faulted:
             # an earlier send to this replica faulted mid-wire and may
             # have landed: retry it first (stable sort keeps least-loaded
@@ -401,11 +458,8 @@ class Router:
                 self._count("route_faults")
                 faulted = True
                 break           # stays pending; routed next tick
-            code, body = self._post(h.endpoint, "/enqueue", {
-                "rid": req.rid, "prompt": req.prompt,
-                "max_new_tokens": req.max_new_tokens,
-                "trace_id": req.trace_id, "force": force,
-                "router": self._rid_ns})
+            code, body = self._post(h.endpoint, "/enqueue",
+                                    self._enqueue_body(req, force))
             req.attempts += 1
             if code == 200 and body.get("ok"):
                 req.replica = h.id
@@ -470,7 +524,7 @@ class Router:
                             int(max_new_tokens), trace_id=0)
         self._next_rid += 1
         req.trace_id = self.slo.on_enqueue(req.rid)
-        cand = self._candidates()
+        cand = self._candidates(role=self._route_role(req))
         if not cand:
             self.slo.on_reject(req.rid)
             self._count("rejected")
@@ -773,18 +827,36 @@ class ServingFleet:
     The kill drill's and serving_bench's harness: every replica builds
     identical weights from `spec` (see replica.build_batcher), logs to
     <root>/<name>.log, and is reaped on shutdown. ``kill()`` SIGKILLs one
-    replica (death is detected by lease expiry, nothing is told)."""
+    replica (death is detected by lease expiry, nothing is told).
+
+    Disaggregation (ISSUE 11): ``n_prefill > 0`` spawns a MIXED fleet —
+    the first ``n_prefill`` replicas run ``--role prefill`` (the prompt
+    pool) and the remaining ``n - n_prefill`` run ``--role decode``;
+    ``router()`` then returns a ``DisaggRouter`` that drives the
+    two-stage lifecycle. ``n_prefill == 0`` (default) spawns the classic
+    unified fleet, byte-identical to the pre-disagg behavior."""
 
     def __init__(self, n: int, spec: dict, root: str,
                  job_id: str = "serve-fleet", ttl: float = 1.5,
-                 host: str = "127.0.0.1", env: dict | None = None):
+                 host: str = "127.0.0.1", env: dict | None = None,
+                 n_prefill: int = 0):
         self.spec = dict(spec)
         self.root, self.job_id, self.ttl, self.host = root, job_id, ttl, host
         self.registry = FileRegistry(root, job_id, ttl=ttl)
         self._env = {**os.environ, **(env or {})}
         self._procs: dict[str, subprocess.Popen] = {}
         self._logs: dict[str, str] = {}
+        self.n_prefill = int(n_prefill)
+        if not 0 <= self.n_prefill <= n:
+            raise ValueError(f"n_prefill={n_prefill} outside [0, {n}]")
+        if self.n_prefill == n and n > 0:
+            raise ValueError("an all-prefill fleet can never stream "
+                             "tokens — leave at least one decode replica")
         self._names = [f"r{i}" for i in range(n)]
+        self._roles = {name: ("prefill" if self.n_prefill and i < self.n_prefill
+                              else "decode" if self.n_prefill
+                              else "unified")
+                       for i, name in enumerate(self._names)}
 
     def start(self, timeout: float = 60.0) -> "ServingFleet":
         for name in self._names:
@@ -796,11 +868,13 @@ class ServingFleet:
         log_path = os.path.join(self.root, f"{name}.log")
         self._logs[name] = log_path
         log = open(log_path, "w")
+        role = self._roles.get(name, "unified")
         proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.inference.replica",
              "--name", name, "--spec", json.dumps(self.spec),
              "--registry-root", self.root, "--job-id", self.job_id,
-             "--ttl", str(self.ttl), "--host", self.host],
+             "--ttl", str(self.ttl), "--host", self.host,
+             "--role", role],
             stdout=log, stderr=subprocess.STDOUT, cwd=_REPO_ROOT,
             env=self._env)
         log.close()  # the child holds the fd
@@ -835,6 +909,11 @@ class ServingFleet:
             return "<no log>"
 
     def router(self, **kw) -> Router:
+        if self.n_prefill > 0:
+            # lazy import: disagg.coordinator subclasses Router, so a
+            # module-level import here would be a cycle
+            from .disagg.coordinator import DisaggRouter
+            return DisaggRouter(self.registry, **kw)
         return Router(self.registry, **kw)
 
     def kill(self, name: str, sig: int = 9):

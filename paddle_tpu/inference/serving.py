@@ -107,6 +107,12 @@ class ServedRequest:
     done: bool = False
     reason: str = "complete"   # how it retired (complete/shed/chaos ...)
     trace_id: int | None = None
+    # disaggregated serving (ISSUE 11): a prefill_only request retires
+    # right after its first token with its pages PARKED for export
+    # (reason "prefilled"); a kv_import request skips prefill entirely —
+    # its pages arrive as a transfer blob installed at admit time
+    prefill_only: bool = False
+    kv_import: dict | None = None
 
 
 class ContinuousBatcher:
@@ -288,6 +294,14 @@ class ContinuousBatcher:
 
         self._queue: deque[ServedRequest] = deque()
         self._finished: dict[int, ServedRequest] = {}
+        # disagg (ISSUE 11): pages parked between a prefill_only retire
+        # and their export (rid -> {"pages", "tlen", "first"}); and the
+        # aggregate page demand of QUEUED kv_import requests — the number
+        # the /kv_transfer pool-pressure gate subtracts from free_pages
+        # (plain int reads are atomic, so the HTTP handler thread may read
+        # it lock-free the way it reads queue length)
+        self._parked: dict[int, dict] = {}
+        self._queued_kv_pages = 0
         self._next_rid = 0
         self._admin = None  # live admin endpoint (start_admin)
         # SLO-aware admission (ISSUE 9): when a policy is installed,
@@ -321,7 +335,9 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------- intake
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
-                    trace_id: int | None = None, force: bool = False) -> int:
+                    trace_id: int | None = None, force: bool = False,
+                    prefill_only: bool = False,
+                    kv_import: dict | None = None) -> int:
         """Enqueue one request. Budget violations are rejected HERE, at
         enqueue time — an over-budget request must never be admitted and
         then silently truncated (or, paged, wedge the queue forever waiting
@@ -329,13 +345,34 @@ class ContinuousBatcher:
         installed, overload is rejected here too (AdmissionReject with a
         computed retry_after_s) unless ``force`` (router failover: already-
         accepted work must land somewhere). ``trace_id`` lets a router
-        carry ONE trace id across replica retries."""
+        carry ONE trace id across replica retries.
+
+        Disaggregation (ISSUE 11): ``prefill_only`` runs the prompt pass
+        and retires after the first token with the live pages parked for
+        :meth:`export_kv` (reason ``"prefilled"``; a request whose budget
+        or an immediate EOS needs no decode retires ``"complete"`` — no
+        pages park). ``kv_import`` takes a transfer blob instead: no
+        prefill runs, the pages install at admit time and decode resumes
+        from the blob's first token. Both need the paged pool."""
         # validation BEFORE admission: a never-admissible request must
         # fail loudly (ValueError) even while draining or over cap — a
         # retryable reject would have an honoring client resubmit the
         # impossible request forever
         prompt, max_new_tokens = self.check_admissible(prompt_ids,
                                                        max_new_tokens)
+        if (prefill_only or kv_import is not None) \
+                and self._layout != "paged":
+            raise ValueError("disaggregated serving (prefill_only / "
+                             "kv_import) needs the paged pool — the dense "
+                             "slot cache has no transferable page unit")
+        if prefill_only and kv_import is not None:
+            raise ValueError("a request is prefill_only OR kv_import, "
+                             "not both")
+        if kv_import is not None \
+                and int(kv_import.get("tlen", -1)) != len(prompt):
+            raise ValueError(
+                f"kv_import blob holds {kv_import.get('tlen')} prompt "
+                f"positions, request prompt has {len(prompt)}")
         if self._draining and not force:
             # drain protocol: finish what was admitted, reject new admits
             _admission_reject("draining", retry_after_floor())
@@ -347,12 +384,29 @@ class ContinuousBatcher:
                                   hists=slo_hists)
         rid = self._next_rid
         self._next_rid += 1
-        req = ServedRequest(rid, prompt, max_new_tokens)
+        req = ServedRequest(rid, prompt, max_new_tokens,
+                            prefill_only=bool(prefill_only),
+                            kv_import=kv_import)
         self._queue.append(req)
+        self._kv_acct(req, +1)
         metrics.counter("serve.requests").inc()
         # trace id issued (or adopted from the router); queue-wait starts
         req.trace_id = self.slo.on_enqueue(rid, trace_id=trace_id)
         return rid
+
+    def _kv_acct(self, req: ServedRequest, sign: int) -> None:
+        """Track the aggregate page demand of QUEUED kv_import requests
+        (+1 on enqueue/re-queue, -1 when one leaves the queue by any
+        exit) — what the replica's /kv_transfer pool-pressure gate
+        subtracts from free_pages so accepted-but-unadmitted transfers
+        still count against the pool."""
+        if req.kv_import is not None:
+            self._queued_kv_pages += sign * pages_for(len(req.prompt),
+                                                      self._ps)
+
+    @property
+    def queued_kv_pages(self) -> int:
+        return self._queued_kv_pages
 
     def check_admissible(self, prompt_ids,
                          max_new_tokens: int = 32) -> tuple[list, int]:
@@ -499,6 +553,7 @@ class ContinuousBatcher:
         metrics.counter("serve.tokens_discarded").inc(len(req.out))
         req.out = []
         self._queue.appendleft(req)
+        self._kv_acct(req, +1)   # a re-queued kv_import demands pages again
         self._retire_slot(slot)
         self.stats["preemptions"] += 1
         metrics.counter("serve.preemptions").inc()
@@ -577,23 +632,127 @@ class ContinuousBatcher:
         self.stats["decode_steps"] += self.burst
         return old_pos, pos_d, tok_d, done_d, emitted_d
 
+    def _install_admit(self, req: ServedRequest, slot: int) -> int:
+        """Admit a kv_import request: allocate its live pages, write the
+        transfer blob into the pool (models.llama_paged.scatter_pages —
+        host-side, once per request), and set the slot decoding from the
+        blob's first token. Returns the first token. The caller has
+        already popped the request and burned its chaos/slo admission
+        edges."""
+        from .disagg.transfer import install_pages
+        tlen = len(req.prompt)
+        need = pages_for(tlen, self._ps)
+        pages = self._alloc.alloc(need)
+        try:
+            self._cache = install_pages(self._cache, self._cfg, pages,
+                                        req.kv_import, self._kv_dtype)
+        except Exception:
+            # nothing slot-side was mutated yet: return the pages and let
+            # the caller turn this into a terminal error result — a bad
+            # blob must cost ONE request, never the serve loop
+            self._alloc.free(pages)
+            raise
+        first = int(req.kv_import["first"])
+        self._page_tbl[slot] = pages
+        self._slot_req[slot] = req
+        self._admit_seq[slot] = self._seq = self._seq + 1
+        # decode resumes EXACTLY where the prefill replica stopped: the
+        # first token is already delivered (it rides the blob), so the
+        # slot state matches a local prefill's post-first-token state
+        req.out = [first]
+        self._pos[slot] = tlen
+        self._tok[slot] = first
+        self._done[slot] = False
+        self._limit[slot] = min(tlen + req.max_new_tokens - 1, self.S - 1)
+        metrics.counter("serve.kv_installed").inc()
+        self.slo.on_first_token(req.rid)
+        return first
+
+    def _admit_kv_import(self, req: ServedRequest, slot: int) -> int | None:
+        """The ONE kv_import admit epilogue (gather and ragged paths
+        share it): install-or-terminal-error, stat bump, and the
+        immediate retire when the transferred first token already
+        satisfies the budget (or ended the stream). Returns the first
+        token while the slot decodes on, None when the request retired
+        here (installed fine but needed no decode, or the install failed
+        as ONE terminal error result — never a dead serve loop)."""
+        try:
+            first = self._install_admit(req, slot)
+        except Exception as e:
+            self._finish(req, reason=f"error: install: "
+                                     f"{type(e).__name__}: {e}")
+            return None
+        self.stats["kv_installs"] = self.stats.get("kv_installs", 0) + 1
+        if req.max_new_tokens <= 1 or first == self.eos_id:
+            # mirror the local prefill's immediate retire
+            self._finish(req)
+            self._retire_slot(slot)
+            return None
+        return first
+
+    def _park_or_finish(self, slot: int, req: ServedRequest) -> None:
+        """The ONE retire decision for a slot whose request just finished:
+        a prefill_only request that still needs decode (budget left, no
+        EOS) parks its live pages for export and retires ``"prefilled"``;
+        everything else retires ``"complete"`` and frees. Parked pages
+        stay allocated until :meth:`export_kv` / :meth:`drop_parked`."""
+        if req.prefill_only and len(req.out) == 1 \
+                and req.out[0] != self.eos_id and req.max_new_tokens > 1:
+            tlen = len(req.prompt)
+            keep = pages_for(tlen, self._ps)
+            pages = self._page_tbl[slot]
+            self._parked[req.rid] = {"pages": pages[:keep], "tlen": tlen,
+                                     "first": req.out[0]}
+            # anything past the live pages (bucket pad) frees with the
+            # slot; the parked slice is now owned by the export table
+            self._page_tbl[slot] = pages[keep:]
+            self._finish(req, reason="prefilled")
+            self._retire_slot(slot)
+            metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+            return
+        self._finish(req)
+        self._retire_slot(slot)
+
     def _admit_paged(self):
         """Pop + bucket + allocate + dispatch prefills — all host work that
         OVERLAPS the in-flight burst. Admission is gated by free pages (and
-        a free slot), never by a worst-case length reservation. Returns the
-        staged list; nothing blocks here."""
+        a free slot), never by a worst-case length reservation. Returns
+        (staged, installed); nothing blocks here except a kv_import
+        install's pool writes (once per transferred request)."""
         from ..models.llama_paged import llama_paged_prefill_slot
         staged = []  # (req, slot, tlen, first_device_scalar)
+        installed = []  # (req, slot, tlen, first) — kv_import admits
         stalled = False
         while self._queue and None in self._slot_req:
             req = self._queue[0]
             tlen = len(req.prompt)
+            if req.kv_import is not None:
+                need = pages_for(tlen, self._ps)
+                if self._alloc.free_pages < need:
+                    stalled = True
+                    break
+                self._queue.popleft()
+                self._kv_acct(req, -1)
+                try:
+                    chaos.hit("serve.admit")
+                except chaos.ChaosError:
+                    self.stats["chaos_retired"] += 1
+                    metrics.counter("serve.chaos_retired").inc()
+                    self._finish(req, reason="chaos serve.admit")
+                    continue
+                self.slo.on_admit(req.rid)
+                slot = self._slot_req.index(None)
+                first = self._admit_kv_import(req, slot)
+                if first is not None:
+                    installed.append((req, slot, tlen, first))
+                continue
             tb = self._bucket_len(tlen)
             bucket_pages = pages_for(tb, self._ps)
             if self._alloc.free_pages < bucket_pages:
                 stalled = True  # stays queued; pages free as slots retire
                 break
             self._queue.popleft()
+            self._kv_acct(req, -1)
             try:
                 chaos.hit("serve.admit")
             except chaos.ChaosError:
@@ -628,7 +787,7 @@ class ContinuousBatcher:
             self.stats["admission_stalls"] += 1
             metrics.counter("serve.admission_stalls").inc()
         metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
-        return staged
+        return staged, installed
 
     def _drain_burst(self, old_pos, done, emitted, skip=frozenset()) -> int:
         """The ONE burst drain loop (dense, gather-paged and ragged steps
@@ -648,21 +807,25 @@ class ContinuousBatcher:
             total += n_new
             self.slo.on_tokens(req.rid, n_new)
             if done[slot]:
-                self._finish(req)
-                self._retire_slot(slot)
+                self._park_or_finish(slot, req)
         return total
 
-    def _sync_merge_paged(self, inflight, staged) -> int:
+    def _sync_merge_paged(self, inflight, staged, installed=()) -> int:
         """THE one blocking point per step: a single device_get covering
         the burst readback and every staged first token, then pure host
-        bookkeeping (drain outputs, retire, install admissions)."""
-        if inflight is None and not staged:
+        bookkeeping (drain outputs, retire, install admissions).
+        ``installed`` holds this step's kv_import admits — their slot
+        state was set at admit time (no prefill ran) and is re-applied
+        after the readback copy, which is the device's STALE view of those
+        slots."""
+        if inflight is None and not staged and not installed:
             return 0
         burst_vals, firsts = jax.device_get(
             (inflight[1:] if inflight else (),
              [f for *_, f in staged]))
         emitted_total = 0
-        staged_slots = {s for _, s, _, _ in staged}
+        staged_slots = {s for _, s, _, _ in staged} \
+            | {s for _, s, _, _ in installed}
         if inflight:
             old_pos = inflight[0]
             pos, tok, done, emitted = burst_vals
@@ -674,14 +837,24 @@ class ContinuousBatcher:
             emitted_total += self._drain_burst(old_pos, done,
                                                np.asarray(emitted),
                                                skip=staged_slots)
+        for req, slot, tlen, first in installed:
+            # state set by _install_admit, clobbered by the readback copy
+            # above when a burst was in flight — re-apply; the blob's
+            # first token is NOT a local emission (the prefill replica
+            # already delivered it), so emitted_total skips it
+            self._pos[slot] = tlen
+            self._tok[slot] = first
+            self._done[slot] = False
+            self._limit[slot] = min(tlen + req.max_new_tokens - 1,
+                                    self.S - 1)
         for (req, slot, tlen, _), first in zip(staged, firsts):
             first = int(first)
             req.out.append(first)
             emitted_total += 1
             self.slo.on_first_token(req.rid)
-            if req.max_new_tokens <= 1 or first == self.eos_id:
-                self._finish(req)
-                self._retire_slot(slot)
+            if req.max_new_tokens <= 1 or first == self.eos_id \
+                    or req.prefill_only:
+                self._park_or_finish(slot, req)
                 continue
             self._pos[slot] = tlen
             self._tok[slot] = first
@@ -711,6 +884,7 @@ class ContinuousBatcher:
                 stalled = True  # stays queued; pages free as slots retire
                 break
             self._queue.popleft()
+            self._kv_acct(req, -1)
             try:
                 chaos.hit("serve.admit")
             except chaos.ChaosError:
@@ -721,6 +895,11 @@ class ContinuousBatcher:
                 continue
             self.slo.on_admit(req.rid)
             slot = self._slot_req.index(None)
+            if req.kv_import is not None:
+                # transferred pages install now; the slot joins THIS
+                # burst's decode rows (new_lens stays 0 — no prefill)
+                self._admit_kv_import(req, slot)
+                continue
             self._page_tbl[slot] = self._alloc.alloc(need)
             self._slot_req[slot] = req
             self._admit_seq[slot] = self._seq = self._seq + 1
@@ -730,8 +909,12 @@ class ContinuousBatcher:
             self._pos[slot] = tlen
             self._tok[slot] = self.pad_id
             self._done[slot] = False
-            self._limit[slot] = min(tlen + req.max_new_tokens - 1,
-                                    self.S - 1)
+            # a prefill_only slot stops at its first token: limit == tlen
+            # makes the in-burst prefill mark it done before any decode
+            # step emits, so the burst's scan adds nothing to its output
+            self._limit[slot] = (tlen if req.prefill_only
+                                 else min(tlen + req.max_new_tokens - 1,
+                                          self.S - 1))
             self.stats["prefills"] += 1
             staged.append((req, slot, tlen))
         if stalled:
@@ -865,8 +1048,8 @@ class ContinuousBatcher:
         elif self._layout == "paged":
             t0 = _slo.now()  # the sanctioned request-timing clock (lint O4)
             inflight = self._dispatch_burst_paged()
-            staged = self._admit_paged()
-            emitted = self._sync_merge_paged(inflight, staged)
+            staged, installed = self._admit_paged()
+            emitted = self._sync_merge_paged(inflight, staged, installed)
             dt = _slo.now() - t0
             metrics.histogram("serve.burst_time_s").observe(dt)
             if emitted and dt > 0:
@@ -954,6 +1137,7 @@ class ContinuousBatcher:
         shed = []
         while n > 0 and self._queue:
             req = self._queue.pop()   # newest-queued first
+            self._kv_acct(req, -1)
             req.out = []
             self.stats["shed"] = self.stats.get("shed", 0) + 1
             metrics.counter("serve.shed").inc()
@@ -961,6 +1145,81 @@ class ContinuousBatcher:
             shed.append(req)
             n -= 1
         return shed
+
+    # --------------------------------------------- disagg export (ISSUE 11)
+    @property
+    def page_size(self) -> int:
+        """The paged pool's page size (the transfer-geometry read the
+        replica's /kv_transfer pressure gate needs)."""
+        if self._layout != "paged":
+            raise ValueError("dense layout has no pages")
+        return self._ps
+
+    @property
+    def parked_count(self) -> int:
+        return len(self._parked)
+
+    def check_kv_blob(self, blob: dict) -> int:
+        """Raise ValueError when a transfer blob cannot fit THIS pool
+        (wire version, layer/head/page geometry, or no pool at all — the
+        dense layout must answer the boundary's 400, not an
+        AttributeError-turned-500 the router reads as a handler bug) —
+        the /kv_transfer boundary's 400 check, so spec drift between
+        pools is refused at the wire instead of surfacing inside the
+        serve loop. Returns the blob's page count. Reads only immutable
+        engine config."""
+        if self._layout != "paged":
+            raise ValueError("this replica serves the dense slot cache — "
+                             "it has no page pool to install a transfer "
+                             "into")
+        from .disagg.transfer import check_blob_geometry
+        return check_blob_geometry(blob, self._cfg, self._ps)
+
+    def export_kv(self, rid: int, scale_gran: str | None = None) -> dict:
+        """Serialize a prefilled request's parked pages into the transfer
+        wire blob (disagg.transfer) and FREE them — the export is the
+        parked pages' one exit besides :meth:`drop_parked`. Must run on
+        the thread that owns the batcher (the replica serve loop calls it
+        from its collect pass). ``scale_gran`` defaults to
+        PADDLE_SERVE_KV_SCALE_GRAN."""
+        from ..quant.codec import normalize_scale_gran
+        from .disagg.transfer import serialize_pages
+        # parse the granularity BEFORE taking ownership of the pages: a
+        # typo'd knob must raise without orphaning the parked allocation
+        if scale_gran is None:
+            from ..utils import env_flags
+            scale_gran = env_flags.get("PADDLE_SERVE_KV_SCALE_GRAN")
+        scale_gran = normalize_scale_gran(scale_gran)
+        entry = self._parked.pop(rid, None)
+        if entry is None:
+            raise KeyError(f"no parked pages for rid {rid} (exported "
+                           "already, dropped, or never prefill_only)")
+        try:
+            blob = serialize_pages(self._cfg, self._cache, entry["pages"],
+                                   entry["tlen"], entry["first"],
+                                   self._kv_dtype, scale_gran)
+        finally:
+            # pages free WHATEVER serialization did — a failed export must
+            # not leak pool capacity (the request re-prefills elsewhere)
+            self._alloc.free(entry["pages"])
+            metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+        metrics.counter("serve.kv_exported").inc()
+        return blob
+
+    def drop_parked(self, rid: int | None = None) -> int:
+        """Free parked pages without exporting (rid None = all) — the
+        cleanup exit when the prefilled result was never collected.
+        Returns how many entries were dropped."""
+        rids = ([rid] if rid is not None else list(self._parked))
+        n = 0
+        for r in rids:
+            entry = self._parked.pop(r, None)
+            if entry is not None:
+                self._alloc.free(entry["pages"])
+                n += 1
+        if n:
+            metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+        return n
 
     def take_finished(self) -> dict[int, ServedRequest]:
         """Drain the finished-request table (rid -> ServedRequest). The
@@ -982,6 +1241,11 @@ class ContinuousBatcher:
             "free_pages": (self._alloc.free_pages
                            if self._layout == "paged" else None),
             "pending": self.pending,
+            # disagg (ISSUE 11): the decode-pool pressure inputs — pages
+            # already promised to queued kv_import transfers, and pages
+            # held parked between a prefill and its export
+            "queued_kv_pages": self._queued_kv_pages,
+            "parked": len(self._parked),
         }
 
     # ------------------------------------------------------------- admin

@@ -60,7 +60,8 @@ from .llama_decode import _cached_attention_slots, _mlp, _qkv, _sample
 
 __all__ = ["init_paged_kv_cache", "llama_paged_prefill_slot",
            "llama_paged_decode_burst", "llama_ragged_burst",
-           "paged_kv_bytes_per_token", "page_bytes"]
+           "paged_kv_bytes_per_token", "page_bytes", "gather_pages",
+           "scatter_pages"]
 
 
 # ------------------------------------------------- quantized pages (ISSUE 10)
@@ -123,6 +124,50 @@ def init_paged_kv_cache(config: LlamaConfig, num_pages: int, page_size: int,
         "v_scale": tuple(jnp.zeros(sshape, SCALE_DTYPE)
                          for _ in range(c.num_hidden_layers)),
     }
+
+
+def gather_pages(cache, page_ids) -> dict:
+    """Host copies of the pool slices at ``page_ids`` — the EXPORT read of
+    the disaggregated page transfer (ISSUE 11). Returns {leaf name: [one
+    numpy array of shape [n_pages, ...] per layer]} covering every leaf
+    the pool has (payload pools always, scale pools when quantized). The
+    slices are taken in logical order, so index j of each array is logical
+    page j of the request — physical page ids never leave the process.
+    ONE device_get covers the whole structure (the slices dispatch async,
+    then a single batched readback) — an export runs on the serve-loop
+    thread between bursts, and per-leaf round trips would stretch the
+    prefill replica's inter-burst gap by 4·L sync latencies."""
+    import numpy as np
+    ids = jnp.asarray(np.asarray(page_ids, np.int32))
+    return jax.device_get({name: [buf[ids] for buf in bufs]
+                           for name, bufs in cache.items()})
+
+
+def scatter_pages(cache, page_ids, rows: dict) -> dict:
+    """Write transferred page rows into the pool at ``page_ids`` — the
+    INSTALL write of the disaggregated page transfer (inverse of
+    :func:`gather_pages`). ``rows`` maps leaf names to per-layer arrays of
+    shape [n_pages, ...]; leaves absent from ``rows`` keep their buffers
+    (a full-precision install never touches scale pools). Values are cast
+    to each buffer's dtype, so callers hand pool-format arrays (payload in
+    the wire dtype, scales f32) or full-precision rows for an unquantized
+    pool. Runs OUTSIDE jit (one ``.at[].set`` per layer per leaf) — an
+    install is a once-per-request event, not a per-step one."""
+    import numpy as np
+    ids = jnp.asarray(np.asarray(page_ids, np.int32))
+    out = {}
+    for name, bufs in cache.items():
+        if name not in rows:
+            out[name] = bufs
+            continue
+        if len(rows[name]) != len(bufs):
+            raise ValueError(
+                f"scatter_pages: {name} carries {len(rows[name])} layers, "
+                f"pool has {len(bufs)}")
+        out[name] = tuple(
+            buf.at[ids].set(jnp.asarray(r).astype(buf.dtype))
+            for buf, r in zip(bufs, rows[name]))
+    return out
 
 
 def _kv_row_head_bytes(config: LlamaConfig, kv_dtype: str | None) -> int:

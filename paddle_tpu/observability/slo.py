@@ -57,7 +57,7 @@ import time
 from . import metrics, recorder, spans
 
 __all__ = ["SloPolicy", "RequestTracker", "now", "bench_payload",
-           "HIST_TTFT", "HIST_TPOT", "HIST_QUEUE", "HIST_E2E"]
+           "HIST_TTFT", "HIST_TPOT", "HIST_QUEUE", "HIST_E2E", "STAGES"]
 
 ENV_TTFT = "PADDLE_SLO_TTFT_S"
 ENV_TPOT = "PADDLE_SLO_TPOT_S"
@@ -70,6 +70,18 @@ HIST_QUEUE = "slo.queue_wait_s"
 HIST_E2E = "slo.e2e_s"
 
 COUNTER_BREACH = "slo.breach"
+
+# disaggregated-serving stages (ISSUE 11): stage key -> (histogram, span
+# name). The DisaggRouter reports each lifecycle stage's duration through
+# RequestTracker.on_stage — durations fill the histogram immediately and
+# the span lands on the request's retire timeline next to req.queue /
+# req.prefill / req.decode, so a trace shows WHICH pool (or the wire) a
+# slow request spent its life in.
+STAGES = {
+    "prefill_pool": ("slo.prefill_pool_s", "req.prefill_pool"),
+    "transfer": ("slo.transfer_s", "req.transfer"),
+    "decode_pool": ("slo.decode_pool_s", "req.decode_pool"),
+}
 
 # process-wide: trace ids stay unique and monotonic across engine instances
 # (a serving process that rebuilds its batcher must not reissue ids)
@@ -131,7 +143,8 @@ class SloPolicy:
 
 class _Rec:
     __slots__ = ("trace_id", "t_enqueue", "t_admit", "t_first", "t_last",
-                 "t_requeued", "queue_s", "admitted", "preemptions", "spans")
+                 "t_requeued", "queue_s", "admitted", "preemptions", "spans",
+                 "stages")
 
     def __init__(self, trace_id, t_enqueue):
         self.trace_id = trace_id
@@ -144,6 +157,7 @@ class _Rec:
         self.admitted = False
         self.preemptions = 0
         self.spans = []  # (name, t0, t1) preempted attempts
+        self.stages = []  # (span name, t0, t1) disagg lifecycle stages
 
 
 class RequestTracker:
@@ -215,6 +229,21 @@ class RequestTracker:
             rec = self._recs.get(rid)
             if rec is not None:
                 rec.t_last = t
+
+    def on_stage(self, rid: int, stage: str, t0: float, t1: float):
+        """One disaggregated lifecycle stage finished (ISSUE 11): observe
+        its duration histogram (``slo.prefill_pool_s`` /
+        ``slo.transfer_s`` / ``slo.decode_pool_s``) NOW — stage latency
+        distributions must exist even for requests that later fail over —
+        and remember the span for the retire-time trace emit. Unknown
+        stages raise (a typo'd stage would silently build an empty
+        histogram)."""
+        hist, span_name = STAGES[stage]
+        metrics.histogram(hist).observe(max(0.0, t1 - t0))
+        with self._lk:
+            rec = self._recs.get(rid)
+            if rec is not None:
+                rec.stages.append((span_name, t0, t1))
 
     def on_preempt(self, rid: int):
         t = now()
@@ -305,6 +334,9 @@ class RequestTracker:
         for name, t0, t1 in rec.spans:  # preempted attempts
             spans.add_span(name, "request", t0, t1, rid=rid,
                            trace=rec.trace_id, preempted=True)
+        for name, t0, t1 in rec.stages:  # disagg lifecycle stages
+            spans.add_span(name, "request", t0, t1, rid=rid,
+                           trace=rec.trace_id)
 
     # ------------------------------------------------------------ summary
     def summary(self) -> dict:

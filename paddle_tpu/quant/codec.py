@@ -34,9 +34,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["MODES", "SCALE_DTYPE", "wire_dtype", "wire_itemsize",
-           "scale_itemsize", "quantize_lastdim", "dequantize_lastdim",
-           "normalize_kv_dtype"]
+__all__ = ["MODES", "SCALE_DTYPE", "SCALE_GRANS", "wire_dtype",
+           "wire_itemsize", "scale_itemsize", "quantize_lastdim",
+           "dequantize_lastdim", "normalize_kv_dtype",
+           "normalize_scale_gran"]
 
 # mode -> (payload dtype, qmax = largest representable magnitude on the grid)
 MODES = {
@@ -69,6 +70,30 @@ def normalize_kv_dtype(raw) -> str | None:
     if v not in MODES:
         raise ValueError(f"unknown kv_dtype {v!r} "
                          "(int8 | fp8 | bf16/'' for unquantized)")
+    return v
+
+
+# KV scale granularities for the disaggregated page-transfer wire
+# (ISSUE 11): "row" ships the pool's native per-(row, head) scales
+# verbatim (bit-exact transfer); "page" re-blocks to ONE scale per
+# (page, head) — ~page_size× fewer scale bytes on the wire, paid for with
+# a requantization pass whose accuracy cost is measured and pinned in
+# tests/test_disagg_serving.py. The POOL layout never changes — this is a
+# wire format, so both read paths and the ragged kernel are untouched.
+SCALE_GRANS = ("row", "page")
+
+
+def normalize_scale_gran(raw) -> str:
+    """The ONE parser for the PADDLE_SERVE_KV_SCALE_GRAN knob: ''/None
+    mean the default "row"; anything else must name a granularity — a
+    typo'd knob must not silently ship the fat wire the operator believes
+    they shrank."""
+    v = (raw or "").strip().lower()
+    if not v:
+        return "row"
+    if v not in SCALE_GRANS:
+        raise ValueError(f"unknown KV scale granularity {v!r} "
+                         f"(one of {SCALE_GRANS})")
     return v
 
 

@@ -273,6 +273,26 @@ declare("PADDLE_SERVE_RESULTS_KEEP", "4096",
         "(prefix truncated past it, cursors stay monotone; 0 = unbounded; "
         "draining replicas never truncate)")
 
+# ---------------------------------------------------- disaggregated serving
+
+declare("PADDLE_SERVE_DISAGG", "0",
+        "'1' runs benchmarks/serving_bench.py's disaggregated-fleet drill "
+        "(prefill + decode pools behind a DisaggRouter) and populates the "
+        "bench line's disagg sub-object")
+declare("PADDLE_SERVE_ROLE", "",
+        "this replica's pool role: 'prefill' | 'decode' | 'unified' "
+        "(empty = unified, the single-pool pre-disagg replica)")
+declare("PADDLE_SERVE_PREFILL_REPLICAS", "2",
+        "prefill-pool size for the serving_bench disagg drill (decode "
+        "pool = PADDLE_SERVE_REPLICAS - this, min 2 each)")
+declare("PADDLE_SERVE_KV_SCALE_GRAN", "",
+        "KV-page transfer wire scale granularity: 'row' (per-(row, head) "
+        "pool scales verbatim — bit-exact, default) | 'page' (one scale "
+        "per (page, head): ~page_size x fewer scale bytes, requantized)")
+declare("PADDLE_SERVE_XFER_TIMEOUT_S", "15",
+        "HTTP timeout for a KV page-transfer POST (/kv_transfer ships "
+        "megabytes where a health probe ships a doc)")
+
 # ------------------------------------------------------------------- misc
 
 declare("PADDLE_EXTENSION_DIR", "<tempdir>/paddle_tpu_extensions",

@@ -308,6 +308,7 @@ class TestRaggedBenchContract:
         from benchmarks import serving_bench
         monkeypatch.setenv("SERVING_TRAIN_STEPS", "0")
         monkeypatch.delenv("PADDLE_SERVE_REPLICAS", raising=False)
+        monkeypatch.delenv("PADDLE_SERVE_DISAGG", raising=False)
         monkeypatch.setattr(sys, "argv", ["serving_bench.py", "2", "3", "4"])
         rc = serving_bench.main()
         out = capsys.readouterr().out
@@ -315,8 +316,11 @@ class TestRaggedBenchContract:
         doc = json.loads(line)
         assert rc == 0
         # single-process run: the ISSUE-9 fleet sub-object is null (the
-        # populated schema is pinned in tests/test_serving_fleet.py)
+        # populated schema is pinned in tests/test_serving_fleet.py), and
+        # so is the ISSUE-11 disagg sub-object (populated schema pinned
+        # in tests/test_disagg_serving.py)
         assert doc["fleet_serve"] is None
+        assert doc["disagg"] is None
         r = doc["ragged"]
         assert set(r) >= {"tokens_per_sec", "kv_read_bytes_per_token",
                           "hbm_roofline_bytes_per_token", "executables",
