@@ -239,13 +239,37 @@ class KVServer:
     DELETE /hb/<node>; durable (no-TTL) re-rendezvous state under
     PUT/GET/DELETE /kv/<key>, PUT /kvmax/<key> (atomic max-CAS, body = int,
     response = winning value) and GET /kvlist/<prefix> (JSON dict).
+
+    Replication (ISSUE 12): every durable entry carries a per-key VERSION
+    ``(vn, writer)`` so N peers driven by the quorum client
+    (``fleet.replicated_kv``) converge by last-writer-wins instead of
+    diverging. Versioned protocol, all backward compatible with the plain
+    single-master client:
+
+      * PUT /kv/<key> accepts optional ``X-Paddle-KV-Ver`` /
+        ``X-Paddle-KV-Writer`` headers — the write applies only when its
+        version exceeds the stored one (equal = idempotent re-accept);
+        the JSON response reports ``{"applied", "ver", "writer"}``.
+        Without the headers the server bumps the version locally (the
+        pre-replication behavior, byte-identical for one master).
+      * GET /kv/<key> answers the stored version in the same headers;
+        GET /kvlist/<prefix>?v=1 answers ``{key: [value, vn, writer]}``.
+      * GET /info/<node> answers the heartbeat wall time in
+        ``X-Paddle-HB-TS`` so a quorum read can pick the freshest lease.
+      * GET /dump + PUT /load move a whole-store snapshot — a restarted
+        peer catches up from a majority snapshot (``kvmax`` keys merge by
+        numeric max, never by version: the counter is monotone by VALUE).
     """
 
     def __init__(self, port: int = 0, ttl: float = 10.0):
         store: dict = {}
-        kv: dict = {}  # durable: generation counter, enrollments, assignments
+        # durable: generation counter, enrollments, assignments —
+        # key -> (value, vn, writer)
+        kv: dict = {}
+        maxkeys: set = set()  # keys written through /kvmax (merge by value)
         lock = threading.Lock()
         self._store, self._kv, self._lock, self.ttl = store, kv, lock, ttl
+        self._maxkeys = maxkeys
         ttl_ref = self
 
         class H(BaseHTTPRequestHandler):
@@ -277,9 +301,46 @@ class KVServer:
                         store[node] = (time.time(), info.decode() or "{}")
                     return self._send(200)
                 if self.path.startswith("/kv/"):
+                    key = self.path[4:]
+                    val = self._body().decode()
+                    hdr_vn = self.headers.get("X-Paddle-KV-Ver")
+                    writer = self.headers.get("X-Paddle-KV-Writer", "")
                     with lock:
-                        kv[self.path[4:]] = self._body().decode()
-                    return self._send(200)
+                        _, cur_vn, cur_w = kv.get(key, ("", 0, ""))
+                        if hdr_vn is None:
+                            # unversioned (single-master) write: local bump
+                            vn, applied = cur_vn + 1, True
+                        else:
+                            try:
+                                vn = int(hdr_vn)
+                            except ValueError:
+                                return self._send(400)
+                            # last-writer-wins by (vn, writer); an equal
+                            # version re-accepts idempotently (a quorum
+                            # client retrying its own write), an older one
+                            # is stale and must not regress the key
+                            applied = (vn, writer) >= (cur_vn, cur_w)
+                        if applied:
+                            if key in maxkeys:
+                                # monotone guard: a kvmax counter's value
+                                # order is authoritative — per-peer
+                                # versions are bumped independently, so a
+                                # version-ordered read-repair could
+                                # otherwise write a LOWER committed value
+                                # over a higher one and regress the
+                                # generation fleet-wide
+                                old, _, _ = kv.get(key, ("", 0, ""))
+                                try:
+                                    val = str(max(int(val or 0),
+                                                  int(old or 0)))
+                                except ValueError:
+                                    pass
+                            kv[key] = (val, vn, writer)
+                        else:
+                            vn, writer = cur_vn, cur_w
+                    return self._send(200, json.dumps(
+                        {"applied": applied, "ver": vn,
+                         "writer": writer}).encode())
                 if self.path.startswith("/kvmax/"):
                     key = self.path[7:]
                     try:
@@ -287,13 +348,23 @@ class KVServer:
                     except ValueError:
                         return self._send(400)
                     with lock:  # the lock IS the CAS: read-max-write is atomic
+                        old, cur_vn, _ = kv.get(key, ("", 0, ""))
                         try:
-                            cur = int(kv.get(key) or 0)
+                            cur = int(old or 0)
                         except ValueError:
                             cur = 0
                         new = max(cur, val)
-                        kv[key] = str(new)
+                        kv[key] = (str(new), cur_vn + 1, "")
+                        maxkeys.add(key)
                     return self._send(200, str(new).encode())
+                if self.path == "/load":
+                    # snapshot install (peer catch-up): merge, never clobber
+                    try:
+                        snap = json.loads(self._body().decode() or "{}")
+                    except ValueError:
+                        return self._send(400)
+                    ttl_ref.load_snapshot(snap)
+                    return self._send(200)
                 self._send(404)
 
             def do_DELETE(self):
@@ -310,27 +381,53 @@ class KVServer:
                 self._send(404)
 
             def do_GET(self):
-                if self.path.startswith("/kv/"):
+                path, _, query = self.path.partition("?")
+                if path.startswith("/kv/"):
                     with lock:
-                        v = kv.get(self.path[4:])
-                    if v is None:
+                        rec = kv.get(path[4:])
+                    if rec is None:
                         return self._send(404)
-                    return self._send(200, v.encode())
-                if self.path.startswith("/kvlist/"):
-                    pfx = self.path[8:]
+                    val, vn, w = rec
+                    self.send_response(200)
+                    body = val.encode()
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("X-Paddle-KV-Ver", str(vn))
+                    self.send_header("X-Paddle-KV-Writer", w)
+                    self.end_headers()
+                    return self.wfile.write(body)
+                if path.startswith("/kvlist/"):
+                    pfx = path[8:]
+                    versioned = "v=1" in query.split("&")
                     with lock:
-                        out = {k: v for k, v in kv.items()
-                               if k.startswith(pfx)}
+                        if versioned:
+                            out = {k: list(rec) for k, rec in kv.items()
+                                   if k.startswith(pfx)}
+                        else:
+                            out = {k: rec[0] for k, rec in kv.items()
+                                   if k.startswith(pfx)}
                     return self._send(200, json.dumps(out).encode())
-                if self.path.startswith("/info/"):
-                    node = self.path[6:]
+                if path == "/dump":
+                    with lock:
+                        snap = {"hb": {n: list(rec)
+                                       for n, rec in store.items()},
+                                "kv": {k: list(rec)
+                                       for k, rec in kv.items()},
+                                "maxkeys": sorted(maxkeys)}
+                    return self._send(200, json.dumps(snap).encode())
+                if path.startswith("/info/"):
+                    node = path[6:]
                     with lock:
                         rec = store.get(node)
                     # same TTL contract as /nodes: stale entries are gone
                     if rec is None or time.time() - rec[0] > ttl_ref.ttl:  # observability: ok (wall-clock liveness TTL, not perf timing)
                         return self._send(404)
-                    return self._send(200, rec[1].encode())
-                if self.path != "/nodes":
+                    self.send_response(200)
+                    body = rec[1].encode()
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("X-Paddle-HB-TS", repr(rec[0]))
+                    self.end_headers()
+                    return self.wfile.write(body)
+                if path != "/nodes":
                     return self._send(404)
                 now = time.time()
                 with lock:
@@ -340,15 +437,43 @@ class KVServer:
 
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), H)
         self.port = self._httpd.server_address[1]
+        self._started = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
 
+    def load_snapshot(self, snap: dict):
+        """Merge one /dump snapshot into this store — hb by freshest ts,
+        kv by version, kvmax counters by VALUE. Callable BEFORE start():
+        a restarted peer is caught up while its port only queues
+        connections, so no client ever reads the blank pre-merge store."""
+        with self._lock:
+            for node, rec in (snap.get("hb") or {}).items():
+                ts, info = float(rec[0]), str(rec[1])
+                if ts > self._store.get(node, (0, ""))[0]:
+                    self._store[node] = (ts, info)
+            self._maxkeys.update(set(snap.get("maxkeys") or []))
+            for key, rec in (snap.get("kv") or {}).items():
+                val, vn, w = str(rec[0]), int(rec[1]), str(rec[2])
+                old, cur_vn, cur_w = self._kv.get(key, ("", 0, ""))
+                if key in self._maxkeys:
+                    try:
+                        if int(val or 0) > int(old or 0):
+                            self._kv[key] = (val, max(vn, cur_vn), w)
+                    except ValueError:
+                        pass
+                elif (vn, w) > (cur_vn, cur_w):
+                    self._kv[key] = (val, vn, w)
+
     def start(self):
+        self._started = True
         self._thread.start()
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._started:
+            # shutdown() handshakes with serve_forever — on a never-
+            # started server it would block forever
+            self._httpd.shutdown()
         self._httpd.server_close()
 
 

@@ -1,0 +1,802 @@
+"""Quorum-replicated KV registry — kill the last fleet SPOF (ISSUE 12).
+
+The single node-0 ``KVServer`` backs BOTH elastic re-rendezvous (ISSUE 4)
+and every serving-fleet lease (ISSUE 9/11): losing that one process lost
+the job AND the fleet. The reference framework delegates this to a
+replicated etcd (fleet/elastic/manager.py leases); etcd isn't vendored,
+so this module replicates the repo's own KV master instead:
+
+  * **peers** — N plain ``KVServer`` processes form a STATIC member set
+    (``PADDLE_KV_PEERS="h1:p1,h2:p2,h3:p3"``). No peer talks to another;
+    all coordination is client-driven (the classic quorum-register
+    construction), which keeps the server a dumb versioned store.
+  * **writes commit on majority ack** — heartbeats, ``kv_put`` (with
+    per-key ``(version, writer)`` ordering so concurrent writers converge
+    by last-writer-wins instead of diverging) and the ``kv_max`` CAS
+    (commutative: the max over any majority is the committed counter).
+    A client that can reach only a MINORITY refuses the write with a
+    typed :class:`NoQuorumError` — a partitioned leader can publish
+    nothing, so there is no split-brain rank assignment to adopt. (A
+    refused write may still have landed on a minority peer; any majority
+    read version-checks it away or the next committed write supersedes
+    it — the generation fencing above this layer absorbs the residue.)
+  * **reads are quorum reads with read-repair** — every read takes the
+    answer with the highest version (``kv_max`` keys: the highest VALUE)
+    over a majority of responses and repairs lagging peers in passing, so
+    one stale or freshly-restarted peer can never roll the fleet back.
+  * **client-side failover** — per-peer backoff (``resilience.retry``
+    jittered policies) keeps one dead peer from taxing every round;
+    a peer's up→down transition counts ``kv.failovers`` and flight-
+    records, and each committed quorum round observes ``kv.quorum_s``.
+  * **peer restart** — a restarted peer boots EMPTY (the store is
+    memory); :func:`catch_up` merges /dump snapshots from the surviving
+    peers into it BEFORE it serves, restoring the writes it had acked.
+    :class:`KVPeerSet` spawns and supervises an in-process peer set (the
+    launcher's multi-controller simulation): a dead peer is restarted on
+    its own port and caught up from a majority snapshot automatically.
+
+N=1 degrades to exactly the old topology: :func:`make_registry` returns
+the untouched single-endpoint :class:`~.elastic.KVRegistry`, byte-identical
+behavior to every pre-replication deployment.
+
+Chaos sites: ``kv.peer_down`` fails one peer's request inside a round
+(the quorum commits on the others), ``kv.partition`` fails one whole
+round (the op retries under its budget; a persistent partition exhausts
+it into ``NoQuorumError``). Both degrade, never diverge: chaos-on runs
+are bitwise-identical to fault-free ones.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+from ...observability import metrics as _metrics, recorder as _recorder
+from ..resilience import chaos
+from ..resilience.retry import RetryPolicy, TransientError
+from .elastic import KVRegistry, KVServer, _kv_token
+
+__all__ = ["NoQuorumError", "ReplicatedKVRegistry", "KVPeerSet",
+           "parse_peers", "make_registry", "catch_up", "fetch_snapshots",
+           "snapshot_coverage", "main"]
+
+# declared (defaults + docs) in utils/env_flags.py
+ENV_PEERS = "PADDLE_KV_PEERS"
+ENV_QUORUM_TIMEOUT = "PADDLE_KV_QUORUM_TIMEOUT_S"
+
+
+class NoQuorumError(TransientError):
+    """A registry op could not reach a MAJORITY of the peer set — this
+    client is (or straddles) a minority partition. Writes refuse rather
+    than diverge; the caller's existing retry/reform discipline owns
+    recovery (TransientError: a healed partition clears it)."""
+
+    def __init__(self, op: str, acks: int, needed: int, n_peers: int,
+                 last: BaseException | None = None):
+        self.op, self.acks, self.needed, self.n_peers = \
+            op, acks, needed, n_peers
+        tail = f" (last peer error: {type(last).__name__}: {last})" \
+            if last is not None else ""
+        super().__init__(
+            f"{op}: only {acks}/{n_peers} registry peers acked "
+            f"(majority {needed} required) — minority partition refuses "
+            f"to proceed{tail}")
+
+
+def parse_peers(raw) -> list[str]:
+    """Normalize a peer spec (comma string or list of host:port) into
+    base URLs. Order is the member-set identity — every client must be
+    constructed with the SAME list."""
+    if isinstance(raw, str):
+        raw = [p for p in (s.strip() for s in raw.split(",")) if p]
+    out = []
+    for ep in raw:
+        ep = str(ep).strip()
+        out.append(ep if ep.startswith("http") else f"http://{ep}")
+    if not out:
+        raise ValueError("empty KV peer list")
+    return out
+
+
+def make_registry(endpoints, ttl: float = 10.0, **kw):
+    """The registry for an endpoint spec: ONE endpoint → the untouched
+    single-master :class:`KVRegistry` (byte-identical N=1 behavior),
+    several (comma-separated or a list) → :class:`ReplicatedKVRegistry`.
+    An empty spec falls back to ``PADDLE_KV_PEERS``."""
+    if not endpoints:
+        endpoints = os.environ.get(ENV_PEERS, "")
+    peers = parse_peers(endpoints)
+    if len(peers) == 1:
+        ep = peers[0]
+        return KVRegistry(ep[len("http://"):] if ep.startswith("http://")
+                          else ep, ttl=ttl)
+    return ReplicatedKVRegistry(peers, ttl=ttl, **kw)
+
+
+class _Peer:
+    """Client-side view of one member: endpoint + backoff/health state.
+    All fields are guarded by the owning registry's ``_lk``."""
+
+    def __init__(self, base: str, policy: RetryPolicy):
+        self.base = base
+        self.policy = policy
+        self.delays = policy.delays()
+        self.up = True
+        self.next_ok = 0.0   # monotonic time before which rounds skip us
+        self.inflight = 0    # requests currently pending against us: a
+        #                      retry round must not stack duplicates on a
+        #                      slow peer (its slowness is the reason the
+        #                      round is retrying)
+
+
+class ReplicatedKVRegistry:
+    """reg = ReplicatedKVRegistry(["http://h1:p1", ...]); reg.heartbeat(...)
+
+    Same duck-type surface as FileRegistry/KVRegistry (heartbeat /
+    alive_nodes / leave / info / kv_put / kv_get / kv_del / kv_list /
+    kv_max / kv_counter + ``.ttl``), so ElasticManager, ReplicaServer and
+    Router switch transports without code changes. Thread-safe: the beat
+    thread, serve loops and rendezvous loops may share one instance.
+    """
+
+    def __init__(self, peers, ttl: float = 10.0, timeout: float = 2.0,
+                 quorum_timeout_s: float | None = None,
+                 backoff: RetryPolicy | None = None):
+        bases = parse_peers(peers)
+        if len(bases) != len(set(bases)):
+            raise ValueError(f"duplicate KV peers in {bases}")
+        if quorum_timeout_s is None:
+            from ...utils import env_flags
+            quorum_timeout_s = env_flags.get_float(ENV_QUORUM_TIMEOUT)
+        self.ttl = float(ttl)
+        self.timeout = float(timeout)
+        self.quorum_timeout = max(0.2, float(quorum_timeout_s))
+        # per-peer backoff: a dead peer is skipped for a jittered,
+        # growing window instead of taxing every round with its timeout
+        pol = backoff or RetryPolicy(max_attempts=0, base_delay=0.2,
+                                     max_delay=2.0, jitter=0.5)
+        self._lk = threading.Lock()
+        self._peers = [_Peer(b, pol) for b in bases]
+        self.n = len(self._peers)
+        self.majority = self.n // 2 + 1
+        # writer identity for version tie-breaks: unique per client, so
+        # two concurrent writers of one key converge on ONE winner
+        self._writer = uuid.uuid4().hex[:12]
+        _metrics.counter("kv.failovers")
+        _metrics.histogram("kv.quorum_s")
+
+    @property
+    def peers(self) -> list[str]:
+        return [p.base for p in self._peers]
+
+    # --------------------------------------------------------- plumbing
+    def _peer_call(self, peer: _Peer, path: str, method: str = "GET",
+                   data: bytes | None = None, headers: dict | None = None):
+        """ONE attempt against ONE peer → (status, body, headers).
+        Transport faults raise (the round counts the peer down); an HTTP
+        status is an ANSWER (404 = missing key, 403 = auth)."""
+        chaos.hit("kv.peer_down")
+        hdrs = {"X-Paddle-Job-Token": _kv_token()}
+        hdrs.update(headers or {})
+        req = urllib.request.Request(peer.base + path, method=method,
+                                     data=data, headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def _eligible(self) -> list[int]:
+        now = time.monotonic()
+        with self._lk:
+            idxs = [i for i, p in enumerate(self._peers)
+                    if now >= p.next_ok and not p.inflight]
+            if len(idxs) < self.majority:
+                # backoff must never make quorum impossible by itself:
+                # when too few peers are in-window, probe every peer that
+                # is not ALREADY being probed — a pending request may yet
+                # resolve, and stacking a duplicate on a slow peer only
+                # deepens the slowness the retry is waiting out
+                idxs = [i for i, p in enumerate(self._peers)
+                        if not p.inflight]
+        return idxs
+
+    def _mark(self, idx: int, ok: bool):
+        p = self._peers[idx]
+        with self._lk:
+            if ok:
+                if not p.up:
+                    _recorder.record("kv.peer_recovered", peer=p.base)
+                p.up = True
+                p.delays = p.policy.delays()
+                p.next_ok = 0.0
+                return
+            was_up = p.up
+            p.up = False
+            p.next_ok = time.monotonic() + next(p.delays)
+        if was_up:
+            # telemetry outside the lock: counters/recorder take their own
+            _metrics.counter("kv.failovers").inc()
+            _recorder.record(
+                "kv.peer_failover", echo=True,
+                message=f"[kv] registry peer {p.base} down — "
+                        f"failing over to the surviving quorum",
+                peer=p.base)
+
+    def _round(self, fn, op: str, wait_all: bool = False) -> dict:
+        """One fan-out over the eligible peers → {idx: result-or-exc}.
+        Chaos site ``kv.partition`` fails the WHOLE round (zero acks) —
+        the op's budget owns the retry, a persistent partition exhausts
+        it into NoQuorumError. ``wait_all`` waits for every launched
+        request instead of returning at the first majority — deletes
+        have no tombstones, so returning early would leave the key live
+        on a lagging peer for the next list-merge to resurrect."""
+        try:
+            chaos.hit("kv.partition")
+        except chaos.ChaosError as e:
+            return {i: e for i in range(self.n)}
+        idxs = self._eligible()
+        out: dict = {}
+        cv = threading.Condition()
+
+        def run(i):
+            try:
+                r = fn(self._peers[i])
+            except Exception as e:
+                r = e
+            # health is marked from the worker thread itself, so a
+            # straggler's verdict still lands (and arms its backoff)
+            # after the round has already returned on the fast majority
+            self._mark(i, not isinstance(r, Exception))
+            with self._lk:
+                self._peers[i].inflight -= 1
+            with cv:
+                out[i] = r
+                cv.notify()
+
+        with self._lk:
+            for i in idxs:
+                self._peers[i].inflight += 1
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in idxs]
+        for t in threads:
+            t.start()
+        # return as soon as a MAJORITY has acked: quorum latency follows
+        # the fastest majority, not the slowest peer — a blackholed/
+        # SIGSTOPped peer (accepts, never answers) must not stall every
+        # registry op to its timeout and lapse leases fleet-wide
+        deadline = time.monotonic() + self.timeout + 0.5
+        with cv:
+            while True:
+                acks = sum(1 for r in out.values()
+                           if not isinstance(r, Exception))
+                if len(out) == len(idxs) or \
+                        (not wait_all and acks >= self.majority):
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                cv.wait(min(left, 0.05))
+            snap = dict(out)
+        for i in idxs:
+            if i not in snap:
+                # still in flight: counts as no-answer for THIS round;
+                # its own thread marks health when it resolves
+                snap[i] = TimeoutError(f"{op}: peer still pending at "
+                                       "round close")
+        return snap
+
+    def _quorum(self, fn, op: str, budget: float | None = None) -> dict:
+        """Round until a MAJORITY of peers answered → {idx: result}.
+        Raises NoQuorumError when the budget expires first."""
+        t0 = time.monotonic()
+        budget = self.quorum_timeout if budget is None else budget
+        delays = RetryPolicy(max_attempts=0, base_delay=0.05,
+                             max_delay=0.4, jitter=0.5).delays()
+        last_exc = None
+        while True:
+            res = self._round(fn, op)
+            ok = {i: r for i, r in res.items()
+                  if not isinstance(r, Exception)}
+            if len(ok) >= self.majority:
+                _metrics.histogram("kv.quorum_s").observe(
+                    time.monotonic() - t0)
+                return ok
+            for r in res.values():
+                if isinstance(r, Exception):
+                    last_exc = r
+            d = next(delays)
+            if time.monotonic() - t0 + d >= budget:
+                _recorder.record("kv.no_quorum", op=op, acks=len(ok),
+                                 needed=self.majority, peers=self.n)
+                raise NoQuorumError(op, len(ok), self.majority, self.n,
+                                    last=last_exc)
+            time.sleep(d)  # resilience: ok (budget-bounded quorum retry; NoQuorumError is the named exit and ChaosError must surface per-round, so retry_call cannot own this loop)
+
+    # ---------------------------------------------- membership (TTL'd)
+    def heartbeat(self, node_id: str, info=None):
+        """Commit one lease renewal on a majority of peers. The budget
+        stays under the TTL for the same reason KVRegistry's does: a
+        heartbeat that retries past its own expiry is worse than a miss.
+        (Chaos coverage rides the per-peer ``kv.peer_down`` and per-round
+        ``kv.partition`` sites — the single-master ``kv.heartbeat`` site
+        stays with KVRegistry, where its literal already lives.)"""
+        data = json.dumps(info or {}).encode()
+
+        def put(p):
+            st, _, _ = self._peer_call(p, f"/hb/{node_id}", "PUT", data)
+            if st != 200:
+                raise TransientError(f"hb status {st}")
+            return True
+
+        self._quorum(put, f"kv.heartbeat {node_id}",
+                     budget=min(self.quorum_timeout,
+                                max(0.5, self.ttl * 0.5)))
+
+    def alive_nodes(self):
+        """Union of the alive sets over a majority (a node whose lease
+        committed is on ≥ majority peers, so any majority read sees it).
+        No quorum → [] — the same 'unreliable read' answer KVRegistry
+        gives, which the manager's own-heartbeat guard turns into HOLD."""
+        def get(p):
+            st, body, _ = self._peer_call(p, "/nodes")
+            if st != 200:
+                raise TransientError(f"nodes status {st}")
+            return json.loads(body)
+
+        try:
+            acks = self._quorum(get, "kv.alive_nodes")
+        except NoQuorumError:
+            return []
+        alive: set = set()
+        for nodes in acks.values():
+            alive.update(nodes)
+        return sorted(alive)
+
+    def leave(self, node_id: str):
+        """Best-effort deregister on every reachable peer (the TTL buries
+        whatever a dead peer still holds)."""
+        def dele(p):
+            self._peer_call(p, f"/hb/{node_id}", "DELETE")
+            return True
+
+        try:
+            self._round(dele, f"kv.leave {node_id}", wait_all=True)
+        except Exception:
+            pass
+
+    def info(self, node_id: str) -> dict | None:
+        """Freshest lease payload over a majority (by heartbeat wall
+        time) — a stale peer cannot serve a dead endpoint to the router."""
+        def get(p):
+            st, body, hdrs = self._peer_call(p, f"/info/{node_id}")
+            if st == 404:
+                return None
+            if st != 200:
+                raise TransientError(f"info status {st}")
+            try:
+                ts = float(hdrs.get("X-Paddle-HB-TS") or 0.0)
+            except ValueError:
+                ts = 0.0
+            return ts, body
+
+        try:
+            acks = self._quorum(get, f"kv.info {node_id}")
+        except NoQuorumError:
+            return None
+        best = None
+        for r in acks.values():
+            if r is not None and (best is None or r[0] > best[0]):
+                best = r
+        if best is None:
+            return None
+        try:
+            return json.loads(best[1])
+        except ValueError:
+            return None
+
+    # ------------------------------------------------------ durable KV
+    def _read_versioned(self, key: str, op: str):
+        """Quorum read of one key → (value|None, vn, writer, stale_idxs)
+        where stale_idxs are responding peers behind the winner (the
+        read-repair targets)."""
+        def get(p):
+            st, body, hdrs = self._peer_call(p, f"/kv/{key}")
+            if st == 404:
+                return None
+            if st != 200:
+                raise TransientError(f"kv get status {st}")
+            try:
+                vn = int(hdrs.get("X-Paddle-KV-Ver") or 0)
+            except ValueError:
+                vn = 0
+            return body.decode(), vn, hdrs.get("X-Paddle-KV-Writer") or ""
+
+        acks = self._quorum(get, op)
+        val, vn, writer = None, 0, ""
+        for r in acks.values():
+            if r is not None and (r[1], r[2]) > (vn, writer):
+                val, vn, writer = r
+        stale = [i for i, r in acks.items()
+                 if (r is None and val is not None)
+                 or (r is not None and (r[1], r[2]) < (vn, writer))]
+        return val, vn, writer, stale
+
+    def _repair(self, key: str, val: str, vn: int, writer: str,
+                idxs: list[int]):
+        """Read-repair: push the winning (value, version) to lagging
+        peers, fire-and-forget — versions make it idempotent and safe."""
+        hdrs = {"X-Paddle-KV-Ver": str(vn), "X-Paddle-KV-Writer": writer}
+        for i in idxs:
+            try:
+                self._peer_call(self._peers[i], f"/kv/{key}", "PUT",
+                                val.encode(), headers=hdrs)
+            except Exception:
+                pass  # repair is opportunistic; quorum reads stay safe
+
+    def kv_get(self, key: str) -> str | None:
+        val, vn, writer, stale = self._read_versioned(key,
+                                                      f"kv.get {key}")
+        if val is not None and stale:
+            self._repair(key, val, vn, writer, stale)
+        return val
+
+    def kv_put(self, key: str, value: str):
+        """Versioned quorum write: discover the current version from a
+        majority, write version+1 under this client's writer id, commit
+        on a majority of APPLIED acks. A concurrent writer's higher
+        version showing up mid-write restarts the attempt (last writer
+        wins once, not twice)."""
+        t0 = time.monotonic()
+        op = f"kv.put {key}"
+        while True:
+            _, vn, _, _ = self._read_versioned(key, op)
+            new_vn = vn + 1
+            hdrs = {"X-Paddle-KV-Ver": str(new_vn),
+                    "X-Paddle-KV-Writer": self._writer}
+
+            def put(p):
+                st, body, _ = self._peer_call(p, f"/kv/{key}", "PUT",
+                                              value.encode(), headers=hdrs)
+                if st != 200:
+                    raise TransientError(f"kv put status {st}")
+                try:
+                    return bool(json.loads(body).get("applied"))
+                except ValueError:
+                    return True  # pre-versioning server: 200 == applied
+            remaining = self.quorum_timeout - (time.monotonic() - t0)
+            if remaining <= 0:
+                raise NoQuorumError(op, 0, self.majority, self.n)
+            acks = self._quorum(put, op, budget=remaining)
+            if sum(1 for ok in acks.values() if ok) >= self.majority:
+                return
+            # a majority responded but refused: a concurrent writer won
+            # the version race — re-discover and try once more on top
+
+    def kv_del(self, key: str):
+        """Best-effort delete on every reachable peer. Deletions are GC
+        of generation-fenced barrier state — a resurrected old key is
+        inert (fenced) and gets collected again next pass."""
+        def dele(p):
+            self._peer_call(p, f"/kv/{key}", "DELETE")
+            return True
+
+        try:
+            self._round(dele, f"kv.del {key}", wait_all=True)
+        except Exception:
+            pass
+
+    def kv_list(self, prefix: str) -> dict:
+        """Per-key version-merged union over a majority of peers."""
+        def get(p):
+            st, body, _ = self._peer_call(p, f"/kvlist/{prefix}?v=1")
+            if st != 200:
+                raise TransientError(f"kvlist status {st}")
+            return json.loads(body)
+
+        acks = self._quorum(get, f"kv.list {prefix}")
+        best: dict = {}
+        for doc in acks.values():
+            for k, rec in doc.items():
+                val, vn, w = str(rec[0]), int(rec[1]), str(rec[2])
+                if k not in best or (vn, w) > best[k][1:]:
+                    best[k] = (val, vn, w)
+        return {k: v[0] for k, v in best.items()}
+
+    def kv_max(self, key: str, value: int) -> int:
+        """Replicated max-CAS: every peer applies max() under its own
+        lock; the committed counter is the max over any majority (max is
+        commutative + idempotent, so replication cannot regress it). A
+        divergent ack (a peer that missed earlier proposals) is repaired
+        with the winner before returning."""
+        data = str(int(value)).encode()
+
+        def put(p):
+            st, body, _ = self._peer_call(p, f"/kvmax/{key}", "PUT", data)
+            if st != 200:
+                raise TransientError(f"kvmax status {st}")
+            return int(body)
+
+        acks = self._quorum(put, f"kv.max {key}")
+        winner = max(acks.values())
+        lagging = [i for i, v in acks.items() if v < winner]
+        if lagging:
+            wdata = str(winner).encode()
+            for i in lagging:
+                try:
+                    self._peer_call(self._peers[i], f"/kvmax/{key}", "PUT",
+                                    wdata)
+                except Exception:
+                    pass
+        return winner
+
+    def kv_counter(self, key: str) -> int:
+        """Quorum read of a kv_max counter: the max VALUE over a majority
+        (value order, not version order — the counter is monotone)."""
+        def get(p):
+            st, body, _ = self._peer_call(p, f"/kv/{key}")
+            if st == 404:
+                return 0
+            if st != 200:
+                raise TransientError(f"kv get status {st}")
+            try:
+                return int(body.decode() or 0)
+            except ValueError:
+                return 0
+
+        acks = self._quorum(get, f"kv.counter {key}")
+        return max(acks.values())
+
+
+# ------------------------------------------------------- peer lifecycle
+
+def _dump(base: str, timeout: float = 3.0) -> dict | None:
+    try:
+        req = urllib.request.Request(base + "/dump")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def fetch_snapshots(peers, exclude: str = "", timeout: float = 3.0) -> list:
+    """/dump snapshots from every reachable peer (minus ``exclude``).
+    The caller judges coverage: restoring a blank peer's forgotten acks
+    needs snapshots from ``n - majority + 1`` OTHERS — any fewer and a
+    committed write whose only surviving copy sits on the one peer that
+    didn't answer would vanish from majority reads."""
+    base = parse_peers([exclude])[0] if exclude else None
+    out = []
+    for peer in parse_peers(peers):
+        if peer == base:
+            continue
+        snap = _dump(peer, timeout=timeout)
+        if snap is not None:
+            out.append(snap)
+    return out
+
+
+def snapshot_coverage(n_peers: int) -> int:
+    """How many OTHER peers' snapshots a blank restart must merge before
+    serving: a committed write lives on >= majority peers, so at worst
+    ``majority - 1`` of the others hold its only surviving copies — the
+    merge set must be big enough to be guaranteed to include one of ANY
+    ``majority - 1`` others, i.e. ``(n-1) - (majority-1) + 1``."""
+    majority = n_peers // 2 + 1
+    return n_peers - majority + 1
+
+
+def catch_up(endpoint: str, peers, timeout: float = 3.0) -> int:
+    """HTTP catch-up: merge the other peers' /dump snapshots into an
+    ALREADY-SERVING peer via PUT /load. Returns how many merged. For a
+    blank restart prefer the pre-start path (``fetch_snapshots`` +
+    ``KVServer.load_snapshot`` BEFORE ``start()``) — merging after the
+    port answers leaves a window where quorum reads see the blank store.
+    """
+    base = parse_peers([endpoint])[0]
+    merged = 0
+    for snap in fetch_snapshots(peers, exclude=endpoint, timeout=timeout):
+        try:
+            req = urllib.request.Request(
+                base + "/load", method="PUT",
+                data=json.dumps(snap).encode(),
+                headers={"X-Paddle-Job-Token": _kv_token()})
+            urllib.request.urlopen(req, timeout=timeout).read()
+            merged += 1
+        except Exception:
+            continue
+    return merged
+
+
+class KVPeerSet:
+    """N in-process KVServer peers + a supervisor that restarts a dead
+    one on its OWN port and catches it up from a majority snapshot — the
+    launcher's multi-controller control plane (``--kv_replicas``).
+
+        ps = KVPeerSet(3, ttl=5.0).start()
+        reg = ps.registry()            # quorum client over the set
+        ps.kill(1)                     # simulated peer crash (tests)
+        ... supervisor revives it, caught up ...
+        ps.stop()
+    """
+
+    def __init__(self, n: int, ttl: float = 10.0, host: str = "127.0.0.1",
+                 probe_s: float = 0.5):
+        if n < 1:
+            raise ValueError(f"kv peer count must be >= 1, got {n}")
+        self.ttl, self.host, self.probe_s = float(ttl), host, float(probe_s)
+        self._lk = threading.Lock()
+        self._servers: list[KVServer | None] = [
+            KVServer(ttl=self.ttl) for _ in range(n)]
+        self._ports = [s.port for s in self._servers]
+        self._misses = [0] * n      # consecutive failed probes per slot
+        self._blocked: set = set()  # slots whose revive awaits coverage
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoints(self) -> list[str]:
+        return [f"{self.host}:{p}" for p in self._ports]
+
+    def registry(self, **kw) -> ReplicatedKVRegistry | KVRegistry:
+        return make_registry(self.endpoints, ttl=self.ttl, **kw)
+
+    def start(self, supervise: bool = True) -> "KVPeerSet":
+        for s in self._servers:
+            s.start()
+        if supervise and len(self._ports) > 1:
+            self._thread = threading.Thread(target=self._supervise,
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def kill(self, i: int):
+        """Simulated peer crash (tests): stop the server, forget it. The
+        supervisor notices and revives a caught-up replacement."""
+        with self._lk:
+            s, self._servers[i] = self._servers[i], None
+        if s is not None:
+            s.stop()
+
+    def _probe(self, i: int) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.host}:{self._ports[i]}/nodes",
+                    timeout=1.0):
+                return True
+        except Exception:
+            return False
+
+    def _supervise(self):
+        """In-process reform: a dead peer is restarted on its own port
+        (the member set is static — clients never re-learn endpoints) and
+        STARTED only after snapshots covering ``snapshot_coverage(n)``
+        other peers were merged into it — the bound below which a
+        committed write's only surviving copy could sit on the one peer
+        that didn't answer, turning the revival into a rollback."""
+        while not self._stop.wait(self.probe_s):
+            for i in range(len(self._ports)):
+                with self._lk:
+                    dead = self._servers[i] is None
+                if not dead:
+                    if self._probe(i):
+                        self._misses[i] = 0  # locks: ok (supervisor thread is the only writer of _misses/_blocked)
+                        continue
+                    # one missed probe is load noise; a LIVE peer must
+                    # not be killed (and restarted BLANK) on a single
+                    # 1s timeout — require two consecutive misses
+                    self._misses[i] += 1  # locks: ok (supervisor thread is the only writer of _misses/_blocked)
+                    if self._misses[i] < 2:
+                        continue
+                    self.kill(i)
+                self._try_revive(i)
+
+    def _try_revive(self, i: int) -> bool:
+        """One revive attempt for a dead slot: fetch the other peers'
+        snapshots, refuse below coverage (a blank quorum member would
+        roll committed writes back), else merge-then-serve on the same
+        port. Returns True when the peer is serving again."""
+        need = snapshot_coverage(len(self._ports))
+        ep = f"{self.host}:{self._ports[i]}"
+        others = [e for j, e in enumerate(self.endpoints) if j != i]
+        snaps = fetch_snapshots(others)
+        if len(snaps) < need:
+            # not enough survivors answered to restore what this peer
+            # may have acked — do NOT serve a hole into majority reads;
+            # the supervisor retries next tick. (With a majority of
+            # peers simultaneously dead this blocks until an operator
+            # restores one: the memory store has genuinely lost data at
+            # that point, and a blank quorum would silently roll the
+            # fleet back.)
+            if i not in self._blocked:
+                self._blocked.add(i)  # locks: ok (supervisor/test thread is the only writer of _misses/_blocked)
+                _recorder.record(
+                    "kv.peer_restart_blocked", echo=True,
+                    message=f"[kv] peer {ep} revive blocked: "
+                            f"{len(snaps)}/{need} snapshot(s) "
+                            "reachable — refusing to serve a blank "
+                            "store into quorum reads",
+                    peer=ep, have=len(snaps), need=need)
+            return False
+        try:
+            srv = KVServer(port=self._ports[i], ttl=self.ttl)
+        except OSError:
+            return False  # port still draining; next probe retries
+        # merge BEFORE start(): the bound port only queues connections
+        # until then, so no client ever reads the blank pre-merge store
+        for snap in snaps:
+            srv.load_snapshot(snap)
+        srv.start()
+        with self._lk:
+            self._servers[i] = srv
+        self._misses[i] = 0  # locks: ok (supervisor/test thread is the only writer of _misses/_blocked)
+        self._blocked.discard(i)
+        _recorder.record(
+            "kv.peer_restarted", echo=True,
+            message=f"[kv] registry peer {ep} restarted and caught up "
+                    f"from {len(snaps)} peer snapshot(s)",
+            peer=ep, merged=len(snaps))
+        return True
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lk:
+            servers, self._servers = list(self._servers), \
+                [None] * len(self._ports)
+        for s in servers:
+            if s is not None:
+                s.stop()
+
+
+# --------------------------------------------------------- process entry
+
+def main(argv=None) -> int:
+    """``python -m paddle_tpu.distributed.fleet.replicated_kv`` — serve
+    ONE registry peer as a process (the SIGKILL-able unit the drills and
+    real deployments use; the in-process KVPeerSet is the launcher's
+    simulation convenience)."""
+    p = argparse.ArgumentParser(description="replicated-KV registry peer")
+    p.add_argument("--port", type=int, required=True,
+                   help="fixed port (the member set is static)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--ttl", type=float, default=10.0)
+    p.add_argument("--catch-up-from", default="",
+                   help="comma peer list to merge /dump snapshots from "
+                        "before serving (peer restart)")
+    args = p.parse_args(argv)
+    # bind first (clients' connections queue in the backlog), merge the
+    # survivors' snapshots into the still-silent store, THEN serve — a
+    # blank restarted peer answering reads before the merge would punch
+    # a hole into majority reads exactly where its forgotten acks were
+    server = KVServer(port=args.port, ttl=args.ttl)
+    merged = 0
+    if args.catch_up_from:
+        for snap in fetch_snapshots(args.catch_up_from,
+                                    exclude=f"{args.host}:{args.port}"):
+            server.load_snapshot(snap)
+            merged += 1
+    server.start()
+    print(json.dumps({"peer": f"{args.host}:{args.port}",  # observability: ok (spawner handshake line on stdout, not runtime telemetry)
+                      "pid": os.getpid(), "caught_up_from": merged}),
+          flush=True)
+    stop = threading.Event()
+    import signal
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

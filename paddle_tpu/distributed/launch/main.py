@@ -84,7 +84,17 @@ def _parse(argv):
     p.add_argument("--elastic_root", default="/tmp/paddle_tpu_elastic",
                    help="shared dir for heartbeat files (FileRegistry)")
     p.add_argument("--elastic_server", default=None,
-                   help="HTTP KV master host:port, or 'auto' (node 0 serves)")
+                   help="HTTP KV master host:port, or 'auto' (node 0 "
+                        "serves); a comma-separated host:port list is a "
+                        "replicated peer set — registry ops then commit "
+                        "on a majority (ISSUE 12)")
+    p.add_argument("--kv_replicas", type=int,
+                   default=int(os.environ.get("PADDLE_KV_REPLICAS", "1")
+                               or 1),
+                   help="with --elastic_server auto: spawn this many "
+                        "registry peers in-process (supervised; a dead "
+                        "peer restarts on its port and catches up from a "
+                        "majority snapshot). 1 = the single KV master")
     p.add_argument("--elastic_timeout", type=float, default=120.0)
     p.add_argument("--heartbeat_interval", type=float, default=2.0)
     p.add_argument("--join_window", type=float, default=1.0,
@@ -156,26 +166,44 @@ def _spawn(args, local_rank: int, world: int, base_rank: int, nnodes: int,
 
 
 def _make_elastic(args, node_id: str):
-    from ..fleet.elastic import (ElasticManager, FileRegistry, KVRegistry,
-                                 KVServer)
+    from ..fleet.elastic import ElasticManager, FileRegistry, KVServer
+    from ..fleet.replicated_kv import KVPeerSet, make_registry
 
     server = None
+    ttl = 5 * args.heartbeat_interval
     if args.elastic_server:
         ep = args.elastic_server
         if ep == "auto":
-            if (args.rank if args.rank >= 0 else 0) == 0:
-                server = KVServer(ttl=5 * args.heartbeat_interval).start()
-                host = (args.master or "127.0.0.1").partition(":")[0]
-                ep = f"{host}:{server.port}"
-                print(f"[launch] elastic KV master at {ep}", file=sys.stderr)
-            else:
+            if (args.rank if args.rank >= 0 else 0) != 0:
                 raise SystemExit(
                     "--elastic_server auto is only valid on node 0; pass the "
-                    "master's host:port on other nodes")
-        registry = KVRegistry(ep, ttl=5 * args.heartbeat_interval)
+                    "master's host:port (or the peer list) on other nodes")
+            host = (args.master or "127.0.0.1").partition(":")[0]
+            if args.kv_replicas > 1:
+                # the replicated control plane (ISSUE 12): N supervised
+                # in-process peers — a dead one restarts on its own port
+                # and catches up from a majority snapshot, and every
+                # registry op below commits on a majority, so no single
+                # peer is load-bearing anymore
+                server = KVPeerSet(args.kv_replicas, ttl=ttl,
+                                   host=host).start()
+                ep = ",".join(server.endpoints)
+                print(f"[launch] elastic KV peers at {ep} "
+                      f"(majority {args.kv_replicas // 2 + 1}/"
+                      f"{args.kv_replicas})", file=sys.stderr)
+            else:
+                server = KVServer(ttl=ttl).start()
+                ep = f"{host}:{server.port}"
+                print(f"[launch] elastic KV master at {ep}",
+                      file=sys.stderr)
+            # children (and serving replicas spawned under them) find the
+            # same control plane without re-plumbing their own flags
+            os.environ["PADDLE_KV_PEERS"] = ep
+        registry = make_registry(ep, ttl=ttl)
+    elif os.environ.get("PADDLE_KV_PEERS"):
+        registry = make_registry(os.environ["PADDLE_KV_PEERS"], ttl=ttl)
     else:
-        registry = FileRegistry(args.elastic_root, args.job_id,
-                                ttl=5 * args.heartbeat_interval)
+        registry = FileRegistry(args.elastic_root, args.job_id, ttl=ttl)
     mgr = ElasticManager(
         node_id, np=args.nnodes, min_np=args.min_nodes, max_np=args.max_nodes,
         registry=registry, heartbeat_interval=args.heartbeat_interval,

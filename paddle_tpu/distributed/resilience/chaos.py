@@ -54,6 +54,13 @@ SITES: dict[str, str] = {
     "data.next":       "before a data-loader batch reaches the trainer",
     "elastic.enroll":  "before a re-rendezvous enrollment write",
     "kv.heartbeat":    "before an elastic KV heartbeat PUT",
+    "kv.partition":    "one whole quorum round of the replicated registry "
+                       "(fault = zero acks this round; the op retries "
+                       "under its budget, a persistent partition exhausts "
+                       "it into a typed NoQuorumError)",
+    "kv.peer_down":    "before one peer's request inside a replicated-"
+                       "registry quorum round (fault = that peer "
+                       "unreachable; the round commits on the others)",
     "quant.allreduce": "before a quantized allreduce takes the low-precision "
                        "wire (fault degrades that call to the full-precision "
                        "reducer — precision goes UP, numbers never wrong)",

@@ -108,11 +108,19 @@ class RetryPolicy:
         rng = random.Random(self.seed)
         k = 0
         while True:
-            d = min(self.max_delay, self.base_delay * (2.0 ** k))
+            base = min(self.max_delay, self.base_delay * (2.0 ** k))
+            d = base
             if self.jitter > 0:
                 d *= (1.0 - self.jitter) + self.jitter * rng.random()
             yield d
-            k += 1
+            if base < self.max_delay:
+                # stop growing the exponent once the cap is reached — and
+                # bound it outright (base_delay=0 never reaches the cap):
+                # a long-lived unlimited-attempt consumer (poller,
+                # per-peer backoff) would otherwise walk 2.0**k into
+                # float OverflowError around k=1024 and kill the
+                # generator with StopIteration forever after
+                k = min(k + 1, 1023)
 
 
 # pacing-only defaults for pollers that manage their own deadline

@@ -52,6 +52,7 @@ from ...distributed.resilience import chaos
 from ...observability import metrics, recorder as _recorder, slo as _slo
 from ...utils import env_flags
 from ..router import Router, RoutedRequest
+from .transfer import blob_meta, pack_frame, unpack_frame
 
 __all__ = ["DisaggRouter"]
 
@@ -144,9 +145,9 @@ class DisaggRouter(Router):
         self._pending.appendleft(req)
         self._count("reprefills")
 
-    def _absorb(self, res: dict):
+    def _absorb(self, res: dict, src: str | None = None):
         if res.get("router") != self._rid_ns:
-            return super()._absorb(res)   # foreign record: base ignores
+            return super()._absorb(res, src=src)  # foreign: base ignores
         rid = res.get("rid")
         req = self._requests.get(rid)
         reason = res.get("reason", "complete")
@@ -174,13 +175,25 @@ class DisaggRouter(Router):
                 pass
             kv = res.get("kv")
             if not kv:
-                # a prefilled result MUST carry the pages; without them
+                # a prefilled result MUST carry the blob meta; without it
                 # (replica export raced a crash) the prompt is all we
                 # have — re-prefill, never lose
                 _recorder.record("serve.disagg.blobless_prefill",
                                  rid=rid, router=self._rid_ns)
                 self._reprefill(req)
                 return
+            if "data" not in kv:
+                # binary wire (ISSUE 12): the result carried only the
+                # meta — pull the payload frame from the prefill replica
+                # in ONE raw octet-stream GET. Any loss (replica died
+                # after the result left, frame evicted) converges on the
+                # same re-prefill every other mid-flight loss does.
+                kv = self._fetch_blob(req, kv, src)
+                if kv is None:
+                    _recorder.record("serve.disagg.frame_lost",
+                                     rid=rid, router=self._rid_ns)
+                    self._reprefill(req)
+                    return
             now = _slo.now()
             # TTFT is REAL now: the first token exists (it rides the
             # blob); the decode pool only adds TPOT after it
@@ -206,7 +219,36 @@ class DisaggRouter(Router):
             else:
                 self.slo.on_stage(rid, "decode_pool", req.t_stage,
                                   _slo.now())
-        super()._absorb(res)
+        super()._absorb(res, src=src)
+
+    def _fetch_blob(self, req: RoutedRequest, meta: dict,
+                    src: str | None = None) -> dict | None:
+        """Rebuild the full blob (meta + raw payload) from the prefill
+        replica's /kv_blob frame. ``src`` is the endpoint the result
+        record physically came from — authoritative even when the
+        replica's handle is already gone (a falsely-suspected replica's
+        late result arrives exactly after _mark_dead deleted it, and
+        salvaging that first attempt is the point). None when the frame
+        cannot be had — the caller re-prefills."""
+        endpoint = src
+        if endpoint is None:
+            h = self._handles.get(req.replica or "")
+            if h is None:
+                return None
+            endpoint = h.endpoint
+        frame = self._get_bytes(endpoint,
+                                f"/kv_blob?rid={req.rid}"
+                                f"&router={self._rid_ns}",
+                                timeout=self._xfer_timeout)
+        if frame is None:
+            return None
+        try:
+            header, payload = unpack_frame(frame)
+        except ValueError:
+            return None
+        blob = dict(header.get("kv") or meta)
+        blob["data"] = payload
+        return blob
 
     # ----------------------------------------------------------- transfer
     def tick(self):
@@ -286,13 +328,18 @@ class DisaggRouter(Router):
             if h.id != req.last_faulted and h.free_pages is not None \
                     and h.free_pages - h.queued_kv_pages < n_pages:
                 continue   # page-starved: don't bounce off its 429
-            code, body = self._post(
-                h.endpoint, "/kv_transfer",
+            # binary hop (ISSUE 12): header JSON + raw payload in one
+            # length-prefixed frame — the payload bytes ship verbatim
+            # instead of paying the old base64-JSON 4/3× inflation
+            frame = pack_frame(
                 {"rid": req.rid, "prompt": req.prompt,
                  "max_new_tokens": req.max_new_tokens,
                  "trace_id": req.trace_id, "force": req.retried,
-                 "router": self._rid_ns, "kv": req.kv},
-                timeout=self._xfer_timeout)
+                 "router": self._rid_ns, "kv": blob_meta(req.kv)},
+                bytes(req.kv["data"]))
+            code, body = self._post_bytes(h.endpoint, "/kv_transfer",
+                                          frame,
+                                          timeout=self._xfer_timeout)
             req.attempts += 1
             if code == 200 and body.get("ok"):
                 now = _slo.now()

@@ -44,7 +44,7 @@ import sys
 import threading
 from collections import deque
 
-from ..distributed.fleet.elastic import FileRegistry, KVRegistry
+from ..distributed.fleet.elastic import FileRegistry
 from ..observability import metrics, recorder as _recorder, slo as _slo
 from ..observability.admin import AdminServer
 from ..utils import env_flags
@@ -73,6 +73,10 @@ ENV_ROLE = "PADDLE_SERVE_ROLE"
 # unset. "prefill" runs prompt passes and exports pages; "decode" installs
 # transferred pages and streams tokens.
 ROLES = ("unified", "prefill", "decode")
+
+# exported KV frames retained for router pickup (multi-MB each, so the
+# bound is count-based and small; an evicted frame's request re-prefills)
+_KV_FRAME_KEEP = 32
 
 
 def normalize_role(raw) -> str:
@@ -124,6 +128,16 @@ class ReplicaServer:
         self._results: list[dict] = []
         self._results_base = 0
         self._results_keep = int(env_flags.get_float(ENV_RESULTS_KEEP))
+        # exported KV page frames (disagg, ISSUE 12 binary wire): the
+        # prefilled RESULT carries only the blob's JSON-able meta; the
+        # multi-MB payload stays here, packed once, and the router pulls
+        # it through GET /kv_blob as one raw octet-stream frame (no
+        # base64, no JSON escaping). Bounded: a router that never
+        # fetched within _KV_FRAME_KEEP exports re-prefills (404 → the
+        # established recovery), which bounds replica RSS the same way
+        # results retention does.
+        self._kv_frames: dict[tuple, bytes] = {}
+        self._kv_frame_order: deque = deque()
         self._active: set = set()       # (router ns, rid) queued/in flight
         self._draining = False
         self._drain_t0: float | None = None
@@ -137,7 +151,8 @@ class ReplicaServer:
             port=port, host=host,
             extra={"serve": batcher.admin_summary, "replica": self.summary},
             health=self._health,
-            get_routes={"/results": self._h_results},
+            get_routes={"/results": self._h_results,
+                        "/kv_blob": self._h_kv_blob},
             post_routes={"/enqueue": self._h_enqueue,
                          "/kv_transfer": self._h_kv_transfer,
                          "/drain": self._h_drain})
@@ -261,7 +276,24 @@ class ReplicaServer:
             self._active.add((rtr, rid))
         return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
 
-    def _h_kv_transfer(self, body: dict):
+    def _h_kv_blob(self, query: dict):
+        """GET /kv_blob?rid=N[&router=ns] — one exported page frame as a
+        raw octet-stream (ISSUE 12 binary wire). 404 once evicted: the
+        router's established answer to a lost blob is re-prefill."""
+        try:
+            rid = int(query.get("rid", [""])[0])
+        except (ValueError, IndexError):
+            return 400, {"ok": False, "reason": "rid=N required"}
+        rtr = (query.get("router") or [None])[0]
+        with self._lk:
+            frame = self._kv_frames.get((rtr, rid))
+        if frame is None:
+            return 404, {"ok": False, "reason": "no frame for rid "
+                                                f"{rid} (evicted or "
+                                                "never exported)"}
+        return 200, frame
+
+    def _h_kv_transfer(self, body):
         """POST /kv_transfer — the disagg page-transfer boundary (ISSUE
         11): a prefilled request arrives WITH its KV pages (the wire blob
         disagg.transfer serialized) and enters the queue as a kv_import
@@ -269,7 +301,19 @@ class ReplicaServer:
         pressure dimension: besides queue depth, the pool itself — free
         pages minus pages already promised to queued transfers must cover
         this request's live pages, else 429 ``pool_pressure`` with the
-        page-turnover retry hint (admission.decide_pages)."""
+        page-turnover retry hint (admission.decide_pages).
+
+        Over HTTP the body is one length-prefixed BINARY frame (ISSUE 12
+        satellite: header JSON + raw payload, no base64); in-process
+        callers may still hand the blob dict directly."""
+        if isinstance(body, (bytes, bytearray, memoryview)):
+            from .disagg.transfer import unpack_frame
+            try:
+                body, payload = unpack_frame(body)
+                body["kv"] = dict(body.get("kv") or {})
+                body["kv"]["data"] = payload
+            except (ValueError, TypeError) as e:
+                return 400, {"ok": False, "reason": f"bad frame: {e}"}
         try:
             rid = int(body["rid"])
             prompt = [int(t) for t in body["prompt"]]
@@ -517,6 +561,20 @@ class ReplicaServer:
                                      "drained clean",
                              replica=self.replica_id)
 
+    def _store_frame(self, key: tuple, frame: bytes):
+        """Retain one exported KV frame under the count bound. A
+        re-export of the SAME (router, rid) — a re-prefill that landed
+        back here — overwrites in place without a second eviction-order
+        entry: a duplicate deque key would otherwise evict the LIVE
+        replacement frame when the stale entry aged out."""
+        with self._lk:
+            if key not in self._kv_frames:
+                self._kv_frame_order.append(key)
+            self._kv_frames[key] = frame
+            while len(self._kv_frame_order) > _KV_FRAME_KEEP:
+                old = self._kv_frame_order.popleft()
+                self._kv_frames.pop(old, None)
+
     def _push_result(self, rid, tid, rtr, tokens, reason, kv=None):
         with self._lk:
             # the (router, rid) key leaves the active set in the same
@@ -552,9 +610,16 @@ class ReplicaServer:
                 # serialize-and-free on THE thread that owns the batcher;
                 # an export failure degrades to a shed (the router
                 # re-routes it under the same trace id — re-prefilled,
-                # never lost, never a half-written blob)
+                # never lost, never a half-written blob). The RESULT
+                # carries only the blob meta; the payload is packed once
+                # into a binary frame the router pulls via /kv_blob
+                # (ISSUE 12: /results stays a small JSON doc instead of
+                # hauling base64 megabytes on every poll)
                 try:
-                    kv = self._b.export_kv(local)
+                    blob = self._b.export_kv(local)
+                    from .disagg.transfer import blob_meta, pack_frame
+                    kv = blob_meta(blob)
+                    frame = pack_frame({"kv": kv}, blob["data"])
                 except Exception as e:
                     _recorder.record("serve.replica.export_error",
                                      replica=self.replica_id, rid=rid,
@@ -562,6 +627,7 @@ class ReplicaServer:
                     self._b.drop_parked(local)
                     self._push_result(rid, tid, rtr, [], "shed")
                     continue
+                self._store_frame((rtr, rid), frame)
             self._push_result(rid, tid, rtr, req.out, req.reason, kv=kv)
             # completed means SERVED to budget: a shed (never served,
             # re-routed elsewhere) or an error result counted here would
@@ -609,7 +675,11 @@ def main(argv=None) -> int:
     p.add_argument("--registry-root", default="",
                    help="FileRegistry root directory")
     p.add_argument("--registry-endpoint", default="",
-                   help="KVServer endpoint (host:port) instead of a root dir")
+                   help="KVServer endpoint (host:port) instead of a root "
+                        "dir; a comma-separated list is a replicated peer "
+                        "set — leases then commit on a majority and the "
+                        "heartbeat/refresh paths fail over between peers "
+                        "(ISSUE 12)")
     p.add_argument("--job-id", default=os.environ.get("PADDLE_JOB_ID",
                                                       "default"))
     p.add_argument("--ttl", type=float,
@@ -628,7 +698,12 @@ def main(argv=None) -> int:
     spec = json.loads(raw)
 
     if args.registry_endpoint:
-        registry = KVRegistry(args.registry_endpoint, ttl=args.ttl)
+        # ONE endpoint → the untouched single-master KVRegistry
+        # (byte-identical pre-replication behavior); a peer LIST → the
+        # quorum client, so a SIGKILL'd registry peer costs a failover
+        # inside the client, never a lapsed lease
+        from ..distributed.fleet.replicated_kv import make_registry
+        registry = make_registry(args.registry_endpoint, ttl=args.ttl)
     elif args.registry_root:
         registry = FileRegistry(args.registry_root, args.job_id,
                                 ttl=args.ttl)

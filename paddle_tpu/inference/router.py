@@ -243,11 +243,24 @@ class Router:
         retry/tick discipline owns recovery — the resilience classify()
         split applied to routed sends. ``timeout`` overrides the probe
         timeout (a KV-page transfer ships megabytes, not a health doc)."""
-        data = json.dumps(obj).encode()
+        return self._post_raw(endpoint, path, json.dumps(obj).encode(),
+                              "application/json", timeout)
+
+    def _post_bytes(self, endpoint: str, path: str, data: bytes,
+                    timeout: float | None = None) -> tuple[int, dict]:
+        """POST one binary frame (octet-stream) — the disagg KV-page
+        transfer hop (ISSUE 12): payload bytes travel VERBATIM, no
+        base64/JSON inflation. Same status contract as :meth:`_post`."""
+        return self._post_raw(endpoint, path, data,
+                              "application/octet-stream", timeout)
+
+    def _post_raw(self, endpoint: str, path: str, data: bytes,
+                  ctype: str, timeout: float | None) -> tuple[int, dict]:
+        headers = dict(self._headers(True))
+        headers["Content-Type"] = ctype
         try:
             req = urllib.request.Request(endpoint + path, data=data,
-                                         headers=self._headers(True),
-                                         method="POST")
+                                         headers=headers, method="POST")
             with urllib.request.urlopen(
                     req, timeout=timeout or self._timeout) as r:
                 return r.status, json.loads(r.read() or b"{}")
@@ -260,6 +273,27 @@ class Router:
         except Exception as e:
             if _transient_send(e):
                 return 0, {}
+            raise
+
+    def _get_bytes(self, endpoint: str, path: str,
+                   timeout: float | None = None) -> bytes | None:
+        """GET a binary body (the /kv_blob frame). None on transport
+        fault OR 404 (frame evicted/never exported — the caller's answer
+        is re-prefill); any other HTTP status propagates loudly, same
+        contract as :meth:`_get`."""
+        try:
+            req = urllib.request.Request(endpoint + path,
+                                         headers=self._headers(False))
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self._timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        except Exception as e:
+            if _transient_send(e):
+                return None
             raise
 
     # ---------------------------------------------------------- discovery
@@ -573,7 +607,11 @@ class Router:
             return None
         h.cursor = int(doc.get("cursor", h.cursor))
         for res in doc.get("results", []):
-            self._absorb(res)
+            # src: where this record physically came from — the disagg
+            # frame fetch needs it even after the handle left the table
+            # (a falsely-suspected replica's late result arrives exactly
+            # when _mark_dead has already deleted its handle)
+            self._absorb(res, src=h.endpoint)
         return doc
 
     def _finished(self, rid) -> bool:
@@ -622,7 +660,7 @@ class Router:
                     "serve.fleet.result_evicted", rid=old_rid,
                     keep=keep, router=self._rid_ns)
 
-    def _absorb(self, res: dict):
+    def _absorb(self, res: dict, src: str | None = None):
         if res.get("router") != self._rid_ns:
             # another sender's record — a second router's, or a direct
             # client's (router=None). Every send THIS router makes is
@@ -809,9 +847,17 @@ def _transient_send(e: Exception) -> bool:
     """Routed-send classification — resilience.retry.classify applied to
     the router's HTTP sends: connection refused/reset, timeouts and wire
     noise are transient (the LEASE, not one exception, decides whether a
-    replica is dead); a truncated JSON body is the same wire noise.
-    Everything else (a TypeError in our own code) must surface."""
-    return isinstance(e, json.JSONDecodeError) or classify(e)
+    replica is dead); a truncated JSON body is the same wire noise, and
+    so is a connection dying MID-BODY (http.client.IncompleteRead /
+    BadStatusLine are HTTPException, not OSError — a replica SIGKILLed
+    while streaming a multi-MB /kv_blob frame must degrade to the
+    re-prefill recovery, not crash the poll loop). urllib's HTTPError —
+    a STATUS answer, which must surface — is re-raised by every caller
+    before this classification runs. Everything else (a TypeError in
+    our own code) must surface."""
+    import http.client
+    return isinstance(e, (json.JSONDecodeError,
+                          http.client.HTTPException)) or classify(e)
 
 
 # ----------------------------------------------------------- fleet spawner
@@ -834,15 +880,29 @@ class ServingFleet:
     pool) and the remaining ``n - n_prefill`` run ``--role decode``;
     ``router()`` then returns a ``DisaggRouter`` that drives the
     two-stage lifecycle. ``n_prefill == 0`` (default) spawns the classic
-    unified fleet, byte-identical to the pre-disagg behavior."""
+    unified fleet, byte-identical to the pre-disagg behavior.
+
+    Replicated registry (ISSUE 12): ``registry_endpoint`` (one
+    ``host:port``, or a comma-separated peer list) replaces the shared
+    FileRegistry with the HTTP registry — a LIST makes every lease and
+    routing-table read go through the quorum client, so killing any
+    single registry peer costs a client-side failover, not the fleet."""
 
     def __init__(self, n: int, spec: dict, root: str,
                  job_id: str = "serve-fleet", ttl: float = 1.5,
                  host: str = "127.0.0.1", env: dict | None = None,
-                 n_prefill: int = 0):
+                 n_prefill: int = 0, registry_endpoint: str = ""):
         self.spec = dict(spec)
         self.root, self.job_id, self.ttl, self.host = root, job_id, ttl, host
-        self.registry = FileRegistry(root, job_id, ttl=ttl)
+        self.registry_endpoint = registry_endpoint
+        # replica logs land under root either way; only the FileRegistry
+        # used to create it as a side effect
+        os.makedirs(root, exist_ok=True)
+        if registry_endpoint:
+            from ..distributed.fleet.replicated_kv import make_registry
+            self.registry = make_registry(registry_endpoint, ttl=ttl)
+        else:
+            self.registry = FileRegistry(root, job_id, ttl=ttl)
         self._env = {**os.environ, **(env or {})}
         self._procs: dict[str, subprocess.Popen] = {}
         self._logs: dict[str, str] = {}
@@ -869,10 +929,14 @@ class ServingFleet:
         self._logs[name] = log_path
         log = open(log_path, "w")
         role = self._roles.get(name, "unified")
+        if self.registry_endpoint:
+            reg_args = ["--registry-endpoint", self.registry_endpoint]
+        else:
+            reg_args = ["--registry-root", self.root]
         proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.inference.replica",
              "--name", name, "--spec", json.dumps(self.spec),
-             "--registry-root", self.root, "--job-id", self.job_id,
+             *reg_args, "--job-id", self.job_id,
              "--ttl", str(self.ttl), "--host", self.host,
              "--role", role],
             stdout=log, stderr=subprocess.STDOUT, cwd=_REPO_ROOT,

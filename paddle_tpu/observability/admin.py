@@ -200,6 +200,11 @@ class AdminServer:
                     except Exception as e:
                         return self._json(
                             {"error": f"{type(e).__name__}: {e}"}, 500)
+                    if isinstance(obj, (bytes, bytearray, memoryview)):
+                        # binary route (the disagg KV-page frame): raw
+                        # octet-stream, no JSON/base64 dressing
+                        return self._send(code, bytes(obj),
+                                          "application/octet-stream")
                     return self._json(obj, code)
                 if route == "/metrics":
                     text = render_prometheus(metrics.snapshot())
@@ -254,10 +259,17 @@ class AdminServer:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) if n else b""
                 if route in ref.post_routes:
-                    try:
-                        payload = json.loads(body) if body else {}
-                    except ValueError:
-                        return self._send(400)
+                    ctype = self.headers.get("Content-Type", "")
+                    if ctype.startswith("application/octet-stream"):
+                        # binary route: the handler gets the raw bytes
+                        # (the disagg transfer frame) — parsing them is
+                        # its contract, not this server's
+                        payload = body
+                    else:
+                        try:
+                            payload = json.loads(body) if body else {}
+                        except ValueError:
+                            return self._send(400)
                     try:
                         code, obj = ref.post_routes[route](payload)
                     except Exception as e:

@@ -149,6 +149,16 @@ declare("PADDLE_ELASTIC_GEN", "0",
 declare("PADDLE_WATCHDOG_WARN_FRAC", "0.75",
         "fraction of the comm-watchdog abort budget at which the "
         "near-deadline warn signal fires")
+declare("PADDLE_KV_PEERS", "",
+        "comma-separated replicated-registry peer endpoints "
+        "(host:port,...); >1 peer = quorum-replicated KV master, "
+        "empty/1 = the single-master pre-replication topology")
+declare("PADDLE_KV_QUORUM_TIMEOUT_S", "5",
+        "budget for one replicated-registry op to reach majority ack "
+        "before it raises the typed NoQuorumError")
+declare("PADDLE_KV_REPLICAS", "1",
+        "registry peer count the launcher spawns with --elastic_server "
+        "auto (in-process peer set, supervised + snapshot catch-up)")
 
 # ----------------------------------------------------------- observability
 

@@ -142,6 +142,148 @@ class _DisaggReplicas:
             rep.stop()
 
 
+# ------------------------------------------------------- binary framing
+
+class TestBinaryFrame:
+    """ISSUE 12 satellite: the transfer wire is a length-prefixed binary
+    frame — payload bytes ship verbatim (the old base64-JSON encoding
+    paid 4/3× transport on every hop) and check_blob_geometry keeps its
+    no-decode validation contract against the raw byte count."""
+
+    def test_frame_roundtrip_bit_identical_install(self, small_model):
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame,
+                                                          unpack_frame)
+        cfg, params = small_model
+        pre = _engine(cfg, params, kv_layout="paged", kv_dtype="int8")
+        rid = pre.add_request(_prompts(1, seed=3)[0], max_new_tokens=4,
+                              prefill_only=True)
+        pre.run()
+        blob = pre.export_kv(rid)
+        frame = pack_frame({"kv": blob_meta(blob), "rid": 7},
+                           blob["data"])
+        header, payload = unpack_frame(frame)
+        assert header["rid"] == 7
+        assert payload == bytes(blob["data"])          # verbatim bytes
+        rebuilt = dict(header["kv"], data=payload)
+        dec = _engine(cfg, params, kv_layout="paged", kv_dtype="int8")
+        dst_ids = list(range(1, 1 + blob["n_pages"]))
+        a = install_pages(dec._cache, cfg, dst_ids, blob, "int8")
+        b = install_pages(dec._cache, cfg, dst_ids, rebuilt, "int8")
+        for leaf in ("k", "v", "k_scale", "v_scale"):
+            for la, lb in zip(a[leaf], b[leaf]):
+                assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_transport_cost_is_wire_bytes_plus_small_header(
+            self, small_model):
+        """The ~33% cut, pinned: frame transport == wire_bytes + a small
+        constant header, where base64-JSON paid ceil(4/3×) plus JSON
+        dressing."""
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame)
+        cfg, params = small_model
+        pre = _engine(cfg, params, kv_layout="paged")
+        rid = pre.add_request(_prompts(1, seed=4, lo=16, hi=17)[0],
+                              max_new_tokens=4, prefill_only=True)
+        pre.run()
+        blob = pre.export_kv(rid)
+        frame = pack_frame({"kv": blob_meta(blob)}, blob["data"])
+        overhead = len(frame) - blob["wire_bytes"]
+        assert 0 < overhead < 512, overhead
+        base64_cost = -(-blob["wire_bytes"] * 4 // 3)  # what the old wire paid
+        assert len(frame) < 0.80 * base64_cost
+
+    def test_bad_frames_answer_400_at_the_wire(self, small_model,
+                                               tmp_path):
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame)
+        cfg, params = small_model
+        eng = _engine(cfg, params, kv_layout="paged",
+                      admission=AdmissionPolicy())
+        rep = ReplicaServer(eng, el.FileRegistry(str(tmp_path), "f",
+                                                 ttl=5), "r0")
+        code, ans = rep._h_kv_transfer(b"not a frame at all")
+        assert code == 400 and "bad frame" in ans["reason"]
+        pre = _engine(cfg, params, kv_layout="paged")
+        rid = pre.add_request(_prompts(1, seed=5)[0],
+                              max_new_tokens=4, prefill_only=True)
+        pre.run()
+        blob = pre.export_kv(rid)
+        frame = pack_frame(
+            {"kv": blob_meta(blob), "rid": 1, "prompt": [1] * 8,
+             "max_new_tokens": 2, "router": "t"}, blob["data"])
+        code, ans = rep._h_kv_transfer(frame[: len(frame) // 2])
+        assert code == 400, ans   # truncated payload: byte-count gate
+
+    def test_mid_body_death_is_transient_wire_noise(self):
+        """A replica SIGKILLed while streaming a multi-MB /kv_blob frame
+        surfaces as http.client.IncompleteRead (HTTPException, not
+        OSError) — it must classify transient so the fetch degrades to
+        re-prefill instead of crashing the router's poll loop."""
+        import http.client
+
+        from paddle_tpu.inference.router import _transient_send
+        assert _transient_send(http.client.IncompleteRead(b"partial"))
+        assert _transient_send(http.client.BadStatusLine("x"))
+        assert not _transient_send(TypeError("our bug"))
+
+    def test_frame_store_reexport_keeps_live_frame(self, small_model,
+                                                   tmp_path):
+        """A re-prefill landing on the same replica overwrites its frame
+        IN PLACE: a duplicate eviction-order entry would otherwise evict
+        the live replacement when the stale entry aged out — 404 → a
+        wasted third prompt pass."""
+        from paddle_tpu.inference.replica import _KV_FRAME_KEEP
+        cfg, params = small_model
+        eng = _engine(cfg, params, kv_layout="paged")
+        rep = ReplicaServer(eng, el.FileRegistry(str(tmp_path), "f",
+                                                 ttl=5), "r0")
+        key = ("rt", 1)
+        rep._store_frame(key, b"first")
+        rep._store_frame(key, b"second")           # re-export, same rid
+        assert list(rep._kv_frame_order).count(key) == 1
+        for i in range(_KV_FRAME_KEEP - 1):        # age the store
+            rep._store_frame(("rt", 100 + i), b"x")
+        assert rep._kv_frames.get(key) == b"second"
+        rep._store_frame(("rt", 999), b"x")        # now key is oldest
+        assert key not in rep._kv_frames
+        assert len(rep._kv_frames) == _KV_FRAME_KEEP
+
+    def test_fetch_blob_uses_result_source_after_mark_dead(
+            self, small_model, tmp_path):
+        """The falsely-suspected-prefill salvage: by the time the late
+        'prefilled' result arrives, _mark_dead deleted the handle — the
+        frame fetch must go to the endpoint the result CAME from, not
+        through the routing table."""
+        cfg, params = small_model
+        fleet = _DisaggReplicas(tmp_path, cfg, params, ["prefill"])
+        try:
+            rep = fleet.reps[0]
+            router = DisaggRouter(fleet.registry)
+            # a parked frame on the replica under this router's namespace
+            code, ans = rep._h_enqueue(
+                {"rid": 5, "prompt": _prompts(1, seed=9)[0],
+                 "max_new_tokens": 4, "router": router._rid_ns,
+                 "prefill_only": True})
+            assert code == 200, ans
+            deadline = time.time() + 30
+            while (router._rid_ns, 5) not in rep._kv_frames:
+                assert time.time() < deadline, "frame never exported"
+                time.sleep(0.05)
+            with rep._lk:
+                meta = next(r["kv"] for r in rep._results
+                            if r["rid"] == 5)
+            req = RoutedRequest(5, [1, 2], 4, trace_id=0)
+            req.replica = "serve.gone"   # handle already swept (no entry)
+            blob = router._fetch_blob(req, meta, src=rep.endpoint)
+            assert blob is not None and blob["data"], "salvage fetch died"
+            assert len(blob["data"]) == meta["wire_bytes"]
+            # and without src (pre-fix path) the handle miss returns None
+            assert router._fetch_blob(req, meta, src=None) is None
+        finally:
+            fleet.stop()
+
+
 # ------------------------------------------------------------ wire format
 
 class TestTransferWire:

@@ -209,6 +209,162 @@ class TestSelfHealingFleetDrill:
                     p.kill()
 
 
+class TestReplicatedRegistryReformDrill:
+    """ISSUE 12 acceptance drill (b): the fleet's elastic state lives on
+    a 3-peer replicated registry (subprocess peers); SIGKILL one peer AND
+    one launcher mid-run — the survivors' quorum clients fail over
+    (kv.peer_failover flight/echo), re-rendezvous completes at the next
+    generation through the remaining majority, and the 12-step loss
+    trajectory stays bitwise-identical to the fault-free run."""
+
+    STEPS = 12
+
+    def _spawn_peers(self, job, n=3, ttl=1.5):
+        ports = [_free_port() for _ in range(n)]
+        # the peers must share the launchers' job identity: the KV write
+        # auth token is derived from PADDLE_JOB_ID
+        env = {**os.environ, "PADDLE_JOB_ID": job, "PYTHONPATH":
+               REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+        procs = [subprocess.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.fleet.replicated_kv",
+             "--port", str(p), "--ttl", str(ttl)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=env) for p in ports]
+        eps = [f"127.0.0.1:{p}" for p in ports]
+        import urllib.request
+        deadline = time.time() + 30
+        for ep in eps:
+            while True:
+                try:
+                    urllib.request.urlopen(f"http://{ep}/nodes",
+                                           timeout=1).read()
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        for pr in procs:
+                            pr.kill()
+                        raise TimeoutError(f"kv peer {ep} never came up")
+                    time.sleep(0.1)
+        return procs, eps
+
+    def test_kill_peer_and_node_step_exact(self, tmp_path):
+        job = f"rk-{uuid.uuid4().hex[:8]}"
+        drill = str(tmp_path / "drill")
+        trace = str(tmp_path / "trace")
+        os.makedirs(drill, exist_ok=True)
+        peers, eps = self._spawn_peers(job, 3, ttl=1.5)
+        env = {"DRILL_DIR": drill, "DRILL_STEPS": str(self.STEPS),
+               "DRILL_STEP_S": "0.3", "DRILL_BAR_TIMEOUT": "4",
+               "PADDLE_TRACE_DIR": trace}
+        args = ("--elastic_server", ",".join(eps), "--job_id", job,
+                "--heartbeat_interval", "0.25", "--elastic_timeout", "60",
+                "--join_window", "0.5")
+        launchers = [
+            _launcher(r, "2:3", "127.0.0.1:0", "elastic_resume.py", job,
+                      extra_env=env, extra_args=args)
+            for r in range(3)
+        ]
+
+        def read_losses():
+            rows = []
+            for node in range(3):
+                path = os.path.join(drill, f"losses.node-{node}.jsonl")
+                if not os.path.exists(path):
+                    continue
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            try:
+                                rows.append(dict(json.loads(line),
+                                                 node=node))
+                            except ValueError:
+                                pass
+            return rows
+
+        try:
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                rows = read_losses()
+                per_node = {}
+                for r in rows:
+                    per_node[r["node"]] = max(
+                        per_node.get(r["node"], 0), r["step"])
+                if len(per_node) == 3 and min(per_node.values()) >= 3:
+                    break
+                dead = [i for i, p in enumerate(launchers)
+                        if p.poll() is not None]
+                if dead:
+                    outs = launchers[dead[0]].communicate()[0]
+                    pytest.fail(f"launcher {dead[0]} died during warmup:\n"
+                                f"{(outs or '')[-2000:]}")
+                time.sleep(0.3)
+            else:
+                pytest.fail(f"fleet never reached step 3: {read_losses()}")
+
+            # the drill's double kill: a registry PEER dies (SIGKILL, no
+            # goodbye) and node 0 goes away — the re-rendezvous that
+            # follows must run entirely on the surviving 2/3 quorum
+            peers[0].kill()
+            launchers[0].send_signal(signal.SIGTERM)
+            launchers[0].wait(timeout=60)
+
+            outs = [None] * 3
+            for i in (1, 2):
+                outs[i], _ = launchers[i].communicate(timeout=240)
+                assert launchers[i].returncode == 0, \
+                    f"launcher {i} rc={launchers[i].returncode}:\n" \
+                    f"{outs[i][-3000:]}"
+
+            survivors = outs[1] + outs[2]
+            assert "relaunch at np=2 gen=" in survivors, survivors[-3000:]
+            gens = [int(m) for m in
+                    re.findall(r"relaunch at np=2 gen=(\d+)", survivors)]
+            assert gens and max(gens) >= 1, survivors[-3000:]
+            assert "DRILL_DONE" in outs[1] and "DRILL_DONE" in outs[2], \
+                survivors[-3000:]
+            assert "exit 124" not in survivors
+            # the quorum client really failed over the dead peer
+            assert "registry peer" in survivors and "down" in survivors, \
+                survivors[-3000:]
+
+            # bitwise step-exactness, same contract as the FileRegistry
+            # self-healing drill
+            expected = TestSelfHealingFleetDrill._expected_losses(
+                self.STEPS)
+            got = {}
+            for r in read_losses():
+                got.setdefault(r["step"], set()).add(r["loss"])
+            assert set(range(1, self.STEPS + 1)) <= set(got), sorted(got)
+            for step in range(1, self.STEPS + 1):
+                assert got[step] == {expected[step]}, (
+                    step, got[step], expected[step])
+
+            # the survivors' launcher flights carry both stories: the
+            # new generation AND the registry-peer failover
+            regen, kvfail = [], []
+            for node in (1, 2):
+                fp = os.path.join(trace, f"node-{node}.launcher",
+                                  "FLIGHT.json")
+                assert os.path.exists(fp), os.listdir(trace)
+                with open(fp) as f:
+                    doc = json.load(f)
+                regen += [e for e in doc["events"]
+                          if e["kind"] == "elastic.regen"]
+                kvfail += [e for e in doc["events"]
+                           if e["kind"] == "kv.peer_failover"]
+            assert regen and max(e["gen"] for e in regen) >= 1, regen
+            assert kvfail, "no kv.peer_failover event in survivor flights"
+        finally:
+            for p in launchers:
+                if p.poll() is None:
+                    p.kill()
+            for p in peers:
+                if p.poll() is None:
+                    p.kill()
+
+
 class TestElasticDrill:
     def test_kill_node_restart_resume(self, tmp_path):
         """Elastic e2e: 2 nodes up (1:2 range) → kill node 1's launcher →

@@ -93,6 +93,20 @@ class TestRetry:
         b = RetryPolicy(seed=7).delays()
         assert [next(a) for _ in range(5)] == [next(b) for _ in range(5)]
 
+    def test_delays_generator_survives_thousands_of_draws(self):
+        """A long-lived unlimited-attempt consumer (poller, the ISSUE-12
+        per-peer backoff) draws from ONE delays() generator for the life
+        of the process: the exponent must saturate at the cap instead of
+        walking 2.0**k into float OverflowError (~k=1024), which would
+        kill the generator and every later retry with StopIteration."""
+        g = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.0).delays()
+        seen = [next(g) for _ in range(2000)]
+        assert seen[-1] == 1.0 and max(seen) == 1.0
+        # base_delay=0 never reaches the cap, so the exponent itself must
+        # be bounded or 2.0**k still overflows at k=1024
+        g0 = RetryPolicy(base_delay=0.0, max_delay=1.0, jitter=0.0).delays()
+        assert [next(g0) for _ in range(1500)][-1] == 0.0
+
     def test_chaos_error_passes_through_unretried(self):
         calls = []
 

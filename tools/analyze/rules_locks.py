@@ -37,7 +37,10 @@ SCOPE_FILES = ("paddle_tpu/inference/serving.py",
                # the serve loop, router vs nothing (single-threaded by
                # contract) — both audited like the telemetry plane
                "paddle_tpu/inference/replica.py",
-               "paddle_tpu/inference/router.py")
+               "paddle_tpu/inference/router.py",
+               # the replicated registry (ISSUE 12): quorum fan-out
+               # threads + beat/rendezvous callers share peer state
+               "paddle_tpu/distributed/fleet/replicated_kv.py")
 
 _LOCKNAME = re.compile(r"lock|(^|_)lk($|_)|(^|_)cv($|_)|mutex")
 _MUTATORS = frozenset({

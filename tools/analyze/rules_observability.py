@@ -46,6 +46,10 @@ HTTP_ALLOWLIST = {
     "paddle_tpu/distributed/fleet/elastic.py":
         "KVServer/KVRegistry — the sanctioned registry transport the "
         "admin/fleet plane mirrors (token-authed, retry-wrapped)",
+    "paddle_tpu/distributed/fleet/replicated_kv.py":
+        "quorum client + peer catch-up of the replicated registry — the "
+        "N-peer extension of elastic.py's sanctioned KV transport "
+        "(token-authed, budget-bounded rounds)",
     "paddle_tpu/distributed/rpc.py":
         "rpc worker discovery GET against the elastic registry master",
     "paddle_tpu/hub.py":
