@@ -279,6 +279,75 @@ def _disagg_drill(n_prefill: int, n_decode: int) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _prefix_bench(cfg, params, max_batch, max_len, buckets, burst,
+                  page_size, cache_pages, prompt, n_req) -> dict:
+    """ISSUE 13: the prefix-sharing sub-object — a common system prompt
+    (2 full pages; fits the smallest bucket grid with its tails) with
+    per-request tails, served with the cache ON (second pass warm: every
+    admit hits) vs OFF. TTFT is measured directly as single-request
+    mnt=1 serve walls (enqueue → first token IS the whole serve),
+    because the slo histograms are process-global and the other serving
+    passes already filled them."""
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.inference import ContinuousBatcher
+
+    rng = np.random.RandomState(17)
+    sys_prompt = prompt(2 * page_size)
+    tail_lens = rng.choice([3, 7, 11], n_req)
+    reqs = [(sys_prompt + prompt(int(k)), 6) for k in tail_lens]
+
+    def engine(pages):
+        return ContinuousBatcher(cfg, params, max_batch=max_batch,
+                                 max_len=max_len, prompt_buckets=buckets,
+                                 burst=burst, kv_layout="paged",
+                                 page_size=page_size,
+                                 prefix_cache_pages=pages)
+
+    def ttft_p50(eng, n=5):
+        walls = []
+        for i in range(n):
+            t0 = _time.perf_counter()
+            eng.add_request(sys_prompt + prompt(3 + i), max_new_tokens=1)
+            eng.run()
+            walls.append(_time.perf_counter() - t0)
+        return float(np.median(walls))
+
+    on = engine(cache_pages)
+    for p, m in reqs:                      # pass 1: compiles + populates
+        on.add_request(p, max_new_tokens=m)
+    on.run()
+    h0 = on.stats.get("prefix_hits", 0)
+    for p, m in reqs:                      # pass 2: warm — every admit hits
+        on.add_request(p, max_new_tokens=m)
+    on.run()
+    hits = on.stats.get("prefix_hits", 0) - h0
+    snap = dict(on.stats)                  # before the TTFT probes admit more
+    ttft_shared = ttft_p50(on)
+
+    off = engine(0)
+    for p, m in reqs:                      # compile pass
+        off.add_request(p, max_new_tokens=m)
+    off.run()
+    ttft_unshared = ttft_p50(off)
+
+    total_hits = snap.get("prefix_hits", 0)
+    return {
+        "cache_pages": int(cache_pages),
+        "hit_rate": round(hits / max(1, n_req), 3),
+        "pages_shared": int(snap.get("prefix_pages_shared", 0)),
+        "marginal_pages_per_shared_admit": (
+            round(snap.get("prefix_marginal_pages", 0) / total_hits, 2)
+            if total_hits else None),
+        "resumes": int(snap.get("prefix_resumes", 0)),
+        "cow_copies": int(snap.get("cow_copies", 0)),
+        "ttft_p50_shared_s": round(ttft_shared, 5),
+        "ttft_p50_unshared_s": round(ttft_unshared, 5),
+    }
+
+
 def _main():
     n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     max_batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -464,6 +533,23 @@ def _main():
         except BaseException as e:
             fleet_obj = {"error": f"{type(e).__name__}: {e}"}
 
+    # prefix sharing (ISSUE 13): PADDLE_PREFIX_CACHE_PAGES > 0 serves a
+    # common-system-prompt workload with the cache on (warm) vs off and
+    # reports the `prefix` sub-object; null otherwise (all-unique prompts
+    # would only pay the hash cost — the README says when not to enable).
+    # A failure lands as prefix.error — the JSON line survives.
+    prefix_obj = None
+    cache_pages = int(os.environ.get("PADDLE_PREFIX_CACHE_PAGES", "0")
+                      or 0)
+    if cache_pages > 0:
+        try:
+            prefix_obj = _prefix_bench(
+                cfg, params, max_batch, max_len, buckets, burst,
+                page_size, cache_pages, prompt,
+                n_req=min(n_req, 8))
+        except BaseException as e:
+            prefix_obj = {"error": f"{type(e).__name__}: {e}"}
+
     # disaggregated prefill/decode drill (ISSUE 11): PADDLE_SERVE_DISAGG=1
     # spawns a mixed fleet (PADDLE_SERVE_PREFILL_REPLICAS prefill +
     # max(2, PADDLE_SERVE_REPLICAS - prefill) decode) behind a
@@ -487,6 +573,7 @@ def _main():
         "slo": slo_obj,
         "fleet_serve": fleet_obj,
         "disagg": disagg_obj,
+        "prefix": prefix_obj,
         "ragged": ragged_obj,
         "quant": quant_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),

@@ -76,6 +76,14 @@ SITES: dict[str, str] = {
                           "prompt pass is re-enqueued by the router "
                           "(fault defers the re-prefill one tick, never "
                           "loses it)",
+    "serve.prefix_evict": "before a prefix-cache entry is LRU-evicted "
+                          "(fault models an eviction racing a concurrent "
+                          "hit: the entry survives, the reclaim returns "
+                          "fewer pages — admission stalls, tokens never "
+                          "change)",
+    "serve.prefix_hash": "before a prefix-cache lookup at admit (fault "
+                         "degrades the hit to a plain MISS — the request "
+                         "admits unshared, token-identically)",
     "serve.reject":    "before an admission rejection is returned (fault "
                        "degrades the retry-after hint to the floor; the "
                        "rejection stands)",

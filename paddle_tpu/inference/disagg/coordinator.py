@@ -52,7 +52,7 @@ from ...distributed.resilience import chaos
 from ...observability import metrics, recorder as _recorder, slo as _slo
 from ...utils import env_flags
 from ..router import Router, RoutedRequest
-from .transfer import blob_meta, pack_frame, unpack_frame
+from .transfer import blob_meta, pack_frame, slice_blob, unpack_frame
 
 __all__ = ["DisaggRouter"]
 
@@ -60,8 +60,8 @@ ENV_XFER_TIMEOUT = "PADDLE_SERVE_XFER_TIMEOUT_S"
 
 # per-stage fleet counters added on top of the base set — same _count
 # discipline (instance tally + process-global counter + per-router gauge)
-_STAGE_COUNTS = ("transfers", "xfer_faults", "reprefills",
-                 "failovers_prefill", "failovers_decode")
+_STAGE_COUNTS = ("transfers", "transfers_sliced", "xfer_faults",
+                 "reprefills", "failovers_prefill", "failovers_decode")
 
 
 class DisaggRouter(Router):
@@ -84,6 +84,8 @@ class DisaggRouter(Router):
         # handles anyway, so declines pause the transfer lane until then
         self._xfer_next_try = -1e9
         self.xfer_bytes_total = 0          # raw wire bytes shipped
+        self.xfer_pages_skipped = 0        # pages the decode pool already
+        #                                    held shared (ISSUE 13)
         for c in _STAGE_COUNTS:
             self._fleet_counts[c] = 0
             metrics.counter(f"serve.fleet.{c}")
@@ -310,12 +312,40 @@ class DisaggRouter(Router):
                     self._xfer_next_try = now + self._probe_s
                 self._xfer.append(rid)
 
+    def _maybe_slice(self, req: RoutedRequest, h) -> tuple[dict, int]:
+        """(blob to ship to replica ``h``, pages skipped): probe a
+        prefix-sharing decode replica for the leading prompt pages its
+        cache already holds (ISSUE 13) and slice the wire to the unshared
+        remainder — a shared system prompt then crosses the transfer wire
+        ONCE per decode replica, not once per request. The probe is one
+        tiny JSON round trip, advisory by design: any probe hiccup or an
+        eviction racing the transfer just ships the full blob (or, past
+        the admit re-match, sheds into the established re-prefill
+        recovery) — never a lost request."""
+        kv = req.kv
+        n = int(kv.get("n_pages", 0))
+        if not h.prefix_sharing or n <= 1:
+            return kv, 0
+        code, body = self._post(h.endpoint, "/kv_transfer",
+                                {"probe": True, "prompt": req.prompt,
+                                 "router": self._rid_ns})
+        if code != 200:
+            return kv, 0
+        k = int(body.get("from_page", 0) or 0) \
+            - int(kv.get("from_page", 0) or 0)
+        if k <= 0:
+            return kv, 0
+        k = min(k, n - 1)   # the tail page always travels
+        try:
+            return slice_blob(kv, k), k
+        except ValueError:
+            return kv, 0
+
     def _try_transfer(self, req: RoutedRequest) -> str:
         """One transfer attempt over the decode candidates, least-loaded
         first — the stage-two twin of _try_route, with the POOL-pressure
         gate where stage one gates on queue depth."""
         faulted = False
-        n_pages = int(req.kv.get("n_pages", 0))
         cands = self._candidates(include_draining=req.retried,
                                  role="decode")
         if req.last_faulted:
@@ -325,8 +355,11 @@ class DisaggRouter(Router):
             else:
                 cands.sort(key=lambda c: c.id != req.last_faulted)
         for h in cands:
+            kv_send, skipped = self._maybe_slice(req, h)
+            n_pages = int(kv_send.get("n_pages", 0))
             if h.id != req.last_faulted and h.free_pages is not None \
-                    and h.free_pages - h.queued_kv_pages < n_pages:
+                    and (h.free_pages + h.evictable_pages
+                         - h.queued_kv_pages) < n_pages:
                 continue   # page-starved: don't bounce off its 429
             # binary hop (ISSUE 12): header JSON + raw payload in one
             # length-prefixed frame — the payload bytes ship verbatim
@@ -335,8 +368,8 @@ class DisaggRouter(Router):
                 {"rid": req.rid, "prompt": req.prompt,
                  "max_new_tokens": req.max_new_tokens,
                  "trace_id": req.trace_id, "force": req.retried,
-                 "router": self._rid_ns, "kv": blob_meta(req.kv)},
-                bytes(req.kv["data"]))
+                 "router": self._rid_ns, "kv": blob_meta(kv_send)},
+                bytes(kv_send["data"]))
             code, body = self._post_bytes(h.endpoint, "/kv_transfer",
                                           frame,
                                           timeout=self._xfer_timeout)
@@ -347,7 +380,10 @@ class DisaggRouter(Router):
                 req.t_stage = now
                 req.replica = h.id
                 req.stage = "decode"
-                self.xfer_bytes_total += int(req.kv.get("wire_bytes", 0))
+                self.xfer_bytes_total += int(kv_send.get("wire_bytes", 0))
+                if skipped:
+                    self.xfer_pages_skipped += skipped
+                    self._count("transfers_sliced")
                 req.kv = None   # delivered; the router holds no copy
                 req.last_faulted = None
                 self._inflight[req.rid] = req
@@ -394,6 +430,7 @@ class DisaggRouter(Router):
         s = super().summary()
         s["transferring"] = len(self._xfer)
         s["xfer_bytes_total"] = self.xfer_bytes_total
+        s["xfer_pages_skipped"] = self.xfer_pages_skipped
         s["stages"] = {
             rid: self._requests[rid].stage
             for rid in list(self._inflight)

@@ -55,7 +55,7 @@ from ...quant.codec import (MODES, dequantize_lastdim, normalize_scale_gran,
 
 __all__ = ["serialize_pages", "install_pages", "wire_breakdown",
            "wire_ratio_vs_f32", "pages_in_blob", "check_blob_geometry",
-           "pack_frame", "unpack_frame", "blob_meta"]
+           "pack_frame", "unpack_frame", "blob_meta", "slice_blob"]
 
 # wire schema version: an install refuses a blob it cannot parse instead
 # of corrupting a pool with misaligned bytes
@@ -192,6 +192,67 @@ def serialize_pages(config, cache, page_ids, tlen: int, first: int,
     }
 
 
+def _blob_segments(blob: dict):
+    """The packed-byte layout of one blob as (dtype, shape) pairs in
+    serialization order, each with the page count on axis 0 — the ONE
+    authoritative walk :func:`slice_blob`, ``_blob_values`` AND
+    ``install_pages``' verbatim fast path all consume. Mirrors
+    :func:`serialize_pages` exactly; a wire-format change edits the two
+    of them together and nothing else."""
+    L, n, ps = int(blob["layers"]), int(blob["n_pages"]), \
+        int(blob["page_size"])
+    kv, hd = int(blob["kv_heads"]), int(blob["head_dim"])
+    mode, gran = blob["kv_dtype"], blob.get("scale_gran", "row")
+    if mode is None:
+        return [(_F32, (n, ps, kv, hd))] * (2 * L)
+    wdt = _np_wire_dtype(mode)
+    if gran == "row":
+        return [(wdt, (n, ps, kv, hd))] * (2 * L) \
+            + [(_F32, (n, ps, kv))] * (2 * L)
+    return [(wdt, (n, kv, ps * hd))] * (2 * L) + [(_F32, (n, kv))] * (2 * L)
+
+
+def slice_blob(blob: dict, from_page: int) -> dict:
+    """A blob covering only pages [from_page, n_pages) — the prefix-
+    sharing transfer shrink (ISSUE 13): when the DECODE pool's prefix
+    cache already holds the request's leading pages (the /kv_transfer
+    probe says so), the wire carries only the unshared remainder and the
+    install maps the prefix from the cache. ``from_page`` accumulates in
+    the blob header (``n_pages`` becomes the remainder) so geometry and
+    byte-count checks stay exact; page-granular scale blocks slice the
+    already-quantized bytes, so the sliced pages land bit-identical to a
+    full transfer's. Callers keep ``from_page < n_pages`` — the tail page
+    always travels (it is the one decode writes into)."""
+    k = int(from_page)
+    n = int(blob["n_pages"])
+    if k <= 0:
+        return blob
+    if k >= n:
+        raise ValueError(f"slice_blob: from_page {k} must leave at least "
+                         f"the tail page of {n}")
+    raw = _Reader(bytes(blob["data"]))
+    parts: list[bytes] = []
+    payload_bytes = scale_bytes = 0
+    segs = _blob_segments(blob)
+    for i, (dt, shape) in enumerate(segs):
+        arr = raw.take(dt, shape)[k:]
+        b = np.ascontiguousarray(arr).tobytes()
+        parts.append(b)
+        # scale segments are the trailing half only for quantized blobs
+        if blob["kv_dtype"] is not None and i >= len(segs) // 2:
+            scale_bytes += len(b)
+        else:
+            payload_bytes += len(b)
+    out = dict(blob)
+    out["n_pages"] = n - k
+    out["from_page"] = int(blob.get("from_page", 0) or 0) + k
+    out["payload_bytes"] = payload_bytes
+    out["scale_bytes"] = scale_bytes
+    out["wire_bytes"] = payload_bytes + scale_bytes
+    out["data"] = b"".join(parts)
+    return out
+
+
 # ---------------------------------------------------------------- framing
 
 def blob_meta(blob: dict) -> dict:
@@ -275,14 +336,23 @@ def check_blob_geometry(blob: dict, config, page_size: int) -> int:
     if n < 1:
         raise ValueError(f"kv transfer blob has n_pages={n}")
     tlen = int(blob.get("tlen", -1))
-    if tlen < 1 or n != (tlen - 1) // int(page_size) + 1:
-        # the install allocates pages_for(tlen) pages and scatter refuses
-        # a count mismatch — catch the inconsistency at the boundary so
-        # it answers 400, not a serve-loop-side terminal error (and so
-        # the pool-pressure gate never reserves an inflated page count)
+    k = int(blob.get("from_page", 0) or 0)
+    total = 0 if tlen < 1 else (tlen - 1) // int(page_size) + 1
+    if k < 0 or k >= max(1, total):
+        # a sliced blob (ISSUE 13) must leave at least the tail page —
+        # the one decode writes into is never supplied by a prefix cache
         raise ValueError(
-            f"kv transfer blob holds {n} pages for tlen={tlen} at "
-            f"page_size={page_size} — inconsistent")
+            f"kv transfer blob from_page={k} out of range for "
+            f"tlen={tlen} at page_size={page_size}")
+    if tlen < 1 or n != total - k:
+        # the install allocates pages_for(tlen) - from_page pages and
+        # scatter refuses a count mismatch — catch the inconsistency at
+        # the boundary so it answers 400, not a serve-loop-side terminal
+        # error (and so the pool-pressure gate never reserves an
+        # inflated page count)
+        raise ValueError(
+            f"kv transfer blob holds {n} pages for tlen={tlen} "
+            f"(from_page={k}) at page_size={page_size} — inconsistent")
     mode, gran = blob.get("kv_dtype"), blob.get("scale_gran", "row")
     if mode is not None and mode not in MODES:
         raise ValueError(f"unknown kv transfer wire dtype {mode!r}")
@@ -307,37 +377,30 @@ def check_blob_geometry(blob: dict, config, page_size: int) -> int:
 def _blob_values(blob: dict, raw: _Reader):
     """Yield per-layer (k_values, v_values) float32 [n_pages, ps, KV, hd]
     reconstructed from the wire — the universal intermediate every
-    mismatched-format install goes through."""
-    L, n, ps = int(blob["layers"]), int(blob["n_pages"]), int(blob["page_size"])
+    mismatched-format install goes through. Driven by
+    :func:`_blob_segments`, the ONE authoritative layout walk."""
+    L, n, ps = int(blob["layers"]), int(blob["n_pages"]), \
+        int(blob["page_size"])
     kv, hd = int(blob["kv_heads"]), int(blob["head_dim"])
     mode, gran = blob["kv_dtype"], blob.get("scale_gran", "row")
+    arrs = [raw.take(dt, shape) for dt, shape in _blob_segments(blob)]
     if mode is None:
-        payload = [(raw.take(_F32, (n, ps, kv, hd)),
-                    raw.take(_F32, (n, ps, kv, hd))) for _ in range(L)]
-        for k, v in payload:
-            yield np.asarray(k), np.asarray(v)
+        for l in range(L):
+            yield np.asarray(arrs[2 * l]), np.asarray(arrs[2 * l + 1])
         return
-    wdt = _np_wire_dtype(mode)
-    if gran == "row":
-        payload = [(raw.take(wdt, (n, ps, kv, hd)),
-                    raw.take(wdt, (n, ps, kv, hd))) for _ in range(L)]
-        scales = [(raw.take(_F32, (n, ps, kv)),
-                   raw.take(_F32, (n, ps, kv))) for _ in range(L)]
-        for (k, v), (ks, vs) in zip(payload, scales):
-            yield (np.asarray(dequantize_lastdim(jnp.asarray(k),
-                                                 jnp.asarray(ks))),
-                   np.asarray(dequantize_lastdim(jnp.asarray(v),
-                                                 jnp.asarray(vs))))
-        return
-    payload = [(raw.take(wdt, (n, kv, ps * hd)),
-                raw.take(wdt, (n, kv, ps * hd))) for _ in range(L)]
-    scales = [(raw.take(_F32, (n, kv)),
-               raw.take(_F32, (n, kv))) for _ in range(L)]
-    for (k, v), (ks, vs) in zip(payload, scales):
-        kvals = dequantize_lastdim(jnp.asarray(k), jnp.asarray(ks))
-        vvals = dequantize_lastdim(jnp.asarray(v), jnp.asarray(vs))
-        yield (np.asarray(kvals.reshape(n, kv, ps, hd).transpose(0, 2, 1, 3)),
-               np.asarray(vvals.reshape(n, kv, ps, hd).transpose(0, 2, 1, 3)))
+    payload, scales = arrs[:2 * L], arrs[2 * L:]
+    for l in range(L):
+        kvals = dequantize_lastdim(jnp.asarray(payload[2 * l]),
+                                   jnp.asarray(scales[2 * l]))
+        vvals = dequantize_lastdim(jnp.asarray(payload[2 * l + 1]),
+                                   jnp.asarray(scales[2 * l + 1]))
+        if gran == "row":
+            yield np.asarray(kvals), np.asarray(vvals)
+        else:
+            yield (np.asarray(kvals.reshape(n, kv, ps, hd)
+                              .transpose(0, 2, 1, 3)),
+                   np.asarray(vvals.reshape(n, kv, ps, hd)
+                              .transpose(0, 2, 1, 3)))
 
 
 def install_pages(cache, config, page_ids, blob: dict,
@@ -357,20 +420,14 @@ def install_pages(cache, config, page_ids, blob: dict,
     if int(blob["n_pages"]) != len(page_ids):
         raise ValueError(f"blob holds {blob['n_pages']} pages, "
                          f"{len(page_ids)} allocated")
-    L, n = int(blob["layers"]), int(blob["n_pages"])
-    kv, hd = int(blob["kv_heads"]), int(blob["head_dim"])
+    L = int(blob["layers"])
     mode, gran = blob["kv_dtype"], blob.get("scale_gran", "row")
     raw = _Reader(bytes(blob["data"]))
 
     if mode is not None and mode == kv_dtype and gran == "row":
-        wdt = _np_wire_dtype(mode)
-        rows = {"k": [], "v": [], "k_scale": [], "v_scale": []}
-        for _ in range(L):
-            rows["k"].append(raw.take(wdt, (n, ps, kv, hd)))
-            rows["v"].append(raw.take(wdt, (n, ps, kv, hd)))
-        for _ in range(L):
-            rows["k_scale"].append(raw.take(_F32, (n, ps, kv)))
-            rows["v_scale"].append(raw.take(_F32, (n, ps, kv)))
+        arrs = [raw.take(dt, shape) for dt, shape in _blob_segments(blob)]
+        rows = {"k": arrs[0:2 * L:2], "v": arrs[1:2 * L:2],
+                "k_scale": arrs[2 * L::2], "v_scale": arrs[2 * L + 1::2]}
         return scatter_pages(cache, page_ids, rows)
 
     if kv_dtype is None:

@@ -17,9 +17,23 @@ on every shard (each device holds that page's slice of its own heads), so
 the allocator, block tables, and scratch convention stay replicated host
 metadata with no layout awareness. That is the "pool/block-table plumbing
 stays layout-agnostic" half of the GSPMD tentpole.
+
+Refcounts (ISSUE 13, prefix sharing): a page may be mapped by SEVERAL
+block tables at once (a shared system-prompt prefix) plus the prefix
+cache's own index reference. ``alloc`` hands out pages at refcount 1,
+``share`` adds references, and ``free`` only returns a page to the free
+list when its count reaches zero — so ``free_pages`` / ``pages_in_use``
+count a refcounted page ONCE however many requests map it (the admission
+accounting the capacity win is measured in). A shared page is READ-ONLY
+by convention: the scheduler copies it into a private page before any
+write that would land in it (copy-on-write, ``serving._grow_for_burst``).
+Mutations take the allocator lock: the batcher thread allocates/frees
+while replica HTTP handler threads read the counters for admission (A5
+lock discipline covers this file).
 """
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 __all__ = ["PageAllocator", "SCRATCH_PAGE", "default_page_buckets",
@@ -61,11 +75,17 @@ def default_page_buckets(max_pages: int) -> tuple:
 
 
 class PageAllocator:
-    """LIFO free list over ``num_pages`` physical pages (page 0 reserved).
+    """LIFO free list over ``num_pages`` physical pages (page 0 reserved),
+    with per-page refcounts (ISSUE 13).
 
     ``alloc`` is all-or-nothing: a partially satisfiable request returns
     None and leaves the free list untouched, so callers can treat "not
-    enough pages" as one atomic admission/growth decision.
+    enough pages" as one atomic admission/growth decision. Allocated
+    pages start at refcount 1; ``share`` adds holders (a prefix-cache hit
+    mapping the page into another block table, or the cache index
+    itself); ``free`` decrements and recycles at zero — so every byte of
+    a shared prefix is accounted exactly once however many requests map
+    it.
     """
 
     def __init__(self, num_pages: int):
@@ -73,9 +93,11 @@ class PageAllocator:
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is scratch)")
         self.num_pages = num_pages
+        self._lk = threading.Lock()
         # low page ids first: keeps early traffic in a compact prefix,
         # which makes pool dumps human-readable
         self._free = list(range(num_pages - 1, SCRATCH_PAGE, -1))
+        self._ref = [0] * num_pages
 
     @property
     def usable(self) -> int:
@@ -89,18 +111,47 @@ class PageAllocator:
     def pages_in_use(self) -> int:
         return self.usable - len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._ref[int(page)]
+
     def alloc(self, n: int) -> list | None:
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
-            return None
-        return [self._free.pop() for _ in range(n)]
+        with self._lk:
+            if n > len(self._free):
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._ref[p] = 1
+            return out
+
+    def share(self, page_ids: Sequence[int], n: int = 1) -> None:
+        """Add ``n`` references to each page — a prefix-cache hit mapping
+        shared pages into one more block table (or the cache index taking
+        its own hold). Only live pages can gain holders."""
+        with self._lk:
+            for p in page_ids:
+                p = int(p)
+                if p == SCRATCH_PAGE or p >= self.num_pages \
+                        or self._ref[p] <= 0:
+                    raise ValueError(f"sharing unallocated page {p}")
+            for p in page_ids:
+                self._ref[int(p)] += int(n)
 
     def free(self, page_ids: Sequence[int]) -> None:
-        for p in page_ids:
-            p = int(p)
-            if p == SCRATCH_PAGE or p >= self.num_pages:
-                raise ValueError(f"freeing invalid page {p}")
-            self._free.append(p)
-        if len(self._free) > self.usable:
-            raise RuntimeError("double free: free list exceeds pool")
+        """Drop one reference per page; a page recycles to the free list
+        when its last holder lets go. Freeing a page nobody holds is the
+        double-free it always was."""
+        with self._lk:
+            for p in page_ids:
+                p = int(p)
+                if p == SCRATCH_PAGE or p >= self.num_pages:
+                    raise ValueError(f"freeing invalid page {p}")
+                if self._ref[p] <= 0:
+                    raise RuntimeError(
+                        f"double free: page {p} has no holders")
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    self._free.append(p)
+            if len(self._free) > self.usable:
+                raise RuntimeError("double free: free list exceeds pool")

@@ -314,6 +314,22 @@ class ReplicaServer:
                 body["kv"]["data"] = payload
             except (ValueError, TypeError) as e:
                 return 400, {"ok": False, "reason": f"bad frame: {e}"}
+        if body.get("probe"):
+            # prefix probe (ISSUE 13): how many leading prompt pages THIS
+            # pool's prefix cache could supply a sliced transfer. A tiny
+            # JSON round trip — advisory (admit re-matches under the
+            # cache lock); never touches intake, dedup, or admission
+            try:
+                prompt = [int(t) for t in body["prompt"]]
+            except (KeyError, TypeError, ValueError) as e:
+                return 400, {"ok": False, "reason": f"bad probe: {e}"}
+            if self.role == "prefill":
+                return 400, {"ok": False,
+                             "reason": "invalid: prefill pool takes no "
+                                       "transfers"}
+            return 200, {"ok": True,
+                         "from_page": int(self._b.prefix_probe(prompt)),
+                         "replica": self.replica_id}
         try:
             rid = int(body["rid"])
             prompt = [int(t) for t in body["prompt"]]
@@ -369,12 +385,14 @@ class ReplicaServer:
                     # PLUS blobs still sitting in OUR intake (the queue
                     # dimension counts intake the same way) — two routers
                     # posting into one step must not both pass on the
-                    # same free-page snapshot
-                    from .paging import pages_for
+                    # same free-page snapshot. Idle prefix-cache pages
+                    # (ISSUE 13) count as free: reclaim turns them into
+                    # free pages before any admit would stall on them
                     intake_kv = sum(
-                        pages_for(len(e[1]), self._b.page_size)
+                        int(e[7].get("n_pages", 0) or 0)
                         for e in self._intake if e[7] is not None)
                     free = (health["free_pages"]
+                            + health.get("evictable_pages", 0)
                             - health["queued_kv_pages"] - intake_kv)
                     d = pol.decide_pages(free, need, hists=hists)
                 if d is not None:

@@ -111,6 +111,8 @@ class _Handle:
     role: str = "unified"        # lease-advertised pool (ISSUE 11)
     free_pages: int | None = None    # decode-pool pressure (from /health)
     queued_kv_pages: int = 0         # pages promised to queued transfers
+    prefix_sharing: bool = False     # /kv_transfer probe worth a round trip
+    evictable_pages: int = 0         # idle prefix-cache pages (reclaimable)
     last_probe: float = field(default_factory=_slo.now)
 
     @property
@@ -363,6 +365,8 @@ class Router:
                 fp = doc.get("free_pages")
                 h.free_pages = None if fp is None else int(fp)
                 h.queued_kv_pages = int(doc.get("queued_kv_pages", 0) or 0)
+                h.prefix_sharing = bool(doc.get("prefix_sharing"))
+                h.evictable_pages = int(doc.get("evictable_pages", 0) or 0)
                 h.last_probe = now
         metrics.gauge("serve.fleet.replicas").set(len(self._handles))
 

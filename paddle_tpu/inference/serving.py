@@ -45,10 +45,23 @@ Three KV layouts share that scheduler:
     temperature=0, pinned by tests/test_serving_paged.py) and for tiny
     models where paging overhead isn't worth it.
 
+Prefix sharing (ISSUE 13, ``PADDLE_PREFIX_CACHE_PAGES`` /
+``prefix_cache_pages=``): a page-granular prefix cache
+(``inference/prefix_cache.py``) over the paged pool lets shared-prompt
+admissions map already-computed prefix pages copy-on-write (per-page
+refcounts in ``PageAllocator``; ``_grow_for_burst`` copies any shared
+page in a burst's write window private before dispatch) and prefill ONLY
+the unshared suffix — a full-prefix hit skips prefill entirely and
+resumes decode at the last prompt token. Near-zero marginal HBM and
+TTFT for a common system prompt; temp=0 token-identical to an unshared
+serve on both read paths (pinned by tests/test_prefix_cache.py).
+
 Chaos sites (PADDLE_CHAOS, ROADMAP PR 1 follow-up): ``serve.admit`` fails
 one admission (that request retires with partial output), ``serve.burst``
 fails one burst (every active request retires with what it has) — the
-scheduler keeps serving the queue either way, never wedges.
+scheduler keeps serving the queue either way, never wedges; faults at
+``serve.prefix_hash`` / ``serve.prefix_evict`` degrade a prefix-cache
+lookup to a miss / spare an eviction, tokens identical either way.
 
 Metrics published (observability.metrics): ``serve.pages_in_use`` gauge,
 ``serve.tokens`` / ``serve.requests`` / ``serve.admission_stalls`` /
@@ -115,6 +128,13 @@ class ServedRequest:
     kv_import: dict | None = None
 
 
+class _PrefixGone(Exception):
+    """A prefix-sliced kv transfer arrived after the shared pages it was
+    sliced against left this pool's cache (eviction raced the probe) —
+    the request SHEDS so the router re-prefills it: deferred, never lost,
+    never a client-visible error for a servable request."""
+
+
 class ContinuousBatcher:
     """Slot-pool serving engine over the compiled llama decode.
 
@@ -138,7 +158,8 @@ class ContinuousBatcher:
                  page_buckets: Sequence[int] | None = None,
                  slo_policy=None, admission: AdmissionPolicy | None = None,
                  kv_dtype: str | None = None,
-                 pool_hbm_bytes: int | None = None):
+                 pool_hbm_bytes: int | None = None,
+                 prefix_cache_pages: int | None = None):
         self._dequant = None
         if precision in ("int8", "weight_only_int8"):
             # int8 weight-only serving: weights live quantized in HBM and
@@ -199,6 +220,10 @@ class ContinuousBatcher:
                              "the dense slot cache is sized by "
                              "max_batch × max_len — a silently ignored "
                              "budget would hide a misconfiguration")
+        if prefix_cache_pages and kv_layout == "dense":
+            raise ValueError("prefix sharing needs the paged pool "
+                             "(kv_layout='paged' or 'ragged') — the dense "
+                             "slot cache has no shareable page unit")
         self._kv_dtype = kv_dtype
         # "ragged" = the paged pool read through the Pallas ragged kernel
         # (ops/ragged_attention.py) in ONE mixed prefill+decode executable.
@@ -227,6 +252,16 @@ class ContinuousBatcher:
         self._done = np.ones(self.B, bool)         # done == slot free
         self._limit = np.zeros(self.B, np.int32)
         self._slot_req: list[ServedRequest | None] = [None] * self.B
+        # prefix sharing (ISSUE 13): installed below for the paged pool
+        # when PADDLE_PREFIX_CACHE_PAGES / prefix_cache_pages says so;
+        # _await_first tracks full-prefix-hit admits whose FIRST token is
+        # a decode emission (no prefill ran) so TTFT still fires once;
+        # _spt is the EMA prefill-seconds-per-token behind the
+        # slo.prefill_skipped_s estimate (measured on unshared prefills)
+        self._prefix = None
+        self._await_first: set[int] = set()
+        self._prefill_t0: dict[int, tuple] = {}
+        self._spt: float | None = None
 
         if self._layout == "paged":
             from ..models.llama_paged import init_paged_kv_cache, page_bytes
@@ -288,6 +323,20 @@ class ContinuousBatcher:
                 self._no_prompts = jnp.full(
                     (self.B, self._buckets[-1]), jnp.int32(self.pad_id))
                 self._no_lens = jnp.zeros(self.B, jnp.int32)
+            # prefix cache (ISSUE 13): page-granular prefix-hash index
+            # over THIS pool. Explicit argument wins; None consults
+            # PADDLE_PREFIX_CACHE_PAGES; 0 (the default) keeps the
+            # pre-sharing engine byte-for-byte (no index, no hash cost)
+            cap = prefix_cache_pages
+            if cap is None:
+                from ..utils import env_flags
+                from .prefix_cache import ENV_CACHE_PAGES
+                cap = env_flags.get_int(ENV_CACHE_PAGES)
+            if int(cap) > 0:
+                from .prefix_cache import PrefixCache
+                self._prefix = PrefixCache(
+                    self._alloc, self._ps,
+                    min(int(cap), self._alloc.usable))
         else:
             from ..models.llama_decode import init_kv_cache
             self._cache = init_kv_cache(model_config, self.B, self.S)
@@ -394,6 +443,14 @@ class ContinuousBatcher:
         req.trace_id = self.slo.on_enqueue(rid, trace_id=trace_id)
         return rid
 
+    def _kv_need(self, req: ServedRequest) -> int:
+        """Fresh pages a kv_import admit will allocate: the blob's page
+        count — a prefix-SLICED transfer (ISSUE 13: the decode pool
+        already holds the shared prefix) demands only its unshared
+        remainder."""
+        n = int((req.kv_import or {}).get("n_pages", 0) or 0)
+        return n if n > 0 else pages_for(len(req.prompt), self._ps)
+
     def _kv_acct(self, req: ServedRequest, sign: int) -> None:
         """Track the aggregate page demand of QUEUED kv_import requests
         (+1 on enqueue/re-queue, -1 when one leaves the queue by any
@@ -401,8 +458,7 @@ class ContinuousBatcher:
         subtracts from free_pages so accepted-but-unadmitted transfers
         still count against the pool."""
         if req.kv_import is not None:
-            self._queued_kv_pages += sign * pages_for(len(req.prompt),
-                                                      self._ps)
+            self._queued_kv_pages += sign * self._kv_need(req)
 
     @property
     def queued_kv_pages(self) -> int:
@@ -447,11 +503,168 @@ class ContinuousBatcher:
     def _bucket_len(self, n: int) -> int:
         return next(b for b in self._buckets if b >= n)
 
+    # ------------------------------------------------- prefix sharing (13)
+    def _reclaim_to(self, need: int) -> bool:
+        """free_pages >= need, evicting IDLE prefix-cache pages if that is
+        what it takes — the cache borrows idle pool capacity; live demand
+        always wins it back."""
+        short = int(need) - self._alloc.free_pages
+        if short > 0 and self._prefix is not None:
+            self._prefix.reclaim(short)
+        return self._alloc.free_pages >= int(need)
+
+    def _palloc(self, n: int) -> list | None:
+        """alloc() with prefix-cache reclaim behind it — the ONE
+        allocation entry for admits, growth, and COW copies."""
+        if not self._reclaim_to(n):
+            return None
+        return self._alloc.alloc(n)
+
+    def _prefix_match(self, req: ServedRequest) -> tuple[list, int]:
+        """(shared pages, matched token count) for this prompt — each
+        page already carries this request's reference (freed like any
+        other page on retire). The ``serve.prefix_hash`` chaos site
+        degrades a faulted lookup to a plain MISS: the request admits
+        unshared, token-identically."""
+        if self._prefix is None or req.kv_import is not None:
+            return [], 0
+        try:
+            chaos.hit("serve.prefix_hash")
+        except chaos.ChaosError:
+            return [], 0
+        return self._prefix.match(req.prompt)
+
+    def _prefix_hit_account(self, pages: list, matched: int) -> None:
+        """Hit bookkeeping, called only once the admission is PAST its
+        stall/chaos exits — a stalled request re-matches every scheduler
+        step (releasing its references each time), and counting those
+        retries would inflate hit rates and the skipped-prefill
+        estimate."""
+        self.stats["prefix_hits"] = self.stats.get("prefix_hits", 0) + 1
+        self.stats["prefix_tokens_shared"] = \
+            self.stats.get("prefix_tokens_shared", 0) + matched
+        self.stats["prefix_pages_shared"] = \
+            self.stats.get("prefix_pages_shared", 0) + len(pages)
+        metrics.counter("serve.prefix_hits").inc()
+        metrics.counter("serve.pages_shared").inc(len(pages))
+        if self._spt is not None:
+            # the TTFT the hit avoided: matched tokens × the measured
+            # EMA prefill-seconds-per-token of this engine's UNSHARED
+            # prefills (an estimate, and documented as one)
+            metrics.counter("slo.prefill_skipped_s").inc(
+                matched * self._spt)
+
+    def _prefix_insert(self, req: ServedRequest, slot: int) -> None:
+        """Index this request's full prompt pages so the NEXT admission
+        with this prefix shares instead of recomputing. Called only once
+        the pages' content has LANDED (the prefill's first-token readback
+        at merge, or a kv_import's synchronous install) — an admit-time
+        insert would let a same-pass resume COW-copy a page the ragged
+        burst's in-flight prefill phase had not written yet."""
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, self._page_tbl[slot])
+
+    def _note_admit_prefill(self, req: ServedRequest, tlen: int) -> None:
+        """Arm the prefill-throughput sample an UNSHARED admit provides
+        (consumed by _observe_first into the _spt EMA)."""
+        self._prefill_t0[req.rid] = (_slo.now(), int(tlen))
+
+    def _observe_first(self, req: ServedRequest) -> None:
+        """The ONE first-token observation point: TTFT fires exactly once
+        per request whichever path produced the token (prefill sample,
+        kv_import blob, or a full-prefix-hit's first decode emission)."""
+        self.slo.on_first_token(req.rid)
+        self._await_first.discard(req.rid)
+        rec = self._prefill_t0.pop(req.rid, None)
+        if rec is not None:
+            spt = max(0.0, _slo.now() - rec[0]) / max(1, rec[1])
+            self._spt = spt if self._spt is None \
+                else 0.8 * self._spt + 0.2 * spt
+
+    def _admit_resume(self, req: ServedRequest, slot: int,
+                      shared: list) -> tuple:
+        """Full-prefix-hit admit (every prompt position's K/V already
+        cached): skip prefill ENTIRELY and resume decode at the LAST
+        prompt token — the next burst's first step recomputes position
+        tlen-1's K/V (a write the growth loop first COWs into a private
+        tail page, since that page is shared) and samples the first
+        generated token, exactly the arithmetic a local prefill's
+        sampling runs. Returns the slot-state tuple the gather path
+        re-applies after its stale readback."""
+        tlen = len(req.prompt)
+        self._page_tbl[slot] = shared
+        self._slot_req[slot] = req
+        self._admit_seq[slot] = self._seq = self._seq + 1
+        limit = (tlen if req.prefill_only
+                 else min(tlen + req.max_new_tokens - 1, self.S - 1))
+        self._pos[slot] = tlen - 1
+        self._tok[slot] = int(req.prompt[-1])
+        self._done[slot] = False
+        self._limit[slot] = limit
+        self._await_first.add(req.rid)
+        self.stats["prefix_resumes"] = \
+            self.stats.get("prefix_resumes", 0) + 1
+        metrics.counter("serve.prefill_skips").inc()
+        return (req, slot, tlen - 1, int(req.prompt[-1]), limit)
+
+    def _cow_for_burst(self, b: int, last_pos: int) -> bool:
+        """Copy-on-write sweep over slot ``b``'s write window for this
+        burst [pos, last_pos]: any page other holders still map (another
+        block table, or the prefix-cache index) is copied into a fresh
+        private page before the burst's writes can touch it. False when
+        the pool cannot supply a copy target (caller preempts, exactly
+        like a growth deficit)."""
+        tbl = self._page_tbl[b]
+        for li in range(int(self._pos[b]) // self._ps,
+                        int(last_pos) // self._ps + 1):
+            if li >= len(tbl):
+                break
+            if self._alloc.refcount(tbl[li]) <= 1:
+                continue
+            got = self._palloc(1)
+            if got is None:
+                # zero-copy fallback: if the ONLY other holder is the
+                # prefix index itself (refcount exactly 2 = this slot +
+                # one more, and the cache confirms the hold by dropping
+                # it), releasing the cache's reference makes the page
+                # private with no allocation — without this, a
+                # worst-case-sized slot whose tail page is cache-shared
+                # would preempt ITSELF forever (free its pages, re-admit,
+                # re-match, fail the same copy). At refcount >= 3 another
+                # SLOT shares the page, so dropping the entry could not
+                # privatize it — keep the still-valid entry and preempt
+                if self._prefix is not None \
+                        and self._alloc.refcount(tbl[li]) == 2 \
+                        and self._prefix.drop_page(tbl[li]):
+                    continue
+                return False
+            from ..models.llama_paged import copy_pages
+            self._cache = copy_pages(self._cache, [tbl[li]], got)
+            self._alloc.free([tbl[li]])
+            tbl[li] = got[0]
+            self.stats["cow_copies"] = self.stats.get("cow_copies", 0) + 1
+            metrics.counter("serve.cow_copies").inc()
+        return True
+
+    def prefix_probe(self, prompt_ids) -> int:
+        """Full prompt pages this engine's prefix cache could lend a
+        SLICED kv transfer (the replica /kv_transfer probe; advisory —
+        admit-time re-matches under the cache lock). Capped one page
+        below the prompt's page count so the wire always carries at
+        least the tail page. 0 without a cache."""
+        if self._prefix is None:
+            return 0
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        n = pages_for(len(prompt), self._ps)
+        return max(0, min(self._prefix.match_pages(prompt), n - 1))
+
     # ----------------------------------------------------------- shared
     def _finish(self, req: ServedRequest, reason: str = "complete") -> None:
         req.done = True
         req.reason = reason
         self._finished[req.rid] = req
+        self._await_first.discard(req.rid)
+        self._prefill_t0.pop(req.rid, None)
         if reason == "shed":
             # a shed request was never SERVED here — measuring its
             # lifetime would pollute the very histograms admission reads
@@ -561,9 +774,13 @@ class ContinuousBatcher:
 
     def _grow_for_burst(self, active: list) -> list:
         """Page growth for every slot in `active` to cover this burst's
-        writes, preempting youngest-first when the pool runs dry (a lone
-        slot always fits: add_request rejected anything that can't).
-        Returns the surviving active list (possibly empty)."""
+        writes — plus the COPY-ON-WRITE sweep (ISSUE 13): a shared page
+        in the write window is copied private BEFORE dispatch, so shared
+        prefix pages stay read-only whoever decodes past them. Preempts
+        youngest-first when the pool runs dry (a lone slot always fits:
+        add_request rejected anything that can't; idle prefix-cache pages
+        reclaim before anyone preempts). Returns the surviving active
+        list (possibly empty)."""
         while True:
             grown = True
             for b in list(active):
@@ -571,12 +788,11 @@ class ContinuousBatcher:
                                int(self._limit[b]))
                 deficit = pages_for(last_pos + 1, self._ps) \
                     - len(self._page_tbl[b])
-                if deficit <= 0:
-                    continue
-                got = self._alloc.alloc(deficit)
+                got = self._palloc(deficit) if deficit > 0 else []
                 if got is not None:
                     self._page_tbl[b].extend(got)
-                    continue
+                    if self._cow_for_burst(b, last_pos):
+                        continue
                 victim = max(active, key=lambda s: self._admit_seq[s])
                 self._preempt(victim)
                 active.remove(victim)
@@ -636,13 +852,38 @@ class ContinuousBatcher:
         """Admit a kv_import request: allocate its live pages, write the
         transfer blob into the pool (models.llama_paged.scatter_pages —
         host-side, once per request), and set the slot decoding from the
-        blob's first token. Returns the first token. The caller has
-        already popped the request and burned its chaos/slo admission
-        edges."""
+        blob's first token. A prefix-SLICED blob (``from_page`` > 0,
+        ISSUE 13: the router probed this pool's prefix cache and shipped
+        only the unshared remainder) maps the shared prefix from the
+        cache and installs only the carried pages. Returns the first
+        token. The caller has already popped the request and burned its
+        chaos/slo admission edges."""
         from .disagg.transfer import install_pages
         tlen = len(req.prompt)
-        need = pages_for(tlen, self._ps)
-        pages = self._alloc.alloc(need)
+        k = int(req.kv_import.get("from_page", 0) or 0)
+        shared: list = []
+        if k:
+            # re-match under the cache lock — the probe was advisory. An
+            # eviction racing the transfer leaves the blob short of its
+            # prefix: shed (the router re-prefills; deferred, never lost)
+            if self._prefix is not None:
+                shared, _ = self._prefix.match(req.prompt)
+            if len(shared) < k:
+                if shared:
+                    self._alloc.free(shared)
+                raise _PrefixGone(
+                    f"transfer sliced at page {k} but only {len(shared)} "
+                    "prefix pages are still cached")
+            if len(shared) > k:
+                self._alloc.free(shared[k:])
+                shared = shared[:k]
+        need = pages_for(tlen, self._ps) - k
+        pages = self._palloc(need)
+        if pages is None:
+            if shared:
+                self._alloc.free(shared)
+            raise _PrefixGone(
+                f"pool cannot supply {need} pages for the sliced install")
         try:
             self._cache = install_pages(self._cache, self._cfg, pages,
                                         req.kv_import, self._kv_dtype)
@@ -650,10 +891,10 @@ class ContinuousBatcher:
             # nothing slot-side was mutated yet: return the pages and let
             # the caller turn this into a terminal error result — a bad
             # blob must cost ONE request, never the serve loop
-            self._alloc.free(pages)
+            self._alloc.free(shared + pages)
             raise
         first = int(req.kv_import["first"])
-        self._page_tbl[slot] = pages
+        self._page_tbl[slot] = shared + pages
         self._slot_req[slot] = req
         self._admit_seq[slot] = self._seq = self._seq + 1
         # decode resumes EXACTLY where the prefill replica stopped: the
@@ -665,6 +906,9 @@ class ContinuousBatcher:
         self._done[slot] = False
         self._limit[slot] = min(tlen + req.max_new_tokens - 1, self.S - 1)
         metrics.counter("serve.kv_installed").inc()
+        # the install is what populates a DECODE replica's prefix cache —
+        # the next transfer with this prompt prefix arrives sliced
+        self._prefix_insert(req, slot)
         self.slo.on_first_token(req.rid)
         return first
 
@@ -678,6 +922,12 @@ class ContinuousBatcher:
         as ONE terminal error result — never a dead serve loop)."""
         try:
             first = self._install_admit(req, slot)
+        except _PrefixGone:
+            # sliced against pages that have since evicted: shed — the
+            # router's decode-shed recovery re-prefills under the same
+            # trace id (the blob cannot be completed locally)
+            self._finish(req, reason="shed")
+            return None
         except Exception as e:
             self._finish(req, reason=f"error: install: "
                                      f"{type(e).__name__}: {e}")
@@ -716,19 +966,22 @@ class ContinuousBatcher:
     def _admit_paged(self):
         """Pop + bucket + allocate + dispatch prefills — all host work that
         OVERLAPS the in-flight burst. Admission is gated by free pages (and
-        a free slot), never by a worst-case length reservation. Returns
-        (staged, installed); nothing blocks here except a kv_import
-        install's pool writes (once per transferred request)."""
-        from ..models.llama_paged import llama_paged_prefill_slot
+        a free slot), never by a worst-case length reservation. A prefix-
+        cache hit (ISSUE 13) maps the shared pages into the block table
+        and prefills ONLY the unshared suffix (a full-prefix hit skips
+        prefill entirely: decode resumes at the last prompt token).
+        Returns (staged, installed); nothing blocks here except a
+        kv_import install's pool writes (once per transferred request)."""
+        from ..models.llama_paged import (llama_paged_prefill_slot,
+                                          llama_paged_prefill_suffix)
         staged = []  # (req, slot, tlen, first_device_scalar)
-        installed = []  # (req, slot, tlen, first) — kv_import admits
+        installed = []  # (req, slot, pos0, tok0, limit0) — no-prefill admits
         stalled = False
         while self._queue and None in self._slot_req:
             req = self._queue[0]
             tlen = len(req.prompt)
             if req.kv_import is not None:
-                need = pages_for(tlen, self._ps)
-                if self._alloc.free_pages < need:
+                if not self._reclaim_to(self._kv_need(req)):
                     stalled = True
                     break
                 self._queue.popleft()
@@ -744,11 +997,17 @@ class ContinuousBatcher:
                 slot = self._slot_req.index(None)
                 first = self._admit_kv_import(req, slot)
                 if first is not None:
-                    installed.append((req, slot, tlen, first))
+                    installed.append((req, slot, tlen, first,
+                                      min(tlen + req.max_new_tokens - 1,
+                                          self.S - 1)))
                 continue
-            tb = self._bucket_len(tlen)
-            bucket_pages = pages_for(tb, self._ps)
-            if self._alloc.free_pages < bucket_pages:
+            shared, matched = self._prefix_match(req)
+            resume = bool(shared) and matched >= tlen
+            tb = self._bucket_len(tlen - matched) if not resume else 0
+            need = 0 if resume else pages_for(tb, self._ps)
+            if not self._reclaim_to(need):
+                if shared:
+                    self._alloc.free(shared)
                 stalled = True  # stays queued; pages free as slots retire
                 break
             self._queue.popleft()
@@ -756,29 +1015,60 @@ class ContinuousBatcher:
             try:
                 chaos.hit("serve.admit")
             except chaos.ChaosError:
+                if shared:
+                    self._alloc.free(shared)
                 self.stats["chaos_retired"] += 1
                 metrics.counter("serve.chaos_retired").inc()
                 # partial (empty) output, queue moves on
                 self._finish(req, reason="chaos serve.admit")
                 continue
             self.slo.on_admit(req.rid)
-            pages = self._alloc.alloc(bucket_pages)
+            if shared:
+                self._prefix_hit_account(shared, matched)
             slot = self._slot_req.index(None)
+            if resume:
+                # every prompt position cached: no prefill dispatch at
+                # all — the slot state rides `installed` because the
+                # in-flight burst's readback is stale for this slot
+                installed.append(self._admit_resume(req, slot, shared))
+                continue
+            pages = self._alloc.alloc(need)
+            suffix = tlen - matched
             toks = np.full(tb, self.pad_id, np.int32)
-            toks[:tlen] = req.prompt
+            toks[:suffix] = req.prompt[matched:]
             self._key, sub = jax.random.split(self._key)
-            first, self._cache = llama_paged_prefill_slot(
-                self._params, self._cache, jnp.asarray(toks),
-                jnp.asarray(np.asarray(pages, np.int32)), jnp.int32(tlen),
-                sub, config=self._cfg, temperature=self._temp,
-                top_k=self._top_k, dequant=self._dequant,
-                kv_dtype=self._kv_dtype)
+            if shared:
+                # suffix-only prefill against the cached prefix pages:
+                # prefix table padded to a page bucket (one executable
+                # per (suffix bucket, prefix page bucket))
+                pp = matched // self._ps
+                pb = next(p for p in self._page_buckets if p >= pp)
+                ptbl = np.full(pb, SCRATCH_PAGE, np.int32)
+                ptbl[:pp] = shared
+                first, self._cache = llama_paged_prefill_suffix(
+                    self._params, self._cache, jnp.asarray(toks),
+                    jnp.asarray(np.asarray(pages, np.int32)),
+                    jnp.asarray(ptbl), jnp.int32(matched),
+                    jnp.int32(suffix), sub, config=self._cfg,
+                    temperature=self._temp, top_k=self._top_k,
+                    dequant=self._dequant, kv_dtype=self._kv_dtype)
+                self.stats["prefix_marginal_pages"] = \
+                    self.stats.get("prefix_marginal_pages", 0) \
+                    + pages_for(suffix, self._ps)
+            else:
+                first, self._cache = llama_paged_prefill_slot(
+                    self._params, self._cache, jnp.asarray(toks),
+                    jnp.asarray(np.asarray(pages, np.int32)),
+                    jnp.int32(tlen), sub, config=self._cfg,
+                    temperature=self._temp, top_k=self._top_k,
+                    dequant=self._dequant, kv_dtype=self._kv_dtype)
+                self._note_admit_prefill(req, tlen)
             # pages past the real prompt hold only bucket-pad garbage the
             # mask never exposes — return them right away; the pre-burst
             # growth path re-allocates the decode page when it's needed
-            keep = pages_for(tlen, self._ps)
+            keep = pages_for(suffix, self._ps)
             self._alloc.free(pages[keep:])
-            self._page_tbl[slot] = pages[:keep]
+            self._page_tbl[slot] = shared + pages[:keep]
             self._slot_req[slot] = req  # reserved; state lands at the sync
             self._admit_seq[slot] = self._seq = self._seq + 1
             self.stats["prefills"] += 1
@@ -805,6 +1095,10 @@ class ContinuousBatcher:
             n_new = int(self._pos[slot] - old_pos[slot])
             req.out.extend(int(t) for t in emitted[:n_new, slot])
             total += n_new
+            if n_new > 0 and req.rid in self._await_first:
+                # a full-prefix-hit admit (ISSUE 13) skipped prefill: its
+                # first decode emission IS the first token
+                self._observe_first(req)
             self.slo.on_tokens(req.rid, n_new)
             if done[slot]:
                 self._park_or_finish(slot, req)
@@ -825,7 +1119,7 @@ class ContinuousBatcher:
              [f for *_, f in staged]))
         emitted_total = 0
         staged_slots = {s for _, s, _, _ in staged} \
-            | {s for _, s, _, _ in installed}
+            | {e[1] for e in installed}
         if inflight:
             old_pos = inflight[0]
             pos, tok, done, emitted = burst_vals
@@ -837,21 +1131,25 @@ class ContinuousBatcher:
             emitted_total += self._drain_burst(old_pos, done,
                                                np.asarray(emitted),
                                                skip=staged_slots)
-        for req, slot, tlen, first in installed:
-            # state set by _install_admit, clobbered by the readback copy
-            # above when a burst was in flight — re-apply; the blob's
-            # first token is NOT a local emission (the prefill replica
-            # already delivered it), so emitted_total skips it
-            self._pos[slot] = tlen
-            self._tok[slot] = first
+        for req, slot, pos0, tok0, limit0 in installed:
+            # state set at admit (_install_admit / _admit_resume),
+            # clobbered by the readback copy above when a burst was in
+            # flight — re-apply; a kv_import's first token is NOT a local
+            # emission (the prefill replica already delivered it) and a
+            # full-prefix resume emits ITS first token in the next burst,
+            # so emitted_total skips both here
+            self._pos[slot] = pos0
+            self._tok[slot] = tok0
             self._done[slot] = False
-            self._limit[slot] = min(tlen + req.max_new_tokens - 1,
-                                    self.S - 1)
+            self._limit[slot] = limit0
         for (req, slot, tlen, _), first in zip(staged, firsts):
             first = int(first)
             req.out.append(first)
             emitted_total += 1
-            self.slo.on_first_token(req.rid)
+            self._observe_first(req)
+            # the first token is BACK: the prompt pages' content landed —
+            # index them (before any retire; cache refs outlive the slot)
+            self._prefix_insert(req, slot)
             if req.max_new_tokens <= 1 or first == self.eos_id \
                     or req.prefill_only:
                 self._park_or_finish(slot, req)
@@ -873,14 +1171,41 @@ class ContinuousBatcher:
         bucketing: pages are reserved for the ACTUAL prompt length and the
         prompt rides into the burst as a (token row, length) pair — the
         prefill happens inside the same executable as the decode steps, so
-        a freshly admitted request's first token lands this very burst."""
-        staged = []  # (req, slot, tlen)
+        a freshly admitted request's first token lands this very burst.
+        A prefix-cache hit (ISSUE 13) maps the shared pages and its row
+        carries ONLY the unshared suffix (prefill_start > 0); a
+        full-prefix hit stages nothing — it joins the burst's decode rows
+        resuming at the last prompt token."""
+        staged = []  # (req, slot, suffix_len, prefill_start)
         stalled = False
         while self._queue and None in self._slot_req:
             req = self._queue[0]
             tlen = len(req.prompt)
-            need = pages_for(tlen, self._ps)
-            if self._alloc.free_pages < need:
+            if req.kv_import is not None:
+                if not self._reclaim_to(self._kv_need(req)):
+                    stalled = True
+                    break
+                self._queue.popleft()
+                self._kv_acct(req, -1)
+                try:
+                    chaos.hit("serve.admit")
+                except chaos.ChaosError:
+                    self.stats["chaos_retired"] += 1
+                    metrics.counter("serve.chaos_retired").inc()
+                    self._finish(req, reason="chaos serve.admit")
+                    continue
+                self.slo.on_admit(req.rid)
+                slot = self._slot_req.index(None)
+                # transferred pages install now; the slot joins THIS
+                # burst's decode rows (new_lens stays 0 — no prefill)
+                self._admit_kv_import(req, slot)
+                continue
+            shared, matched = self._prefix_match(req)
+            resume = bool(shared) and matched >= tlen
+            need = 0 if resume else pages_for(tlen - matched, self._ps)
+            if not self._reclaim_to(need):
+                if shared:
+                    self._alloc.free(shared)
                 stalled = True  # stays queued; pages free as slots retire
                 break
             self._queue.popleft()
@@ -888,19 +1213,24 @@ class ContinuousBatcher:
             try:
                 chaos.hit("serve.admit")
             except chaos.ChaosError:
+                if shared:
+                    self._alloc.free(shared)
                 self.stats["chaos_retired"] += 1
                 metrics.counter("serve.chaos_retired").inc()
                 # partial (empty) output, queue moves on
                 self._finish(req, reason="chaos serve.admit")
                 continue
             self.slo.on_admit(req.rid)
+            if shared:
+                self._prefix_hit_account(shared, matched)
             slot = self._slot_req.index(None)
-            if req.kv_import is not None:
-                # transferred pages install now; the slot joins THIS
-                # burst's decode rows (new_lens stays 0 — no prefill)
-                self._admit_kv_import(req, slot)
+            if resume:
+                # no prefill row at all: decode resumes at the last
+                # prompt token (growth COWs the shared tail page before
+                # this burst's first write)
+                self._admit_resume(req, slot, shared)
                 continue
-            self._page_tbl[slot] = self._alloc.alloc(need)
+            self._page_tbl[slot] = shared + self._alloc.alloc(need)
             self._slot_req[slot] = req
             self._admit_seq[slot] = self._seq = self._seq + 1
             # host slot state for the burst: the device's prefill phase
@@ -916,7 +1246,12 @@ class ContinuousBatcher:
                                  else min(tlen + req.max_new_tokens - 1,
                                           self.S - 1))
             self.stats["prefills"] += 1
-            staged.append((req, slot, tlen))
+            if shared:
+                self.stats["prefix_marginal_pages"] = \
+                    self.stats.get("prefix_marginal_pages", 0) + need
+            else:
+                self._note_admit_prefill(req, tlen)
+            staged.append((req, slot, tlen - matched, matched))
         if stalled:
             self.stats["admission_stalls"] += 1
             metrics.counter("serve.admission_stalls").inc()
@@ -963,13 +1298,18 @@ class ContinuousBatcher:
             t_max = self._buckets[-1]            # the ONE static width
             new_tokens = np.full((self.B, t_max), self.pad_id, np.int32)
             new_lens = np.zeros(self.B, np.int32)
-            for req, slot, tlen in staged:
-                new_tokens[slot, :tlen] = req.prompt
-                new_lens[slot] = tlen
-            new_tokens, new_lens = jnp.asarray(new_tokens), \
-                jnp.asarray(new_lens)
+            starts = np.zeros(self.B, np.int32)
+            for req, slot, sl, start in staged:
+                # the row carries ONLY the unshared suffix; the shared
+                # prefix (prefill_start tokens) is already in the pool
+                new_tokens[slot, :sl] = req.prompt[start:]
+                new_lens[slot] = sl
+                starts[slot] = start
+            new_tokens, new_lens, starts = jnp.asarray(new_tokens), \
+                jnp.asarray(new_lens), jnp.asarray(starts)
         else:
-            new_tokens, new_lens = self._no_prompts, self._no_lens
+            new_tokens, new_lens, starts = self._no_prompts, \
+                self._no_lens, self._no_lens
 
         old_pos = self._pos.copy()
         self._key, sub = jax.random.split(self._key)
@@ -978,7 +1318,7 @@ class ContinuousBatcher:
                 self._params, self._cache, jnp.asarray(bt),
                 jnp.asarray(self._pos), jnp.asarray(self._tok),
                 jnp.asarray(self._done), jnp.asarray(self._limit),
-                new_tokens, new_lens,
+                new_tokens, new_lens, starts,
                 jnp.int32(self.eos_id), sub, config=self._cfg,
                 n=self.burst, has_prefill=bool(staged),
                 temperature=self._temp, top_k=self._top_k,
@@ -1001,12 +1341,16 @@ class ContinuousBatcher:
         self._tok = np.array(tok)    # admissions write these in place
         self._done = np.array(done)
         emitted_total = 0
-        for req, slot, _ in staged:
+        for req, slot, *_ in staged:
             # the prefill token, sampled inside the same burst; the drain
             # below appends this slot's scan emissions AFTER it
             req.out.append(int(firsts[slot]))
             emitted_total += 1
-            self.slo.on_first_token(req.rid)
+            self._observe_first(req)
+            # the burst is read back: the prompt pages' content landed in
+            # the pool — NOW they are indexable (an admit-time insert
+            # would let a same-pass hit copy/read unwritten pages)
+            self._prefix_insert(req, slot)
         emitted_total += self._drain_burst(old_pos, done,
                                            np.asarray(emitted))
         metrics.counter("serve.tokens").inc(emitted_total)
@@ -1246,6 +1590,13 @@ class ContinuousBatcher:
             # held parked between a prefill and its export
             "queued_kv_pages": self._queued_kv_pages,
             "parked": len(self._parked),
+            # prefix sharing (ISSUE 13): whether the router may probe for
+            # sliced transfers, and the idle cached pages an admission
+            # decision can treat as free (reclaim turns them into free
+            # pages without touching a live request)
+            "prefix_sharing": self._prefix is not None,
+            "evictable_pages": (self._prefix.evictable_pages()
+                                if self._prefix is not None else 0),
         }
 
     # ------------------------------------------------------------- admin
@@ -1296,6 +1647,9 @@ class ContinuousBatcher:
             "finished": len(self._finished),
             "stats": dict(self.stats),
             "slo": self.slo.summary(),
+            "prefix": (None if self._prefix is None else
+                       {"cached_pages": self._prefix.cached_pages,
+                        **self._prefix.stats}),
         }
 
     @property

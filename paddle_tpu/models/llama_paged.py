@@ -50,6 +50,7 @@ equivalence baseline.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -59,9 +60,9 @@ from .llama import LlamaConfig, _rmsnorm, _rope, lm_head_logits, \
 from .llama_decode import _cached_attention_slots, _mlp, _qkv, _sample
 
 __all__ = ["init_paged_kv_cache", "llama_paged_prefill_slot",
-           "llama_paged_decode_burst", "llama_ragged_burst",
-           "paged_kv_bytes_per_token", "page_bytes", "gather_pages",
-           "scatter_pages"]
+           "llama_paged_prefill_suffix", "llama_paged_decode_burst",
+           "llama_ragged_burst", "paged_kv_bytes_per_token", "page_bytes",
+           "gather_pages", "scatter_pages", "copy_pages"]
 
 
 # ------------------------------------------------- quantized pages (ISSUE 10)
@@ -168,6 +169,22 @@ def scatter_pages(cache, page_ids, rows: dict) -> dict:
             buf.at[ids].set(jnp.asarray(r).astype(buf.dtype))
             for buf, r in zip(bufs, rows[name]))
     return out
+
+
+def copy_pages(cache, src_ids, dst_ids):
+    """Copy whole pool pages ``src_ids[i] -> dst_ids[i]`` across every
+    leaf (payload pools always, scale pools when quantized) — the
+    COPY-ON-WRITE primitive of prefix sharing (ISSUE 13): before a burst
+    writes into a page other block tables still map, the scheduler copies
+    it into a freshly allocated private page and redirects only the
+    writer. Runs OUTSIDE jit (one ``.at[].set`` per layer per leaf, like
+    :func:`scatter_pages`): a COW is a once-per-shared-tail event, not a
+    per-step one."""
+    import numpy as np
+    s = jnp.asarray(np.asarray(src_ids, np.int32))
+    d = jnp.asarray(np.asarray(dst_ids, np.int32))
+    return {name: tuple(buf.at[d].set(buf[s]) for buf in bufs)
+            for name, bufs in cache.items()}
 
 
 def _kv_row_head_bytes(config: LlamaConfig, kv_dtype: str | None) -> int:
@@ -377,6 +394,134 @@ def llama_paged_prefill_slot(params, cache, tokens, page_ids, tlen, key,
     return first[0], cache
 
 
+def _suffix_attention(q, k_all, v_all, start, rows_p, config: LlamaConfig):
+    """Causal attention of suffix queries over [gathered prefix rows ++
+    in-pass suffix rows]. q [1, T, H, hd]; k_all/v_all [1, rows_p + T,
+    KV, hd] where the first ``rows_p`` rows are the prefix pages gathered
+    from the pool (valid below the traced ``start``, scratch garbage
+    beyond) and the last T rows are the suffix computed this pass
+    (causal). Same arithmetic as ``llama._attention``'s XLA reference —
+    f32 logits, -1e30 mask, softmax rounded to q.dtype — so a
+    prefix-shared prefill stays token-identical to the unshared dense
+    pass it replaces (pinned by tests/test_prefix_cache.py)."""
+    from .llama import _expand_gqa
+    c = config
+    k_all, v_all = _expand_gqa(k_all, v_all, c)
+    scale = 1.0 / math.sqrt(c.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+                        k_all).astype(jnp.float32) * scale
+    T = q.shape[1]
+    cols = jnp.arange(rows_p + T, dtype=jnp.int32)[None, :]
+    qpos = jnp.arange(T, dtype=jnp.int32)[:, None]
+    valid = jnp.where(cols < jnp.int32(rows_p), cols < start,
+                      (cols - jnp.int32(rows_p)) <= qpos)
+    logits = jnp.where(valid[None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "temperature", "top_k", "dequant", "kv_dtype"),
+    donate_argnums=(1,))
+def llama_paged_prefill_suffix(params, cache, tokens, page_ids,
+                               prefix_table, start, tlen, key,
+                               config: LlamaConfig,
+                               temperature: float = 0.0, top_k: int = 0,
+                               dequant=None, kv_dtype: str | None = None):
+    """Prefill ONLY a prompt's unshared SUFFIX against cached prefix pages
+    (ISSUE 13 — the prefill-FLOPs half of prefix sharing).
+
+    tokens [Tb] int32: the suffix (prompt positions [start, start+tlen))
+    padded to a bucket length; page_ids [ceil(Tb/ps)] fresh pages the
+    suffix rows land in (logical order, page-aligned: ``start`` is a
+    multiple of page_size); prefix_table [Pp] the SHARED pages holding
+    positions [0, start) (padded with scratch to a page bucket — rows at
+    or past ``start`` are masked); tlen = real suffix length (traced).
+    Per layer the suffix K/V is written into its fresh pages exactly like
+    :func:`llama_paged_prefill_slot`, then attention runs the suffix
+    queries over [prefix pages gathered from the pool ++ in-pass suffix]
+    — the pool rows are the SAME bits the original request's prefill
+    wrote (quantized pools dequantize them, the standard quantized-KV
+    read), so greedy outputs match an unshared serve. Samples the first
+    generated token at suffix position tlen-1; returns (first, cache).
+    One executable per (suffix bucket, prefix page bucket)."""
+    c = config
+    if dequant is not None:
+        params = dequant(params)
+    layer_p, other = split_layer_params(params)
+    T = tokens.shape[0]
+    ps = cache["k"][0].shape[1]
+    n_pages = page_ids.shape[0]
+    pad = n_pages * ps - T
+    Pp = prefix_table.shape[0]
+    rows_p = Pp * ps
+    x = jnp.take(other["embed_tokens"], tokens[None, :],
+                 axis=0).astype(c.dtype)
+    start32 = start.astype(jnp.int32) if hasattr(start, "astype") \
+        else jnp.int32(start)
+    positions = start32 + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    quant = kv_dtype is not None
+    z = jnp.int32(0)
+    kl, vl = list(cache["k"]), list(cache["v"])
+    ksl = list(cache["k_scale"]) if quant else None
+    vsl = list(cache["v_scale"]) if quant else None
+    for l in range(c.num_hidden_layers):
+        lp = jax.tree.map(lambda a: a[l], layer_p)
+        h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv(h, lp, c)
+        q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+        kp, vp = kl[l], vl[l]
+        krows = jnp.pad(k[0], ((0, pad), (0, 0), (0, 0)))
+        vrows = jnp.pad(v[0], ((0, pad), (0, 0), (0, 0)))
+        if quant:
+            kw, ksrows = _kv_encode(krows, kv_dtype)
+            vw, vsrows = _kv_encode(vrows, kv_dtype)
+            ksp, vsp = ksl[l], vsl[l]
+        else:
+            kw, vw = krows, vrows
+        for j in range(n_pages):
+            at = (page_ids[j], z, z, z)
+            kp = jax.lax.dynamic_update_slice(
+                kp, kw[j * ps:(j + 1) * ps][None], at)
+            vp = jax.lax.dynamic_update_slice(
+                vp, vw[j * ps:(j + 1) * ps][None], at)
+            if quant:
+                ats = (page_ids[j], z, z)
+                ksp = jax.lax.dynamic_update_slice(
+                    ksp, ksrows[j * ps:(j + 1) * ps][None], ats)
+                vsp = jax.lax.dynamic_update_slice(
+                    vsp, vsrows[j * ps:(j + 1) * ps][None], ats)
+        kl[l], vl[l] = kp, vp
+        if quant:
+            ksl[l], vsl[l] = ksp, vsp
+        # gather the SHARED prefix rows from the pool (pages disjoint from
+        # this request's fresh writes) — the read decode already does
+        kc = jnp.take(kp, prefix_table, axis=0)
+        vc = jnp.take(vp, prefix_table, axis=0)
+        if quant:
+            kc = _kv_decode(kc, jnp.take(ksp, prefix_table, axis=0),
+                            c.dtype)
+            vc = _kv_decode(vc, jnp.take(vsp, prefix_table, axis=0),
+                            c.dtype)
+        kc = kc.reshape(rows_p, c.num_key_value_heads, c.head_dim)
+        vc = vc.reshape(rows_p, c.num_key_value_heads, c.head_dim)
+        k_all = jnp.concatenate([kc[None], k], axis=1)
+        v_all = jnp.concatenate([vc[None], v], axis=1)
+        att = _suffix_attention(q, k_all, v_all, start32, rows_p, c)
+        y = x + (att.reshape(1, T, -1) @ lp["wo"])
+        x = _mlp(y, lp, c)
+
+    cache = {"k": tuple(kl), "v": tuple(vl)}
+    if quant:
+        cache["k_scale"], cache["v_scale"] = tuple(ksl), tuple(vsl)
+
+    last = jax.lax.dynamic_slice_in_dim(x[0], tlen - 1, 1, axis=0)  # [1, D]
+    logits = lm_head_logits(last, other, c)
+    first = _sample(logits, temperature, top_k, key)
+    return first[0], cache
+
+
 @functools.partial(jax.jit, static_argnames=(
     "config", "n", "temperature", "top_k", "pad_id", "dequant", "kv_dtype"),
     donate_argnums=(1,))
@@ -531,18 +676,25 @@ def _ragged_decode_step_slots(params, cache, block_table, pos, tok,
 
 
 def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
+                          prefill_start,
                           config: LlamaConfig, interpret: bool, mesh=None,
                           kv_dtype: str | None = None):
     """Ragged prompt forward for EVERY newly admitted slot at once.
 
     new_tokens [B, Tmax] (Tmax = the engine's widest prompt bucket, the
     ONE static width), new_lens [B] (0 = slot not prefilling — its lanes
-    are dead compute, not corruption). Per layer: K/V rows land in the
-    slot's pages (non-prefilling slots' writes are redirected to the
-    scratch page so a decoding neighbour's context is never touched),
-    then the ragged kernel reads them back causally (q_len = kv_len =
-    new_lens) — the same paged read path decode uses, per the RPA paper.
-    Returns (last-position logits [B, V], cache)."""
+    are dead compute, not corruption). ``prefill_start`` [B] (ISSUE 13,
+    prefix sharing): the absolute position the slot's prompt ROW begins
+    at — 0 for an ordinary admission, a page-aligned shared-prefix length
+    for a prefix-cache hit, whose row then carries ONLY the unshared
+    suffix. Per layer: K/V rows land in the slot's pages starting at
+    logical page ``prefill_start // page_size`` (non-prefilling slots'
+    writes are redirected to the scratch page so a decoding neighbour's
+    context is never touched), then the ragged kernel reads them back
+    causally (q_len = new_lens, kv_len = prefill_start + new_lens — the
+    kernel's decode-style offset mask covers suffix rows attending the
+    shared prefix) — the same paged read path decode uses, per the RPA
+    paper. Returns (last-position logits [B, V], cache)."""
     from ..inference.paging import SCRATCH_PAGE
 
     c = config
@@ -551,13 +703,22 @@ def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
     ps = int(cache["k"][0].shape[1])
     t_pages = (Tmax - 1) // ps + 1
     pad = t_pages * ps - Tmax
+    P = block_table.shape[1]
     is_new = new_lens > 0
-    # prefill slots write through their block table; everyone else (and
-    # table rows past the slot's allocation, already SCRATCH) to scratch
-    wt = jnp.where(is_new[:, None], block_table[:, :t_pages],
+    start32 = prefill_start.astype(jnp.int32)
+    off_pages = start32 // jnp.int32(ps)
+    # prefill slots write through their block table at a page offset of
+    # their shared prefix; everyone else (rows past the slot's allocation
+    # — already SCRATCH in the table — and column overhangs past the
+    # table's width) to scratch
+    idx = off_pages[:, None] + jnp.arange(t_pages, dtype=jnp.int32)[None, :]
+    gathered = jnp.take_along_axis(block_table,
+                                   jnp.minimum(idx, jnp.int32(P - 1)),
+                                   axis=1)
+    wt = jnp.where(is_new[:, None] & (idx < jnp.int32(P)), gathered,
                    jnp.int32(SCRATCH_PAGE))
     x = jnp.take(other["embed_tokens"], new_tokens, axis=0).astype(c.dtype)
-    positions = jnp.broadcast_to(
+    positions = start32[:, None] + jnp.broadcast_to(
         jnp.arange(Tmax, dtype=jnp.int32)[None, :], (B, Tmax))
     z = jnp.int32(0)
     lens32 = new_lens.astype(jnp.int32)
@@ -594,7 +755,7 @@ def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
         ks[l], vs[l] = kp, vp
         if quant:
             kss[l], vss[l] = ksp, vsp
-        att = _ragged_attn(q, kp, vp, block_table, lens32, lens32,
+        att = _ragged_attn(q, kp, vp, block_table, lens32, start32 + lens32,
                            page_size=ps, interpret=interpret, mesh=mesh,
                            ksc=ksp if quant else None,
                            vsc=vsp if quant else None)
@@ -612,7 +773,7 @@ def _ragged_prefill_phase(params, cache, block_table, new_tokens, new_lens,
     "config", "n", "has_prefill", "temperature", "top_k", "pad_id",
     "dequant", "interpret", "mesh", "kv_dtype"), donate_argnums=(1,))
 def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
-                       new_tokens, new_lens, eos_id, key,
+                       new_tokens, new_lens, prefill_start, eos_id, key,
                        config: LlamaConfig, n: int, has_prefill: bool,
                        temperature: float = 0.0, top_k: int = 0,
                        pad_id: int = 0, dequant=None, interpret: bool = True,
@@ -622,11 +783,15 @@ def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
     Same contract as llama_paged_decode_burst plus the admission inputs:
     slots with ``new_lens[b] > 0`` first prefill their prompt (ragged —
     any length ≤ Tmax in the same launch), sample their first token and
-    join the n decode steps alongside the already-decoding slots. The
-    block table is always FULL WIDTH (slot_max_pages): the ragged kernel
-    reads only live pages, so no page bucketing and no prompt bucketing —
-    the executable inventory is exactly {prefill-carrying, decode-only},
-    O(1) in the request mix (pinned by tests/test_ragged_attention.py).
+    join the n decode steps alongside the already-decoding slots.
+    ``prefill_start`` [B] (ISSUE 13): a prefix-cache hit maps its shared
+    pages into the block table and its prompt row carries ONLY the
+    unshared suffix — the prefill phase writes/attends at the offset, so
+    a shared system prompt pays no prefill FLOPs here. The block table is
+    always FULL WIDTH (slot_max_pages): the ragged kernel reads only live
+    pages, so no page bucketing and no prompt bucketing — the executable
+    inventory is exactly {prefill-carrying, decode-only}, O(1) in the
+    request mix (pinned by tests/test_ragged_attention.py).
 
     Returns (cache, pos, tok, done, emitted [n, B], firsts [B]) — firsts
     holds each newly admitted slot's prefill token (pad_id elsewhere);
@@ -638,13 +803,14 @@ def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
     if has_prefill:
         key, sub = jax.random.split(key)
         logits, cache = _ragged_prefill_phase(
-            p, cache, block_table, new_tokens, new_lens, config, interpret,
-            mesh, kv_dtype=kv_dtype)
+            p, cache, block_table, new_tokens, new_lens, prefill_start,
+            config, interpret, mesh, kv_dtype=kv_dtype)
         first = _sample(logits, temperature, top_k, sub)
         is_new = new_lens > 0
         firsts = jnp.where(is_new, first, firsts)
         tok = jnp.where(is_new, first, tok)
-        pos = jnp.where(is_new, new_lens.astype(pos.dtype), pos)
+        pos = jnp.where(is_new,
+                        (prefill_start + new_lens).astype(pos.dtype), pos)
         done = jnp.where(is_new, (first == eos_id) | (pos >= limit), done)
 
     def step(carry, _):

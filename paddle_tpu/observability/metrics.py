@@ -280,12 +280,16 @@ _STANDARD_COUNTERS = (
     "checkpoint.save_bytes", "checkpoint.load_bytes", "collective.barriers",
     "serve.requests", "serve.tokens", "serve.tokens_discarded",
     "serve.admission_stalls", "serve.preemptions", "serve.chaos_retired",
+    "serve.prefix_hits", "serve.pages_shared", "serve.cow_copies",
+    "serve.prefill_skips", "serve.prefix_evictions",
+    "slo.prefill_skipped_s",
     "telemetry.pushes", "telemetry.drops", "fleet.straggler",
     "slo.breach", "telemetry.exports", "telemetry.export_drops",
     "trigger.captures", "watchdog.near_deadline",
 )
 _STANDARD_GAUGES = (
     "serve.pages_in_use", "serve.tokens_per_s", "serve.kv_read_mb_per_tok",
+    "serve.prefix_cached_pages",
 )
 _STANDARD_HISTOGRAMS = (
     "train.step_time_s", "loop.step_time_s", "collective.wait_s",

@@ -247,6 +247,11 @@ declare("PADDLE_SERVE_KV_DTYPE", "",
 
 # ------------------------------------------------------------ paged serving
 
+declare("PADDLE_PREFIX_CACHE_PAGES", "0",
+        "prefix-sharing cache size in pool pages (>0 enables the "
+        "page-granular prefix-hash index: shared-prompt admissions map "
+        "cached pages copy-on-write and prefill only their suffix; "
+        "0 = off, the pre-sharing engine byte-for-byte)")
 declare("PADDLE_RAGGED_ATTN", "1",
         "'0' falls back from the ragged Pallas kernel (kv_layout='ragged') "
         "to the XLA block-table gather — token-identical, bucket-bound")

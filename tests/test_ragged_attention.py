@@ -309,6 +309,7 @@ class TestRaggedBenchContract:
         monkeypatch.setenv("SERVING_TRAIN_STEPS", "0")
         monkeypatch.delenv("PADDLE_SERVE_REPLICAS", raising=False)
         monkeypatch.delenv("PADDLE_SERVE_DISAGG", raising=False)
+        monkeypatch.delenv("PADDLE_PREFIX_CACHE_PAGES", raising=False)
         monkeypatch.setattr(sys, "argv", ["serving_bench.py", "2", "3", "4"])
         rc = serving_bench.main()
         out = capsys.readouterr().out
@@ -321,6 +322,9 @@ class TestRaggedBenchContract:
         # in tests/test_disagg_serving.py)
         assert doc["fleet_serve"] is None
         assert doc["disagg"] is None
+        # ISSUE 13: the prefix sub-object is null with the cache off (the
+        # populated schema is pinned in tests/test_prefix_cache.py)
+        assert doc["prefix"] is None
         r = doc["ragged"]
         assert set(r) >= {"tokens_per_sec", "kv_read_bytes_per_token",
                           "hbm_roofline_bytes_per_token", "executables",

@@ -40,7 +40,12 @@ SCOPE_FILES = ("paddle_tpu/inference/serving.py",
                "paddle_tpu/inference/router.py",
                # the replicated registry (ISSUE 12): quorum fan-out
                # threads + beat/rendezvous callers share peer state
-               "paddle_tpu/distributed/fleet/replicated_kv.py")
+               "paddle_tpu/distributed/fleet/replicated_kv.py",
+               # prefix sharing (ISSUE 13): page refcounts + the prefix
+               # index are shared mutable counters — the batcher thread
+               # mutates them while replica HTTP handlers probe/read
+               "paddle_tpu/inference/paging.py",
+               "paddle_tpu/inference/prefix_cache.py")
 
 _LOCKNAME = re.compile(r"lock|(^|_)lk($|_)|(^|_)cv($|_)|mutex")
 _MUTATORS = frozenset({
