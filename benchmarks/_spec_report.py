@@ -1,0 +1,59 @@
+"""The `spec` bench sub-object, shared by decode_bench and serving_bench
+(ISSUE 14): one definition of the speculative-decoding comparison — the
+measured accept rate, tokens per (slot, verify-launch), the draft's
+share of the spec serve wall, and the spec-vs-plain throughput ratio —
+so two benches reporting the same claim cannot drift apart. The 2-3×
+decode-throughput claim itself stays TPU-window-gated per ROADMAP; the
+CPU ratio here is the scheduling-level evidence (tokens_per_launch > 1
+at the measured accept rate)."""
+from __future__ import annotations
+
+
+def spec_enabled() -> bool:
+    """PADDLE_SPEC_DECODE gates the bench sub-object exactly like the
+    serving engine: off (the default) emits null — dashboards must be
+    able to distinguish 'spec off' from 'spec on, nothing accepted'."""
+    from paddle_tpu.utils import env_flags
+    return env_flags.get_bool("PADDLE_SPEC_DECODE")
+
+
+def spec_subobject(eng, total_new: int, spec_s: float, plain_s: float,
+                   parity: bool, accept_hist_count0: int = 0) -> dict:
+    """Build the sub-object from a finished speculative serve.
+
+    ``eng``: the spec-enabled engine after its timed run; ``plain_s``:
+    the same workload's plain-engine wall (the already-timed baseline
+    pass); ``accept_hist_count0``: the serve.spec_accept_rate histogram
+    count before this run (the registry is process-global — the p50 is
+    only reported when THIS run observed into it)."""
+    from paddle_tpu.observability import metrics
+
+    st = eng.stats
+    info = eng.admin_summary()["spec"] or {}
+    proposed = st.get("spec_proposed", 0)
+    launches = st.get("spec_slot_launches", 0)
+    ar = metrics.histogram("serve.spec_accept_rate").stats()
+    return {
+        "k": info.get("k"),
+        "draft_layers": info.get("draft_layers"),
+        "spec_steps": st.get("spec_steps", 0),
+        "proposed": proposed,
+        "accepted": st.get("spec_accepted", 0),
+        "accept_rate": (round(st.get("spec_accepted", 0) / proposed, 4)
+                        if proposed else None),
+        "accept_rate_p50": (ar["p50"]
+                            if ar["count"] > accept_hist_count0 else None),
+        # emitted tokens per (slot, verify launch) — plain decode is 1.0
+        # by definition, so > 1 is the speculation win in launch units
+        "tokens_per_launch": (round(st.get("spec_emitted", 0) / launches,
+                                    3) if launches else None),
+        "draft_overhead_frac": (round(min(1.0, float(info.get("draft_s",
+                                                              0.0))
+                                          / spec_s), 4)
+                                if spec_s > 0 else None),
+        "tokens_per_sec": (round(total_new / spec_s, 1)
+                           if spec_s > 0 else None),
+        "spec_vs_plain_ratio": (round(plain_s / spec_s, 3)
+                                if spec_s > 0 else None),
+        "parity": bool(parity),
+    }

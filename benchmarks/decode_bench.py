@@ -154,14 +154,17 @@ def _paged_main(args, ragged: bool = False) -> dict:
                             rng.choice(budgets, n_req))]
     total_new = sum(m for _, m in reqs)
 
-    def serve(layout="paged", kv_dtype=""):
+    def serve(layout="paged", kv_dtype="", spec=False):
         # kv_dtype="" pins the baseline passes to full-precision pages
         # even when PADDLE_SERVE_KV_DTYPE is set fleet-wide — the quant
-        # sub-object below is a COMPARISON, not a global override
+        # sub-object below is a COMPARISON, not a global override; the
+        # prefix-cache and spec-decode envs are pinned off the baselines
+        # for the same reason (their sub-objects own those comparisons)
         eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
                                 max_len=max_len, prompt_buckets=buckets,
                                 burst=burst, kv_layout=layout,
-                                page_size=page_size, kv_dtype=kv_dtype)
+                                page_size=page_size, kv_dtype=kv_dtype,
+                                prefix_cache_pages=0, spec_decode=spec)
         rids = [eng.add_request(p, max_new_tokens=m) for p, m in reqs]
         out = eng.run()
         return eng, [out[r] for r in rids]
@@ -208,6 +211,24 @@ def _paged_main(args, ragged: bool = False) -> dict:
     _, quant_out = serve(kv_dtype=kv_dt)
     payload["quant"] = kv_quant_subobject(cfg, page_size, worst_bucket,
                                           kv_dt, gather_out, quant_out)
+
+    # ---- speculative decoding (ISSUE 14): PADDLE_SPEC_DECODE=1 reruns
+    # the workload with draft-propose + one-launch verify on the GATHER
+    # engine (the decode bench's baseline path) and lands the `spec`
+    # sub-object; null otherwise — off is distinguishable from
+    # zero-accepts.
+    from benchmarks._spec_report import spec_enabled, spec_subobject
+    from paddle_tpu.observability import metrics as _metrics
+    payload["spec"] = None
+    if spec_enabled():
+        serve(spec=True)  # compile pass
+        ar0 = _metrics.histogram("serve.spec_accept_rate").stats()["count"]
+        t0 = time.perf_counter()
+        seng, spec_out = serve(spec=True)
+        spec_s = time.perf_counter() - t0
+        payload["spec"] = spec_subobject(
+            seng, total_new, spec_s=spec_s, plain_s=dt,
+            parity=spec_out == gather_out, accept_hist_count0=ar0)
     if not ragged:
         return payload
 

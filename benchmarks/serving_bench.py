@@ -83,7 +83,7 @@ def _fleet_drill(n_replicas: int) -> dict:
     fleet = ServingFleet(
         n_replicas, spec, root=root, ttl=1.2,
         env={"JAX_PLATFORMS": "cpu", "PADDLE_ADMIT_MAX_QUEUE": "4",
-             "PADDLE_CHAOS": ""})
+             "PADDLE_CHAOS": "", "PADDLE_SPEC_DECODE": "0"})
     t_up0 = _time.perf_counter()
     try:
         fleet.start(timeout=180)
@@ -200,7 +200,7 @@ def _disagg_drill(n_prefill: int, n_decode: int) -> dict:
         n_prefill + n_decode, spec, root=root, ttl=1.2,
         n_prefill=n_prefill,
         env={"JAX_PLATFORMS": "cpu", "PADDLE_ADMIT_MAX_QUEUE": "6",
-             "PADDLE_CHAOS": ""})
+             "PADDLE_CHAOS": "", "PADDLE_SPEC_DECODE": "0"})
     xfer0 = metrics.histogram("slo.transfer_s").stats()["count"]
     t_up0 = _time.perf_counter()
     try:
@@ -300,11 +300,15 @@ def _prefix_bench(cfg, params, max_batch, max_len, buckets, burst,
     reqs = [(sys_prompt + prompt(int(k)), 6) for k in tail_lens]
 
     def engine(pages):
+        # spec_decode pinned off: the prefix sub-object is a prefill/TTFT
+        # comparison — a fleet-wide PADDLE_SPEC_DECODE must not inject
+        # draft+verify launches into its walls (same rule as serve()'s)
         return ContinuousBatcher(cfg, params, max_batch=max_batch,
                                  max_len=max_len, prompt_buckets=buckets,
                                  burst=burst, kv_layout="paged",
                                  page_size=page_size,
-                                 prefix_cache_pages=pages)
+                                 prefix_cache_pages=pages,
+                                 spec_decode=False)
 
     def ttft_p50(eng, n=5):
         walls = []
@@ -446,10 +450,17 @@ def _main():
     # are timed: paged (block-table pool, the default) and dense slots.
     page_size = 64 if on_tpu else 8   # ONE knob: engines + bytes/token math
 
-    def serve(kv_layout, kv_dtype=""):
+    def serve(kv_layout, kv_dtype="", spec=False):
         # kv_dtype="" pins the baseline passes to full-precision pages
-        # even under a fleet-wide PADDLE_SERVE_KV_DTYPE (dense ignores it)
-        kw = {} if kv_layout == "dense" else {"kv_dtype": kv_dtype}
+        # even under a fleet-wide PADDLE_SERVE_KV_DTYPE (dense ignores
+        # it); prefix_cache_pages=0 and spec_decode likewise pin the
+        # baselines: the `prefix` and `spec` sub-objects are the ONE
+        # comparison surface for those features — a fleet-wide env must
+        # not silently recompute them inside every baseline pass, and
+        # null-off must mean OFF, not zero-hits (ISSUE 14 satellite)
+        kw = {} if kv_layout == "dense" else {"kv_dtype": kv_dtype,
+                                              "prefix_cache_pages": 0,
+                                              "spec_decode": spec}
         eng = ContinuousBatcher(cfg, params, max_batch=max_batch,
                                 max_len=max_len, prompt_buckets=buckets,
                                 burst=burst, kv_layout=kv_layout,
@@ -503,6 +514,33 @@ def _main():
         cfg, page_size, dense_pages, kv_dt,
         [out[r] for r in rids], [quant_out[r] for r in quant_rids],
         tokens_per_sec=round(total_new / quant_s, 1))
+
+    # ---- speculative decoding (ISSUE 14): PADDLE_SPEC_DECODE=1 serves
+    # the same workload once more through draft-propose + one-launch
+    # verify on the ragged engine and reports the `spec` sub-object
+    # (accept rate, tokens per slot-launch, draft overhead, spec-vs-plain
+    # ratio); null otherwise — off must be distinguishable from
+    # zero-accepts. A failure lands as spec.error (never JSON-less).
+    from benchmarks._spec_report import spec_enabled, spec_subobject
+    from paddle_tpu.observability import metrics as _metrics
+    spec_obj = None
+    spec_divergent = 0
+    if spec_enabled():
+        try:
+            serve("ragged", spec=True)  # compile pass
+            ar0 = _metrics.histogram("serve.spec_accept_rate") \
+                .stats()["count"]
+            t0 = time.perf_counter()
+            seng, spec_rids, spec_out = serve("ragged", spec=True)
+            spec_s = time.perf_counter() - t0
+            spec_divergent = sum(spec_out[s] != ragged_out[r]
+                                 for s, r in zip(spec_rids, ragged_rids))
+            spec_obj = spec_subobject(seng, total_new, spec_s=spec_s,
+                                      plain_s=ragged_s,
+                                      parity=spec_divergent == 0,
+                                      accept_hist_count0=ar0)
+        except BaseException as e:
+            spec_obj = {"error": f"{type(e).__name__}: {e}"}
 
     # With trained weights greedy equality is a HARD assertion (logits
     # peaked, no load-bearing argmax ties); with random weights
@@ -574,6 +612,7 @@ def _main():
         "fleet_serve": fleet_obj,
         "disagg": disagg_obj,
         "prefix": prefix_obj,
+        "spec": spec_obj,
         "ragged": ragged_obj,
         "quant": quant_obj,
         "vs_sequential_b1": round(seq_s / cont_s, 2),
@@ -594,10 +633,12 @@ def _main():
     # hard parity gate AFTER the JSON line: the measured throughputs must
     # never be discarded by the failure they diagnose (cf. bench.py
     # _record_latest rationale). Plain `if` — `assert` dies under -O.
-    if train_steps and (mismatch or paged_vs_dense or ragged_vs_paged):
+    if train_steps and (mismatch or paged_vs_dense or ragged_vs_paged
+                        or spec_divergent):
         print(f"# FAIL: {mismatch}/{n_req} paged-vs-sequential, "
-              f"{paged_vs_dense}/{n_req} paged-vs-dense and "
-              f"{ragged_vs_paged}/{n_req} ragged-vs-paged requests diverged "
+              f"{paged_vs_dense}/{n_req} paged-vs-dense, "
+              f"{ragged_vs_paged}/{n_req} ragged-vs-paged and "
+              f"{spec_divergent}/{n_req} spec-vs-plain requests diverged "
               f"WITH TRAINED WEIGHTS — a real numerics bug, not a bf16 "
               f"tiebreak", file=sys.stderr)
         return 1

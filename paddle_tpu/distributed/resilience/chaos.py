@@ -92,6 +92,10 @@ SITES: dict[str, str] = {
                           "failover one tick, never loses it)",
     "serve.route":     "before the router sends a request to a replica "
                        "(fault leaves it pending for the next tick)",
+    "serve.spec_verify": "before a speculative draft-propose/verify step "
+                         "(fault serves that burst through the plain "
+                         "decode path — degraded throughput, tokens "
+                         "identical, never a wedge)",
     "telemetry.export": "before an external metric-sink push",
     "telemetry.push":  "before a fleet telemetry report is sent",
 }

@@ -111,6 +111,7 @@ class DisaggRouter(Router):
             self._count("failovers_decode")
             req.stage = "prefill"
             req.kv = None
+            req.kv_src = None
         else:
             self._count("failovers_prefill")
         req.t_stage = _slo.now()
@@ -137,6 +138,7 @@ class DisaggRouter(Router):
         from the prompt, so every unrecoverable mid-flight loss converges
         here. Same trace id; the fleet-level queue-wait clock resumes."""
         req.kv = None
+        req.kv_src = None
         req.stage = "prefill"
         req.replica = None
         req.retried = True
@@ -186,16 +188,15 @@ class DisaggRouter(Router):
                 return
             if "data" not in kv:
                 # binary wire (ISSUE 12): the result carried only the
-                # meta — pull the payload frame from the prefill replica
-                # in ONE raw octet-stream GET. Any loss (replica died
-                # after the result left, frame evicted) converges on the
+                # meta. The payload fetch is DEFERRED to the transfer
+                # tick (ISSUE 14 satellite) — the decode pool's prefix
+                # probe runs FIRST, and the /kv_blob GET then asks the
+                # prefill replica for `?from_page=k`, so the first hop
+                # stops hauling pages the decode pool already holds.
+                # Only the source endpoint is pinned here; any later
+                # loss (replica died, frame evicted) converges on the
                 # same re-prefill every other mid-flight loss does.
-                kv = self._fetch_blob(req, kv, src)
-                if kv is None:
-                    _recorder.record("serve.disagg.frame_lost",
-                                     rid=rid, router=self._rid_ns)
-                    self._reprefill(req)
-                    return
+                req.kv_src = src
             now = _slo.now()
             # TTFT is REAL now: the first token exists (it rides the
             # blob); the decode pool only adds TPOT after it
@@ -216,6 +217,7 @@ class DisaggRouter(Router):
                 # re-enter at stage one
                 req.stage = "prefill"
                 req.kv = None
+                req.kv_src = None
                 req.t_stage = _slo.now()
                 self._count("reprefills")
             else:
@@ -224,23 +226,28 @@ class DisaggRouter(Router):
         super()._absorb(res, src=src)
 
     def _fetch_blob(self, req: RoutedRequest, meta: dict,
-                    src: str | None = None) -> dict | None:
+                    src: str | None = None,
+                    from_page: int = 0) -> dict | None:
         """Rebuild the full blob (meta + raw payload) from the prefill
         replica's /kv_blob frame. ``src`` is the endpoint the result
         record physically came from — authoritative even when the
         replica's handle is already gone (a falsely-suspected replica's
         late result arrives exactly after _mark_dead deleted it, and
-        salvaging that first attempt is the point). None when the frame
-        cannot be had — the caller re-prefills."""
+        salvaging that first attempt is the point). ``from_page`` > 0
+        (ISSUE 14 satellite) asks the prefill replica to SLICE the frame
+        server-side against the decode pool's probed prefix, so the
+        skipped pages never cross the first hop either. None when the
+        frame cannot be had — the caller re-prefills."""
         endpoint = src
         if endpoint is None:
             h = self._handles.get(req.replica or "")
             if h is None:
                 return None
             endpoint = h.endpoint
-        frame = self._get_bytes(endpoint,
-                                f"/kv_blob?rid={req.rid}"
-                                f"&router={self._rid_ns}",
+        path = f"/kv_blob?rid={req.rid}&router={self._rid_ns}"
+        if from_page > 0:
+            path += f"&from_page={int(from_page)}"
+        frame = self._get_bytes(endpoint, path,
                                 timeout=self._xfer_timeout)
         if frame is None:
             return None
@@ -304,6 +311,15 @@ class DisaggRouter(Router):
                 # survives the operator fixing the fleet), then surface
                 self._xfer.appendleft(rid)
                 raise
+            if status == "lost":
+                # the deferred /kv_blob fetch found the frame gone (the
+                # prefill replica died after its result left, or the
+                # frame aged out) — re-prefill, the one recovery every
+                # mid-flight loss converges on
+                _recorder.record("serve.disagg.frame_lost",
+                                 rid=rid, router=self._rid_ns)
+                self._reprefill(req)
+                continue
             if status != "routed":
                 # fault (ambiguous send: dedup retries that replica next
                 # tick) or declined (decode pool saturated: pages free as
@@ -312,39 +328,28 @@ class DisaggRouter(Router):
                     self._xfer_next_try = now + self._probe_s
                 self._xfer.append(rid)
 
-    def _maybe_slice(self, req: RoutedRequest, h) -> tuple[dict, int]:
-        """(blob to ship to replica ``h``, pages skipped): probe a
-        prefix-sharing decode replica for the leading prompt pages its
-        cache already holds (ISSUE 13) and slice the wire to the unshared
-        remainder — a shared system prompt then crosses the transfer wire
-        ONCE per decode replica, not once per request. The probe is one
-        tiny JSON round trip, advisory by design: any probe hiccup or an
-        eviction racing the transfer just ships the full blob (or, past
-        the admit re-match, sheds into the established re-prefill
-        recovery) — never a lost request."""
-        kv = req.kv
-        n = int(kv.get("n_pages", 0))
-        if not h.prefix_sharing or n <= 1:
-            return kv, 0
+    def _probe_prefix(self, req: RoutedRequest, h) -> int:
+        """ABSOLUTE leading prompt pages replica ``h``'s prefix cache
+        could supply a sliced transfer (the advisory /kv_transfer probe,
+        ISSUE 13) — 0 when ``h`` doesn't share prefixes or the probe
+        hiccups (advisory by design: a failed probe just ships more
+        bytes, never loses a request)."""
+        if not h.prefix_sharing:
+            return 0
         code, body = self._post(h.endpoint, "/kv_transfer",
                                 {"probe": True, "prompt": req.prompt,
                                  "router": self._rid_ns})
         if code != 200:
-            return kv, 0
-        k = int(body.get("from_page", 0) or 0) \
-            - int(kv.get("from_page", 0) or 0)
-        if k <= 0:
-            return kv, 0
-        k = min(k, n - 1)   # the tail page always travels
-        try:
-            return slice_blob(kv, k), k
-        except ValueError:
-            return kv, 0
+            return 0
+        return int(body.get("from_page", 0) or 0)
 
     def _try_transfer(self, req: RoutedRequest) -> str:
         """One transfer attempt over the decode candidates, least-loaded
         first — the stage-two twin of _try_route, with the POOL-pressure
-        gate where stage one gates on queue depth."""
+        gate where stage one gates on queue depth. Returns "routed" /
+        "fault" / "declined" like _try_route, plus "lost" when the
+        deferred /kv_blob fetch found the payload frame gone (the caller
+        re-prefills)."""
         faulted = False
         cands = self._candidates(include_draining=req.retried,
                                  role="decode")
@@ -355,12 +360,55 @@ class DisaggRouter(Router):
             else:
                 cands.sort(key=lambda c: c.id != req.last_faulted)
         for h in cands:
-            kv_send, skipped = self._maybe_slice(req, h)
-            n_pages = int(kv_send.get("n_pages", 0))
+            kv = req.kv
+            # per-candidate slice point (ISSUE 14 satellite): probe THIS
+            # candidate's prefix cache before any payload moves, and
+            # work in ABSOLUTE pages of the full blob — the in-hand copy
+            # may itself already be a slice (base > 0)
+            base = int(kv.get("from_page", 0) or 0)
+            total = base + int(kv.get("n_pages", 0))
+            k_abs = 0
+            if total > 1:
+                k_abs = max(0, min(self._probe_prefix(req, h), total - 1))
+            n_pages = total - k_abs          # what THIS candidate needs
             if h.id != req.last_faulted and h.free_pages is not None \
                     and (h.free_pages + h.evictable_pages
                          - h.queued_kv_pages) < n_pages:
                 continue   # page-starved: don't bounce off its 429
+            if "data" not in kv or k_abs < base:
+                # deferred first hop: the prefilled result carried only
+                # the blob meta — /kv_blob fetches ONLY the unshared
+                # remainder (?from_page=k, sliced server-side), AFTER
+                # the pressure gate so a declined candidate costs zero
+                # payload bytes. The k_abs < base case is the failover
+                # refetch: the in-hand blob was server-sliced for an
+                # earlier, warmer candidate — refetch the missing prefix
+                # from the source rather than shipping an unsatisfiable
+                # from_page that would shed into a full re-prefill.
+                kv_send = self._fetch_blob(req, kv, req.kv_src,
+                                           from_page=k_abs)
+                if kv_send is None:
+                    # frame gone (replica died after the result left, or
+                    # evicted): the caller re-prefills — deferred, lost
+                    # work never
+                    return "lost"
+                # in hand now: a 429 walk over later candidates reuses
+                # (and may re-slice) this blob instead of refetching
+                req.kv = kv = kv_send
+            else:
+                kv_send = kv
+                rel = k_abs - base
+                if rel > 0:
+                    try:
+                        kv_send = slice_blob(kv, rel)
+                    except ValueError:
+                        kv_send = kv
+            # slice accounting vs the FULL blob, not vs the in-hand copy:
+            # an already-server-sliced blob shipping unchanged to a later
+            # candidate in the same walk (base > 0, rel == 0) is still a
+            # sliced transfer — its skipped pages must not vanish from
+            # the fleet counters just because a 429 interposed
+            skipped = total - int(kv_send.get("n_pages", 0))
             # binary hop (ISSUE 12): header JSON + raw payload in one
             # length-prefixed frame — the payload bytes ship verbatim
             # instead of paying the old base64-JSON 4/3× inflation

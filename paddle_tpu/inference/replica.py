@@ -277,20 +277,44 @@ class ReplicaServer:
         return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
 
     def _h_kv_blob(self, query: dict):
-        """GET /kv_blob?rid=N[&router=ns] — one exported page frame as a
-        raw octet-stream (ISSUE 12 binary wire). 404 once evicted: the
-        router's established answer to a lost blob is re-prefill."""
+        """GET /kv_blob?rid=N[&router=ns][&from_page=k] — one exported
+        page frame as a raw octet-stream (ISSUE 12 binary wire). 404
+        once evicted: the router's established answer to a lost blob is
+        re-prefill. ``from_page`` (ISSUE 14 satellite) slices the frame
+        SERVER-SIDE to pages [k, n): the router probed the decode pool's
+        prefix cache first, so pages the destination already holds never
+        cross this hop either — the prefill→router leg stops hauling
+        bytes the router would immediately slice away."""
         try:
             rid = int(query.get("rid", [""])[0])
         except (ValueError, IndexError):
             return 400, {"ok": False, "reason": "rid=N required"}
         rtr = (query.get("router") or [None])[0]
+        try:
+            k = int((query.get("from_page") or ["0"])[0])
+        except ValueError:
+            return 400, {"ok": False,
+                         "reason": "from_page must be an integer"}
         with self._lk:
             frame = self._kv_frames.get((rtr, rid))
         if frame is None:
             return 404, {"ok": False, "reason": "no frame for rid "
                                                 f"{rid} (evicted or "
                                                 "never exported)"}
+        if k > 0:
+            from .disagg.transfer import (blob_meta, pack_frame,
+                                          slice_blob, unpack_frame)
+            try:
+                header, payload = unpack_frame(frame)
+                blob = dict(header.get("kv") or {})
+                blob["data"] = payload
+                sliced = slice_blob(blob, k)
+                frame = pack_frame({"kv": blob_meta(sliced)},
+                                   sliced["data"])
+            except (ValueError, KeyError) as e:
+                # an over-slice (k past the tail page) is a router logic
+                # bug, not capacity — answer loudly, never a torn frame
+                return 400, {"ok": False, "reason": f"bad slice: {e}"}
         return 200, frame
 
     def _h_kv_transfer(self, body):

@@ -94,6 +94,12 @@ class RoutedRequest:
     # stage's start time for the per-stage slo histograms
     stage: str = "prefill"
     kv: dict | None = None
+    # where the prefilled result physically came from (ISSUE 14
+    # satellite): the /kv_blob fetch is DEFERRED until after the decode
+    # pool's prefix probe, so the endpoint must outlive the handle (a
+    # falsely-suspected replica's late result arrives exactly after
+    # _mark_dead deleted it)
+    kv_src: str | None = None
     t_stage: float = 0.0
 
 

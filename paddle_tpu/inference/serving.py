@@ -159,7 +159,14 @@ class ContinuousBatcher:
                  slo_policy=None, admission: AdmissionPolicy | None = None,
                  kv_dtype: str | None = None,
                  pool_hbm_bytes: int | None = None,
-                 prefix_cache_pages: int | None = None):
+                 prefix_cache_pages: int | None = None,
+                 spec_decode: bool | None = None,
+                 spec_k: int | None = None,
+                 spec_draft_layers: int | None = None):
+        # speculative decoding (ISSUE 14): the draft builds from the
+        # PRE-precision view (weight-only int8 reshapes the target tree;
+        # the draft applies its own PADDLE_SPEC_DRAFT_PRECISION instead)
+        spec_src = (model_config, params)
         self._dequant = None
         if precision in ("int8", "weight_only_int8"):
             # int8 weight-only serving: weights live quantized in HBM and
@@ -340,6 +347,18 @@ class ContinuousBatcher:
         else:
             from ..models.llama_decode import init_kv_cache
             self._cache = init_kv_cache(model_config, self.B, self.S)
+
+        # speculative decoding (ISSUE 14): a draft model proposing k
+        # greedy tokens per slot + ONE target verify launch per step.
+        # None (off / unsupported) keeps the scheduler byte-for-byte the
+        # plain engine — spec_from_env degrades silently by contract.
+        from .speculative import spec_from_env
+        self._spec = spec_from_env(
+            spec_src[0], spec_src[1], max_batch=self.B, max_len=self.S,
+            prompt_buckets=self._buckets, temperature=self._temp,
+            paged=self._layout == "paged", spec_decode=spec_decode,
+            k=spec_k, draft_layers=spec_draft_layers)
+        del spec_src
 
         self._queue: deque[ServedRequest] = deque()
         self._finished: dict[int, ServedRequest] = {}
@@ -692,6 +711,11 @@ class ContinuousBatcher:
             self._alloc.free(self._page_tbl[slot])
             self._page_tbl[slot] = []
             metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+        if self._spec is not None:
+            # the draft's cache watermark dies with the slot: every path
+            # that vacates a slot (retire, preempt, chaos) lands here, so
+            # the next occupant re-prefills the draft from ITS sequence
+            self._spec.invalidate(slot)
 
     def _retire_all_active(self, why: str) -> None:
         """A faulted burst retires every active request with the output it
@@ -772,20 +796,26 @@ class ContinuousBatcher:
         metrics.counter("serve.preemptions").inc()
         self.slo.on_preempt(req.rid)  # same trace id; e2e clock keeps going
 
-    def _grow_for_burst(self, active: list) -> list:
+    def _grow_for_burst(self, active: list, last_pos_of=None) -> list:
         """Page growth for every slot in `active` to cover this burst's
         writes — plus the COPY-ON-WRITE sweep (ISSUE 13): a shared page
         in the write window is copied private BEFORE dispatch, so shared
         prefix pages stay read-only whoever decodes past them. Preempts
         youngest-first when the pool runs dry (a lone slot always fits:
         add_request rejected anything that can't; idle prefix-cache pages
-        reclaim before anyone preempts). Returns the surviving active
-        list (possibly empty)."""
+        reclaim before anyone preempts). ``last_pos_of`` overrides the
+        per-slot write-window end (the speculative verify writes
+        pos + proposals rows, not a whole burst — ISSUE 14); None keeps
+        the plain-burst window. Returns the surviving active list
+        (possibly empty)."""
         while True:
             grown = True
             for b in list(active):
-                last_pos = min(int(self._pos[b]) + self.burst - 1,
-                               int(self._limit[b]))
+                if last_pos_of is None:
+                    last_pos = min(int(self._pos[b]) + self.burst - 1,
+                                   int(self._limit[b]))
+                else:
+                    last_pos = int(last_pos_of(b))
                 deficit = pages_for(last_pos + 1, self._ps) \
                     - len(self._page_tbl[b])
                 got = self._palloc(deficit) if deficit > 0 else []
@@ -1373,6 +1403,148 @@ class ContinuousBatcher:
         if emitted and dt > 0:
             metrics.gauge("serve.tokens_per_s").set(emitted / dt)
 
+    # -------------------------------------------------- speculative (14)
+    def _spec_applicable(self) -> bool:
+        """Speculative steps run when there is decode work and no
+        admission work this engine could do instead: an empty queue, or
+        a full slot table (queued requests can't admit anyway — the
+        plain path resumes the moment a slot frees AND the queue has
+        work, so admissions never starve behind speculation)."""
+        if self._spec is None:
+            return False
+        if all(r is None for r in self._slot_req):
+            return False
+        return not self._queue or None not in self._slot_req
+
+    def _try_step_spec(self) -> bool:
+        """One speculative iteration (ISSUE 14): the draft proposes up
+        to k tokens per live slot, ONE target launch verifies every
+        slot's segment (``llama_paged_verify`` on this engine's read
+        path), and the accept-prefix walk emits 1..k+1 tokens per slot —
+        token-identical to plain greedy decode by construction. Returns
+        False when the ``serve.spec_verify`` chaos site faults BEFORE
+        any state moved: the caller serves that burst through the plain
+        path instead (degraded throughput, identical tokens, never a
+        wedge)."""
+        try:
+            chaos.hit("serve.spec_verify")
+        except chaos.ChaosError:
+            self.stats["spec_fallbacks"] = \
+                self.stats.get("spec_fallbacks", 0) + 1
+            metrics.counter("serve.spec_fallbacks").inc()
+            return False
+        from ..models.llama_paged import llama_paged_verify
+        t0 = _slo.now()
+        spec = self._spec
+        # (prompt, out) ride as a PAIR — propose() slices the few tokens
+        # it needs (≤ k+2 once a slot is warm); concatenating the full
+        # sequence here would be O(prompt+emitted) host work per launch
+        jobs = [(b, int(self._pos[b]), int(self._limit[b]),
+                 (r.prompt, r.out))
+                for b, r in enumerate(self._slot_req) if r is not None]
+        props = spec.propose(jobs)
+        # grow + COW over the verify write window [pos, pos + n_props]:
+        # any page another block table or the prefix cache still maps is
+        # privatized BEFORE the speculative writes — a later rewind frees
+        # only private pages, shared prefixes are never truncated
+        active = self._grow_for_burst(
+            [b for b, *_ in jobs],
+            last_pos_of=lambda b: int(self._pos[b]) + len(props[b]))
+        if not active:
+            metrics.histogram("serve.burst_time_s").observe(
+                _slo.now() - t0)
+            return True       # everything preempted; queue serves next step
+        metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+
+        Tv = spec.k + 1
+        tokens = np.full((self.B, Tv), self.pad_id, np.int32)
+        n_tok = np.zeros(self.B, np.int32)
+        start = np.zeros(self.B, np.int32)
+        for b in active:
+            row = [int(self._tok[b])] + props[b]
+            tokens[b, :len(row)] = row
+            n_tok[b] = len(row)
+            start[b] = self._pos[b]
+        if self._ragged:
+            P = pages_for(self.S, self._ps)      # full width, one program
+        else:
+            width = max(len(self._page_tbl[b]) for b in active)
+            P = next(p for p in self._page_buckets if p >= width)
+        bt = np.full((self.B, P), SCRATCH_PAGE, np.int32)
+        for b in active:
+            ids = self._page_tbl[b]
+            bt[b, :len(ids)] = ids
+
+        targets_d, self._cache = llama_paged_verify(
+            self._params, self._cache, jnp.asarray(bt),
+            jnp.asarray(start), jnp.asarray(tokens), jnp.asarray(n_tok),
+            config=self._cfg, ragged=self._ragged,
+            interpret=self._interpret, mesh=self._mesh,
+            dequant=self._dequant, kv_dtype=self._kv_dtype)
+        targets = np.asarray(jax.device_get(targets_d))
+        self.stats["bursts"] += 1
+        self.stats["spec_steps"] = self.stats.get("spec_steps", 0) + 1
+        self.stats["spec_slot_launches"] = \
+            self.stats.get("spec_slot_launches", 0) + len(active)
+        metrics.counter("serve.spec_steps").inc()
+
+        from .speculative import accept_prefix
+        emitted_total = proposed_total = accepted_total = 0
+        for b in active:
+            req = self._slot_req[b]
+            pos0 = int(self._pos[b])
+            out_toks, acc, done = accept_prefix(
+                props[b], targets[b, :int(n_tok[b])], pos=pos0,
+                limit=int(self._limit[b]), eos_id=self.eos_id)
+            req.out.extend(out_toks)
+            emitted_total += len(out_toks)
+            proposed_total += len(props[b])
+            accepted_total += acc
+            self._pos[b] = pos0 + len(out_toks)
+            self._tok[b] = out_toks[-1]
+            self._done[b] = done
+            if req.rid in self._await_first:
+                # a full-prefix-hit admit whose first token is a spec
+                # emission — TTFT fires here, exactly once
+                self._observe_first(req)
+            self.slo.on_tokens(req.rid, len(out_toks))
+            if done:
+                self._park_or_finish(b, req)
+                continue
+            spec.commit(b, acc)
+            # rewind the rejected tail's page writes: pages past the
+            # accepted position hold only stale speculative rows — free
+            # them (COW above already privatized anything shared, so a
+            # freed page can only be this slot's own)
+            keep = pages_for(int(self._pos[b]), self._ps)
+            tbl = self._page_tbl[b]
+            if len(tbl) > keep:
+                self._alloc.free(tbl[keep:])
+                del tbl[keep:]
+        metrics.gauge("serve.pages_in_use").set(self._alloc.pages_in_use)
+        metrics.counter("serve.tokens").inc(emitted_total)
+        metrics.counter("serve.spec_proposed").inc(proposed_total)
+        metrics.counter("serve.spec_accepted").inc(accepted_total)
+        self.stats["spec_proposed"] = \
+            self.stats.get("spec_proposed", 0) + proposed_total
+        self.stats["spec_accepted"] = \
+            self.stats.get("spec_accepted", 0) + accepted_total
+        self.stats["spec_emitted"] = \
+            self.stats.get("spec_emitted", 0) + emitted_total
+        if proposed_total:
+            metrics.histogram("serve.spec_accept_rate").observe(
+                accepted_total / proposed_total)
+        metrics.histogram("serve.spec_tokens_per_launch").observe(
+            emitted_total / len(active))
+        self.stats["max_concurrent"] = max(
+            self.stats["max_concurrent"],
+            sum(r is not None for r in self._slot_req))
+        dt = _slo.now() - t0
+        metrics.histogram("serve.burst_time_s").observe(dt)
+        if emitted_total and dt > 0:
+            metrics.gauge("serve.tokens_per_s").set(emitted_total / dt)
+        return True
+
     # ------------------------------------------------------------- decode
     def step(self):
         """One scheduling iteration.
@@ -1380,6 +1552,9 @@ class ContinuousBatcher:
         Paged (overlap-scheduled): dispatch the burst async → do ALL host
         scheduling while the device runs → block once on the combined
         readback. Dense (legacy order): admit synchronously, then burst.
+        Speculative (ISSUE 14, ``self._spec``): decode-only iterations go
+        through draft-propose + one-launch verify instead of the scanned
+        burst — same tokens, more of them per launch.
         """
         if self._admission is not None:
             # graceful degradation under forced overload (router failover
@@ -1387,7 +1562,9 @@ class ContinuousBatcher:
             cap = self._admission.max_queue_for(self.B)
             if len(self._queue) > cap:
                 self.shed_newest(len(self._queue) - cap)
-        if self._ragged:
+        if self._spec_applicable() and self._try_step_spec():
+            pass                      # spec step served this iteration
+        elif self._ragged:
             self._step_ragged()
         elif self._layout == "paged":
             t0 = _slo.now()  # the sanctioned request-timing clock (lint O4)
@@ -1650,6 +1827,7 @@ class ContinuousBatcher:
             "prefix": (None if self._prefix is None else
                        {"cached_pages": self._prefix.cached_pages,
                         **self._prefix.stats}),
+            "spec": (None if self._spec is None else self._spec.summary()),
         }
 
     @property

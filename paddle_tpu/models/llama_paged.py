@@ -61,7 +61,8 @@ from .llama_decode import _cached_attention_slots, _mlp, _qkv, _sample
 
 __all__ = ["init_paged_kv_cache", "llama_paged_prefill_slot",
            "llama_paged_prefill_suffix", "llama_paged_decode_burst",
-           "llama_ragged_burst", "paged_kv_bytes_per_token", "page_bytes",
+           "llama_ragged_burst", "llama_paged_verify",
+           "paged_kv_bytes_per_token", "page_bytes",
            "gather_pages", "scatter_pages", "copy_pages"]
 
 
@@ -831,3 +832,153 @@ def llama_ragged_burst(params, cache, block_table, pos, tok, done, limit,
     (cache, pos, tok, done, _), emitted = jax.lax.scan(
         step, (cache, pos, tok, done, key), None, length=n)
     return cache, pos, tok, done, emitted, firsts
+
+
+# ------------------------------------------------------- verify (ISSUE 14)
+# Speculative decoding's target half: each verifying slot's row carries
+# [current_tok, d_1 .. d_np] — its np draft proposals behind the token the
+# plain path would feed next — as a short "prefill-carrying" segment at
+# prefill_start = pos (q_len = np + 1, TRACED), and the launch returns the
+# greedy target token for EVERY row position. Accept-prefix then emits the
+# longest prefix where draft and target argmax agree plus the target's
+# correction/bonus token, so up to k+1 tokens cost ONE target launch while
+# staying token-identical to plain greedy decode (the host walk in
+# inference/speculative.py mirrors the scan's eos/limit arithmetic).
+# q_len rides in a traced vector, so mixed per-slot proposal counts (slots
+# near their budget propose fewer; a draft catching up proposes none and
+# the row degenerates to a plain decode step) all share ONE executable —
+# no per-k bucket grid (pinned by tests/test_speculative.py).
+
+
+def _verify_attention(q, kc, vc, start, config: LlamaConfig):
+    """Verify-segment attention for the GATHER read path: q [B, Tv, H, hd]
+    queries at absolute positions ``start[b] + j`` over the block-table-
+    gathered rows kc/vc [B, R, KV, hd] (R = page_bucket × page_size, row
+    r = logical position r). Query j attends rows ≤ start + j — the
+    decode-style offset mask the ragged kernel computes from (q_len,
+    kv_len). Same arithmetic family as ``_cached_attention_slots``
+    (grouped einsum, f32 logits, -1e30 mask, softmax rounded to q.dtype)
+    so greedy targets match the plain decode step's token for token."""
+    c = config
+    H, KV = c.num_attention_heads, c.num_key_value_heads
+    g = H // KV
+    B, Tv, _, hd = q.shape
+    R = kc.shape[1]
+    qg = q.reshape(B, Tv, KV, g, hd)
+    scale = 1.0 / jnp.sqrt(jnp.float32(c.head_dim))
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg.astype(jnp.float32),
+                        kc.astype(jnp.float32)) * scale
+    cols = jnp.arange(R, dtype=jnp.int32)[None, None, :]
+    qpos = (start.astype(jnp.int32)[:, None, None]
+            + jnp.arange(Tv, dtype=jnp.int32)[None, :, None])
+    valid = cols <= qpos                          # [B, Tv, R]
+    logits = jnp.where(valid[:, None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, vc)
+    return out.reshape(B, Tv, H, hd)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "ragged", "interpret", "mesh", "dequant", "kv_dtype"),
+    donate_argnums=(1,))
+def llama_paged_verify(params, cache, block_table, start, tokens, n_tok,
+                       config: LlamaConfig, ragged: bool = False,
+                       interpret: bool = True, mesh=None, dequant=None,
+                       kv_dtype: str | None = None):
+    """ONE launch verifying every slot's speculative segment (ISSUE 14).
+
+    tokens [B, Tv] int32 (Tv = k+1, static per engine): slot b's row is
+    [current_tok, proposals...] padded; n_tok [B] the live row length
+    (0 skips the slot — its writes go to scratch, its outputs are junk
+    the host ignores); start [B] = the slot's pos (row j lands at
+    absolute position start+j, NOT page-aligned — writes are per-row).
+    K/V rows are written through the block table exactly like a decode
+    step would write them one launch at a time, then read back with the
+    slot's own read path: the Pallas ragged kernel (``ragged=True``,
+    q_len = n_tok, kv_len = start + n_tok) or the XLA gather +
+    ``_verify_attention``. Rows past the accepted prefix become stale
+    pool garbage the validity masks hide — rewind is free (the host just
+    resets pos and frees trailing pages; shared pages were COW'd by the
+    growth sweep BEFORE these writes could touch them).
+
+    Returns (targets [B, Tv] int32 — the greedy target token after each
+    row position, i.e. targets[b, j] is the token at start+j+1 — and the
+    updated cache). Greedy only: speculative serving is gated to
+    temperature 0, where accept-prefix is exact."""
+    from ..inference.paging import SCRATCH_PAGE
+
+    c = config
+    p = dequant(params) if dequant is not None else params
+    layer_p, other = split_layer_params(p)
+    B, Tv = tokens.shape
+    ps = int(cache["k"][0].shape[1])
+    P = block_table.shape[1]
+    start32 = start.astype(jnp.int32)
+    lens32 = n_tok.astype(jnp.int32)
+    x = jnp.take(other["embed_tokens"], tokens, axis=0).astype(c.dtype)
+    positions = start32[:, None] + jnp.arange(Tv, dtype=jnp.int32)[None, :]
+    live = jnp.arange(Tv, dtype=jnp.int32)[None, :] < lens32[:, None]
+    pg_idx = jnp.minimum(positions // jnp.int32(ps), jnp.int32(P - 1))
+    wpage = jnp.where(live,
+                      jnp.take_along_axis(block_table, pg_idx, axis=1),
+                      jnp.int32(SCRATCH_PAGE))
+    wrow = positions % jnp.int32(ps)
+    z = jnp.int32(0)
+
+    quant = kv_dtype is not None
+    ks, vs = list(cache["k"]), list(cache["v"])
+    kss = list(cache["k_scale"]) if quant else None
+    vss = list(cache["v_scale"]) if quant else None
+    for l in range(c.num_hidden_layers):
+        lp = jax.tree.map(lambda a: a[l], layer_p)
+        h = _rmsnorm(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv(h, lp, c)
+        q, k = _rope(q, k, positions, c.rope_theta, c.head_dim)
+        kp, vp = ks[l], vs[l]
+        ku, vu = k, v                              # [B, Tv, KV, hd]
+        if quant:
+            ku, ksr = _kv_encode(ku, kv_dtype)     # + scales [B, Tv, KV]
+            vu, vsr = _kv_encode(vu, kv_dtype)
+            ksp, vsp = kss[l], vss[l]
+        for b in range(B):
+            for j in range(Tv):
+                at = (wpage[b, j], wrow[b, j], z, z)
+                kp = jax.lax.dynamic_update_slice(
+                    kp, ku[b, j][None, None], at)
+                vp = jax.lax.dynamic_update_slice(
+                    vp, vu[b, j][None, None], at)
+                if quant:
+                    ats = (wpage[b, j], wrow[b, j], z)
+                    ksp = jax.lax.dynamic_update_slice(
+                        ksp, ksr[b, j][None, None], ats)
+                    vsp = jax.lax.dynamic_update_slice(
+                        vsp, vsr[b, j][None, None], ats)
+        ks[l], vs[l] = kp, vp
+        if quant:
+            kss[l], vss[l] = ksp, vsp
+        if ragged:
+            att = _ragged_attn(q, kp, vp, block_table, lens32,
+                               start32 + lens32, page_size=ps,
+                               interpret=interpret, mesh=mesh,
+                               ksc=ksp if quant else None,
+                               vsc=vsp if quant else None)
+        else:
+            kc = jnp.take(kp, block_table, axis=0)
+            vc = jnp.take(vp, block_table, axis=0)
+            if quant:
+                kc = _kv_decode(kc, jnp.take(ksp, block_table, axis=0),
+                                c.dtype)
+                vc = _kv_decode(vc, jnp.take(vsp, block_table, axis=0),
+                                c.dtype)
+            kc = kc.reshape(B, -1, c.num_key_value_heads, c.head_dim)
+            vc = vc.reshape(B, -1, c.num_key_value_heads, c.head_dim)
+            att = _verify_attention(q, kc, vc, start32, c)
+        y = x + (att.reshape(B, Tv, -1) @ lp["wo"])
+        x = _mlp(y, lp, c)
+
+    logits = lm_head_logits(x, other, c)           # [B, Tv, V] f32
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    out = {"k": tuple(ks), "v": tuple(vs)}
+    if quant:
+        out["k_scale"], out["v_scale"] = tuple(kss), tuple(vss)
+    return targets, out

@@ -247,6 +247,23 @@ declare("PADDLE_SERVE_KV_DTYPE", "",
 
 # ------------------------------------------------------------ paged serving
 
+declare("PADDLE_SPEC_DECODE", "0",
+        "'1' enables speculative decoding on the paged serving engine: a "
+        "small draft model proposes PADDLE_SPEC_K tokens per slot and ONE "
+        "target launch verifies them (accept-prefix, temp=0 "
+        "token-identical; silently plain decode when unsupported)")
+declare("PADDLE_SPEC_K", "4",
+        "draft tokens proposed per slot per speculative step (the verify "
+        "row carries k+1 positions; k is traced per slot, so mixed "
+        "proposal counts share one executable)")
+declare("PADDLE_SPEC_DRAFT_LAYERS", "0",
+        "draft model depth: the target truncated to this many leading "
+        "layers (0 = half the target's layers; == target layers is the "
+        "self-draft used by tests for a deterministic 100% accept rate)")
+declare("PADDLE_SPEC_DRAFT_PRECISION", "",
+        "draft model weight precision: 'int8' serves the draft "
+        "weight-only-quantized (near-free in HBM); '' = the target's "
+        "weights as handed in")
 declare("PADDLE_PREFIX_CACHE_PAGES", "0",
         "prefix-sharing cache size in pool pages (>0 enables the "
         "page-granular prefix-hash index: shared-prompt admissions map "

@@ -964,3 +964,239 @@ class TestDisaggBenchContract:
             for stats in d["per_pool"][pool].values():
                 assert set(stats) == {"ttft_p50", "ttft_p95",
                                       "tpot_p50", "tpot_p95"}
+
+
+# ------------------------------------------- sliced first hop (ISSUE 14)
+class TestSlicedKvBlobHop:
+    """ISSUE 14 satellite (ROADMAP PR-13 follow-up 1): the prefill→router
+    /kv_blob hop is sliced too — the router probes the decode pool's
+    prefix cache FIRST, then fetches ``?from_page=k``, so pages the
+    destination already holds never cross EITHER hop. The replica slices
+    the stored frame server-side, byte-equal to a local slice_blob."""
+
+    def _frame_fixture(self, cfg, params):
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame)
+        pre = _engine(cfg, params)
+        prompt = list(range(1, 2 * 8 + 4))          # 3 pages at ps=8
+        rid = pre.add_request(prompt, max_new_tokens=6, prefill_only=True)
+        pre.run()
+        blob = pre.export_kv(rid)
+        frame = pack_frame({"kv": blob_meta(blob)}, blob["data"])
+        return prompt, blob, frame
+
+    def test_kv_blob_handler_slices_byte_equal(self, small_model,
+                                               tmp_path):
+        """GET /kv_blob?from_page=k returns a frame whose header is the
+        sliced meta and whose payload is BYTE-EQUAL to slice_blob's —
+        the install on the far side is therefore bit-identical to the
+        full-transfer path's (install equality already pinned in
+        tests/test_prefix_cache.py)."""
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          slice_blob,
+                                                          unpack_frame)
+        cfg, params = small_model
+        prompt, blob, frame = self._frame_fixture(cfg, params)
+        reg = el.FileRegistry(str(tmp_path), "t", ttl=5.0)
+        rep = ReplicaServer(_engine(cfg, params), reg, "p0",
+                            role="prefill")
+        rep._admin.start()   # handlers only; no serve loop, no heartbeat
+        try:
+            rep._store_frame(("ns", 7), frame)
+            code, full = rep._h_kv_blob({"rid": ["7"], "router": ["ns"]})
+            assert code == 200 and full == frame
+            code, sliced_frame = rep._h_kv_blob(
+                {"rid": ["7"], "router": ["ns"], "from_page": ["2"]})
+            assert code == 200
+            header, payload = unpack_frame(sliced_frame)
+            want = slice_blob(blob, 2)
+            assert payload == want["data"]            # byte-equal slice
+            assert header["kv"] == blob_meta(want)
+            assert len(sliced_frame) < len(frame) / 2  # the hop shrank
+            # an over-slice (past the tail page) is refused loudly
+            code, body = rep._h_kv_blob(
+                {"rid": ["7"], "router": ["ns"], "from_page": ["3"]})
+            assert code == 400
+            code, body = rep._h_kv_blob(
+                {"rid": ["7"], "router": ["ns"], "from_page": ["x"]})
+            assert code == 400
+        finally:
+            rep._admin.stop()
+
+    def _router_and_req(self, prompt, meta):
+        class _Reg:
+            def alive_nodes(self):
+                return []
+
+            def info(self, node):
+                return {}
+
+        router = DisaggRouter(_Reg())
+        req = RoutedRequest(rid=1, prompt=prompt, max_new_tokens=4,
+                            trace_id=0)
+        req.trace_id = router.slo.on_enqueue(req.rid)
+        router._requests[req.rid] = req
+        req.kv = dict(meta)                      # meta only — no payload
+        req.kv_src = "http://prefill"
+        req.stage = "transfer"
+        req.t_stage = 0.0
+        return router, req
+
+    def test_deferred_fetch_asks_from_page(self, small_model,
+                                           monkeypatch):
+        """_try_transfer with a meta-only blob probes the decode
+        candidate, THEN fetches /kv_blob?from_page=k from the prefill
+        replica — the skipped pages never cross the first hop — and the
+        POSTed frame carries exactly the server-sliced payload."""
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame,
+                                                          slice_blob,
+                                                          unpack_frame)
+        from paddle_tpu.inference.router import _Handle
+        cfg, params = small_model
+        prompt, blob, _frame = self._frame_fixture(cfg, params)
+        router, req = self._router_and_req(prompt, blob_meta(blob))
+        h = _Handle(id="serve.d0", endpoint="http://decode",
+                    prefix_sharing=True, free_pages=64, role="decode",
+                    ready=True)
+        router._handles[h.id] = h
+        fetched = {}
+
+        def fake_get_bytes(endpoint, path, timeout=None):
+            fetched["endpoint"], fetched["path"] = endpoint, path
+            want = slice_blob(blob, 2)
+            return pack_frame({"kv": blob_meta(want)}, want["data"])
+
+        posted = {}
+
+        def fake_post_bytes(endpoint, path, data, timeout=None):
+            posted["path"], posted["data"] = path, data
+            return 200, {"ok": True}
+
+        monkeypatch.setattr(router, "_post",
+                            lambda *a, **k: (200, {"from_page": 2}))
+        monkeypatch.setattr(router, "_get_bytes", fake_get_bytes)
+        monkeypatch.setattr(router, "_post_bytes", fake_post_bytes)
+        assert router._try_transfer(req) == "routed"
+        assert fetched["endpoint"] == "http://prefill"
+        assert "from_page=2" in fetched["path"]
+        hdr, payload = unpack_frame(posted["data"])
+        assert payload == slice_blob(blob, 2)["data"]   # byte-equal
+        assert router.xfer_pages_skipped == 2
+        assert router._fleet_counts["transfers_sliced"] == 1
+        router.close()
+
+    def test_failover_refetches_missing_prefix(self, small_model,
+                                               monkeypatch):
+        """The in-hand blob was server-sliced for a WARM candidate that
+        then 429'd: the walk's next (cold-cache) candidate must not be
+        shipped an unsatisfiable from_page — the router refetches the
+        missing prefix from the source and ships the full blob, instead
+        of shedding a completed prefill into a re-prefill."""
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame,
+                                                          slice_blob,
+                                                          unpack_frame)
+        from paddle_tpu.inference.router import _Handle
+        cfg, params = small_model
+        prompt, blob, _frame = self._frame_fixture(cfg, params)
+        router, req = self._router_and_req(prompt, blob_meta(blob))
+        warm = _Handle(id="serve.dw", endpoint="http://warm", role="decode",
+                       prefix_sharing=True, free_pages=64, ready=True)
+        cold = _Handle(id="serve.dc", endpoint="http://cold", role="decode",
+                       prefix_sharing=False, free_pages=64, ready=True,
+                       queue_depth=1)           # sorts after warm
+        router._handles[warm.id] = warm
+        router._handles[cold.id] = cold
+        fetches = []
+
+        def fake_get_bytes(endpoint, path, timeout=None):
+            k = 0
+            if "from_page=" in path:
+                k = int(path.split("from_page=")[1].split("&")[0])
+            fetches.append(k)
+            b = slice_blob(blob, k) if k else blob
+            return pack_frame({"kv": blob_meta(b)}, b["data"])
+
+        posted = {}
+
+        def fake_post_bytes(endpoint, path, data, timeout=None):
+            if endpoint == "http://warm":
+                return 429, {"retry_after_s": 0.1}
+            posted["endpoint"], posted["data"] = endpoint, data
+            return 200, {"ok": True}
+
+        monkeypatch.setattr(router, "_post",
+                            lambda *a, **k: (200, {"from_page": 2}))
+        monkeypatch.setattr(router, "_get_bytes", fake_get_bytes)
+        monkeypatch.setattr(router, "_post_bytes", fake_post_bytes)
+        assert router._try_transfer(req) == "routed"
+        assert fetches == [2, 0]       # sliced for warm, refetched full
+        assert posted["endpoint"] == "http://cold"
+        _hdr, payload = unpack_frame(posted["data"])
+        assert payload == blob["data"]  # the cold pool got the FULL blob
+        router.close()
+
+    def test_sliced_accounting_survives_429_walk(self, small_model,
+                                                 monkeypatch):
+        """An in-hand blob already server-sliced at page 2 ships
+        UNCHANGED to a second equally-warm candidate after the first
+        429s — the transfer is still a sliced one, so
+        transfers_sliced/xfer_pages_skipped count against the FULL blob
+        (the old per-attempt recompute's accounting, kept)."""
+        from paddle_tpu.inference.disagg.transfer import (blob_meta,
+                                                          pack_frame,
+                                                          slice_blob)
+        from paddle_tpu.inference.router import _Handle
+        cfg, params = small_model
+        prompt, blob, _frame = self._frame_fixture(cfg, params)
+        router, req = self._router_and_req(prompt, blob_meta(blob))
+        a = _Handle(id="serve.da", endpoint="http://a", role="decode",
+                    prefix_sharing=True, free_pages=64, ready=True)
+        b = _Handle(id="serve.db", endpoint="http://b", role="decode",
+                    prefix_sharing=True, free_pages=64, ready=True,
+                    queue_depth=1)                 # sorts after a
+        router._handles[a.id] = a
+        router._handles[b.id] = b
+
+        def fake_get_bytes(endpoint, path, timeout=None):
+            want = slice_blob(blob, 2)
+            return pack_frame({"kv": blob_meta(want)}, want["data"])
+
+        monkeypatch.setattr(router, "_post",
+                            lambda *ar, **k: (200, {"from_page": 2}))
+        monkeypatch.setattr(router, "_get_bytes", fake_get_bytes)
+        monkeypatch.setattr(
+            router, "_post_bytes",
+            lambda ep, path, data, timeout=None:
+                ((429, {"retry_after_s": 0.1}) if ep == "http://a"
+                 else (200, {"ok": True})))
+        assert router._try_transfer(req) == "routed"
+        assert router.xfer_pages_skipped == 2
+        assert router._fleet_counts["transfers_sliced"] == 1
+        router.close()
+
+    def test_declined_candidate_costs_no_fetch(self, small_model,
+                                               monkeypatch):
+        """The pressure gate runs on (meta pages − probed prefix) BEFORE
+        the fetch: a page-starved decode pool declines the transfer
+        without the payload ever crossing the first hop; a gone frame
+        surfaces as 'lost' → the established re-prefill recovery."""
+        from paddle_tpu.inference.disagg.transfer import blob_meta
+        from paddle_tpu.inference.router import _Handle
+        cfg, params = small_model
+        prompt, blob, _frame = self._frame_fixture(cfg, params)
+        router, req = self._router_and_req(prompt, blob_meta(blob))
+        h = _Handle(id="serve.d0", endpoint="http://decode",
+                    prefix_sharing=False, free_pages=0, role="decode",
+                    ready=True)
+        router._handles[h.id] = h
+        monkeypatch.setattr(
+            router, "_get_bytes",
+            lambda *a, **k: pytest.fail("fetched past a declined gate"))
+        assert router._try_transfer(req) == "declined"
+        # frame gone on a passing candidate: "lost", caller re-prefills
+        h.free_pages = 64
+        monkeypatch.setattr(router, "_get_bytes", lambda *a, **k: None)
+        assert router._try_transfer(req) == "lost"
+        router.close()

@@ -554,11 +554,16 @@ class TestDisaggCompose:
         finally:
             rep._admin.stop()
 
-    def test_router_maybe_slice(self, small_model, monkeypatch):
-        """DisaggRouter probes a prefix-sharing decode handle and ships
-        the sliced blob; a probe hiccup ships the full blob."""
+    def test_router_transfer_slices_in_hand_blob(self, small_model,
+                                                 monkeypatch):
+        """_try_transfer probes a prefix-sharing decode handle and ships
+        the in-hand blob SLICED to the unshared remainder; a probe
+        hiccup or a non-sharing handle ships the full blob; the slice is
+        capped one page below the blob (the tail page always travels)."""
         cfg, params = small_model
         from paddle_tpu.inference.disagg.coordinator import DisaggRouter
+        from paddle_tpu.inference.disagg.transfer import (slice_blob,
+                                                          unpack_frame)
         from paddle_tpu.inference.router import _Handle, RoutedRequest
 
         prompt = list(range(1, 2 * PS + 4))
@@ -571,32 +576,50 @@ class TestDisaggCompose:
             def info(self, node):
                 return {}
 
-        router = DisaggRouter(_Reg())
-        req = RoutedRequest(rid=1, prompt=prompt, max_new_tokens=4,
-                            trace_id=1)
-        req.kv = blob
-        h = _Handle(id="serve.d0", endpoint="http://x", prefix_sharing=True)
-        monkeypatch.setattr(router, "_post",
-                            lambda *a, **k: (200, {"from_page": 2}))
-        kv, skipped = router._maybe_slice(req, h)
-        assert skipped == 2 and kv["n_pages"] == 1
-        assert kv["wire_bytes"] < blob["wire_bytes"]
+        def run_one(sharing, post_fn):
+            router = DisaggRouter(_Reg())
+            req = RoutedRequest(rid=1, prompt=prompt, max_new_tokens=4,
+                                trace_id=0)
+            req.trace_id = router.slo.on_enqueue(req.rid)
+            router._requests[req.rid] = req
+            req.kv = dict(blob)       # full blob in hand (data carried)
+            req.stage = "transfer"
+            h = _Handle(id="serve.d0", endpoint="http://x", role="decode",
+                        prefix_sharing=sharing, free_pages=64, ready=True)
+            router._handles[h.id] = h
+            posted = {}
+            monkeypatch.setattr(router, "_post", post_fn)
+            monkeypatch.setattr(
+                router, "_post_bytes",
+                lambda ep, path, data, timeout=None:
+                    (posted.__setitem__("data", data) or (200,
+                                                          {"ok": True})))
+            monkeypatch.setattr(
+                router, "_get_bytes",
+                lambda *a, **k: pytest.fail("fetched with blob in hand"))
+            assert router._try_transfer(req) == "routed"
+            hdr, payload = unpack_frame(posted["data"])
+            skipped = router.xfer_pages_skipped
+            router.close()
+            return hdr["kv"], payload, skipped
+
+        kvh, payload, skipped = run_one(
+            True, lambda *a, **k: (200, {"from_page": 2}))
+        assert skipped == 2 and kvh["n_pages"] == 1
+        assert kvh["from_page"] == 2
+        assert payload == slice_blob(blob, 2)["data"]
         # probe says everything cached: still capped at n-1
-        monkeypatch.setattr(router, "_post",
-                            lambda *a, **k: (200, {"from_page": 9}))
-        kv, skipped = router._maybe_slice(req, h)
-        assert skipped == 2 and kv["n_pages"] == 1
+        kvh, payload, skipped = run_one(
+            True, lambda *a, **k: (200, {"from_page": 9}))
+        assert skipped == 2 and kvh["n_pages"] == 1
         # probe transport fault: full blob ships
-        monkeypatch.setattr(router, "_post", lambda *a, **k: (0, {}))
-        kv, skipped = router._maybe_slice(req, h)
-        assert skipped == 0 and kv is blob
+        kvh, payload, skipped = run_one(True, lambda *a, **k: (0, {}))
+        assert skipped == 0 and kvh["n_pages"] == 3
+        assert payload == blob["data"]
         # non-sharing handle: no probe at all
-        h2 = _Handle(id="serve.d1", endpoint="http://y")
-        monkeypatch.setattr(router, "_post",
-                            lambda *a, **k: pytest.fail("probed"))
-        kv, skipped = router._maybe_slice(req, h2)
-        assert skipped == 0 and kv is blob
-        router.close()
+        kvh, payload, skipped = run_one(
+            False, lambda *a, **k: pytest.fail("probed"))
+        assert skipped == 0 and payload == blob["data"]
 
 
 # ------------------------------------------------------------------- bench

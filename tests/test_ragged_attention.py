@@ -280,6 +280,9 @@ class TestRaggedBenchContract:
         the CPU fallback path (tier-1) exactly as on TPU."""
         from benchmarks import decode_bench
         payload = decode_bench.main(["--paged", "--ragged", "6", "3", "8"])
+        # ISSUE 14: spec sub-object is null with PADDLE_SPEC_DECODE off
+        # (the populated schema is pinned in tests/test_speculative.py)
+        assert payload["spec"] is None
         r = payload["ragged"]
         assert set(r) >= {"tokens_per_sec", "kv_read_bytes_per_token",
                           "hbm_roofline_bytes_per_token", "executables",
@@ -310,6 +313,7 @@ class TestRaggedBenchContract:
         monkeypatch.delenv("PADDLE_SERVE_REPLICAS", raising=False)
         monkeypatch.delenv("PADDLE_SERVE_DISAGG", raising=False)
         monkeypatch.delenv("PADDLE_PREFIX_CACHE_PAGES", raising=False)
+        monkeypatch.delenv("PADDLE_SPEC_DECODE", raising=False)
         monkeypatch.setattr(sys, "argv", ["serving_bench.py", "2", "3", "4"])
         rc = serving_bench.main()
         out = capsys.readouterr().out
@@ -325,6 +329,10 @@ class TestRaggedBenchContract:
         # ISSUE 13: the prefix sub-object is null with the cache off (the
         # populated schema is pinned in tests/test_prefix_cache.py)
         assert doc["prefix"] is None
+        # ISSUE 14: spec sub-object null with PADDLE_SPEC_DECODE off —
+        # dashboards must distinguish 'off' from 'zero accepts' (the
+        # populated schema is pinned in tests/test_speculative.py)
+        assert doc["spec"] is None
         r = doc["ragged"]
         assert set(r) >= {"tokens_per_sec", "kv_read_bytes_per_token",
                           "hbm_roofline_bytes_per_token", "executables",
