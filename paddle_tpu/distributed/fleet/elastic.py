@@ -305,16 +305,23 @@ class KVServer:
                     val = self._body().decode()
                     hdr_vn = self.headers.get("X-Paddle-KV-Ver")
                     writer = self.headers.get("X-Paddle-KV-Writer", "")
+                    if hdr_vn is not None:
+                        # parse (and answer 400) BEFORE taking the store
+                        # lock: the 400 response is a socket send, and a
+                        # slow/blackholed reader must stall only its own
+                        # connection, never every KV op fleet-wide
+                        # (analyzer rule A7 surfaced the old shape)
+                        try:
+                            hdr_vn = int(hdr_vn)
+                        except ValueError:
+                            return self._send(400)
                     with lock:
                         _, cur_vn, cur_w = kv.get(key, ("", 0, ""))
                         if hdr_vn is None:
                             # unversioned (single-master) write: local bump
                             vn, applied = cur_vn + 1, True
                         else:
-                            try:
-                                vn = int(hdr_vn)
-                            except ValueError:
-                                return self._send(400)
+                            vn = hdr_vn
                             # last-writer-wins by (vn, writer); an equal
                             # version re-accepts idempotently (a quorum
                             # client retrying its own write), an older one

@@ -133,6 +133,9 @@ class _Peer:
         #                      retry round must not stack duplicates on a
         #                      slow peer (its slowness is the reason the
         #                      round is retrying)
+        self.inflight_w = 0  # the WRITE subset of inflight — what a
+        #                      delete's ordering drain waits on (a
+        #                      pending GET cannot resurrect anything)
 
 
 class ReplicatedKVRegistry:
@@ -192,20 +195,43 @@ class ReplicatedKVRegistry:
         except urllib.error.HTTPError as e:
             return e.code, e.read(), dict(e.headers)
 
-    def _eligible(self) -> list[int]:
+    def _eligible(self, include_busy: bool = False) -> list[int]:
         now = time.monotonic()
         with self._lk:
             idxs = [i for i, p in enumerate(self._peers)
-                    if now >= p.next_ok and not p.inflight]
+                    if now >= p.next_ok
+                    and (include_busy or not p.inflight)]
             if len(idxs) < self.majority:
                 # backoff must never make quorum impossible by itself:
-                # when too few peers are in-window, probe every peer that
-                # is not ALREADY being probed — a pending request may yet
-                # resolve, and stacking a duplicate on a slow peer only
-                # deepens the slowness the retry is waiting out
+                # when too few peers are in-window, widen to every peer
+                # this round may use. Without include_busy that still
+                # skips peers mid-request (a RETRY round must not stack
+                # duplicates on a slow peer — its slowness is why the
+                # round is retrying); with include_busy (first rounds,
+                # wait_all rounds) busy peers are fair game by design.
                 idxs = [i for i, p in enumerate(self._peers)
-                        if not p.inflight]
+                        if include_busy or not p.inflight]
         return idxs
+
+    def _drain_own_inflight(self, budget: float) -> None:
+        """Wait (bounded) until no peer has a WRITE in flight FROM THIS
+        CLIENT. Deletes need it for ordering: a DELETE fanned out while
+        our own earlier PUT is still in a peer's handler queue can be
+        processed FIRST — the stacked PUT then re-applies and the key
+        resurrects. Draining our own write tail first makes same-client
+        put→delete sequences ordered; cross-client races remain the
+        documented no-tombstone caveat (a resurrected fenced key is
+        inert and gets collected again next GC pass). Only WRITES are
+        waited on: a pending GET against a blackholed peer cannot
+        resurrect anything, and making every delete pay that peer's
+        full timeout would re-lose the slow-peer-must-not-stall
+        property this module exists for."""
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            with self._lk:
+                if not any(p.inflight_w for p in self._peers):
+                    return
+            time.sleep(0.002)  # resilience: ok (bounded ordering wait, not a retry loop; the round below proceeds either way)
 
     def _mark(self, idx: int, ok: bool):
         p = self._peers[idx]
@@ -229,19 +255,35 @@ class ReplicatedKVRegistry:
                         f"failing over to the surviving quorum",
                 peer=p.base)
 
-    def _round(self, fn, op: str, wait_all: bool = False) -> dict:
+    def _round(self, fn, op: str, wait_all: bool = False,
+               first: bool = False, write: bool = False) -> dict:
         """One fan-out over the eligible peers → {idx: result-or-exc}.
         Chaos site ``kv.partition`` fails the WHOLE round (zero acks) —
         the op's budget owns the retry, a persistent partition exhausts
         it into NoQuorumError. ``wait_all`` waits for every launched
         request instead of returning at the first majority — deletes
         have no tombstones, so returning early would leave the key live
-        on a lagging peer for the next list-merge to resurrect."""
+        on a lagging peer for the next list-merge to resurrect. For the
+        same reason a wait_all round includes peers with a request still
+        IN FLIGHT: a kv_put commits on majority ack, so the slowest peer
+        is routinely mid-PUT when the very next kv_del fans out — the
+        busy-peer exclusion (a RETRY-stacking guard) would silently skip
+        it, and the key it never deleted would resurrect in the next
+        version-merged list read (real race: the tier-1 quorum
+        round-trip test flaked on exactly this). ``first`` marks an op's
+        FIRST round, which also includes busy peers: the exclusion is a
+        RETRY-stacking guard, and applying it to a fresh op let the
+        previous op's in-flight tail shrink a write's fan-out to exactly
+        the majority — a committed key could then be absent from the one
+        survivor of a two-peer loss (the same race, write-side).
+        ``write`` marks a mutating round — tracked per peer so a
+        delete's ordering drain waits only on writes, never on a
+        pending read against a slow peer."""
         try:
             chaos.hit("kv.partition")
         except chaos.ChaosError as e:
             return {i: e for i in range(self.n)}
-        idxs = self._eligible()
+        idxs = self._eligible(include_busy=wait_all or first)
         out: dict = {}
         cv = threading.Condition()
 
@@ -256,6 +298,8 @@ class ReplicatedKVRegistry:
             self._mark(i, not isinstance(r, Exception))
             with self._lk:
                 self._peers[i].inflight -= 1
+                if write:
+                    self._peers[i].inflight_w -= 1
             with cv:
                 out[i] = r
                 cv.notify()
@@ -263,6 +307,8 @@ class ReplicatedKVRegistry:
         with self._lk:
             for i in idxs:
                 self._peers[i].inflight += 1
+                if write:
+                    self._peers[i].inflight_w += 1
         threads = [threading.Thread(target=run, args=(i,), daemon=True)
                    for i in idxs]
         for t in threads:
@@ -292,7 +338,8 @@ class ReplicatedKVRegistry:
                                        "round close")
         return snap
 
-    def _quorum(self, fn, op: str, budget: float | None = None) -> dict:
+    def _quorum(self, fn, op: str, budget: float | None = None,
+                write: bool = False) -> dict:
         """Round until a MAJORITY of peers answered → {idx: result}.
         Raises NoQuorumError when the budget expires first."""
         t0 = time.monotonic()
@@ -300,8 +347,10 @@ class ReplicatedKVRegistry:
         delays = RetryPolicy(max_attempts=0, base_delay=0.05,
                              max_delay=0.4, jitter=0.5).delays()
         last_exc = None
+        first = True
         while True:
-            res = self._round(fn, op)
+            res = self._round(fn, op, first=first, write=write)
+            first = False
             ok = {i: r for i, r in res.items()
                   if not isinstance(r, Exception)}
             if len(ok) >= self.majority:
@@ -335,7 +384,7 @@ class ReplicatedKVRegistry:
                 raise TransientError(f"hb status {st}")
             return True
 
-        self._quorum(put, f"kv.heartbeat {node_id}",
+        self._quorum(put, f"kv.heartbeat {node_id}", write=True,
                      budget=min(self.quorum_timeout,
                                 max(0.5, self.ttl * 0.5)))
 
@@ -367,7 +416,9 @@ class ReplicatedKVRegistry:
             return True
 
         try:
-            self._round(dele, f"kv.leave {node_id}", wait_all=True)
+            self._drain_own_inflight(min(self.timeout, 1.0))
+            self._round(dele, f"kv.leave {node_id}", wait_all=True,
+                        write=True)
         except Exception:
             pass
 
@@ -473,7 +524,7 @@ class ReplicatedKVRegistry:
             remaining = self.quorum_timeout - (time.monotonic() - t0)
             if remaining <= 0:
                 raise NoQuorumError(op, 0, self.majority, self.n)
-            acks = self._quorum(put, op, budget=remaining)
+            acks = self._quorum(put, op, budget=remaining, write=True)
             if sum(1 for ok in acks.values() if ok) >= self.majority:
                 return
             # a majority responded but refused: a concurrent writer won
@@ -488,7 +539,13 @@ class ReplicatedKVRegistry:
             return True
 
         try:
-            self._round(dele, f"kv.del {key}", wait_all=True)
+            # order behind our own in-flight writes first: a DELETE that
+            # overtakes this client's still-queued PUT on one peer would
+            # be re-applied over (the key resurrects); see
+            # _drain_own_inflight
+            self._drain_own_inflight(min(self.timeout, 1.0))
+            self._round(dele, f"kv.del {key}", wait_all=True,
+                        write=True)
         except Exception:
             pass
 
@@ -523,7 +580,7 @@ class ReplicatedKVRegistry:
                 raise TransientError(f"kvmax status {st}")
             return int(body)
 
-        acks = self._quorum(put, f"kv.max {key}")
+        acks = self._quorum(put, f"kv.max {key}", write=True)
         winner = max(acks.values())
         lagging = [i for i, v in acks.items() if v < winner]
         if lagging:

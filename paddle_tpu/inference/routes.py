@@ -1,0 +1,138 @@
+"""The HTTP wire-contract registry (ISSUE 15, rule A8).
+
+Every HTTP route the fleet serves — replica faces, admin/telemetry
+endpoints, the elastic/replicated KV registry — is declared HERE, with the
+methods it accepts and the status codes its handler may answer. The
+review rounds of the fleet PRs kept hand-finding the same drift class:
+a handler growing a status no client branches on (the AttributeError-
+turned-500 on dense /kv_transfer), a client branching on a status no
+handler can send (HTTPError masquerading as a dead replica), a route
+added in one place and probed in another under a typo. Paddle's
+reference bakes these invariants into ``PADDLE_ENFORCE`` at every
+boundary (SURVEY §L0); this registry is the same idea applied to the
+wire, enforced two ways:
+
+  * **statically** — analyzer pass A8 (``tools/analyze/rules_routes.py``)
+    cross-checks every route registration (AdminServer ``get_routes``/
+    ``post_routes`` dicts, the hand-rolled ``do_GET``/``do_PUT``/... path
+    literals in the KV server), every client call site (``_get``/
+    ``_post``/``_peer_call``/``_kv_req``/urlopen path literals), every
+    handler-returnable status, and every client status branch against
+    this table — and requires each route to be named by at least one
+    test (the A2 chaos-site shape applied to the wire);
+  * **at runtime** — importing this module hands the table to
+    ``observability.admin`` (:func:`admin.declare_routes`); AdminServer
+    then warn-and-flight-records ``admin.unregistered_route`` ONCE per
+    undeclared route it actually serves, and never raises — the exact
+    mirror ``chaos.hit`` keeps for unregistered chaos sites.
+
+Declared statuses are what the HANDLER itself may answer. Three statuses
+are server-level and implied on every route (``IMPLIED_STATUSES``):
+403 (auth), 404 (unknown route / unrouted path), 500 (handler crash,
+rendered by AdminServer's catch). Status 0 is the client-side sentinel
+for a transport fault (no HTTP answer at all) and is never declared.
+"""
+from __future__ import annotations
+
+__all__ = ["ROUTES", "IMPLIED_STATUSES", "route_of"]
+
+# statuses any route can answer without its handler ever returning them:
+# the serving layer itself speaks these (read-auth 403, unknown-path 404,
+# handler-crash 500)
+IMPLIED_STATUSES = (403, 404, 500)
+
+# route -> {"methods": (...), "statuses": (handler-returnable...),
+#           "doc": one line}
+ROUTES = {
+    # ---- AdminServer built-ins (observability/admin.py) ----
+    "/health": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "liveness + readiness probe (ready/draining/queue depth/"
+               "free pages merged from the health callable)"},
+    "/metrics": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "Prometheus text exposition of the metrics registry"},
+    "/snapshot": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "full metrics snapshot JSON + fleet summary + extras"},
+    "/flight": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "the in-process flight-recorder ring as JSON"},
+    "/ranks": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "per-rank fleet summary from the telemetry aggregator"},
+    "/logs": {
+        "methods": ("GET",), "statuses": (200, 400),
+        "doc": "?rank=N flight/log tail (400: rank=N required with an "
+               "aggregator attached)"},
+    "/push": {
+        "methods": ("POST",), "statuses": (200, 400, 503),
+        "doc": "telemetry report ingest; response piggy-backs aggregator "
+               "commands (400: bad JSON, 503: no aggregator)"},
+    # ---- serving replica face (inference/replica.py) ----
+    "/enqueue": {
+        "methods": ("POST",), "statuses": (200, 400, 429),
+        "doc": "admission boundary (400: never-admissible, 429: "
+               "policy/draining rejection with retry_after_s)"},
+    "/results": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "?since=N cursor-addressed finished outputs; carries "
+               "draining/drained flags"},
+    "/kv_blob": {
+        "methods": ("GET",), "statuses": (200, 400, 404),
+        "doc": "one exported KV page frame, raw octet-stream (400: bad "
+               "rid/slice, 404: evicted — the router re-prefills)"},
+    "/kv_transfer": {
+        "methods": ("POST",), "statuses": (200, 400, 429),
+        "doc": "disagg page-transfer install + prefix probe (400: "
+               "drifted blob/misdirected pool, 429: pool pressure)"},
+    "/drain": {
+        "methods": ("POST",), "statuses": (200,),
+        "doc": "begin the drain protocol (finish accepted, reject new, "
+               "deregister, exit clean)"},
+    # ---- elastic KV registry (distributed/fleet/elastic.py KVServer) ----
+    "/hb": {
+        "methods": ("PUT", "DELETE"), "statuses": (200,),
+        "doc": "TTL'd lease heartbeat / deregister for one node id"},
+    "/kv": {
+        "methods": ("GET", "PUT", "DELETE"), "statuses": (200, 400, 404),
+        "doc": "durable versioned KV entry (400: bad version header, "
+               "404: missing key)"},
+    "/kvmax": {
+        "methods": ("PUT",), "statuses": (200, 400),
+        "doc": "atomic max-CAS counter; response body is the winning "
+               "value (400: non-integer body)"},
+    "/kvlist": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "prefix-scan of the durable KV (?v=1 adds versions)"},
+    "/dump": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "whole-store snapshot (peer catch-up source)"},
+    "/load": {
+        "methods": ("PUT",), "statuses": (200, 400),
+        "doc": "merge one /dump snapshot into this store (400: bad JSON)"},
+    "/info": {
+        "methods": ("GET",), "statuses": (200, 404),
+        "doc": "one node's last heartbeat payload (404: lease lapsed)"},
+    "/nodes": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "the TTL-alive node id list"},
+}
+
+
+def route_of(path: str) -> str | None:
+    """The registry key a request path falls under: the first path
+    segment, query string stripped ("/kv/gen" -> "/kv")."""
+    path = path.split("?", 1)[0]
+    parts = path.split("/")
+    if len(parts) < 2 or not parts[1]:
+        return None
+    return "/" + parts[1]
+
+
+# hand the table to the admin server's runtime mirror: any AdminServer
+# process that imported the serving stack now warn-records undeclared
+# routes it serves (chaos.unregistered_site, applied to the wire)
+from ..observability import admin as _admin  # noqa: E402  (import-time hookup)
+
+_admin.declare_routes(ROUTES, route_of)

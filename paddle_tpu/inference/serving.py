@@ -107,6 +107,10 @@ from .admission import AdmissionPolicy, reject as _admission_reject, \
     retry_after_floor, slo_hists
 from .paging import (PageAllocator, SCRATCH_PAGE, default_page_buckets,
                      pages_for)
+# import for its side effect: hands the HTTP wire-contract registry to
+# observability.admin, arming the admin.unregistered_route runtime mirror
+# in every process that serves (ISSUE 15, rule A8)
+from . import routes as _routes  # noqa: F401
 
 __all__ = ["ContinuousBatcher", "PredictorPool", "ServedRequest"]
 

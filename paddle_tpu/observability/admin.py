@@ -53,10 +53,54 @@ from urllib.parse import parse_qs, urlsplit
 
 from . import metrics, recorder
 
-__all__ = ["AdminServer", "job_token", "render_prometheus",
+__all__ = ["AdminServer", "job_token", "render_prometheus", "declare_routes",
            "write_endpoint_file", "read_endpoint_file", "ENDPOINT_FILE"]
 
 ENDPOINT_FILE = "admin.json"
+
+# ---- wire-contract runtime mirror (ISSUE 15, rule A8) -------------------
+# inference/routes.py hands its ROUTES table over at import time; every
+# AdminServer then warn-and-flight-records `admin.unregistered_route` ONCE
+# per undeclared route it actually serves — and never raises (the exact
+# mirror chaos.hit keeps for unregistered chaos sites). Processes that
+# never import the serving stack (table is None) skip the check entirely.
+_declared_routes: dict | None = None
+_route_of = None
+_warned_routes: set[str] = set()
+_routes_lock = threading.Lock()
+
+
+def declare_routes(table: dict, route_of) -> None:
+    """Install the wire-contract registry (called by inference.routes at
+    import). `route_of` maps a raw request path to its registry key.
+    The resolver is published BEFORE the table: _check_declared_route
+    gates on the table, so a request racing this import must never see
+    a table without a resolver (the mirror promises to never raise)."""
+    global _declared_routes, _route_of
+    _route_of = route_of
+    _declared_routes = dict(table)
+
+
+def _check_declared_route(path: str) -> None:
+    """Warn-once on serving a route the registry doesn't declare. Never
+    raises: an undeclared route is an analyzer finding (rule A8) and a
+    postmortem breadcrumb, not an outage."""
+    table = _declared_routes
+    if table is None:
+        return
+    route = _route_of(path)
+    if route is None or route in table:
+        return
+    with _routes_lock:
+        first = route not in _warned_routes
+        if first:
+            _warned_routes.add(route)
+    if first:
+        recorder.record(
+            "admin.unregistered_route", echo=True,
+            message=f"[admin] serving undeclared HTTP route {route!r} — "
+                    "declare it in paddle_tpu/inference/routes.py",
+            route=route)
 
 
 def job_token() -> str:
@@ -72,6 +116,15 @@ def job_token() -> str:
 
 def _prom_name(name: str) -> str:
     return "paddle_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+# the GET routes AdminServer itself answers (the mirror only checks routes
+# that are actually served; an unknown path 404s without a warning).
+# Kept in lockstep with do_GET's dispatch literals by
+# tests/test_wire_contract.py::TestBuiltinGetTupleNotDrifted — a new
+# builtin added to do_GET without extending this tuple fails the suite.
+_BUILTIN_GET = ("/health", "/metrics", "/snapshot", "/flight", "/ranks",
+                "/logs")
 
 
 def _fmt_le(b: float) -> str:
@@ -180,6 +233,8 @@ class AdminServer:
                 agg = ref.aggregator
                 parsed = urlsplit(self.path)
                 route, query = parsed.path, parse_qs(parsed.query)
+                if route in ref.get_routes or route in _BUILTIN_GET:
+                    _check_declared_route(route)
                 if route == "/health":
                     doc = {"ok": True, "pid": os.getpid(), "time": time.time()}
                     if agg is not None:
@@ -253,6 +308,7 @@ class AdminServer:
                 route = urlsplit(self.path).path
                 if route != "/push" and route not in ref.post_routes:
                     return self._send(404)
+                _check_declared_route(route)
                 tok = self.headers.get("X-Paddle-Job-Token", "")
                 if not hmac.compare_digest(tok, job_token()):
                     return self._send(403)

@@ -176,6 +176,52 @@ class TestQuorumClient:
         reg.leave("n1")
         assert reg.alive_nodes() == ["n0"]
 
+    def test_delete_reaches_a_peer_still_mid_put(self, peers3):
+        """ISSUE-15 regression (real race): kv_put commits on MAJORITY
+        ack, so the slowest peer is routinely still mid-PUT when the next
+        kv_del fans out. The round's busy-peer exclusion (a retry-
+        stacking guard) used to skip that peer — which never deleted the
+        key, and the next version-merged kv_list resurrected it. A
+        wait_all round now includes busy peers; deletes are idempotent,
+        so stacking one DELETE is harmless. Pinned with a tight loop:
+        pre-fix this resurrected ~30% of iterations on this machine."""
+        reg = peers3.registry(quorum_timeout_s=QT)
+        for i in range(25):
+            reg.kv_put(f"r.{i}.a", "x")
+            reg.kv_put(f"r.{i}.b", "y")
+            reg.kv_del(f"r.{i}.a")
+            assert sorted(reg.kv_list(f"r.{i}.")) == [f"r.{i}.b"], \
+                f"deleted key resurrected on iteration {i}"
+
+    def test_first_round_fanout_reaches_every_live_peer(self, peers3):
+        """ISSUE-15 regression (the same race, write-side): kv_put's
+        internal read round leaves an in-flight tail on the slowest
+        peer, and the put round's busy-peer exclusion then skipped that
+        peer entirely — the committed write was never LAUNCHED to it, so
+        the one survivor of a two-peer loss could lack a committed key
+        (the revive-coverage drill failed exactly so under load). An
+        op's FIRST round now includes busy peers; only retry rounds keep
+        the stacking guard. Every live peer must therefore receive every
+        committed write within a bounded window."""
+        reg = peers3.registry(quorum_timeout_s=QT)
+        for i in range(15):
+            reg.kv_put(f"w.{i}", "v")
+            for ep in peers3.endpoints:
+                deadline = time.monotonic() + 2.0
+                while True:
+                    try:
+                        body, _ = _direct(ep, f"/kv/w.{i}")
+                        assert body == b"v"
+                        break
+                    except AssertionError:
+                        raise
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise AssertionError(
+                                f"peer {ep} never received committed "
+                                f"write w.{i} — the put round skipped it")
+                        time.sleep(0.01)
+
     def test_one_peer_down_commits_with_failover_counted(self, peers3):
         reg = peers3.registry(quorum_timeout_s=QT)
         f0 = metrics.counter("kv.failovers").value

@@ -5,8 +5,13 @@ The contracts under test:
     file shared by all rules, unified `# <layer>: ok (<why>)` markers
     (bare marker = finding M1), per-rule allowlists, SYNTAX findings,
     unknown-rule rejection.
-  * RULES — every rule (R1-R3, O1-O4, A1-A5, M1) has a triggering fixture
-    AND a near-miss that must stay clean.
+  * RULES — every rule (R1-R3, O1-O4, A1-A8, M1) has a triggering fixture
+    AND a near-miss that must stay clean. The ISSUE-15 passes: A6
+    lock-order (cycle / self-reacquire vs consistent order), A7
+    blocking-under-lock (sleep/urlopen/queue.get/one-hop socket send vs
+    after-release), A8 wire-contract registry (undeclared route/status/
+    branch/unnamed-by-test vs clean), each with the --changed
+    cross-file-globality contract.
   * DRIVER — `python -m tools.analyze` exits 0 on the repo against the
     committed baseline; --rules/--json/--changed/--fix-markers/--env-table
     work; deleting the rank guard from an A1 fixture / registering a
@@ -566,6 +571,21 @@ class TestEnvFlagRegistry:
             "README env-flags table is stale: regenerate with " \
             "`python -m tools.analyze --env-table`"
 
+    def test_readme_routes_table_not_stale(self):
+        # the A8 twin of the env table: the README HTTP-route reference
+        # is generated from inference/routes.py and must not drift
+        from tools.analyze.__main__ import routes_table
+        table = routes_table(REPO).strip()
+        with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+            readme = f.read()
+        assert "<!-- routes:begin -->" in readme, \
+            "README lost its generated routes block"
+        block = readme.split("<!-- routes:begin -->")[1] \
+                      .split("<!-- routes:end -->")[0].strip()
+        assert block == table, \
+            "README routes table is stale: regenerate with " \
+            "`python -m tools.analyze --routes-table`"
+
 
 # --------------------------------------------------- fixtures: A5 locks
 
@@ -643,8 +663,10 @@ class TestLockDiscipline:
                 "        with self._lk:\n"
                 "            pass\n"
                 "        self.n += 1  # locks: ok (only the poll thread touches n)\n",
-            # out of scope: serving-adjacent but not serving.py
-            "paddle_tpu/inference/paging_x.py":
+            # out of scope: models/ is not the concurrent surface (the
+            # ISSUE-15 scope extension covers ALL of inference/**, so the
+            # old paging-adjacent near-miss now correctly trips)
+            "paddle_tpu/models/paging_x.py":
                 "import threading\n"
                 "class P:\n"
                 "    def __init__(self):\n"
@@ -656,6 +678,565 @@ class TestLockDiscipline:
                 "        self.n += 1\n",
         })
         assert run(str(tmp_path), rule_ids=["A5"]) == []
+
+    def test_extended_scope_covers_disagg_and_elastic(self, tmp_path):
+        # ISSUE 15 satellite: the PR-7 file list grew to the whole
+        # concurrent surface — a race in inference/disagg/** or
+        # fleet/elastic.py is now in scope
+        race = ("import threading\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "        self.n = 0\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            pass\n"
+                "        self.n += 1\n")
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/disagg/coord_x.py": race,
+            "paddle_tpu/distributed/fleet/elastic.py": race,
+            "paddle_tpu/distributed/fleet/topology.py": race,  # not listed
+        })
+        findings = run(str(tmp_path), rule_ids=["A5"])
+        assert sorted(f.path for f in findings) == [
+            "paddle_tpu/distributed/fleet/elastic.py",
+            "paddle_tpu/inference/disagg/coord_x.py"]
+
+
+# ------------------------------------------------ fixtures: A6 lock-order
+
+_A6_CYCLE = {
+    # Cache takes its own lock then calls into Alloc (which locks);
+    # Alloc's pressure path locks itself then reaches back into a Cache
+    # lock — opposite orders, a deadlock one interleaving away
+    "paddle_tpu/inference/cache_x.py": """\
+        import threading
+        class Cache:
+            def __init__(self, alloc):
+                self._lk = threading.Lock()
+                self._alloc = alloc
+            def match(self):
+                with self._lk:
+                    self._alloc.share()
+        """,
+    "paddle_tpu/inference/alloc_x.py": """\
+        import threading
+        class Alloc:
+            def __init__(self):
+                self._lk = threading.Lock()
+            def share(self):
+                with self._lk:
+                    pass
+            def pressure(self, cache):
+                with self._lk:
+                    with cache._lk:
+                        pass
+        """,
+}
+
+
+class TestLockOrder:
+    def test_cross_file_cycle_flagged_with_both_sites(self, tmp_path):
+        write_tree(tmp_path, _A6_CYCLE)
+        findings = run(str(tmp_path), rule_ids=["A6"])
+        assert len(findings) == 1
+        msg = findings[0].message
+        assert "cycle" in msg
+        assert "Cache._lk -> Alloc._lk" in msg \
+            and "Alloc._lk -> Cache._lk" in msg
+        # both acquisition sites named (file:line each direction)
+        assert "cache_x.py:" in msg and "alloc_x.py:" in msg
+
+    def test_self_reacquire_is_its_own_finding(self, tmp_path):
+        write_tree(tmp_path, {
+            "paddle_tpu/observability/t_x.py":
+                "import threading\n"
+                "class T:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def summary(self):\n"
+                "        with self._lk:\n"
+                "            return 1\n"
+                "    def snapshot(self):\n"
+                "        with self._lk:\n"
+                "            return self.summary()\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A6"])
+        assert len(findings) == 1
+        assert "not reentrant" in findings[0].message
+        assert "T.summary()" in findings[0].message
+
+    def test_self_attr_chain_resolves_through_constructor_type(
+            self, tmp_path):
+        # the ISSUE-15 canonical shape: `self._cache._lk` acquired under
+        # `self._lk`, the attribute's class pinned by its constructor
+        # assignment — colliding with the cache's own call-edge back
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/engine_x.py":
+                "import threading\n"
+                "from .cache_x import Cache\n"
+                "class Engine:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "        self._cache = Cache(self)\n"
+                "    def step(self):\n"
+                "        with self._lk:\n"
+                "            with self._cache._lk:\n"
+                "                pass\n",
+            "paddle_tpu/inference/cache_x.py":
+                "import threading\n"
+                "class Cache:\n"
+                "    def __init__(self, eng):\n"
+                "        self._lk = threading.Lock()\n"
+                "        self._eng = eng\n"
+                "    def evict(self):\n"
+                "        with self._lk:\n"
+                "            self._eng.on_evict()\n",
+            "paddle_tpu/inference/engine_hooks_x.py":
+                "import threading\n"
+                "class EngineHooks:\n"
+                "    pass\n",
+        })
+        # Engine.on_evict doesn't exist, so no reverse edge yet: clean
+        assert run(str(tmp_path), rule_ids=["A6"]) == []
+        # give Engine an on_evict that locks -> the cycle closes
+        p = tmp_path / "paddle_tpu/inference/engine_x.py"
+        p.write_text(p.read_text() +
+                     "    def on_evict(self):\n"
+                     "        with self._lk:\n"
+                     "            pass\n")
+        findings = run(str(tmp_path), rule_ids=["A6"])
+        assert len(findings) == 1 and "cycle" in findings[0].message
+        assert "Engine._lk -> Cache._lk" in findings[0].message
+
+    def test_consistent_order_stays_clean(self, tmp_path):
+        # same two locks, always Cache -> Alloc: an edge, not a cycle
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/cache_x.py":
+                _A6_CYCLE["paddle_tpu/inference/cache_x.py"],
+            "paddle_tpu/inference/alloc_x.py": """\
+                import threading
+                class Alloc:
+                    def __init__(self):
+                        self._lk = threading.Lock()
+                    def share(self):
+                        with self._lk:
+                            pass
+                """,
+        })
+        assert run(str(tmp_path), rule_ids=["A6"]) == []
+
+    def test_multi_item_with_opposite_orders(self, tmp_path):
+        # `with a, b:` acquires left to right — two methods doing it in
+        # opposite orders is the classic deadlock and must edge per ITEM
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/multi_x.py":
+                "import threading\n"
+                "class M:\n"
+                "    def __init__(self):\n"
+                "        self._a_lk = threading.Lock()\n"
+                "        self._b_lk = threading.Lock()\n"
+                "    def one(self):\n"
+                "        with self._a_lk, self._b_lk:\n"
+                "            pass\n"
+                "    def two(self):\n"
+                "        with self._b_lk, self._a_lk:\n"
+                "            pass\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A6"])
+        assert len(findings) == 1 and "cycle" in findings[0].message
+        assert "M._a_lk" in findings[0].message \
+            and "M._b_lk" in findings[0].message
+
+    def test_marker_on_inner_site_suppresses(self, tmp_path):
+        files = dict(_A6_CYCLE)
+        files["paddle_tpu/inference/alloc_x.py"] = \
+            files["paddle_tpu/inference/alloc_x.py"].replace(
+                "with cache._lk:",
+                "with cache._lk:  # locks: ok (pressure path only runs "
+                "single-threaded in the drain drill)")
+        write_tree(tmp_path, files)
+        assert run(str(tmp_path), rule_ids=["A6"]) == []
+
+    def test_marker_on_callee_acquisition_suppresses_call_edge(
+            self, tmp_path):
+        # the finding's advice is "mark the audited inner site" — that
+        # must also clear an edge built through a CALL into that site
+        # (Alloc.share's own `with self._lk:` is the inner site here)
+        files = dict(_A6_CYCLE)
+        src = files["paddle_tpu/inference/alloc_x.py"]
+        # share's own `with self._lk:` (the only one followed by `pass`
+        # directly) is the inner site the cycle finding names
+        needle = "with self._lk:\n                    pass"
+        assert needle in src
+        files["paddle_tpu/inference/alloc_x.py"] = src.replace(
+            needle,
+            "with self._lk:  # locks: ok (share never calls back into "
+            "any holder)\n                    pass")
+        write_tree(tmp_path, files)
+        assert run(str(tmp_path), rule_ids=["A6"]) == []
+
+    def test_changed_scope_cannot_miss_cross_file_edges(self, tmp_path):
+        # the acquisition graph is global: a --changed walk restricted to
+        # ONE file must still see the edge living in the other
+        write_tree(tmp_path, _A6_CYCLE)
+        full = run(str(tmp_path), rule_ids=["A6"])
+        partial = run(str(tmp_path), rule_ids=["A6"],
+                      files=["paddle_tpu/inference/cache_x.py"])
+        assert [f.message for f in partial] == [f.message for f in full]
+
+
+# ------------------------------------------- fixtures: A7 blocking-under-lock
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_vs_after_release(self, tmp_path):
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/bad.py":
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            time.sleep(0.1)\n",
+            "paddle_tpu/inference/near.py":  # sleep AFTER the release
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            pass\n"
+                "        time.sleep(0.1)\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A7"])
+        assert [(f.path, f.line) for f in findings] == \
+            [("paddle_tpu/inference/bad.py", 7)]
+        assert "time.sleep" in findings[0].message
+
+    def test_one_hop_socket_send_the_elastic_regression_shape(self, tmp_path):
+        # the REAL finding this pass surfaced (ISSUE 15): the KV server
+        # answered a 400 while holding the store lock — wfile.write is a
+        # socket send, so one slow reader stalls every KV op. The exact
+        # pre-fix shape must keep tripping.
+        write_tree(tmp_path, {
+            "paddle_tpu/distributed/fleet/kv_x.py":
+                "import threading\n"
+                "class KVServer:\n"
+                "    def __init__(self):\n"
+                "        lock = threading.Lock()\n"
+                "        class H:\n"
+                "            def _send(self, code, body=b''):\n"
+                "                self.wfile.write(body)\n"
+                "            def do_PUT(self):\n"
+                "                with lock:\n"
+                "                    try:\n"
+                "                        vn = int(self.headers.get('X'))\n"
+                "                    except ValueError:\n"
+                "                        return self._send(400)\n"
+                "                return self._send(200)\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A7"])
+        assert len(findings) == 1 and findings[0].line == 13
+        assert "socket send" in findings[0].message
+
+    def test_urlopen_and_unbounded_queue_get(self, tmp_path):
+        write_tree(tmp_path, {
+            "paddle_tpu/distributed/fleet/bad.py":
+                "import threading, urllib.request\n"
+                "class C:\n"
+                "    def __init__(self, q):\n"
+                "        self._lk = threading.Lock()\n"
+                "        self._queue = q\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            urllib.request.urlopen('http://x')\n"
+                "    def g(self):\n"
+                "        with self._lk:\n"
+                "            return self._queue.get()\n",
+            "paddle_tpu/distributed/fleet/near.py":
+                "import threading\n"
+                "class C:\n"
+                "    def __init__(self, q, d):\n"
+                "        self._lk = threading.Lock()\n"
+                "        self._queue, self._d = q, d\n"
+                "    def g(self):\n"
+                "        with self._lk:\n"
+                "            # bounded get + a dict .get are both fine\n"
+                "            return self._queue.get(timeout=1), \\\n"
+                "                self._d.get('k')\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A7"])
+        assert [f.line for f in findings] == [8, 11]
+        msgs = " | ".join(f.message for f in findings)
+        assert "urlopen" in msgs and "unbounded" in msgs
+
+    def test_marker_and_scope_near_misses(self, tmp_path):
+        write_tree(tmp_path, {
+            # audited: the lock is private to one thread by construction
+            "paddle_tpu/observability/marked.py":
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            time.sleep(0.1)  # locks: ok (test-only pacing; no second thread exists)\n",
+            # out of scope: models/ is not the concurrent surface
+            "paddle_tpu/models/outside.py":
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            time.sleep(0.1)\n",
+            # a callback DEFINED under a lock runs later, not under it
+            "paddle_tpu/inference/deferred.py":
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            def cb():\n"
+                "                time.sleep(0.1)\n"
+                "            return cb\n",
+            # ...and the same exemption one hop out: a method that only
+            # DEFINES a blocking callback is not itself blocking, so
+            # calling the factory under a lock is clean
+            "paddle_tpu/inference/factory.py":
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def make_cb(self):\n"
+                "        def cb():\n"
+                "            time.sleep(0.1)\n"
+                "        return cb\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            return self.make_cb()\n",
+        })
+        assert run(str(tmp_path), rule_ids=["A7"]) == []
+
+
+# --------------------------------------------- fixtures: A8 wire contract
+
+_ROUTES_REG = """\
+    IMPLIED_STATUSES = (403, 404, 500)
+    ROUTES = {
+        "/good": {"methods": ("GET",), "statuses": (200, 400),
+                  "doc": "a documented route"},
+        "/post_only": {"methods": ("POST",), "statuses": (200,),
+                       "doc": "another one"},
+    }
+"""
+
+_A8_SERVER = """\
+    class Server:
+        def __init__(self):
+            self._admin = AdminServer(
+                get_routes={"/good": self._h_good},
+                post_routes={"/post_only": self._h_post})
+        def _h_good(self, q):
+            if q:
+                return 400, {}
+            return 200, {}
+        def _h_post(self, body):
+            return 200, {}
+"""
+
+_A8_CLIENT = """\
+    class Client:
+        def _get(self, endpoint, path):
+            return 200, {}
+        def _post(self, endpoint, path, obj):
+            return 200, {}
+        def poll(self, ep):
+            code, _ = self._get(ep, "/good?x=1")
+            if code == 400:
+                return None
+            self._post(ep, "/post_only", {})
+"""
+
+_A8_TESTS = "PATHS = ['/good', '/post_only']\n"
+
+
+def _a8_tree(**overrides):
+    files = {
+        "paddle_tpu/inference/routes.py": _ROUTES_REG,
+        "paddle_tpu/inference/server_x.py": _A8_SERVER,
+        "paddle_tpu/inference/client_x.py": _A8_CLIENT,
+        "tests/test_x.py": _A8_TESTS,
+    }
+    files.update(overrides)
+    return files
+
+
+class TestWireContractRegistry:
+    def test_clean_fixture(self, tmp_path):
+        write_tree(tmp_path, _a8_tree())
+        assert run(str(tmp_path), rule_ids=["A8"]) == []
+
+    def test_undeclared_registration(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/server_x.py": _A8_SERVER.replace(
+                '"/good": self._h_good',
+                '"/good": self._h_good, "/rogue": self._h_good')}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "'/rogue'" in findings[0].message
+        assert "undeclared route" in findings[0].message
+
+    def test_undeclared_client_route_and_method_mismatch(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/client_x.py": _A8_CLIENT.replace(
+                'self._post(ep, "/post_only", {})',
+                'self._post(ep, "/typo_route", {})\n'
+                '        self._post(ep, "/good", {})')}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        msgs = " | ".join(f.message for f in findings)
+        assert "'/typo_route'" in msgs
+        # /good declares GET only; the POST is the method-drift finding
+        assert "sends POST to '/good'" in msgs
+        # plus /post_only went dead (no client, no second registration
+        # needed — the server still registers it, so NOT dead)
+        assert "no registration" not in msgs
+
+    def test_undeclared_handler_status(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/server_x.py": _A8_SERVER.replace(
+                "return 400, {}", "return 418, {}")}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "418" in findings[0].message
+        assert "_h_good" in findings[0].message
+
+    def test_one_hop_status_through_helper(self, tmp_path):
+        # return self._reject(...) counts the helper's 429 as the
+        # handler's — the replica _reject_429 idiom
+        server = _A8_SERVER.replace(
+            "        def _h_post(self, body):\n"
+            "            return 200, {}\n",
+            "        def _h_post(self, body):\n"
+            "            if body:\n"
+            "                return self._reject()\n"
+            "            return 200, {}\n"
+            "        def _reject(self):\n"
+            "            return 429, {}\n")
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/server_x.py": server}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "429" in findings[0].message and "_h_post" in findings[0].message
+        # declaring it clears the finding
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/routes.py": _ROUTES_REG.replace(
+                '"statuses": (200,),', '"statuses": (200, 429),')})
+        assert run(str(tmp_path), rule_ids=["A8"]) == []
+
+    def test_client_branch_on_impossible_status(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/client_x.py": _A8_CLIENT.replace(
+                "if code == 400:", "if code == 402:")}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "402" in findings[0].message
+        assert "no declared route can answer" in findings[0].message
+
+    def test_transport_fault_sentinel_and_implied_are_fine(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/client_x.py": _A8_CLIENT.replace(
+                "if code == 400:",
+                "if code == 0 or code == 500 or code == 400:")}))
+        assert run(str(tmp_path), rule_ids=["A8"]) == []
+
+    def test_do_handler_literals_are_registrations(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/kvserver_x.py": """\
+                class H:
+                    def do_GET(self):
+                        if self.path.startswith("/good/"):
+                            return
+                    def do_PUT(self):
+                        if self.path == "/unplanned":
+                            return
+                """}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        msgs = " | ".join(f.message for f in findings)
+        # /good exists but declares GET only — do_GET matches; the PUT
+        # route is undeclared entirely
+        assert "'/unplanned'" in msgs
+        assert len(findings) == 1
+
+    def test_route_unnamed_by_any_test(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "tests/test_x.py": "PATHS = ['/good']\n"}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "'/post_only'" in findings[0].message
+        assert "named by no test" in findings[0].message
+        # substring safety: naming "/good" must not satisfy "/goo"
+
+    def test_dead_declaration(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/routes.py": _ROUTES_REG.replace(
+                "    }",
+                '    "/never_wired": {"methods": ("GET",),\n'
+                '                     "statuses": (200,), "doc": "dead"},\n'
+                "    }"),
+            "tests/test_x.py":
+                "PATHS = ['/good', '/post_only', '/never_wired']\n"}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "'/never_wired'" in findings[0].message
+        assert "no registration and no client call site" in \
+            findings[0].message
+
+    def test_missing_registry_reported_once(self, tmp_path):
+        files = _a8_tree()
+        del files["paddle_tpu/inference/routes.py"]
+        write_tree(tmp_path, files)
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        assert len(findings) == 1
+        assert "no parseable ROUTES registry" in findings[0].message
+
+    def test_registry_hygiene_duplicate_and_docless(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/routes.py": _ROUTES_REG.replace(
+                "    }",
+                '    "/good": {"methods": ("GET",), "statuses": (200,),\n'
+                '              "doc": "duplicate"},\n'
+                '    "/bare": {"methods": ("GET",), "statuses": (200,),\n'
+                '              "doc": ""},\n'
+                "    }")}))
+        findings = run(str(tmp_path), rule_ids=["A8"])
+        msgs = " | ".join(f.message for f in findings)
+        assert "duplicate route '/good'" in msgs
+        assert "without a doc" in msgs
+
+    def test_changed_scope_cannot_fabricate_or_miss(self, tmp_path):
+        # registries are global under --changed: a walk restricted to the
+        # CLIENT file must neither invent findings (the registry and
+        # server it never visited still count) nor miss the typo finding
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/client_x.py": _A8_CLIENT.replace(
+                '"/good?x=1"', '"/typo_route?x=1"')}))
+        full = run(str(tmp_path), rule_ids=["A8"])
+        partial = run(str(tmp_path), rule_ids=["A8"],
+                      files=["paddle_tpu/inference/client_x.py"])
+        assert [f.message for f in partial] == [f.message for f in full]
+        assert len(full) == 1 and "'/typo_route'" in full[0].message
+
+    def test_marker_suppresses_call_site(self, tmp_path):
+        write_tree(tmp_path, _a8_tree(**{
+            "paddle_tpu/inference/client_x.py": _A8_CLIENT.replace(
+                'self._post(ep, "/post_only", {})',
+                'self._post(ep, "/post_only", {})\n'
+                '        self._get(ep, "/external_svc")'
+                '  # wire: ok (third-party sidecar endpoint, not ours)')}))
+        assert run(str(tmp_path), rule_ids=["A8"]) == []
 
 
 # ------------------------------------------------------ driver contract
@@ -782,6 +1363,56 @@ class TestDriver:
         rc, out = analyze_run(root, "--changed", capsys=capsys)
         assert rc == 1 and "[O1]" in out
         assert "clean.py" in out
+
+    def test_json_and_exit_flip_for_new_rules(self, tmp_path, capsys):
+        # A6/A7/A8 ride the same driver contract: --json schema, exit 1
+        root = write_tree(tmp_path, {
+            "paddle_tpu/inference/bad.py":
+                "import threading, time\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lk:\n"
+                "            time.sleep(0.1)\n"})
+        rc, out = analyze_run(root, "--rules", "A7", "--json",
+                              capsys=capsys)
+        assert rc == 1
+        doc = json.loads(out)
+        assert doc["counts"]["live"] == 1
+        assert doc["findings"][0]["rule"] == "A7"
+        # fixing it flips the driver back to 0
+        (tmp_path / "paddle_tpu/inference/bad.py").write_text(
+            textwrap.dedent("""\
+                import threading, time
+                class C:
+                    def __init__(self):
+                        self._lk = threading.Lock()
+                    def f(self):
+                        with self._lk:
+                            pass
+                        time.sleep(0.1)
+                """))
+        assert analyze_run(root, "--rules", "A7", capsys=capsys)[0] == 0
+
+    def test_stats_reports_per_rule_seconds(self, tmp_path, capsys):
+        root = write_tree(tmp_path, {"paddle_tpu/clean.py": "x = 1\n"})
+        rc = analyze_main([root, "--stats"])
+        err = capsys.readouterr().err
+        assert rc == 0
+        assert "per-rule wall seconds" in err
+        for rid in ("A6", "A7", "A8"):
+            assert rid in err
+
+    def test_committed_baseline_passes_the_reason_gate(self):
+        # the satellite contract: the committed baseline parses, carries
+        # no reasonless entries (driver would exit 2), and has nothing
+        # stale (--fix-markers exits 0: the file only ever shrinks)
+        from tools.analyze.core import BASELINE_NAME, load_baseline
+        bl = load_baseline(os.path.join(REPO, BASELINE_NAME))
+        assert bl.errors() == []
+        r = analyze_cli(REPO, "--fix-markers")
+        assert r.returncode == 0, r.stdout + r.stderr
 
     def test_shims_restricted_to_their_families(self, tmp_path, capsys):
         # an A5 race trips the unified driver but NOT the legacy shims
@@ -934,6 +1565,17 @@ class TestPreCommitWiring:
         assert "pass_filenames: false" in src
         assert "id: paddle-analyze" in src
 
+    def test_hook_rule_set_covers_the_new_passes(self, capsys):
+        # the --changed hook runs EVERY registered rule; --list is the
+        # user-facing catalog and must show the ISSUE-15 passes
+        rc = analyze_main(["--list"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for rid, title in (("A6", "lock-order"),
+                           ("A7", "blocking-under-lock"),
+                           ("A8", "wire-contract-registry")):
+            assert rid in out and title in out
+
     def test_hook_command_is_clean_on_the_repo(self):
         """Run the exact committed hook entry (fresh interpreter, repo
         root): a dirty working tree must analyze clean, else every commit
@@ -946,3 +1588,25 @@ class TestPreCommitWiring:
                            capture_output=True, text=True, cwd=REPO,
                            timeout=180)
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestAnalyzerPerfGuard:
+    """ISSUE 15 satellite: the whole-repo analyzer wall is pinned under a
+    budget so new cross-file passes cannot silently regress the tier-1
+    wall the way PR 7 had to profile down after the fact (the ROADMAP's
+    verify-timeout history is load-bearing). Measured wall on this tree:
+    ~1.5s in-process; the 30s budget is machine-load headroom, not an
+    invitation."""
+
+    BUDGET_S = 30.0
+
+    def test_whole_repo_wall_under_budget(self):
+        import time as _time
+        t0 = _time.perf_counter()
+        r = analyze_cli(REPO)
+        wall = _time.perf_counter() - t0
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert wall < self.BUDGET_S, (
+            f"whole-repo analyze took {wall:.1f}s (budget "
+            f"{self.BUDGET_S}s) — profile the new passes with "
+            "`python -m tools.analyze --stats` before raising this")

@@ -16,6 +16,12 @@
   --list                                    print the rule catalog
   --env-table                               print the generated README
                                             "Environment flags" table
+  --routes-table                            print the generated README
+                                            "HTTP routes" table
+  --stats                                   print per-rule wall seconds
+                                            (the perf guard: a cross-file
+                                            pass regressing the tier-1
+                                            wall shows up here first)
 """
 from __future__ import annotations
 
@@ -51,6 +57,25 @@ def env_table(root: str) -> str:
     return "\n".join(lines)
 
 
+def routes_table(root: str) -> str:
+    """The markdown HTTP-route reference table, generated from the wire
+    registry (statically — no runtime import, no jax)."""
+    from .core import FileCtx
+    from .rules_routes import REGISTRY_REL, parse_registry
+    path = os.path.join(root, *REGISTRY_REL.split("/"))
+    ctx = FileCtx(root, REGISTRY_REL) if os.path.isfile(path) else None
+    routes, _implied, _findings = parse_registry(ctx)
+    lines = ["| Route | Methods | Statuses | What it serves |",
+             "| --- | --- | --- | --- |"]
+    for route in sorted(routes or {}):
+        spec = (routes or {})[route]
+        methods = " ".join(spec["methods"])
+        statuses = " ".join(str(s) for s in spec["statuses"])
+        lines.append(f"| `{route}` | {methods} | {statuses} | "
+                     f"{spec['doc']} |")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m tools.analyze")
     p.add_argument("root", nargs="?", default=None)
@@ -63,6 +88,9 @@ def main(argv=None) -> int:
     p.add_argument("--fix-markers", action="store_true", dest="fix_markers")
     p.add_argument("--list", action="store_true", dest="list_rules")
     p.add_argument("--env-table", action="store_true", dest="env_table")
+    p.add_argument("--routes-table", action="store_true",
+                   dest="routes_table")
+    p.add_argument("--stats", action="store_true", dest="stats")
     args = p.parse_args(argv)
 
     root = os.path.abspath(args.root or _default_root())
@@ -75,6 +103,9 @@ def main(argv=None) -> int:
     if args.env_table:
         print(env_table(root))
         return 0
+    if args.routes_table:
+        print(routes_table(root))
+        return 0
 
     rule_ids = args.rules.split(",") if args.rules else None
     files = None
@@ -86,11 +117,18 @@ def main(argv=None) -> int:
         if not files:
             print("analyze: no changed .py files in scope")
             return 0
+    stats: dict | None = {} if args.stats else None
     try:
-        findings = run(root, rule_ids=rule_ids, files=files)
+        findings = run(root, rule_ids=rule_ids, files=files, stats=stats)
     except KeyError as e:
         print(f"analyze: {e.args[0]}", file=sys.stderr)
         return 2
+    if stats is not None:
+        total = sum(stats.values())
+        print("analyze: per-rule wall seconds "
+              f"(total {total:.3f}s):", file=sys.stderr)
+        for rid in sorted(stats, key=stats.get, reverse=True):
+            print(f"  {rid:>6}  {stats[rid]:8.3f}s", file=sys.stderr)
 
     bl_path = args.baseline or os.path.join(root, BASELINE_NAME)
     baseline = None

@@ -1,7 +1,7 @@
 """M1 bare-marker: an audit marker without a reason is not an audit.
 
 The unified suppression grammar is `# <layer>: ok (<why>)` — resilience,
-observability, spmd, chaos, telemetry, envflag, locks. The parenthesized
+observability, spmd, chaos, telemetry, envflag, locks, wire. The parenthesized
 why is the audit trail; a bare `# <layer>: ok` (or an empty `()`) claims
 an exemption nobody can review. Bare markers never suppressed anything in
 the old lints either — this rule makes them a finding in their own right

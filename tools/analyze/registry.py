@@ -52,9 +52,10 @@ class Rule:
 
 def _load_all():
     # importing the modules populates RULES via @register
-    from . import (markers, rules_chaos, rules_envflags, rules_locks,  # noqa: F401
-                   rules_observability, rules_resilience, rules_spmd,
-                   rules_telemetry)
+    from . import (markers, rules_blocking, rules_chaos,  # noqa: F401
+                   rules_envflags, rules_lockorder, rules_locks,
+                   rules_observability, rules_resilience, rules_routes,
+                   rules_spmd, rules_telemetry)
 
 
 def get_rules(ids=None) -> list[Rule]:

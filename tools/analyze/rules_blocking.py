@@ -1,0 +1,213 @@
+"""A7 blocking-under-lock: no I/O or unbounded waits while holding a lock.
+
+A lock in this codebase guards scheduler/handler shared state that OTHER
+threads need on their hot paths (admission decisions, heartbeats, quorum
+rounds). A blocking call made while holding one turns a slow peer into a
+fleet-wide stall: the PR-12 blackholed-peer bug was exactly a registry op
+waiting on a dead socket while every lease renewal queued behind its
+lock. This pass flags, lexically inside any ``with <lock>`` block in the
+concurrent surface (``paddle_tpu/inference/**``,
+``distributed/fleet/**``, ``observability/**``):
+
+  * ``urllib.request.urlopen`` (network round trip);
+  * ``time.sleep`` (a pause every waiter pays);
+  * ``subprocess.*`` (process spawn/wait);
+  * ``jax.block_until_ready`` / ``jax.device_get`` (device sync);
+  * thread ``.join()`` (receiver name matching thread/proc/worker);
+  * unbounded queue ``.get()`` (no args, no timeout=/block=);
+  * socket ``.recv``/``.sendall``/``.accept`` and ``wfile.write`` (an
+    HTTP response body send — a slow READER blocks the server thread);
+  * a call to a same-class method that itself makes one of the calls
+    above (one hop — ``self._send(...)`` under a lock is how the real
+    finding hid).
+
+Lock = a ``with`` on a name or attribute matching lock/lk/cv/mutex (the
+A5 convention). ``Condition.wait`` is deliberately NOT flagged: waiting
+on the condition's own lock releases it — that is the one sanctioned
+block-under-lock. Escape: ``# locks: ok (<why>)`` on the line (e.g. the
+lock is private to one thread by construction).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, FileCtx
+from .registry import Rule, register
+
+SCOPE_DIRS = ("paddle_tpu/inference/", "paddle_tpu/distributed/fleet/",
+              "paddle_tpu/observability/")
+
+_LOCKNAME = re.compile(r"lock|(^|_)lk($|_)|(^|_)cv($|_)|mutex")
+_THREADISH = re.compile(r"thread|proc|worker")
+_QUEUEISH = re.compile(r"queue|(^|_)q($|_)")
+_SOCKET_METHODS = frozenset({"recv", "sendall", "accept"})
+
+
+def _lock_label(expr: ast.AST) -> str | None:
+    """The display name of a lock acquired by a with-item, or None."""
+    if isinstance(expr, ast.Name) and _LOCKNAME.search(expr.id):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and _LOCKNAME.search(expr.attr):
+        try:
+            return ast.unparse(expr)
+        except Exception:
+            return expr.attr
+    return None
+
+
+def lock_labels(node: ast.With) -> list[str]:
+    out = []
+    for item in node.items:
+        lab = _lock_label(item.context_expr)
+        if lab is not None:
+            out.append(lab)
+    return out
+
+
+def _recv_name(expr: ast.AST) -> str | None:
+    """The innermost useful name of a call receiver: Name id, Attribute
+    attr, or the same through a Subscript (self._threads[1] -> _threads)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Subscript):
+        return _recv_name(expr.value)
+    return None
+
+
+def blocking_reason(node: ast.Call) -> str | None:
+    """Why this call blocks, or None. Shared with A6's documentation of
+    what 'blocking' means; the sets are deliberately name-based — the
+    analyzer never imports runtime code."""
+    f = node.func
+    name = getattr(f, "attr", None) or getattr(f, "id", None)
+    if name == "urlopen":
+        return "urlopen() is a network round trip"
+    if name == "sleep":
+        return "time.sleep() makes every waiter pay the pause"
+    if name in ("block_until_ready", "device_get"):
+        return f"jax.{name}() blocks on the device"
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) and f.value.id == "subprocess":
+            return f"subprocess.{f.attr}() spawns/waits on a process"
+        recv = _recv_name(f.value)
+        if f.attr == "join" and recv is not None \
+                and _THREADISH.search(recv):
+            return f"{recv}.join() waits on another thread"
+        if f.attr in _SOCKET_METHODS:
+            return f"socket .{f.attr}() blocks on the peer"
+        if f.attr == "write" and recv == "wfile":
+            return "wfile.write() is a socket send — a slow reader " \
+                   "blocks the handler"
+        if f.attr == "get" and recv is not None and _QUEUEISH.search(recv) \
+                and not node.args \
+                and not any(kw.arg in ("timeout", "block")
+                            for kw in node.keywords):
+            return f"unbounded {recv}.get() waits forever on an empty queue"
+    return None
+
+
+def _first_direct_blocking(meth: ast.AST) -> tuple[str, int] | None:
+    """The first blocking Call reachable WITHOUT crossing a nested scope
+    (def/lambda/class) — what it means for a method to block when
+    called."""
+    stack = list(ast.iter_child_nodes(meth))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            why = blocking_reason(n)
+            if why is not None:
+                return why, n.lineno
+        stack.extend(ast.iter_child_nodes(n))
+    return None
+
+
+@register
+class BlockingUnderLock(Rule):
+    id = "A7"
+    layer = "locks"
+    title = "blocking-under-lock"
+    rationale = ("a blocking call (urlopen, sleep, subprocess, thread "
+                 "join, device sync, socket send) inside `with <lock>` "
+                 "turns one slow peer into a stall for every thread "
+                 "waiting on that lock")
+
+    def scope(self, rel: str) -> bool:
+        return any(rel.startswith(d) for d in SCOPE_DIRS)
+
+    def check_file(self, ctx: FileCtx):
+        # pass 1: per-class map of methods that make a DIRECT blocking
+        # call — the one-hop resolution for `self._send(...)`-style
+        # hides. Same deferred-execution exemption as the direct check:
+        # a nested def/lambda inside the method is a callback the method
+        # only DEFINES, so its blocking calls must not classify the
+        # method itself as blocking (a factory called under a lock is
+        # not a block under that lock)
+        blocking_methods: dict[tuple[str, str], tuple[str, int]] = {}
+        for cls in [n for n in ctx.nodes_of(ast.ClassDef)]:
+            for meth in [n for n in cls.body
+                         if isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))]:
+                hit = _first_direct_blocking(meth)
+                if hit is not None:
+                    blocking_methods[(cls.name, meth.name)] = hit
+        # pass 2: walk every function with a lexical lock stack
+        findings: list[Finding] = []
+
+        def walk(node, locks: list[tuple[str, int]], cls_name: str | None):
+            for child in ast.iter_child_nodes(node):
+                held = locks
+                if isinstance(child, ast.With):
+                    held = locks + [(lab, child.lineno)
+                                    for lab in lock_labels(child)]
+                if isinstance(child, ast.ClassDef):
+                    walk(child, [], child.name)
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    # deferred execution: a callback DEFINED under a lock
+                    # does not run under it
+                    walk(child, [], cls_name)
+                    continue
+                if isinstance(child, ast.Call) and locks:
+                    findings.extend(
+                        self._check_call(ctx, child, locks[-1], cls_name,
+                                         blocking_methods))
+                walk(child, held, cls_name)
+
+        walk(ctx.tree, [], None)
+        return findings
+
+    def _check_call(self, ctx: FileCtx, call: ast.Call,
+                    lock: tuple[str, int], cls_name: str | None,
+                    blocking_methods: dict):
+        if ctx.marked(call.lineno, self.layer):
+            return
+        lock_name, lock_line = lock
+        why = blocking_reason(call)
+        if why is not None:
+            yield Finding(
+                "A7", ctx.rel, call.lineno,
+                f"blocking call under `with {lock_name}` (acquired line "
+                f"{lock_line}): {why} — move it outside the lock, or mark "
+                "'# locks: ok (<why>)' if the lock is single-threaded by "
+                "construction")
+            return
+        # one hop: self.m(...) where m blocks directly
+        f = call.func
+        if cls_name is not None and isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) and f.value.id == "self":
+            hit = blocking_methods.get((cls_name, f.attr))
+            if hit is not None:
+                why, bline = hit
+                yield Finding(
+                    "A7", ctx.rel, call.lineno,
+                    f"self.{f.attr}() under `with {lock_name}` (acquired "
+                    f"line {lock_line}) blocks: {why} at line {bline} — "
+                    "answer/compute under the lock, do the blocking part "
+                    "outside it, or mark '# locks: ok (<why>)'")

@@ -1,0 +1,284 @@
+"""A6 lock-order: the fleet's lock acquisition graph must be acyclic.
+
+Two threads acquiring the same two locks in opposite orders is a
+deadlock that no test catches until the scheduler interleaves just so.
+This pass builds a directed acquisition graph over the concurrent
+surface and flags every cycle — including the degenerate one, a lock
+re-acquired under itself (``threading.Lock`` is not reentrant).
+
+Edges come from two shapes:
+
+  * **lexical nesting** — ``with self._lk:`` containing
+    ``with self._cache._lk:`` (or any lock-named name/attribute) adds an
+    edge outer → inner at those two sites;
+  * **one-hop calls** — a call made while holding a lock, into a method
+    that itself acquires one: ``self.m(...)`` resolves within the class;
+    ``self._cache.m(...)`` resolves through the attribute's constructor
+    type (``self._cache = PrefixCache(...)`` in ``__init__``) or, when
+    the attribute is a constructor parameter, through a unique method
+    name among lock-acquiring classes (``self._alloc.share`` can only be
+    ``PageAllocator.share``). One hop is deliberate: deeper chains
+    belong to a real points-to analysis, and every in-tree convention
+    keeps lock acquisition one call from the holder.
+
+Lock identity is ``(owning class, attribute)`` for ``self.<attr>`` locks
+and ``(module, name)`` for bare-name (module/closure) locks, so the
+SAME attribute on two objects of one class is one node — which is the
+conservative direction: a cycle on the class-level graph is a potential
+deadlock on some pair of instances. Registries stay GLOBAL under
+``--changed`` (the graph is cross-file by nature; a partial walk could
+neither fabricate nor miss an edge). Escape: ``# locks: ok (<why>)`` on
+the INNER acquisition (or call) site.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileCtx, RepoCtx
+from .registry import Rule, register
+from .rules_blocking import SCOPE_DIRS, _LOCKNAME
+
+
+def _self_chain(expr: ast.AST) -> list[str] | None:
+    """['_cache', '_lk'] for self._cache._lk; ['_lk'] for self._lk."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name) and expr.id == "self":
+        return list(reversed(parts))
+    return None
+
+
+@register
+class LockOrder(Rule):
+    id = "A6"
+    layer = "locks"
+    title = "lock-order"
+    rationale = ("two code paths acquiring the same locks in opposite "
+                 "orders (or a lock re-taken under itself) is a deadlock "
+                 "only a scheduler interleaving away")
+
+    def __init__(self):
+        # raw per-class facts, resolved cross-file in finalize
+        self._classes: list[dict] = []
+        # module-lock nesting edges discovered outside classes
+        self._edges_raw: list[tuple] = []
+
+    def scope(self, rel: str) -> bool:
+        return any(rel.startswith(d) for d in SCOPE_DIRS)
+
+    # ------------------------------------------------------------ collect
+    def check_file(self, ctx: FileCtx):
+        for cls in ctx.nodes_of(ast.ClassDef):
+            self._collect_class(ctx, cls)
+        return ()
+
+    def _lock_node(self, ctx: FileCtx, cls_name: str, expr: ast.AST):
+        """(kind, ...) node id for a with-item lock expr, or None.
+        kinds: ("cls", class, attr) — self.<attr>;
+               ("attr", class, attr, lockattr) — self.<attr>.<lockattr>,
+               resolved to ("cls", type, lockattr) in finalize;
+               ("mod", rel, name) — bare-name module/closure lock."""
+        if isinstance(expr, ast.Name) and _LOCKNAME.search(expr.id):
+            return ("mod", ctx.rel, expr.id)
+        chain = _self_chain(expr)
+        if chain is not None and _LOCKNAME.search(chain[-1]):
+            if len(chain) == 1:
+                return ("cls", cls_name, chain[0])
+            if len(chain) == 2:
+                return ("attr", cls_name, chain[0], chain[1])
+            return None
+        # <var>._lk — a parameter/local holding another object's lock;
+        # resolved by class-name match in finalize (cache -> Cache)
+        if isinstance(expr, ast.Attribute) \
+                and _LOCKNAME.search(expr.attr) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id != "self":
+            return ("name", expr.value.id, expr.attr)
+        return None
+
+    def _collect_class(self, ctx: FileCtx, cls: ast.ClassDef):
+        attr_types: dict[str, str] = {}
+        acquires: dict[str, list] = {}   # method -> [(node, line)]
+        rec = {"rel": ctx.rel, "cls": cls.name, "attr_types": attr_types,
+               "acquires": acquires, "under": []}
+        # attribute -> constructed type (self.x = SomeClass(...))
+        for sub in ast.walk(cls):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                tname = getattr(sub.value.func, "id", None) \
+                    or getattr(sub.value.func, "attr", None)
+                if tname and tname[:1].isupper():
+                    for t in sub.targets:
+                        ch = _self_chain(t)
+                        if ch is not None and len(ch) == 1:
+                            attr_types[ch[0]] = tname
+        for meth in [n for n in cls.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            acq: list = []
+            self._walk_method(ctx, cls.name, meth, meth, [], acq, rec)
+            if acq:
+                acquires[meth.name] = acq
+        self._classes.append(rec)
+
+    def _walk_method(self, ctx, cls_name, meth, node, stack, acq, rec):
+        for child in ast.iter_child_nodes(node):
+            held = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # deferred execution / nested scope: not held
+            if isinstance(child, ast.With):
+                # items acquire left to right: `with a_lk, b_lk:` holds a
+                # while taking b, so each item edges from the CURRENT top
+                # (which may be an earlier item of this same with) and
+                # then joins the held stack
+                for item in child.items:
+                    ln = self._lock_node(ctx, cls_name, item.context_expr)
+                    if ln is not None:
+                        # a marked acquisition is audited OUT of the
+                        # graph entirely — both as a lexical inner site
+                        # and as a one-hop call-edge target, so the
+                        # finding's "mark the audited inner site" advice
+                        # actually clears it
+                        marked = ctx.marked(child.lineno, self.layer)
+                        acq.append((ln, child.lineno, marked))
+                        if held and not marked:
+                            self._edges_raw.append(
+                                (held[-1][0], ln,
+                                 (ctx.rel, held[-1][1]),
+                                 (ctx.rel, child.lineno)))
+                        held = held + [(ln, child.lineno)]
+            if isinstance(child, ast.Call) and stack \
+                    and not ctx.marked(child.lineno, self.layer):
+                f = child.func
+                if isinstance(f, ast.Attribute):
+                    ch = _self_chain(f.value)
+                    if ch is not None and len(ch) <= 1:
+                        # self.m() [ch == []] or self.attr.m() [ch == [a]]
+                        rec["under"].append(
+                            (stack[-1][0], (ctx.rel, stack[-1][1]),
+                             ch[0] if ch else None, f.attr, child.lineno))
+            self._walk_method(ctx, cls_name, meth, child, held, acq, rec)
+
+    # ------------------------------------------------------------ resolve
+    def finalize(self, repo: RepoCtx):
+        # method -> classes (that acquire locks) defining it, for the
+        # unique-name fallback when an attribute's type is a parameter
+        acquiring_cls: dict[str, dict] = {}
+        for rec in self._classes:
+            if rec["acquires"]:
+                acquiring_cls.setdefault(rec["cls"], rec)
+        by_method: dict[str, set] = {}
+        for cname, rec in acquiring_cls.items():
+            for m in rec["acquires"]:
+                by_method.setdefault(m, set()).add(cname)
+
+        known_cls = {rec["cls"].lower(): rec["cls"]
+                     for rec in self._classes}
+
+        def by_varname(name, lockattr):
+            """cache -> Cache, _alloc -> Alloc: the naming-convention
+            fallback when no constructor assignment pins the type."""
+            hit = known_cls.get(name.lstrip("_").lower())
+            return ("cls", hit, lockattr) if hit else None
+
+        def resolve_node(node):
+            if node[0] == "name":
+                _, varname, lockattr = node
+                return by_varname(varname, lockattr) \
+                    or ("ext", varname, lockattr)
+            if node[0] != "attr":
+                return node
+            _, cls_name, attr, lockattr = node
+            for rec in self._classes:
+                if rec["cls"] == cls_name and attr in rec["attr_types"]:
+                    return ("cls", rec["attr_types"][attr], lockattr)
+            return by_varname(attr, lockattr) \
+                or ("cls", f"{cls_name}.{attr}", lockattr)
+
+        edges: dict = {}   # (n1, n2) -> (site1, site2, via)
+
+        def add_edge(n1, n2, s1, s2, via=""):
+            n1, n2 = resolve_node(n1), resolve_node(n2)
+            edges.setdefault((n1, n2), (s1, s2, via))
+
+        for n1, n2, s1, s2 in self._edges_raw:
+            add_edge(n1, n2, s1, s2)
+        for rec in self._classes:
+            for held, hsite, attr, meth, lineno in rec["under"]:
+                if attr is None:
+                    target = acquiring_cls.get(rec["cls"])
+                else:
+                    tname = rec["attr_types"].get(attr)
+                    if tname is None:
+                        cands = by_method.get(meth, set())
+                        tname = next(iter(cands)) if len(cands) == 1 \
+                            else None
+                    target = acquiring_cls.get(tname) if tname else None
+                if target is None:
+                    continue
+                for ln, acq_line, marked in target["acquires"].get(meth,
+                                                                   ()):
+                    if marked:
+                        continue  # audited acquisition: no edges into it
+                    add_edge(held, ln, hsite, (target["rel"], acq_line),
+                             via=f"{rec['rel']}:{lineno} calls "
+                                 f"{target['cls']}.{meth}()")
+        yield from self._report_cycles(edges)
+
+    def _report_cycles(self, edges: dict):
+        def fmt(node):
+            if node[0] == "cls":
+                return f"{node[1]}.{node[2]}"
+            return f"{node[1]}:{node[2]}"
+
+        adj: dict = {}
+        for (n1, n2), _meta in edges.items():
+            adj.setdefault(n1, []).append(n2)
+
+        # self-loops first: re-acquiring a non-reentrant lock is its own,
+        # sharper message (the cycle DFS below only walks paths of >= 2
+        # nodes, so these are never double-reported)
+        for (n1, n2), (s1, s2, via) in sorted(edges.items(),
+                                              key=lambda kv: kv[1][1]):
+            if n1 == n2:
+                yield Finding(
+                    "A6", s2[0], s2[1],
+                    f"lock {fmt(n1)} acquired at {s1[0]}:{s1[1]} is "
+                    f"re-acquired under itself here"
+                    + (f" ({via})" if via else "")
+                    + " — threading.Lock is not reentrant: this "
+                    "self-deadlocks the first time both sites run on one "
+                    "thread")
+        # cycles: DFS from every node, report each cycle once (by its
+        # sorted node set)
+        seen_cycles: set = set()
+
+        def dfs(start, node, path):
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        yield list(path)
+                elif nxt not in path and nxt in adj:
+                    yield from dfs(start, nxt, path + [nxt])
+
+        for start in sorted(adj, key=fmt):
+            for cycle in dfs(start, start, [start]):
+                sites = []
+                for i, n in enumerate(cycle):
+                    nxt = cycle[(i + 1) % len(cycle)]
+                    s1, s2, via = edges[(n, nxt)]
+                    sites.append(f"{fmt(n)} -> {fmt(nxt)} at "
+                                 f"{s2[0]}:{s2[1]}"
+                                 + (f" ({via})" if via else ""))
+                s1, s2, _via = edges[(cycle[0], cycle[1 % len(cycle)])]
+                yield Finding(
+                    "A6", s2[0], s2[1],
+                    "lock-order cycle (potential deadlock): "
+                    + "; ".join(sites)
+                    + " — pick ONE acquisition order and hold it "
+                    "everywhere, or mark the audited inner site "
+                    "'# locks: ok (<why>)'")

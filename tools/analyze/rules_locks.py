@@ -31,21 +31,14 @@ from collections import defaultdict
 from .core import Finding, FileCtx
 from .registry import Rule, register
 
-SCOPE_DIRS = ("paddle_tpu/observability/",)
-SCOPE_FILES = ("paddle_tpu/inference/serving.py",
-               # the fleet runtime (ISSUE 9): replica handler threads vs
-               # the serve loop, router vs nothing (single-threaded by
-               # contract) — both audited like the telemetry plane
-               "paddle_tpu/inference/replica.py",
-               "paddle_tpu/inference/router.py",
-               # the replicated registry (ISSUE 12): quorum fan-out
-               # threads + beat/rendezvous callers share peer state
-               "paddle_tpu/distributed/fleet/replicated_kv.py",
-               # prefix sharing (ISSUE 13): page refcounts + the prefix
-               # index are shared mutable counters — the batcher thread
-               # mutates them while replica HTTP handlers probe/read
-               "paddle_tpu/inference/paging.py",
-               "paddle_tpu/inference/prefix_cache.py")
+# ISSUE 15 extended the scope from the PR-7 file list to the whole
+# concurrent surface: every inference/** module (the serve loop, replica
+# handler threads, disagg coordinator, speculative scheduler, page/prefix
+# accounting), the whole telemetry plane, and both registry transports
+# (quorum fan-out threads + beat/rendezvous callers share peer state)
+SCOPE_DIRS = ("paddle_tpu/observability/", "paddle_tpu/inference/")
+SCOPE_FILES = ("paddle_tpu/distributed/fleet/replicated_kv.py",
+               "paddle_tpu/distributed/fleet/elastic.py")
 
 _LOCKNAME = re.compile(r"lock|(^|_)lk($|_)|(^|_)cv($|_)|mutex")
 _MUTATORS = frozenset({

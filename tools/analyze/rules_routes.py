@@ -1,0 +1,461 @@
+"""A8 wire-contract registry: every HTTP route is declared, statuses match.
+
+``paddle_tpu/inference/routes.py`` declares every HTTP route the fleet
+serves (path -> methods -> handler-returnable statuses + one-line doc).
+The fleet PRs kept hand-finding wire drift in review: handlers growing
+statuses no client branches on, clients branching on statuses no handler
+sends, routes registered under one spelling and probed under another.
+This pass closes the loop statically (the A2 chaos-site shape applied to
+the wire):
+
+  * **(a) registrations are declared** — every ``AdminServer(...)``
+    ``get_routes=``/``post_routes=`` dict key, and every path literal a
+    hand-rolled ``do_GET``/``do_PUT``/... handler compares or
+    ``startswith``-matches, must be a declared route accepting that
+    method;
+  * **(b) client call sites are declared** — every literal path fed to
+    the audited client helpers (``_get``/``_post``/``_get_bytes``/
+    ``_post_bytes``/``_peer_call``/``_kv_req``) or to
+    ``urlopen``/``Request`` must reference a declared route + method;
+  * **(c) handler statuses are declared** — a dict-registered handler's
+    ``return (code, body)`` statuses (one same-class hop deep, so
+    ``return self._reject_429(...)`` counts) must be a subset of the
+    route's declared statuses;
+  * **(d) clients branch only on declared statuses** — an int compared
+    against a ``code``/``st``/``status`` variable in a client file must
+    be declared somewhere (or the implied server statuses / the 0
+    transport-fault sentinel) — branching on a status nothing can send
+    is dead recovery code, and usually a drifted contract;
+  * **(e) every declared route is named by >= 1 test** under tests/
+    (skipped on fixture trees without tests/);
+  * registry hygiene — literal keys only, no duplicates, docs required,
+    and no dead declarations (a route neither registered nor called).
+
+The runtime mirror lives in ``observability.admin``: serving an
+undeclared route warn-and-flight-records ``admin.unregistered_route``
+once, never raises. Escape: ``# wire: ok (<why>)`` on the line.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, FileCtx, RepoCtx
+from .registry import Rule, register
+
+REGISTRY_REL = "paddle_tpu/inference/routes.py"
+REGISTRY_VAR = "ROUTES"
+IMPLIED_VAR = "IMPLIED_STATUSES"
+
+# audited client helpers: name -> (path argpos, method argpos or fixed)
+_CLIENT_HELPERS = {
+    "_get": (1, "GET"),
+    "_get_bytes": (1, "GET"),
+    "_post": (1, "POST"),
+    "_post_bytes": (1, "POST"),
+    "_post_raw": (1, "POST"),
+    "_peer_call": (1, 2),      # method is positional arg 2 / kw "method"
+    "_kv_req": (0, 1),         # method is positional arg 1 / kw "method"
+}
+
+_DO_METHODS = {"do_GET": "GET", "do_POST": "POST", "do_PUT": "PUT",
+               "do_DELETE": "DELETE"}
+
+_STATUS_NAMES = {"code", "st", "status"}
+
+
+def normalize_route(fragment: str) -> str | None:
+    """Registry key for a path literal: first segment, query stripped —
+    "/kv/" -> "/kv", "/results?since=" -> "/results"."""
+    fragment = fragment.split("?", 1)[0]
+    parts = fragment.split("/")
+    if len(parts) < 2 or not parts[1]:
+        return None
+    seg = parts[1]
+    if not re.fullmatch(r"[A-Za-z0-9_.-]+", seg):
+        return None
+    return "/" + seg
+
+
+def _path_fragment(expr: ast.AST) -> str | None:
+    """The leading literal path in a URL/path expression: a constant, the
+    first "/"-leading piece of an f-string, or either side of a `+`."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value if expr.value.startswith("/") else None
+    if isinstance(expr, ast.JoinedStr):
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str) \
+                    and v.value.startswith("/") and len(v.value) > 1:
+                return v.value
+        return None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _path_fragment(expr.right) or _path_fragment(expr.left)
+    return None
+
+
+def parse_registry(ctx: FileCtx | None):
+    """({route: {"lineno", "methods", "statuses", "doc"}} or None,
+    implied statuses, findings) from the ROUTES dict literal."""
+    findings: list[Finding] = []
+    if ctx is None or ctx.tree is None:
+        return None, set(), findings
+    table = None
+    implied: set[int] = set()
+    for node in ctx.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if REGISTRY_VAR in names and isinstance(node.value, ast.Dict):
+            table = node.value
+        if IMPLIED_VAR in names:
+            try:
+                implied = {int(v) for v in ast.literal_eval(node.value)}
+            except (ValueError, TypeError):
+                findings.append(Finding(
+                    "A8", ctx.rel, node.lineno,
+                    f"{IMPLIED_VAR} must be a literal tuple of ints"))
+    if table is None:
+        return None, implied, findings
+    routes: dict = {}
+    for k, v in zip(table.keys, table.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            findings.append(Finding(
+                "A8", ctx.rel, getattr(k, "lineno", table.lineno),
+                "non-literal key in ROUTES: the wire registry must be a "
+                "plain dict literal the analyzer (and grep) can read"))
+            continue
+        if k.value in routes:
+            findings.append(Finding(
+                "A8", ctx.rel, k.lineno,
+                f"duplicate route {k.value!r} in ROUTES: a duplicate dict "
+                "key silently drops the first declaration"))
+            continue
+        try:
+            spec = ast.literal_eval(v)
+            methods = tuple(str(m) for m in spec["methods"])
+            statuses = tuple(int(s) for s in spec["statuses"])
+            doc = str(spec.get("doc") or "")
+        except Exception:
+            findings.append(Finding(
+                "A8", ctx.rel, k.lineno,
+                f"route {k.value!r}: value must be a literal dict with "
+                "'methods' (tuple of verbs), 'statuses' (tuple of ints) "
+                "and 'doc'"))
+            continue
+        if not doc.strip():
+            findings.append(Finding(
+                "A8", ctx.rel, k.lineno,
+                f"route {k.value!r} declared without a doc — the one-line "
+                "'what this endpoint serves' is the point of the registry"))
+        routes[k.value] = {"lineno": k.lineno, "methods": methods,
+                           "statuses": statuses, "doc": doc}
+    return routes, implied, findings
+
+
+@register
+class WireContractRegistry(Rule):
+    id = "A8"
+    layer = "wire"
+    title = "wire-contract-registry"
+    rationale = ("an HTTP route/status outside inference/routes.py is "
+                 "invisible drift: handlers and clients age apart until a "
+                 "status line masquerades as a dead replica")
+
+    def __init__(self):
+        self._regs: list[tuple] = []     # (rel, line, route, method)
+        self._clients: list[tuple] = []  # (rel, line, route, method|None)
+        self._branches: list[tuple] = []  # (rel, line, int)
+        self._client_files: set[str] = set()
+        # (rel, cls) -> {meth: (direct status set, same-class calls, line)}
+        self._returns: dict = {}
+        # dict-registered handlers: (rel, cls, meth, route, line)
+        self._handlers: list[tuple] = []
+
+    def scope(self, rel: str) -> bool:
+        return True  # paddle_tpu/** + bench.py + benchmarks/
+
+    # ------------------------------------------------------------ collect
+    def check_file(self, ctx: FileCtx):
+        if ctx.rel == REGISTRY_REL:
+            return ()
+        self._collect_calls(ctx)
+        self._collect_do_handlers(ctx)
+        self._collect_branches(ctx)
+        return ()
+
+    def _collect_calls(self, ctx: FileCtx):
+        # class context by lineno span (for handler resolution)
+        spans = []
+        for cls in ctx.nodes_of(ast.ClassDef):
+            end = max((n.lineno for n in ast.walk(cls)
+                       if hasattr(n, "lineno")), default=cls.lineno)
+            spans.append((cls.lineno, end, cls.name))
+            self._collect_returns(ctx, cls)
+
+        def cls_at(lineno):
+            best = None
+            for lo, hi, name in spans:
+                if lo <= lineno <= hi and (best is None or lo > best[0]):
+                    best = (lo, name)
+            return best[1] if best else None
+
+        for call in ctx.nodes_of(ast.Call):
+            fname = getattr(call.func, "attr", None) \
+                or getattr(call.func, "id", None)
+            if fname == "AdminServer":
+                for kw in call.keywords:
+                    if kw.arg not in ("get_routes", "post_routes") \
+                            or not isinstance(kw.value, ast.Dict):
+                        continue
+                    method = "GET" if kw.arg == "get_routes" else "POST"
+                    for k, v in zip(kw.value.keys, kw.value.values):
+                        if not (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            continue
+                        if ctx.marked(k.lineno, self.layer):
+                            continue
+                        route = normalize_route(k.value)
+                        if route is None:
+                            continue
+                        self._regs.append((ctx.rel, k.lineno, route,
+                                           method))
+                        h = getattr(v, "attr", None)
+                        owner = cls_at(call.lineno)
+                        if h and owner:
+                            self._handlers.append(
+                                (ctx.rel, owner, h, route, k.lineno))
+            elif fname in _CLIENT_HELPERS:
+                pos, marg = _CLIENT_HELPERS[fname]
+                if len(call.args) <= pos:
+                    continue
+                frag = _path_fragment(call.args[pos])
+                if frag is None:
+                    continue
+                if ctx.marked(call.lineno, self.layer):
+                    continue
+                route = normalize_route(frag)
+                if route is None:
+                    continue
+                method = marg if isinstance(marg, str) else None
+                if method is None:
+                    marg_expr = (call.args[marg]
+                                 if len(call.args) > marg else None)
+                    for kw in call.keywords:
+                        if kw.arg == "method":
+                            marg_expr = kw.value
+                    if isinstance(marg_expr, ast.Constant) \
+                            and isinstance(marg_expr.value, str):
+                        method = marg_expr.value
+                    elif marg_expr is None:
+                        method = "GET"
+                self._clients.append((ctx.rel, call.lineno, route, method))
+                self._client_files.add(ctx.rel)
+            elif fname in ("urlopen", "Request") and call.args:
+                frag = _path_fragment(call.args[0])
+                if frag is None:
+                    continue
+                if ctx.marked(call.lineno, self.layer):
+                    continue
+                route = normalize_route(frag)
+                if route is None:
+                    continue
+                method = "GET"
+                for kw in call.keywords:
+                    if kw.arg == "method":
+                        method = (kw.value.value
+                                  if isinstance(kw.value, ast.Constant)
+                                  and isinstance(kw.value.value, str)
+                                  else None)
+                self._clients.append((ctx.rel, call.lineno, route, method))
+                self._client_files.add(ctx.rel)
+
+    def _collect_returns(self, ctx: FileCtx, cls: ast.ClassDef):
+        table: dict = {}
+        for meth in [n for n in cls.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            direct: set[int] = set()
+            calls: set[str] = set()
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Return) or sub.value is None:
+                    continue
+                v = sub.value
+                if isinstance(v, ast.Tuple) and v.elts \
+                        and isinstance(v.elts[0], ast.Constant) \
+                        and isinstance(v.elts[0].value, int):
+                    if not ctx.marked(sub.lineno, self.layer):
+                        direct.add(int(v.elts[0].value))
+                elif isinstance(v, ast.Call) \
+                        and isinstance(v.func, ast.Attribute) \
+                        and isinstance(v.func.value, ast.Name) \
+                        and v.func.value.id == "self":
+                    calls.add(v.func.attr)
+            table[meth.name] = (direct, calls, meth.lineno)
+        if table:
+            self._returns[(ctx.rel, cls.name)] = table
+
+    def _collect_do_handlers(self, ctx: FileCtx):
+        for fn in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            method = _DO_METHODS.get(fn.name)
+            if method is None:
+                continue
+            for sub in ast.walk(fn):
+                lits: list[tuple[str, int]] = []
+                if isinstance(sub, ast.Compare):
+                    for side in [sub.left] + list(sub.comparators):
+                        if isinstance(side, ast.Constant) \
+                                and isinstance(side.value, str):
+                            lits.append((side.value, side.lineno))
+                        elif isinstance(side, ast.Tuple):
+                            lits.extend(
+                                (e.value, e.lineno) for e in side.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str))
+                elif isinstance(sub, ast.Call) \
+                        and getattr(sub.func, "attr", None) == "startswith" \
+                        and sub.args \
+                        and isinstance(sub.args[0], ast.Constant) \
+                        and isinstance(sub.args[0].value, str):
+                    lits.append((sub.args[0].value, sub.args[0].lineno))
+                for lit, lineno in lits:
+                    if not lit.startswith("/"):
+                        continue
+                    if ctx.marked(lineno, self.layer):
+                        continue
+                    route = normalize_route(lit)
+                    if route is not None:
+                        self._regs.append((ctx.rel, lineno, route, method))
+
+    def _collect_branches(self, ctx: FileCtx):
+        for cmp in ctx.nodes_of(ast.Compare):
+            sides = [cmp.left] + list(cmp.comparators)
+            named = any(
+                (isinstance(s, ast.Name) and s.id in _STATUS_NAMES)
+                or (isinstance(s, ast.Attribute) and s.attr in _STATUS_NAMES)
+                for s in sides)
+            if not named:
+                continue
+            for s in sides:
+                if isinstance(s, ast.Constant) and isinstance(s.value, int) \
+                        and not isinstance(s.value, bool) \
+                        and (s.value == 0 or 100 <= s.value <= 599) \
+                        and not ctx.marked(cmp.lineno, self.layer):
+                    self._branches.append((ctx.rel, cmp.lineno, s.value))
+
+    # ----------------------------------------------------------- finalize
+    def finalize(self, repo: RepoCtx):
+        reg_ctx = repo.file(REGISTRY_REL)
+        routes, implied, findings = parse_registry(reg_ctx)
+        yield from findings
+        if routes is None:
+            if self._regs or self._clients:
+                rel, lineno, route, _ = (self._regs + self._clients)[0]
+                yield Finding(
+                    "A8", REGISTRY_REL, 0,
+                    f"HTTP routes exist (first: {route!r} at "
+                    f"{rel}:{lineno}) but {REGISTRY_REL} has no parseable "
+                    "ROUTES registry")
+            return
+        # (a) registrations declared (route + method)
+        seen_reg: set = set()
+        live: set = set()
+        for rel, lineno, route, method in sorted(self._regs):
+            live.add(route)
+            key = (rel, route, method)
+            if key in seen_reg:
+                continue
+            seen_reg.add(key)
+            if route not in routes:
+                yield Finding(
+                    "A8", rel, lineno,
+                    f"handler registers undeclared route {route!r}: "
+                    f"declare it in {REGISTRY_REL} (methods, statuses, "
+                    "doc) — or mark '# wire: ok (<why>)'")
+            elif method not in routes[route]["methods"]:
+                yield Finding(
+                    "A8", rel, lineno,
+                    f"route {route!r} is registered for {method} but "
+                    f"declares only {routes[route]['methods']} — update "
+                    f"the declaration in {REGISTRY_REL} or the handler")
+        # (b) client call sites declared
+        seen_cli: set = set()
+        for rel, lineno, route, method in sorted(
+                self._clients, key=lambda t: (t[0], t[1])):
+            live.add(route)
+            key = (rel, route, method)
+            if key in seen_cli:
+                continue
+            seen_cli.add(key)
+            if route not in routes:
+                yield Finding(
+                    "A8", rel, lineno,
+                    f"client calls undeclared route {route!r}: a typo'd "
+                    "or drifted path 404s at runtime — declare it in "
+                    f"{REGISTRY_REL} or fix the call site")
+            elif method is not None \
+                    and method not in routes[route]["methods"]:
+                yield Finding(
+                    "A8", rel, lineno,
+                    f"client sends {method} to {route!r} which declares "
+                    f"only {routes[route]['methods']}")
+        # (c) dict-registered handler statuses within declaration
+        for rel, cls, meth, route, reg_line in sorted(self._handlers):
+            spec = routes.get(route)
+            if spec is None:
+                continue  # already reported by (a)
+            statuses, line = self._handler_statuses(rel, cls, meth)
+            extra = statuses - set(spec["statuses"]) - implied
+            if extra:
+                yield Finding(
+                    "A8", rel, line or reg_line,
+                    f"handler {cls}.{meth} for {route!r} can return "
+                    f"status(es) {sorted(extra)} not in the declared "
+                    f"{spec['statuses']} — update {REGISTRY_REL} so "
+                    "clients know, or fix the handler")
+        # (d) client branches only on declared statuses
+        declared_union: set[int] = set(implied) | {0}
+        for spec in routes.values():
+            declared_union.update(spec["statuses"])
+        seen_br: set = set()
+        for rel, lineno, val in sorted(self._branches):
+            if rel not in self._client_files:
+                continue  # status-shaped int in a non-client file
+            if val in declared_union or (rel, val) in seen_br:
+                continue
+            seen_br.add((rel, val))
+            yield Finding(
+                "A8", rel, lineno,
+                f"client branches on HTTP status {val} which no declared "
+                "route can answer — dead recovery code or a drifted "
+                f"contract; reconcile with {REGISTRY_REL}")
+        # (e) every declared route named by >= 1 test
+        tests = repo.tests_text()
+        if tests is not None:
+            for route, spec in sorted(routes.items()):
+                if not re.search(re.escape(route) + r"(?![A-Za-z0-9_])",
+                                 tests):
+                    yield Finding(
+                        "A8", REGISTRY_REL, spec["lineno"],
+                        f"declared route {route!r} is named by no test "
+                        "under tests/ — an untested endpoint is a wire "
+                        "contract that has never been exercised")
+        # dead declarations
+        for route, spec in sorted(routes.items()):
+            if route not in live:
+                yield Finding(
+                    "A8", REGISTRY_REL, spec["lineno"],
+                    f"declared route {route!r} has no registration and no "
+                    "client call site — delete the declaration or wire "
+                    "the endpoint")
+
+    def _handler_statuses(self, rel, cls, meth) -> tuple[set[int], int]:
+        table = self._returns.get((rel, cls), {})
+        direct, calls, line = table.get(meth, (set(), set(), 0))
+        out = set(direct)
+        for callee in calls:   # one same-class hop (_reject_429)
+            d2, _c2, _l2 = table.get(callee, (set(), set(), 0))
+            out |= d2
+        return out, line

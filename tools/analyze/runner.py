@@ -3,24 +3,34 @@ from __future__ import annotations
 
 import os
 import subprocess
+import time
 
 from .core import Finding, RepoCtx, walk_repo
 from .registry import Rule, get_rules
 
 
-def run(root: str, rule_ids=None, files=None) -> list[Finding]:
+def run(root: str, rule_ids=None, files=None,
+        stats: dict | None = None) -> list[Finding]:
     """Run the selected rules over `root` (a repo tree or a fixture tree
     containing paddle_tpu/). `files`: optional explicit repo-relative file
     list (the --changed mode) — PER-FILE checks are restricted to it, but
     rules with a cross-file finalize pass (registries, name tables) still
     visit the whole tree: their invariants are global, and feeding them a
-    subset would fabricate 'unused'/'unregistered' findings. Returns
-    findings sorted by (path, line, rule)."""
+    subset would fabricate 'unused'/'unregistered' findings. `stats`: an
+    optional dict filled with per-rule wall seconds (check_file +
+    finalize summed) — the --stats perf guard, so a new cross-file pass
+    that regresses the tier-1 wall is visible BEFORE the suite times out.
+    Returns findings sorted by (path, line, rule)."""
     root = os.path.abspath(root)
     rules = get_rules(rule_ids)
     repo = RepoCtx(root)
     findings: list[Finding] = []
     seen_syntax: set[str] = set()
+
+    def charge(rule_id: str, t0: float):
+        if stats is not None:
+            stats[rule_id] = stats.get(rule_id, 0.0) \
+                + (time.perf_counter() - t0)
 
     def visit(rels, active_rules):
         for rel in rels:
@@ -41,7 +51,9 @@ def run(root: str, rule_ids=None, files=None) -> list[Finding]:
                                             f"unparseable: {e.msg}"))
                 continue
             for r in in_scope:
+                t0 = time.perf_counter()
                 findings.extend(r.check_file(ctx))
+                charge(r.id, t0)
 
     if files is None:
         visit(walk_repo(root), rules)
@@ -54,7 +66,9 @@ def run(root: str, rule_ids=None, files=None) -> list[Finding]:
             rest = [rel for rel in walk_repo(root) if rel not in set(changed)]
             visit(rest, cross)
     for r in rules:
+        t0 = time.perf_counter()
         findings.extend(r.finalize(repo))
+        charge(r.id, t0)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
