@@ -154,6 +154,118 @@ def _fleet_drill(n_replicas: int) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _autoscale_drill() -> dict:
+    """ISSUE 16: a 1-replica warm fleet + AutoscaleController under a
+    flash crowd — the controller must scale out THROUGH the warm-start
+    path (jit cache + weights fetched from the donor), serve everything,
+    then drain back to the floor when the load drops. Reports the
+    decision ledger totals and the warm-vs-cold breach-to-first-token
+    story (ready_s is measured identically on both replicas: process
+    main() start → first warmup token served)."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.inference.admission import AdmissionReject
+    from paddle_tpu.inference.autoscale import (AutoscaleController,
+                                                FleetActuator,
+                                                RegistryObserver)
+    from paddle_tpu.inference.router import ServingFleet
+    from paddle_tpu.observability import recorder as _recorder
+
+    spec = {
+        "config": {"vocab_size": 256, "hidden_size": 64,
+                   "intermediate_size": 128, "num_hidden_layers": 2,
+                   "num_attention_heads": 4, "num_key_value_heads": 2,
+                   "max_position_embeddings": 128, "dtype": "float32"},
+        "seed": 3,
+        "batcher": {"max_batch": 3, "max_len": 96,
+                    "prompt_buckets": [8, 16, 32], "burst": 4,
+                    "page_size": 8},
+    }
+    n_req = int(os.environ.get("AUTOSCALE_DRILL_REQUESTS", "10"))
+    rng = np.random.RandomState(16)
+    reqs = [(rng.randint(1, 256, int(n)).tolist(), 8)
+            for n in rng.randint(4, 12, n_req)]
+
+    root = tempfile.mkdtemp(prefix="autoscale_bench_")
+    fleet = ServingFleet(
+        1, spec, root=root, ttl=1.5,
+        env={"JAX_PLATFORMS": "cpu", "PADDLE_WARMSTART": "1",
+             "PADDLE_CHAOS": "", "PADDLE_SPEC_DECODE": "0"})
+    ctl = None
+    try:
+        fleet.start(timeout=240)
+        router = fleet.router()
+        lease0 = fleet.registry.info("serve.r0")
+        cold_s = float(lease0["ready_s"])     # r0 compiled from scratch
+        ctl = AutoscaleController(
+            RegistryObserver(fleet.registry), FleetActuator(fleet),
+            ("unified",), interval_s=0.25, breach_windows=2,
+            idle_windows=4, high_water=1.0, low_water=0.05,
+            cooldown_s=4.0, min_replicas=1, max_replicas=2,
+            drain_timeout_s=60.0).start()
+        ev0 = len(_recorder.events())
+        for p, m in reqs:                     # the flash crowd
+            deadline = _time.perf_counter() + 150.0
+            while True:
+                try:
+                    router.submit(p, m)
+                    break
+                except AdmissionReject as e:
+                    if _time.perf_counter() > deadline:
+                        raise TimeoutError(
+                            "autoscale drill: submission still rejected "
+                            "after 150s of honoring retry-after") from e
+                    _time.sleep(min(e.retry_after_s, 1.0))
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:   # scale-out resolves
+            if ctl.decisions("scale_out") \
+                    and not ctl.status()["pending_out"]:
+                break
+            _time.sleep(0.1)
+        outs = ctl.decisions("scale_out")
+        new = outs[0]["name"] if outs else None
+        lease1 = fleet.registry.info("serve." + new) if new else None
+        out = router.wait(timeout=240)
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:   # idle → drain-back
+            alive = [x for x in fleet.registry.alive_nodes()
+                     if x.startswith("serve.")]
+            if ctl.decisions("scale_in") and not ctl.status()["draining"] \
+                    and len(alive) == 1:
+                break
+            _time.sleep(0.2)
+        ready = [e for e in _recorder.events()[ev0:]
+                 if e.get("kind") == "autoscale.scale_out_ready"]
+        return {
+            "requests": n_req,
+            "completed": sum(
+                1 for rid in out
+                if (router.result(rid) or {}).get("reason") == "complete"),
+            "decisions": len(ctl.decisions()),
+            "scale_out": len(outs),
+            "scale_in": len(ctl.decisions("scale_in")),
+            "warm": bool(lease1 and lease1.get("warm")),
+            "cold_ready_s": round(cold_s, 3),
+            "warm_ready_s": (round(float(lease1["ready_s"]), 3)
+                             if lease1 else None),
+            "breach_to_first_token_s": (
+                round(ready[0]["breach_to_first_token_s"], 3)
+                if ready else None),
+            "pool_after_drain_back": len(
+                [x for x in fleet.registry.alive_nodes()
+                 if x.startswith("serve.")]),
+        }
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        fleet.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _disagg_drill(n_prefill: int, n_decode: int) -> dict:
     """ISSUE 11: a MIXED fleet — prefill-pool + decode-pool subprocess
     replicas behind a DisaggRouter, quantized (int8) KV pages on the
@@ -603,7 +715,19 @@ def _main():
         except BaseException as e:
             disagg_obj = {"error": f"{type(e).__name__}: {e}"}
 
-    print(json.dumps({
+    # SLO-driven autoscaler drill (ISSUE 16): PADDLE_AUTOSCALE=1 runs a
+    # 1→2 warm-scale-out / drain-back drill and the JSON line gains the
+    # `autoscale` sub-object; the key is ABSENT (not null) when the
+    # controller is off. A drill failure lands as autoscale.error — the
+    # JSON line survives.
+    autoscale_obj = None
+    if (os.environ.get("PADDLE_AUTOSCALE", "") or "0") not in ("", "0"):
+        try:
+            autoscale_obj = _autoscale_drill()
+        except BaseException as e:
+            autoscale_obj = {"error": f"{type(e).__name__}: {e}"}
+
+    payload = {
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": round(total_new / cont_s, 1),
         "unit": "tokens/s",
@@ -628,7 +752,10 @@ def _main():
         "greedy_divergent_requests": mismatch,
         "paged_vs_dense_divergent_requests": paged_vs_dense,
         "device": str(getattr(jax.devices()[0], "device_kind", "?")),
-    }))
+    }
+    if autoscale_obj is not None:
+        payload["autoscale"] = autoscale_obj
+    print(json.dumps(payload))
 
     # hard parity gate AFTER the JSON line: the measured throughputs must
     # never be discarded by the failure they diagnose (cf. bench.py

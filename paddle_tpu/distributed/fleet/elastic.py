@@ -231,6 +231,62 @@ class FileRegistry:
             pass
 
 
+def _merge_snapshot(store: dict, kv: dict, maxkeys: set, snap: dict):
+    """Merge one /dump-shaped snapshot into raw store dicts — hb by
+    freshest ts, kv by version, kvmax counters by VALUE. Shared by
+    ``KVServer.load_snapshot`` and WAL replay so a replayed snapshot
+    record applies byte-identically to the live merge it logged."""
+    for node, rec in (snap.get("hb") or {}).items():
+        ts, info = float(rec[0]), str(rec[1])
+        if ts > store.get(node, (0, ""))[0]:
+            store[node] = (ts, info)
+    maxkeys.update(set(snap.get("maxkeys") or []))
+    for key, rec in (snap.get("kv") or {}).items():
+        val, vn, w = str(rec[0]), int(rec[1]), str(rec[2])
+        old, cur_vn, cur_w = kv.get(key, ("", 0, ""))
+        if key in maxkeys:
+            try:
+                if int(val or 0) > int(old or 0):
+                    kv[key] = (val, max(vn, cur_vn), w)
+            except ValueError:
+                pass
+        elif (vn, w) > (cur_vn, cur_w):
+            kv[key] = (val, vn, w)
+
+
+def _wal_replay(path: str, store: dict, kv: dict, maxkeys: set):
+    """Apply every committed record of a write-ahead file, in commit
+    order. A torn tail line (the crash interrupted the append) parses as
+    invalid JSON and is skipped — everything before it was fsynced whole."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        op = rec.get("op")
+        if op == "hb":
+            store[rec["n"]] = (float(rec["ts"]), str(rec["i"]))
+        elif op == "kv":
+            kv[rec["k"]] = (str(rec["v"]), int(rec["vn"]), str(rec["w"]))
+        elif op == "kvmax":
+            kv[rec["k"]] = (str(rec["v"]), int(rec["vn"]), "")
+            maxkeys.add(rec["k"])
+        elif op == "delhb":
+            store.pop(rec["n"], None)
+        elif op == "delkv":
+            kv.pop(rec["k"], None)
+        elif op == "snap":
+            _merge_snapshot(store, kv, maxkeys, rec)
+
+
 class KVServer:
     """TTL'd KV over HTTP — the master side of KVRegistry.
 
@@ -259,9 +315,18 @@ class KVServer:
       * GET /dump + PUT /load move a whole-store snapshot — a restarted
         peer catches up from a majority snapshot (``kvmax`` keys merge by
         numeric max, never by version: the counter is monotone by VALUE).
+
+    Durability (ISSUE 16): with ``wal_path`` set, every committed
+    mutation is appended to a JSON-lines write-ahead file (fsynced inside
+    the store lock, so line order IS commit order) and replayed on
+    construction — a peer that restarts with its WAL recovers every write
+    it ever acked, even when ALL peers died simultaneously and no
+    snapshot survives to catch up from. Replay compacts the file to one
+    snapshot line, so restart cost is O(state), not O(lifetime writes).
     """
 
-    def __init__(self, port: int = 0, ttl: float = 10.0):
+    def __init__(self, port: int = 0, ttl: float = 10.0,
+                 wal_path: str | None = None):
         store: dict = {}
         # durable: generation counter, enrollments, assignments —
         # key -> (value, vn, writer)
@@ -270,6 +335,42 @@ class KVServer:
         lock = threading.Lock()
         self._store, self._kv, self._lock, self.ttl = store, kv, lock, ttl
         self._maxkeys = maxkeys
+        self.wal_path = wal_path
+        wal: list = [None]  # closure cell: append handle, None = WAL off
+        if wal_path:
+            _wal_replay(wal_path, store, kv, maxkeys)
+            # compact: one snapshot line replaces the replayed history
+            tmp = wal_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(
+                    {"op": "snap",
+                     "hb": {n: list(r) for n, r in store.items()},
+                     "kv": {k: list(r) for k, r in kv.items()},
+                     "maxkeys": sorted(maxkeys)}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, wal_path)
+            wal[0] = open(wal_path, "a")
+        self._wal = wal
+
+        def _wal_append(rec: dict):
+            # caller holds `lock`; a failed append is flight-recorded,
+            # never raised into the KV response path (the in-memory
+            # commit already happened — durability degrades, the
+            # registry keeps serving)
+            f = wal[0]
+            if f is None:
+                return
+            try:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            except (OSError, ValueError) as e:
+                _recorder.record("kv.wal_write_failed", echo=True,
+                                 message=f"[kv] WAL append failed: {e}",
+                                 path=wal_path, error=str(e))
+
+        self._wal_append = _wal_append
         ttl_ref = self
 
         class H(BaseHTTPRequestHandler):
@@ -298,7 +399,10 @@ class KVServer:
                     node = self.path[4:]
                     info = self._body() or b"{}"
                     with lock:
-                        store[node] = (time.time(), info.decode() or "{}")
+                        ts = time.time()
+                        store[node] = (ts, info.decode() or "{}")
+                        _wal_append({"op": "hb", "n": node, "ts": ts,
+                                     "i": store[node][1]})
                     return self._send(200)
                 if self.path.startswith("/kv/"):
                     key = self.path[4:]
@@ -343,6 +447,8 @@ class KVServer:
                                 except ValueError:
                                     pass
                             kv[key] = (val, vn, writer)
+                            _wal_append({"op": "kv", "k": key, "v": val,
+                                         "vn": vn, "w": writer})
                         else:
                             vn, writer = cur_vn, cur_w
                     return self._send(200, json.dumps(
@@ -363,6 +469,8 @@ class KVServer:
                         new = max(cur, val)
                         kv[key] = (str(new), cur_vn + 1, "")
                         maxkeys.add(key)
+                        _wal_append({"op": "kvmax", "k": key, "v": str(new),
+                                     "vn": cur_vn + 1})
                     return self._send(200, str(new).encode())
                 if self.path == "/load":
                     # snapshot install (peer catch-up): merge, never clobber
@@ -380,10 +488,12 @@ class KVServer:
                 if self.path.startswith("/hb/"):
                     with lock:
                         store.pop(self.path[4:], None)
+                        _wal_append({"op": "delhb", "n": self.path[4:]})
                     return self._send(200)
                 if self.path.startswith("/kv/"):
                     with lock:
                         kv.pop(self.path[4:], None)
+                        _wal_append({"op": "delkv", "k": self.path[4:]})
                     return self._send(200)
                 self._send(404)
 
@@ -454,22 +564,11 @@ class KVServer:
         a restarted peer is caught up while its port only queues
         connections, so no client ever reads the blank pre-merge store."""
         with self._lock:
-            for node, rec in (snap.get("hb") or {}).items():
-                ts, info = float(rec[0]), str(rec[1])
-                if ts > self._store.get(node, (0, ""))[0]:
-                    self._store[node] = (ts, info)
-            self._maxkeys.update(set(snap.get("maxkeys") or []))
-            for key, rec in (snap.get("kv") or {}).items():
-                val, vn, w = str(rec[0]), int(rec[1]), str(rec[2])
-                old, cur_vn, cur_w = self._kv.get(key, ("", 0, ""))
-                if key in self._maxkeys:
-                    try:
-                        if int(val or 0) > int(old or 0):
-                            self._kv[key] = (val, max(vn, cur_vn), w)
-                    except ValueError:
-                        pass
-                elif (vn, w) > (cur_vn, cur_w):
-                    self._kv[key] = (val, vn, w)
+            _merge_snapshot(self._store, self._kv, self._maxkeys, snap)
+            self._wal_append({"op": "snap",
+                              "hb": snap.get("hb") or {},
+                              "kv": snap.get("kv") or {},
+                              "maxkeys": list(snap.get("maxkeys") or [])})
 
     def start(self):
         self._started = True
@@ -482,6 +581,10 @@ class KVServer:
             # started server it would block forever
             self._httpd.shutdown()
         self._httpd.server_close()
+        with self._lock:
+            f, self._wal[0] = self._wal[0], None
+        if f is not None:
+            f.close()
 
 
 class KVRegistry:

@@ -684,18 +684,26 @@ class KVPeerSet:
     """
 
     def __init__(self, n: int, ttl: float = 10.0, host: str = "127.0.0.1",
-                 probe_s: float = 0.5):
+                 probe_s: float = 0.5, wal_dir: str | None = None):
         if n < 1:
             raise ValueError(f"kv peer count must be >= 1, got {n}")
         self.ttl, self.host, self.probe_s = float(ttl), host, float(probe_s)
+        self.wal_dir = wal_dir
+        if wal_dir:
+            os.makedirs(wal_dir, exist_ok=True)
         self._lk = threading.Lock()
         self._servers: list[KVServer | None] = [
-            KVServer(ttl=self.ttl) for _ in range(n)]
+            KVServer(ttl=self.ttl, wal_path=self._wal_path(i))
+            for i in range(n)]
         self._ports = [s.port for s in self._servers]
         self._misses = [0] * n      # consecutive failed probes per slot
         self._blocked: set = set()  # slots whose revive awaits coverage
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _wal_path(self, i: int) -> str | None:
+        return os.path.join(self.wal_dir, f"peer{i}.wal") \
+            if self.wal_dir else None
 
     @property
     def endpoints(self) -> list[str]:
@@ -763,7 +771,9 @@ class KVPeerSet:
         ep = f"{self.host}:{self._ports[i]}"
         others = [e for j, e in enumerate(self.endpoints) if j != i]
         snaps = fetch_snapshots(others)
-        if len(snaps) < need:
+        wal = self._wal_path(i)
+        has_wal = bool(wal) and os.path.exists(wal)
+        if len(snaps) < need and not has_wal:
             # not enough survivors answered to restore what this peer
             # may have acked — do NOT serve a hole into majority reads;
             # the supervisor retries next tick. (With a majority of
@@ -782,7 +792,10 @@ class KVPeerSet:
                     peer=ep, have=len(snaps), need=need)
             return False
         try:
-            srv = KVServer(port=self._ports[i], ttl=self.ttl)
+            # the WAL replays this peer's own acked writes on
+            # construction — that is exactly the data the coverage gate
+            # protects, so a WAL-backed peer may revive below coverage
+            srv = KVServer(port=self._ports[i], ttl=self.ttl, wal_path=wal)
         except OSError:
             return False  # port still draining; next probe retries
         # merge BEFORE start(): the bound port only queues connections
@@ -828,12 +841,19 @@ def main(argv=None) -> int:
     p.add_argument("--catch-up-from", default="",
                    help="comma peer list to merge /dump snapshots from "
                         "before serving (peer restart)")
+    p.add_argument("--wal", default="",
+                   help="write-ahead file: committed mutations are "
+                        "appended (fsynced) and replayed before serving, "
+                        "so a restart keeps every acked write even when "
+                        "no live peer has a snapshot")
     args = p.parse_args(argv)
-    # bind first (clients' connections queue in the backlog), merge the
-    # survivors' snapshots into the still-silent store, THEN serve — a
-    # blank restarted peer answering reads before the merge would punch
-    # a hole into majority reads exactly where its forgotten acks were
-    server = KVServer(port=args.port, ttl=args.ttl)
+    # bind first (clients' connections queue in the backlog), replay the
+    # WAL and merge the survivors' snapshots into the still-silent
+    # store, THEN serve — a blank restarted peer answering reads before
+    # the merge would punch a hole into majority reads exactly where its
+    # forgotten acks were
+    server = KVServer(port=args.port, ttl=args.ttl,
+                      wal_path=args.wal or None)
     merged = 0
     if args.catch_up_from:
         for snap in fetch_snapshots(args.catch_up_from,

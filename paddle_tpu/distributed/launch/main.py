@@ -185,8 +185,10 @@ def _make_elastic(args, node_id: str):
                 # and catches up from a majority snapshot, and every
                 # registry op below commits on a majority, so no single
                 # peer is load-bearing anymore
+                from ...utils import env_flags as _flags
+                wal_dir = _flags.get("PADDLE_KV_WAL_DIR") or None
                 server = KVPeerSet(args.kv_replicas, ttl=ttl,
-                                   host=host).start()
+                                   host=host, wal_dir=wal_dir).start()
                 ep = ",".join(server.endpoints)
                 print(f"[launch] elastic KV peers at {ep} "
                       f"(majority {args.kv_replicas // 2 + 1}/"

@@ -48,6 +48,10 @@ SEED_VAR = "PADDLE_CHAOS_SEED"
 # at least one test; hit() itself warns once per unregistered site at
 # runtime. Keep it sorted.
 SITES: dict[str, str] = {
+    "autoscale.decide": "one autoscale controller decision for one pool "
+                        "(fault = no action this window + a flight "
+                        "record; hysteresis counters freeze, the fleet "
+                        "never wedges or flaps)",
     "ckpt.rename":     "between a shard's tmp-write and its atomic rename",
     "ckpt.write":      "before a checkpoint shard file is written",
     "collective.wait": "before a blocking collective wait/barrier",
@@ -98,6 +102,10 @@ SITES: dict[str, str] = {
                          "identical, never a wedge)",
     "telemetry.export": "before an external metric-sink push",
     "telemetry.push":  "before a fleet telemetry report is sent",
+    "warmstart.fetch": "before a warm-start fetch (/warm_cache or "
+                       "/weights) from a peer replica (fault degrades "
+                       "the scale-out to a cold start — compiled/"
+                       "initialized locally, token-identical, slower)",
 }
 
 _warned_unregistered: set[str] = set()

@@ -1,0 +1,430 @@
+"""SLO-driven autoscaler (ISSUE 16): the fleet grows and shrinks itself.
+
+``AutoscaleController`` is a control loop hosted beside the router /
+rank-0 launcher (``PADDLE_AUTOSCALE=1``). Once per observation window it
+reads the fleet's existing signals — heartbeat leases and each replica's
+``/health`` doc — and moves the prefill and decode pools
+**independently**:
+
+  * **pressure** per pool = queued work / serving slots
+    (``sum(queue_depth) / sum(max_batch)`` over the pool's non-draining
+    replicas) — the same inputs admission already rejects on, read from
+    the docs the router already polls;
+  * **hysteresis** — pressure must exceed the high water for
+    ``PADDLE_AUTOSCALE_BREACH_WINDOWS`` consecutive windows to scale
+    out, and sit under the low water for
+    ``PADDLE_AUTOSCALE_IDLE_WINDOWS`` consecutive windows to scale in;
+  * **cooldown** — after ANY decision a pool makes no further decision
+    for ``PADDLE_AUTOSCALE_COOLDOWN_S`` (with hysteresis this is the
+    flapping bound: ≤1 decision per cooldown window under oscillating
+    load);
+  * **bounds** — per-pool ``PADDLE_AUTOSCALE_MIN``/``_MAX``; scale-in
+    never drains below the floor, scale-out never spawns past the
+    ceiling (in-flight spawns count against it).
+
+Scale-out goes through the actuator's ``scale_out(pool, warm_from)`` —
+the fleet spawner with a live same-pool donor endpoint, so the new
+replica warm-starts (``inference/warmstart.py``) and its lease appears
+only after it has served a warmup token. The controller times
+**breach-to-first-token** from the decision to that lease and feeds the
+``autoscale.breach_to_first_token_s`` histogram.
+
+Scale-in ALWAYS goes through the PR-9 drain protocol: POST ``/drain``,
+wait for the lease to leave and the process to exit clean, then reap. A
+replica with in-flight work is never killed; a drain stalled past
+``PADDLE_AUTOSCALE_DRAIN_TIMEOUT_S`` is flight-recorded and the drain
+re-POSTed — never force-escalated into lost requests.
+
+Every decision (trigger signals, direction, target pool, outcome) is a
+metric + flight event, and the whole ledger is served over the
+registered GET ``/autoscale`` route. Chaos at ``autoscale.decide``
+degrades one pool's window to "no action + recorded"; an observer or
+actuator error degrades the tick the same way — the loop never wedges
+and never kills anything as a fault reaction.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from collections import deque
+
+from ..distributed.resilience import chaos
+from ..observability import metrics, recorder as _recorder, slo as _slo
+from ..observability.admin import AdminServer, job_token
+from ..utils import env_flags
+from .replica import REPLICA_PREFIX
+
+__all__ = ["AutoscaleController", "RegistryObserver", "FleetActuator"]
+
+ENV_ON = "PADDLE_AUTOSCALE"
+ENV_INTERVAL = "PADDLE_AUTOSCALE_INTERVAL_S"
+ENV_BREACH_W = "PADDLE_AUTOSCALE_BREACH_WINDOWS"
+ENV_IDLE_W = "PADDLE_AUTOSCALE_IDLE_WINDOWS"
+ENV_HIGH = "PADDLE_AUTOSCALE_HIGH_WATER"
+ENV_LOW = "PADDLE_AUTOSCALE_LOW_WATER"
+ENV_COOLDOWN = "PADDLE_AUTOSCALE_COOLDOWN_S"
+ENV_MIN = "PADDLE_AUTOSCALE_MIN"
+ENV_MAX = "PADDLE_AUTOSCALE_MAX"
+ENV_DRAIN_TIMEOUT = "PADDLE_AUTOSCALE_DRAIN_TIMEOUT_S"
+
+
+def _pool_of(doc: dict) -> str:
+    return doc.get("role") or "unified"
+
+
+class RegistryObserver:
+    """The default observer: one fleet sample from the signals that
+    already exist — the lease table plus each replica's /health doc.
+    Returns a list of per-replica dicts; a replica whose probe fails is
+    reported with ``ready=False`` and zero capacity (it cannot serve, so
+    it contributes pressure relief of nothing) rather than dropped."""
+
+    def __init__(self, registry, timeout: float = 2.0):
+        self._registry = registry
+        self._timeout = timeout
+
+    def _probe(self, endpoint: str) -> dict:
+        req = urllib.request.Request(
+            endpoint + "/health",
+            headers={"X-Paddle-Job-Token": job_token()})
+        with urllib.request.urlopen(req, timeout=self._timeout) as r:
+            return json.loads(r.read().decode())
+
+    def __call__(self) -> list[dict]:
+        out = []
+        for node in self._registry.alive_nodes():
+            if not node.startswith(REPLICA_PREFIX):
+                continue
+            lease = self._registry.info(node) or {}
+            ep = lease.get("endpoint")
+            doc = {"name": node[len(REPLICA_PREFIX):], "lease": lease,
+                   "role": lease.get("role") or "unified",
+                   "endpoint": ep, "queue_depth": 0, "active_slots": 0,
+                   "max_batch": 0, "draining": False, "ready": False}
+            if ep:
+                try:
+                    h = self._probe(ep)
+                    doc.update(
+                        queue_depth=int(h.get("queue_depth", 0)),
+                        active_slots=int(h.get("active_slots", 0)),
+                        max_batch=int(h.get("max_batch",
+                                            lease.get("max_batch", 0))),
+                        draining=bool(h.get("draining")),
+                        ready=bool(h.get("ready")))
+                except Exception as e:
+                    _recorder.record("autoscale.probe_failed",
+                                     replica=node, endpoint=ep,
+                                     error=f"{type(e).__name__}: {e}")
+            out.append(doc)
+        return out
+
+
+class FleetActuator:
+    """The default actuator over a ServingFleet: spawn via
+    ``add_replica`` (with a warm-start donor), drain via POST /drain on
+    the replica's own AdminServer (the PR-9 protocol), collect via
+    ``reap`` — which never signals; the drained process exits itself."""
+
+    def __init__(self, fleet, timeout: float = 5.0):
+        self._fleet = fleet
+        self._timeout = timeout
+
+    def scale_out(self, pool: str, warm_from: str = "") -> str:
+        role = pool if pool in ("prefill", "decode") else "unified"
+        return self._fleet.add_replica(role=role, warm_from=warm_from)
+
+    def drain(self, name: str, endpoint: str) -> bool:
+        try:
+            req = urllib.request.Request(
+                endpoint + "/drain", method="POST", data=b"{}",
+                headers={"X-Paddle-Job-Token": job_token(),
+                         "Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                r.read()
+            return True
+        except Exception as e:
+            _recorder.record("autoscale.drain_post_failed", replica=name,
+                             endpoint=endpoint,
+                             error=f"{type(e).__name__}: {e}")
+            return False
+
+    def reap(self, name: str) -> int | None:
+        return self._fleet.reap(name, timeout=0.1)
+
+
+class AutoscaleController:
+    """ctl = AutoscaleController(observer, actuator).start(); ctl.stop()
+
+    ``observer`` is a callable → list of per-replica observation dicts
+    (see RegistryObserver); ``actuator`` exposes scale_out/drain/reap
+    (see FleetActuator). Tests drive ``tick()`` directly with stubs —
+    hysteresis, cooldown, bounds, and chaos behavior need no fleet."""
+
+    def __init__(self, observer, actuator,
+                 pools: tuple = ("unified",), *,
+                 interval_s: float | None = None,
+                 breach_windows: int | None = None,
+                 idle_windows: int | None = None,
+                 high_water: float | None = None,
+                 low_water: float | None = None,
+                 cooldown_s: float | None = None,
+                 min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 drain_timeout_s: float | None = None,
+                 status_port: int | None = None,
+                 host: str = "127.0.0.1"):
+        def _f(v, env):
+            return float(env_flags.get_float(env)) if v is None else float(v)
+
+        self._observer, self._actuator = observer, actuator
+        self.pools = tuple(pools)
+        self.interval_s = _f(interval_s, ENV_INTERVAL)
+        self.breach_windows = int(_f(breach_windows, ENV_BREACH_W))
+        self.idle_windows = int(_f(idle_windows, ENV_IDLE_W))
+        self.high_water = _f(high_water, ENV_HIGH)
+        self.low_water = _f(low_water, ENV_LOW)
+        self.cooldown_s = _f(cooldown_s, ENV_COOLDOWN)
+        self.min_replicas = int(_f(min_replicas, ENV_MIN))
+        self.max_replicas = int(_f(max_replicas, ENV_MAX))
+        self.drain_timeout_s = _f(drain_timeout_s, ENV_DRAIN_TIMEOUT)
+        self._lk = threading.Lock()
+        self._breach = {p: 0 for p in self.pools}
+        self._idle = {p: 0 for p in self.pools}
+        self._cooldown_until = {p: 0.0 for p in self.pools}
+        self._pending_out: dict[str, dict] = {}  # name -> spawn tracking
+        self._draining: dict[str, dict] = {}     # name -> drain tracking
+        self._decisions: deque = deque(maxlen=256)
+        self._windows = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._admin: AdminServer | None = None
+        if status_port is not None:
+            self._admin = AdminServer(
+                port=status_port, host=host,
+                get_routes={"/autoscale": self._h_status})
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "AutoscaleController":
+        if self._admin is not None:
+            self._admin.start()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._admin is not None:
+            self._admin.stop()
+
+    @property
+    def port(self) -> int | None:
+        return self._admin.port if self._admin is not None else None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:
+                # the controller loop NEVER wedges on one bad window —
+                # an observer/actuator fault is a recorded no-op
+                metrics.counter("autoscale.tick_errors").inc()
+                _recorder.record("autoscale.tick_error", echo=True,
+                                 message=f"[autoscale] tick failed: "
+                                         f"{type(e).__name__}: {e}",
+                                 error=f"{type(e).__name__}: {e}")
+
+    # ----------------------------------------------------------- status API
+    def _h_status(self, query: dict):
+        return 200, self.status()
+
+    def status(self) -> dict:
+        with self._lk:
+            return {"enabled": True, "pools": list(self.pools),
+                    "windows": self._windows,
+                    "breach": dict(self._breach),
+                    "idle": dict(self._idle),
+                    "pending_out": sorted(self._pending_out),
+                    "draining": sorted(self._draining),
+                    "decisions": [dict(d) for d in self._decisions]}
+
+    def decisions(self, action: str | None = None) -> list[dict]:
+        with self._lk:
+            out = [dict(d) for d in self._decisions]
+        return [d for d in out if action is None or d["action"] == action]
+
+    # ------------------------------------------------------------- one tick
+    def tick(self):
+        obs = self._observer()  # blocking HTTP: outside the lock
+        now = _slo.now()
+        plans = self._decide(obs, now)
+        for plan in plans:
+            self._actuate(plan, now)
+        self._settle(obs, now)
+
+    def _decide(self, obs: list[dict], now: float) -> list[dict]:
+        """Update hysteresis state and emit at most one plan per pool.
+        Pure bookkeeping under the lock; all actuation happens after."""
+        plans = []
+        with self._lk:
+            self._windows += 1
+            for pool in self.pools:
+                members = [o for o in obs if _pool_of(o) == pool]
+                active = [o for o in members if not o["draining"]
+                          and o["name"] not in self._draining]
+                slots = sum(o["max_batch"] for o in active)
+                queued = sum(o["queue_depth"] for o in active)
+                pressure = queued / slots if slots else 0.0
+                metrics.gauge(f"autoscale.pool_size.{pool}").set(
+                    len(active))
+                try:
+                    chaos.hit("autoscale.decide")
+                except chaos.ChaosError:
+                    # fault = NO ACTION this window, recorded — never a
+                    # wedge, never a kill, never a flap
+                    metrics.counter("autoscale.chaos_skips").inc()
+                    _recorder.record("autoscale.chaos_skip", pool=pool,
+                                     pressure=round(pressure, 4))
+                    continue
+                if pressure > self.high_water:
+                    self._breach[pool] += 1
+                    self._idle[pool] = 0
+                elif pressure < self.low_water:
+                    self._idle[pool] += 1
+                    self._breach[pool] = 0
+                else:
+                    self._breach[pool] = 0
+                    self._idle[pool] = 0
+                if now < self._cooldown_until[pool]:
+                    continue
+                n_out = sum(1 for d in self._pending_out.values()
+                            if d["pool"] == pool)
+                if self._breach[pool] >= self.breach_windows \
+                        and len(active) + n_out < self.max_replicas:
+                    donors = [o for o in active if o["ready"]
+                              and o["endpoint"]]
+                    plans.append({"action": "scale_out", "pool": pool,
+                                  "pressure": pressure,
+                                  "queued": queued, "slots": slots,
+                                  "warm_from": (donors[0]["endpoint"]
+                                                if donors else "")})
+                elif self._idle[pool] >= self.idle_windows \
+                        and len(active) > self.min_replicas:
+                    # drain the emptiest member (ties → newest name):
+                    # least in-flight work to finish, and the drain
+                    # protocol finishes even that — nothing is killed
+                    victim = min(
+                        active,
+                        key=lambda o: (o["queue_depth"]
+                                       + o["active_slots"],
+                                       -len(o["name"]), o["name"]))
+                    plans.append({"action": "scale_in", "pool": pool,
+                                  "pressure": pressure,
+                                  "queued": queued, "slots": slots,
+                                  "name": victim["name"],
+                                  "endpoint": victim["endpoint"] or ""})
+        return plans
+
+    def _actuate(self, plan: dict, now: float):
+        """Run one plan's blocking side effects, then commit its ledger
+        entry. A failed actuation is a recorded no-op — cooldown still
+        arms, so a broken spawner cannot be retried every window."""
+        pool = plan["pool"]
+        event = {"action": plan["action"], "pool": pool, "t": now,
+                 "pressure": round(plan["pressure"], 4),
+                 "queued": plan["queued"], "slots": plan["slots"],
+                 "outcome": "error"}
+        try:
+            if plan["action"] == "scale_out":
+                name = self._actuator.scale_out(
+                    pool, warm_from=plan["warm_from"])
+                event.update(name=name, warm_from=plan["warm_from"],
+                             outcome="spawned")
+                metrics.counter("autoscale.scale_out").inc()
+            else:
+                ok = self._actuator.drain(plan["name"], plan["endpoint"])
+                event.update(name=plan["name"],
+                             outcome="draining" if ok else "drain_failed")
+                metrics.counter("autoscale.scale_in").inc()
+        except Exception as e:
+            event["error"] = f"{type(e).__name__}: {e}"
+        metrics.counter("autoscale.decisions").inc()
+        _recorder.record("autoscale.decision", echo=True,
+                         message=f"[autoscale] {event['action']} "
+                                 f"pool={pool} pressure="
+                                 f"{event['pressure']} -> "
+                                 f"{event['outcome']}",
+                         **{k: v for k, v in event.items()
+                            if k != "action"},
+                         decision=event["action"])
+        with self._lk:
+            self._decisions.append(event)
+            self._cooldown_until[pool] = now + self.cooldown_s
+            self._breach[pool] = 0
+            self._idle[pool] = 0
+            if event["outcome"] == "spawned":
+                self._pending_out[event["name"]] = {"pool": pool,
+                                                    "t0": now}
+            elif event["outcome"] == "draining":
+                self._draining[event["name"]] = {
+                    "pool": pool, "t0": now,
+                    "endpoint": plan["endpoint"], "retries": 0}
+
+    def _settle(self, obs: list[dict], now: float):
+        """Resolve in-flight transitions: a pending spawn whose lease
+        appeared (breach-to-first-token lands here), and a draining
+        replica whose lease left and process exited. A drain stalled
+        past its deadline is flight-recorded and RE-POSTED — never
+        escalated to a kill."""
+        by_name = {o["name"]: o for o in obs}
+        with self._lk:
+            pending = dict(self._pending_out)
+            draining = dict(self._draining)
+        for name, rec in pending.items():
+            o = by_name.get(name)
+            if o is None:
+                continue
+            bft = now - rec["t0"]
+            lease = o.get("lease") or {}
+            metrics.histogram(
+                "autoscale.breach_to_first_token_s").observe(bft)
+            _recorder.record(
+                "autoscale.scale_out_ready", echo=True,
+                message=f"[autoscale] {name} serving after "
+                        f"{bft:.2f}s (warm={lease.get('warm')})",
+                replica=name, pool=rec["pool"],
+                breach_to_first_token_s=round(bft, 4),
+                ready_s=lease.get("ready_s"), warm=lease.get("warm"))
+            with self._lk:
+                self._pending_out.pop(name, None)
+        retry = []
+        for name, rec in draining.items():
+            gone = name not in by_name
+            rc = self._actuator.reap(name) if gone else None
+            if gone and rc is not None:
+                _recorder.record("autoscale.scale_in_done", echo=True,
+                                 message=f"[autoscale] {name} drained "
+                                         f"and reaped (rc={rc})",
+                                 replica=name, pool=rec["pool"], rc=rc)
+                with self._lk:
+                    self._draining.pop(name, None)
+                continue
+            if now - rec["t0"] > self.drain_timeout_s:
+                metrics.counter("autoscale.drain_retries").inc()
+                _recorder.record(
+                    "autoscale.drain_stalled", echo=True,
+                    message=f"[autoscale] drain of {name} stalled past "
+                            f"{self.drain_timeout_s}s — retrying the "
+                            "drain (never killing in-flight work)",
+                    replica=name, pool=rec["pool"],
+                    waited_s=round(now - rec["t0"], 2),
+                    retries=rec["retries"] + 1)
+                retry.append((name, rec["endpoint"]))
+                with self._lk:
+                    if name in self._draining:
+                        self._draining[name]["t0"] = now
+                        self._draining[name]["retries"] += 1
+        for name, endpoint in retry:
+            self._actuator.drain(name, endpoint)  # blocking: outside _lk

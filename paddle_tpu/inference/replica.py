@@ -102,9 +102,12 @@ class ReplicaServer:
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_s: float | None = None,
                  drain_grace_s: float | None = None,
-                 role: str | None = None):
+                 role: str | None = None, warm=None,
+                 lease_extra: dict | None = None):
         self._b = batcher
         self._registry = registry
+        self._warm = warm  # WarmStartCache | None (ISSUE 16 donor side)
+        self._lease_extra = dict(lease_extra or {})
         self.role = normalize_role(role if role is not None
                                    else env_flags.get(ENV_ROLE))
         self.replica_id = (name if name.startswith(REPLICA_PREFIX)
@@ -152,7 +155,9 @@ class ReplicaServer:
             extra={"serve": batcher.admin_summary, "replica": self.summary},
             health=self._health,
             get_routes={"/results": self._h_results,
-                        "/kv_blob": self._h_kv_blob},
+                        "/kv_blob": self._h_kv_blob,
+                        "/warm_cache": self._h_warm_cache,
+                        "/weights": self._h_weights},
             post_routes={"/enqueue": self._h_enqueue,
                          "/kv_transfer": self._h_kv_transfer,
                          "/drain": self._h_drain})
@@ -191,8 +196,13 @@ class ReplicaServer:
         self._admin.stop()
 
     def _lease_info(self) -> dict:
-        return {"endpoint": self.endpoint, "pid": os.getpid(),
+        info = {"endpoint": self.endpoint, "pid": os.getpid(),
                 "max_batch": self._b.B, "role": self.role}
+        # warm-start/rejoin breadcrumbs (ISSUE 16): ready_s, warm, gen —
+        # the autoscale controller reads these off the lease it was
+        # already watching, no extra probe
+        info.update(self._lease_extra)
+        return info
 
     # ------------------------------------------------------- HTTP handlers
     def _health(self) -> dict:
@@ -316,6 +326,26 @@ class ReplicaServer:
                 # bug, not capacity — answer loudly, never a torn frame
                 return 400, {"ok": False, "reason": f"bad slice: {e}"}
         return 200, frame
+
+    def _h_warm_cache(self, query: dict):
+        """GET /warm_cache?spec=<hash> — warm-start donor (ISSUE 16):
+        this replica's jit executable cache as one tar frame. 404 when
+        warm start is disabled here (no WarmStartCache wired) — the
+        fetcher's cold-path fallback, same as a spec mismatch."""
+        if self._warm is None:
+            return 404, {"ok": False,
+                         "reason": "warm start disabled on this replica "
+                                   "(PADDLE_WARMSTART=0)"}
+        return self._warm.handle_warm_cache(query)
+
+    def _h_weights(self, query: dict):
+        """GET /weights?spec=<hash> — the donor's params pytree as one
+        npz frame; 404 when warm start is disabled here."""
+        if self._warm is None:
+            return 404, {"ok": False,
+                         "reason": "warm start disabled on this replica "
+                                   "(PADDLE_WARMSTART=0)"}
+        return self._warm.handle_weights(query)
 
     def _h_kv_transfer(self, body):
         """POST /kv_transfer — the disagg page-transfer boundary (ISSUE
@@ -682,29 +712,63 @@ class ReplicaServer:
 
 # ------------------------------------------------------------ process entry
 
-def build_batcher(spec: dict) -> ContinuousBatcher:
-    """A batcher from a JSON-able spec: {"config": {LlamaConfig kwargs,
-    "dtype": "float32"}, "seed": 0, "batcher": {ContinuousBatcher kwargs}}.
-    Every replica of a fleet builds from the SAME spec, so weights are
-    identical across replicas and a failover retry at temperature=0 is
-    token-identical to the first attempt."""
-    import jax
+def _spec_config(spec: dict):
     import jax.numpy as jnp
 
-    from ..models.llama import LlamaConfig, llama_init_params
+    from ..models.llama import LlamaConfig
 
     ckw = dict(spec.get("config") or {})
     if "dtype" in ckw:
         ckw["dtype"] = jnp.dtype(ckw["dtype"])
-    cfg = LlamaConfig(**ckw)
-    params = llama_init_params(cfg, jax.random.PRNGKey(int(spec.get("seed",
-                                                                    0))))
+    return LlamaConfig(**ckw)
+
+
+def build_params(spec: dict):
+    """The seeded parameter pytree the spec describes — what every
+    replica of the fleet serves. Warm start fetches these SAME values
+    from a peer instead of initializing (bit-identical either way)."""
+    import jax
+
+    from ..models.llama import llama_init_params
+
+    return llama_init_params(_spec_config(spec),
+                             jax.random.PRNGKey(int(spec.get("seed", 0))))
+
+
+def build_batcher(spec: dict, params=None) -> ContinuousBatcher:
+    """A batcher from a JSON-able spec: {"config": {LlamaConfig kwargs,
+    "dtype": "float32"}, "seed": 0, "batcher": {ContinuousBatcher kwargs}}.
+    Every replica of a fleet builds from the SAME spec, so weights are
+    identical across replicas and a failover retry at temperature=0 is
+    token-identical to the first attempt. ``params`` short-circuits the
+    seeded init with an identical tree fetched from a peer (ISSUE 16
+    warm start)."""
+    cfg = _spec_config(spec)
+    if params is None:
+        params = build_params(spec)
     bkw = dict(spec.get("batcher") or {})
     bkw.setdefault("temperature", 0.0)
     if isinstance(bkw.get("prompt_buckets"), list):
         bkw["prompt_buckets"] = tuple(bkw["prompt_buckets"])
     return ContinuousBatcher(cfg, params, admission=AdmissionPolicy(),
                              **bkw)
+
+
+def serve_warmup(batcher: ContinuousBatcher, role: str = "unified"):
+    """Run one tiny request through the batcher BEFORE the lease
+    registers: the replica's executables are compiled (or loaded from
+    the warm cache) and a token has actually been served by the time the
+    fleet can see the lease — "ready" means ready, not "will compile on
+    your first request"."""
+    po = role == "prefill"
+    local = batcher.add_request([1, 2, 3], 2, force=True, prefill_only=po)
+    while batcher.pending:
+        batcher.step()
+        for lid, req in batcher.take_finished().items():
+            if req.reason == "prefilled":
+                batcher.drop_parked(lid)
+    batcher.take_finished()
+    return local
 
 
 def main(argv=None) -> int:
@@ -731,7 +795,19 @@ def main(argv=None) -> int:
     p.add_argument("--role", default=env_flags.get(ENV_ROLE),
                    help="replica role: prefill | decode | unified "
                         "(default PADDLE_SERVE_ROLE, else unified)")
+    p.add_argument("--cache-dir",
+                   default=env_flags.get("PADDLE_WARMSTART_CACHE_DIR"),
+                   help="persistent jit cache dir for this replica "
+                        "(PADDLE_WARMSTART=1: populated locally, "
+                        "exported via /warm_cache, installable from a "
+                        "peer)")
+    p.add_argument("--warm-from",
+                   default=env_flags.get("PADDLE_WARMSTART_PEER"),
+                   help="host:port of a live peer replica to fetch the "
+                        "jit cache + weights from before building "
+                        "(PADDLE_WARMSTART=1; empty = cold start)")
     args = p.parse_args(argv)
+    t0 = _slo.now()  # breach-to-first-token starts at process main
 
     raw = args.spec
     if raw.startswith("@"):
@@ -752,15 +828,58 @@ def main(argv=None) -> int:
     else:
         p.error("--registry-root or --registry-endpoint required")
 
-    batcher = build_batcher(spec)
+    # warm start (ISSUE 16): cache + weights from a peer, warmup BEFORE
+    # the lease registers — a visible lease means compiled-and-served
+    warm_on = env_flags.get_bool("PADDLE_WARMSTART")
+    warm_cache = None
+    params = None
+    warm_used = {"cache": False, "weights": False}
+    if warm_on:
+        from .warmstart import (WarmStartCache, enable_jit_cache,
+                                fetch_warm_cache, fetch_weights,
+                                spec_hash)
+        shash = spec_hash(spec)
+        if args.cache_dir:
+            if args.warm_from:
+                warm_used["cache"] = fetch_warm_cache(
+                    args.warm_from, shash, args.cache_dir) is not None
+            enable_jit_cache(args.cache_dir)
+        if args.warm_from:
+            params = fetch_weights(args.warm_from, shash)
+            warm_used["weights"] = params is not None
+        if params is None:
+            params = build_params(spec)  # cold: seeded init, same values
+    batcher = build_batcher(spec, params=params)
+    role = normalize_role(args.role)
+    if warm_on:
+        serve_warmup(batcher, role)
+        warm_cache = WarmStartCache(spec, args.cache_dir or None,
+                                    params=params)
+    ready_s = _slo.now() - t0
+    # rejoin breadcrumb: adopt the fleet generation (the re-rendezvous
+    # counter behind ElasticManager.behind_generation()) so a stale lease
+    # from an older fleet formation is distinguishable on sight
+    gen = None
+    try:
+        if hasattr(registry, "kv_counter"):
+            gen = int(registry.kv_counter("gen"))
+    except Exception:
+        gen = None
+    lease_extra = {"ready_s": round(ready_s, 4),
+                   "warm": warm_used["cache"] or warm_used["weights"]}
+    if gen is not None:
+        lease_extra["gen"] = gen
     rep = ReplicaServer(batcher, registry, args.name, host=args.host,
-                        port=args.port, role=args.role)
+                        port=args.port, role=args.role, warm=warm_cache,
+                        lease_extra=lease_extra)
     signal.signal(signal.SIGTERM, lambda *a: rep.begin_drain())
     rep.start()
     # one machine-readable line for the spawner, then serve until drained
     print(json.dumps({"replica": rep.replica_id,  # observability: ok (spawner handshake line on stdout, not runtime telemetry)
                       "endpoint": rep.endpoint,
                       "role": rep.role,
+                      "ready_s": round(ready_s, 4),
+                      "warm": warm_used,
                       "pid": os.getpid()}), flush=True)
     while not rep.join(timeout=60.0):
         pass

@@ -927,6 +927,7 @@ class ServingFleet:
                               else "decode" if self.n_prefill
                               else "unified")
                        for i, name in enumerate(self._names)}
+        self._spawn_extra: dict[str, list] = {}  # per-replica CLI extras
 
     def start(self, timeout: float = 60.0) -> "ServingFleet":
         for name in self._names:
@@ -943,12 +944,20 @@ class ServingFleet:
             reg_args = ["--registry-endpoint", self.registry_endpoint]
         else:
             reg_args = ["--registry-root", self.root]
+        extra = list(self._spawn_extra.get(name, ()))
+        if self._env.get("PADDLE_WARMSTART") == "1" \
+                and "--cache-dir" not in extra:
+            # warm-started fleets give every replica its OWN persistent
+            # jit cache dir — donors populate theirs during warmup, a
+            # scale-out fetches a donor's into its own
+            extra += ["--cache-dir",
+                      os.path.join(self.root, f"{name}.jitcache")]
         proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.inference.replica",
              "--name", name, "--spec", json.dumps(self.spec),
              *reg_args, "--job-id", self.job_id,
              "--ttl", str(self.ttl), "--host", self.host,
-             "--role", role],
+             "--role", role, *extra],
             stdout=log, stderr=subprocess.STDOUT, cwd=_REPO_ROOT,
             env=self._env)
         log.close()  # the child holds the fd
@@ -992,6 +1001,52 @@ class ServingFleet:
 
     def kill(self, name: str, sig: int = 9):
         self._procs[name].send_signal(sig)
+
+    # ------------------------------------------- autoscale actuators (16)
+    def add_replica(self, name: str | None = None, role: str = "unified",
+                    warm_from: str = "") -> str:
+        """Scale-out actuator: spawn ONE new replica into the running
+        fleet. ``warm_from`` (a live peer's host:port) rides to the
+        child as ``--warm-from`` so it fetches the jit cache + weights
+        instead of compiling cold. Returns the replica name; its lease
+        appearing in the registry is the ready signal."""
+        if name is None:
+            i = 0
+            while f"r{i}" in self._roles:
+                i += 1
+            name = f"r{i}"
+        if name in self._procs and self._procs[name].poll() is None:
+            raise ValueError(f"replica {name} is already running")
+        if name not in self._names:
+            self._names.append(name)
+        self._roles[name] = role
+        if warm_from:
+            self._spawn_extra[name] = ["--warm-from", warm_from]
+        else:
+            self._spawn_extra.pop(name, None)
+        self.spawn(name)
+        return name
+
+    def reap(self, name: str, timeout: float = 5.0) -> int | None:
+        """Scale-in collector: wait for a DRAINED replica's process to
+        exit and forget it. Never signals — the drain protocol owns the
+        exit; a process that hasn't exited yet answers None and the
+        controller retries next window."""
+        p = self._procs.get(name)
+        if p is None:
+            return None
+        rc = p.poll()
+        if rc is None:
+            try:
+                rc = p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return None
+        self._procs.pop(name, None)
+        self._spawn_extra.pop(name, None)
+        if name in self._names:
+            self._names.remove(name)
+        self._roles.pop(name, None)
+        return rc
 
     def replica_id(self, name: str) -> str:
         return REPLICA_PREFIX + name

@@ -90,6 +90,21 @@ ROUTES = {
         "methods": ("POST",), "statuses": (200,),
         "doc": "begin the drain protocol (finish accepted, reject new, "
                "deregister, exit clean)"},
+    "/warm_cache": {
+        "methods": ("GET",), "statuses": (200, 400, 404),
+        "doc": "?spec=<hash> jit executable-cache archive for warm start, "
+               "raw octet-stream (400: spec param missing, 404: hash "
+               "mismatch / no cache dir — fetcher falls back cold)"},
+    "/weights": {
+        "methods": ("GET",), "statuses": (200, 400, 404),
+        "doc": "?spec=<hash> packed model weights for warm start, raw "
+               "octet-stream (400: spec param missing, 404: hash "
+               "mismatch — fetcher falls back to seeded init)"},
+    # ---- autoscale controller face (inference/autoscale.py) ----
+    "/autoscale": {
+        "methods": ("GET",), "statuses": (200,),
+        "doc": "controller status: pools, hysteresis counters, in-flight "
+               "spawns/drains, and the bounded decision ledger"},
     # ---- elastic KV registry (distributed/fleet/elastic.py KVServer) ----
     "/hb": {
         "methods": ("PUT", "DELETE"), "statuses": (200,),

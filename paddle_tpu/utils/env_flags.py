@@ -159,6 +159,11 @@ declare("PADDLE_KV_QUORUM_TIMEOUT_S", "5",
 declare("PADDLE_KV_REPLICAS", "1",
         "registry peer count the launcher spawns with --elastic_server "
         "auto (in-process peer set, supervised + snapshot catch-up)")
+declare("PADDLE_KV_WAL_DIR", "",
+        "directory for per-peer replicated-registry write-ahead files "
+        "(peer<i>.wal): committed mutations are fsynced and replayed on "
+        "restart, so a majority simultaneous crash loses no acked write "
+        "(empty = memory-only peers, the pre-WAL behavior)")
 
 # ----------------------------------------------------------- observability
 
@@ -324,6 +329,55 @@ declare("PADDLE_SERVE_KV_SCALE_GRAN", "",
 declare("PADDLE_SERVE_XFER_TIMEOUT_S", "15",
         "HTTP timeout for a KV page-transfer POST (/kv_transfer ships "
         "megabytes where a health probe ships a doc)")
+
+# ----------------------------------------------------- elastic autoscaling
+
+declare("PADDLE_AUTOSCALE", "0",
+        "'1' runs the SLO-driven autoscale controller beside the router: "
+        "prefill/decode pools grow on sustained breach and shrink (via "
+        "drain) on sustained idle, independently per pool")
+declare("PADDLE_AUTOSCALE_INTERVAL_S", "1.0",
+        "controller observation-window length in seconds (one pool "
+        "pressure sample + at most one decision per window per pool)")
+declare("PADDLE_AUTOSCALE_BREACH_WINDOWS", "3",
+        "hysteresis N: pool pressure must exceed the high water for this "
+        "many consecutive windows before a scale-out")
+declare("PADDLE_AUTOSCALE_IDLE_WINDOWS", "5",
+        "hysteresis M: pool pressure must sit below the low water for "
+        "this many consecutive windows before a scale-in")
+declare("PADDLE_AUTOSCALE_HIGH_WATER", "1.0",
+        "scale-out threshold on pool pressure (queued work / pool serving "
+        "slots); >1.0 means a standing queue beyond capacity")
+declare("PADDLE_AUTOSCALE_LOW_WATER", "0.1",
+        "scale-in threshold on pool pressure — below it the pool is idle "
+        "enough to drain its newest surplus replica")
+declare("PADDLE_AUTOSCALE_COOLDOWN_S", "10",
+        "per-pool cooldown after any decision: no further decision for "
+        "this many seconds (the flapping bound, with hysteresis)")
+declare("PADDLE_AUTOSCALE_MIN", "1",
+        "per-pool floor: scale-in never drains below this many replicas")
+declare("PADDLE_AUTOSCALE_MAX", "4",
+        "per-pool ceiling: scale-out never spawns beyond this many "
+        "replicas")
+declare("PADDLE_AUTOSCALE_DRAIN_TIMEOUT_S", "60",
+        "deadline for a scale-in drain: past it the stall is flight-"
+        "recorded and the drain retried — never force-killed (in-flight "
+        "work is never lost to the autoscaler)")
+declare("PADDLE_WARMSTART", "0",
+        "'1' enables warm scale-out: a new replica fetches the jit "
+        "executable cache and weights from a live peer over HTTP instead "
+        "of compiling/loading cold, then serves a warmup token before "
+        "registering its lease")
+declare("PADDLE_WARMSTART_CACHE_DIR", "",
+        "this replica's jit persistent-cache directory (populated by "
+        "jax's compilation cache; exported to peers via /warm_cache; "
+        "empty = no persistent cache, cold compilation)")
+declare("PADDLE_WARMSTART_PEER", "",
+        "host:port of the peer replica to warm-start from (the "
+        "controller passes the donor explicitly; empty = cold start)")
+declare("PADDLE_WARMSTART_TIMEOUT_S", "20",
+        "HTTP timeout for one warm-start fetch (/warm_cache or /weights "
+        "— archives ship megabytes where a health probe ships a doc)")
 
 # ------------------------------------------------------------------- misc
 

@@ -1162,6 +1162,10 @@ class TestFleetBenchContract:
             assert k in fs
         for stats in fs["per_replica"].values():
             assert set(stats) == {"ttft_p50", "ttft_p95", "count"}
+        # the autoscale sub-object is ABSENT (not null) with the
+        # controller off — its presence half is pinned in
+        # test_autoscale.py on its own bench run
+        assert "autoscale" not in doc
         # single-process absence (fleet_serve None) is asserted on the
         # already-paid-for bench run in test_ragged_attention.py
 

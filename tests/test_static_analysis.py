@@ -1021,6 +1021,101 @@ class TestBlockingUnderLock:
         assert run(str(tmp_path), rule_ids=["A7"]) == []
 
 
+# ---------------------------- fixtures: A5/A6/A7 on the autoscale surface
+
+class TestAutoscaleSurfaceInScope:
+    """ISSUE 16: the autoscaler is a lock-using, HTTP-touching concurrent
+    class living at ``paddle_tpu/inference/autoscale.py`` — exactly the
+    surface A5/A6/A7 police. These fixtures pin that the scope covers it
+    (and the warm-start module) by planting each defect class at those
+    literal paths, plus the shipped files staying clean."""
+
+    def test_a5_unlocked_hysteresis_counter_trips(self, tmp_path):
+        # the one race an autoscaler must not have: hysteresis counters
+        # bumped outside the decision lock double-count under a
+        # concurrent status read
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/autoscale.py":
+                "import threading\n"
+                "class Controller:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "        self._breach = 0\n"
+                "    def tick(self, pressure):\n"
+                "        with self._lk:\n"
+                "            pass\n"
+                "        if pressure > 1.0:\n"
+                "            self._breach += 1\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A5"])
+        assert len(findings) == 1 and findings[0].line == 10
+        assert "read-modify-write" in findings[0].message
+
+    def test_a6_controller_cache_inversion_trips(self, tmp_path):
+        # controller holds its decision lock while asking the warm cache
+        # to pack; the cache's eviction path locks itself then reads the
+        # controller's ledger — opposite orders across the two modules
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/autoscale.py": """\
+                import threading
+                class Controller:
+                    def __init__(self, cache):
+                        self._lk = threading.Lock()
+                        self._cache = cache
+                    def decide(self):
+                        with self._lk:
+                            self._cache.export()
+                """,
+            "paddle_tpu/inference/warmstart.py": """\
+                import threading
+                class WarmCache:
+                    def __init__(self):
+                        self._lk = threading.Lock()
+                    def export(self):
+                        with self._lk:
+                            pass
+                    def evict(self, controller):
+                        with self._lk:
+                            with controller._lk:
+                                pass
+                """,
+        })
+        findings = run(str(tmp_path), rule_ids=["A6"])
+        assert len(findings) == 1 and "cycle" in findings[0].message
+        assert "autoscale.py:" in findings[0].message \
+            and "warmstart.py:" in findings[0].message
+
+    def test_a7_probe_under_decision_lock_trips(self, tmp_path):
+        # the tempting bug: /health probes (urlopen) inside the decision
+        # lock — one unresponsive replica freezes status() for everyone.
+        # The shipped controller observes OUTSIDE the lock; this pins
+        # the analyzer catching the inverse.
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/autoscale.py":
+                "import threading, urllib.request\n"
+                "class Controller:\n"
+                "    def __init__(self):\n"
+                "        self._lk = threading.Lock()\n"
+                "    def tick(self):\n"
+                "        with self._lk:\n"
+                "            urllib.request.urlopen('http://x/health')\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A7"])
+        assert len(findings) == 1 and findings[0].line == 7
+        assert "urlopen" in findings[0].message
+
+    def test_shipped_autoscale_and_warmstart_are_clean(self, tmp_path):
+        # the real modules, verbatim, under all three passes: the
+        # controller's decide-under-lock / actuate-outside-lock split is
+        # load-bearing, not stylistic
+        for rel in ("paddle_tpu/inference/autoscale.py",
+                    "paddle_tpu/inference/warmstart.py"):
+            dst = tmp_path / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copyfile(os.path.join(REPO, rel), dst)
+        assert run(str(tmp_path), rule_ids=["A5", "A6", "A7"]) == []
+
+
 # --------------------------------------------- fixtures: A8 wire contract
 
 _ROUTES_REG = """\

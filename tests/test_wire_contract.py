@@ -178,6 +178,72 @@ class TestReplicaDrainWire:
             rep._admin.stop()
 
 
+class TestWarmStartWire:
+    """GET /warm_cache and /weights (ISSUE 16): the warm-start faces a
+    scale-out replica fetches from — driven over real HTTP against a
+    ReplicaServer carrying a WarmStartCache."""
+
+    def test_warm_cache_and_weights_routes(self, tmp_path):
+        import numpy as np
+        from paddle_tpu.inference.replica import ReplicaServer
+        from paddle_tpu.inference.warmstart import (
+            WarmStartCache, unpack_cache_archive, unpack_params)
+        cd = tmp_path / "jitcache"
+        cd.mkdir()
+        (cd / "entry0").write_bytes(b"xla-bits")
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        warm = WarmStartCache({"hidden": 8}, str(cd), params=params)
+        rep = ReplicaServer(_StubBatcher(), FileRegistry(str(tmp_path),
+                                                         "wire"),
+                            "w1", warm=warm)
+        rep._admin.start()
+        try:
+            base = rep.endpoint
+            st, body, _ = _req(base, f"/warm_cache?spec={warm.hash}")
+            assert st == 200 and body
+            dest = tmp_path / "dest"
+            assert unpack_cache_archive(body, str(dest)) == 1
+            assert (dest / "entry0").read_bytes() == b"xla-bits"
+            # hash mismatch -> the declared 404 (drifted fleet goes cold)
+            st, _, _ = _req(base, "/warm_cache?spec=deadbeef")
+            assert st == 404
+            # missing spec param -> the declared 400
+            st, _, _ = _req(base, "/warm_cache")
+            assert st == 400
+            st, body, _ = _req(base, f"/weights?spec={warm.hash}")
+            assert st == 200
+            p2 = unpack_params(body)
+            np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                          params["w"])
+            st, _, _ = _req(base, "/weights?spec=deadbeef")
+            assert st == 404
+            st, _, _ = _req(base, "/weights")
+            assert st == 400
+        finally:
+            rep._admin.stop()
+
+
+class TestAutoscaleStatusWire:
+    def test_autoscale_route_serves_status(self):
+        """GET /autoscale on the controller's own AdminServer: the
+        declared 200 with pools + hysteresis + the decision ledger."""
+        from paddle_tpu.inference.autoscale import AutoscaleController
+        ctl = AutoscaleController(lambda: [], None, ("prefill", "decode"),
+                                  interval_s=900.0, status_port=0)
+        ctl.start()
+        try:
+            base = f"http://127.0.0.1:{ctl.port}"
+            st, body, _ = _req(base, "/autoscale", token=False)
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["enabled"] is True
+            assert doc["pools"] == ["prefill", "decode"]
+            assert doc["decisions"] == []
+            assert set(doc["breach"]) == {"prefill", "decode"}
+        finally:
+            ctl.stop()
+
+
 class TestAdminRouteMirror:
     """admin.unregistered_route: the runtime mirror of rule A8 — exactly
     the warn-once/never-raise contract chaos.hit keeps for sites."""

@@ -58,6 +58,15 @@ HTTP_ALLOWLIST = {
         "serving-fleet router CLIENT of replica AdminServers (/enqueue, "
         "/results, /health, /drain) — request data plane, token-authed, "
         "lease-gated; the replica SERVER side extends AdminServer",
+    "paddle_tpu/inference/autoscale.py":
+        "autoscale controller CLIENT of replica AdminServers (/health "
+        "probes, /drain) — the observe/actuate plane over the same "
+        "token-authed transport the router uses; its own status route "
+        "extends AdminServer",
+    "paddle_tpu/inference/warmstart.py":
+        "warm-start CLIENT of a peer replica's AdminServer (/warm_cache, "
+        "/weights) — executable-cache and weight data plane, "
+        "token-authed; the server side extends AdminServer",
 }
 
 
