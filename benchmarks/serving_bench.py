@@ -385,6 +385,11 @@ def _disagg_drill(n_prefill: int, n_decode: int) -> dict:
                 "transfer_faults": s["xfer_faults"],
                 "reprefills": s["reprefills"],
             },
+            # critical-path TTFT attribution (ISSUE 17): per-stage
+            # p50/p95 SHARES of TTFT from the router's trace assembler
+            # (None when tracing is off — PADDLE_REQTRACE=0)
+            "crit": (router.trace.bench_payload()
+                     if router.trace is not None else None),
         }
     finally:
         fleet.shutdown()

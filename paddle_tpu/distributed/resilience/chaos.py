@@ -102,6 +102,9 @@ SITES: dict[str, str] = {
                          "identical, never a wedge)",
     "telemetry.export": "before an external metric-sink push",
     "telemetry.push":  "before a fleet telemetry report is sent",
+    "trace.push":      "before a replica's retired-request span batch is "
+                       "shipped to the router (fault drops the batch — "
+                       "the trace degrades, serving tokens never change)",
     "warmstart.fetch": "before a warm-start fetch (/warm_cache or "
                        "/weights) from a peer replica (fault degrades "
                        "the scale-out to a cold start — compiled/"

@@ -50,7 +50,8 @@ import urllib.request
 from collections import deque
 
 from ..distributed.resilience import chaos
-from ..observability import metrics, recorder as _recorder, slo as _slo
+from ..observability import metrics, recorder as _recorder, \
+    reqtrace as _reqtrace, slo as _slo
 from ..observability.admin import AdminServer, job_token
 from ..utils import env_flags
 from .replica import REPLICA_PREFIX
@@ -67,6 +68,14 @@ ENV_COOLDOWN = "PADDLE_AUTOSCALE_COOLDOWN_S"
 ENV_MIN = "PADDLE_AUTOSCALE_MIN"
 ENV_MAX = "PADDLE_AUTOSCALE_MAX"
 ENV_DRAIN_TIMEOUT = "PADDLE_AUTOSCALE_DRAIN_TIMEOUT_S"
+ENV_SLO_SIGNAL = "PADDLE_AUTOSCALE_SLO"
+
+# which slo.breach.<dim> counters charge which pool (ISSUE 17 satellite):
+# TTFT and queue-wait breaches are prompt-side (the prefill pool's queue
+# and compute dominate time-to-first-token), TPOT/e2e breaches are
+# decode-side; a unified pool owns every dimension
+_SLO_DIMS = {"prefill": ("ttft", "queue"), "decode": ("tpot", "e2e"),
+             "unified": ("ttft", "queue", "tpot", "e2e")}
 
 
 def _pool_of(doc: dict) -> str:
@@ -172,6 +181,7 @@ class AutoscaleController:
                  min_replicas: int | None = None,
                  max_replicas: int | None = None,
                  drain_timeout_s: float | None = None,
+                 slo_signal: bool | None = None,
                  status_port: int | None = None,
                  host: str = "127.0.0.1"):
         def _f(v, env):
@@ -179,6 +189,17 @@ class AutoscaleController:
 
         self._observer, self._actuator = observer, actuator
         self.pools = tuple(pools)
+        # SLO breach-rate second trigger (ISSUE 17 satellite, off by
+        # default): a window in which a pool's attributed slo.breach.*
+        # counters advanced counts as a breach-window even when its queue
+        # pressure looks healthy — and blocks its scale-in
+        self.slo_signal = (env_flags.get_bool(ENV_SLO_SIGNAL)
+                           if slo_signal is None else bool(slo_signal))
+        # baseline NOW: breaches from before this controller existed must
+        # not fire its first window (counters are process-global monotone)
+        self._slo_last = {d: metrics.counter(f"slo.breach.{d}").value
+                          for dims in _SLO_DIMS.values() for d in dims}
+        self._breach_sig = {p: set() for p in pools}
         self.interval_s = _f(interval_s, ENV_INTERVAL)
         self.breach_windows = int(_f(breach_windows, ENV_BREACH_W))
         self.idle_windows = int(_f(idle_windows, ENV_IDLE_W))
@@ -243,6 +264,7 @@ class AutoscaleController:
     def status(self) -> dict:
         with self._lk:
             return {"enabled": True, "pools": list(self.pools),
+                    "slo_signal": self.slo_signal,
                     "windows": self._windows,
                     "breach": dict(self._breach),
                     "idle": dict(self._idle),
@@ -264,10 +286,22 @@ class AutoscaleController:
             self._actuate(plan, now)
         self._settle(obs, now)
 
+    def _slo_deltas(self) -> dict:
+        """Per-dimension slo.breach.<dim> counter advance since the last
+        window (reads the process-global counters the trackers already
+        feed — no new signal plumbing)."""
+        out = {}
+        for d in set(self._slo_last):
+            v = metrics.counter(f"slo.breach.{d}").value
+            out[d] = v - self._slo_last[d]
+            self._slo_last[d] = v
+        return out
+
     def _decide(self, obs: list[dict], now: float) -> list[dict]:
         """Update hysteresis state and emit at most one plan per pool.
         Pure bookkeeping under the lock; all actuation happens after."""
         plans = []
+        slo_delta = self._slo_deltas() if self.slo_signal else {}
         with self._lk:
             self._windows += 1
             for pool in self.pools:
@@ -288,15 +322,25 @@ class AutoscaleController:
                     _recorder.record("autoscale.chaos_skip", pool=pool,
                                      pressure=round(pressure, 4))
                     continue
-                if pressure > self.high_water:
+                slo_hits = sum(
+                    slo_delta.get(d, 0)
+                    for d in _SLO_DIMS.get(pool, _SLO_DIMS["unified"])) \
+                    if self.slo_signal else 0
+                if pressure > self.high_water or slo_hits > 0:
                     self._breach[pool] += 1
                     self._idle[pool] = 0
+                    if pressure > self.high_water:
+                        self._breach_sig[pool].add("pressure")
+                    if slo_hits > 0:
+                        self._breach_sig[pool].add("slo")
                 elif pressure < self.low_water:
                     self._idle[pool] += 1
                     self._breach[pool] = 0
+                    self._breach_sig[pool].clear()
                 else:
                     self._breach[pool] = 0
                     self._idle[pool] = 0
+                    self._breach_sig[pool].clear()
                 if now < self._cooldown_until[pool]:
                     continue
                 n_out = sum(1 for d in self._pending_out.values()
@@ -307,6 +351,9 @@ class AutoscaleController:
                               and o["endpoint"]]
                     plans.append({"action": "scale_out", "pool": pool,
                                   "pressure": pressure,
+                                  "signal": ("+".join(sorted(
+                                      self._breach_sig[pool]))
+                                      or "pressure"),
                                   "queued": queued, "slots": slots,
                                   "warm_from": (donors[0]["endpoint"]
                                                 if donors else "")})
@@ -321,7 +368,7 @@ class AutoscaleController:
                                        + o["active_slots"],
                                        -len(o["name"]), o["name"]))
                     plans.append({"action": "scale_in", "pool": pool,
-                                  "pressure": pressure,
+                                  "pressure": pressure, "signal": "idle",
                                   "queued": queued, "slots": slots,
                                   "name": victim["name"],
                                   "endpoint": victim["endpoint"] or ""})
@@ -334,6 +381,7 @@ class AutoscaleController:
         pool = plan["pool"]
         event = {"action": plan["action"], "pool": pool, "t": now,
                  "pressure": round(plan["pressure"], 4),
+                 "signal": plan.get("signal", "pressure"),
                  "queued": plan["queued"], "slots": plan["slots"],
                  "outcome": "error"}
         try:
@@ -359,11 +407,16 @@ class AutoscaleController:
                          **{k: v for k, v in event.items()
                             if k != "action"},
                          decision=event["action"])
+        # annotate overlapping request traces (ISSUE 17): a trace whose
+        # lifetime straddles this decision carries it under
+        # doc["autoscale"] — the postmortem reads WHY latency moved
+        _reqtrace.note_autoscale(event)
         with self._lk:
             self._decisions.append(event)
             self._cooldown_until[pool] = now + self.cooldown_s
             self._breach[pool] = 0
             self._idle[pool] = 0
+            self._breach_sig[pool].clear()
             if event["outcome"] == "spawned":
                 self._pending_out[event["name"]] = {"pool": pool,
                                                     "t0": now}

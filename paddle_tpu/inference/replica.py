@@ -45,7 +45,8 @@ import threading
 from collections import deque
 
 from ..distributed.fleet.elastic import FileRegistry
-from ..observability import metrics, recorder as _recorder, slo as _slo
+from ..observability import metrics, recorder as _recorder, \
+    reqtrace as _reqtrace, slo as _slo
 from ..observability.admin import AdminServer
 from ..utils import env_flags
 from .admission import AdmissionPolicy, AdmissionReject, \
@@ -150,12 +151,23 @@ class ReplicaServer:
         self._stop = threading.Event()
         self.crash: BaseException | None = None  # serve-loop death, if any
         self._rid_map: dict[int, tuple] = {}  # local rid -> (router rid, tid)
+        # distributed request tracing (ISSUE 17): the engine tracker hands
+        # every retire's span payload to this buffer; batches piggy-back
+        # on /results records (chaos site trace.push gates the ship) with
+        # /trace_pull as the cursor-addressed fallback. PADDLE_REQTRACE=0
+        # leaves the sink unset — spans are then never built.
+        self._tracebuf = _reqtrace.ReplicaSpanBuffer(self.replica_id,
+                                                     role=self.role)
+        slo_tracker = getattr(batcher, "slo", None)  # stubs have no slo
+        if _reqtrace.enabled() and slo_tracker is not None:
+            slo_tracker.trace_sink = self._tracebuf.publish
         self._admin = AdminServer(
             port=port, host=host,
             extra={"serve": batcher.admin_summary, "replica": self.summary},
             health=self._health,
             get_routes={"/results": self._h_results,
                         "/kv_blob": self._h_kv_blob,
+                        "/trace_pull": self._h_trace_pull,
                         "/warm_cache": self._h_warm_cache,
                         "/weights": self._h_weights},
             post_routes={"/enqueue": self._h_enqueue,
@@ -492,9 +504,26 @@ class ReplicaServer:
             cursor = base + len(self._results)
             draining = self._draining
             drained = self._drained_flag
-        return 200, {"results": out, "cursor": cursor, "base": base,
-                     "draining": draining, "drained": drained,
-                     "replica": self.replica_id}
+        doc = {"results": out, "cursor": cursor, "base": base,
+               "draining": draining, "drained": drained,
+               "replica": self.replica_id}
+        if _reqtrace.enabled():
+            # clock anchor stamped at RESPONSE time (not publish time):
+            # the router's minimum-filter offset estimate needs t_send ≈
+            # the moment the bytes leave, not when the batch was queued
+            doc["trace_clock"] = _reqtrace.clock_anchor()
+        return 200, doc
+
+    def _h_trace_pull(self, query: dict):
+        """GET /trace_pull?cursor=N — the retained retired-request span
+        batches after cursor N (ISSUE 17 fallback for a lost /results
+        piggy-back). Same cursor/base semantics as /results: a cursor
+        behind the base gets the oldest retained batches plus the base."""
+        try:
+            cursor = int(query.get("cursor", ["0"])[0])
+        except ValueError:
+            return 400, {"ok": False, "reason": "cursor must be an integer"}
+        return 200, self._tracebuf.pull(cursor)
 
     def _h_drain(self, body: dict):
         self.begin_drain()
@@ -648,6 +677,11 @@ class ReplicaServer:
                 self._kv_frames.pop(old, None)
 
     def _push_result(self, rid, tid, rtr, tokens, reason, kv=None):
+        # the retire's span batch (published by the tracker sink moments
+        # ago) rides OUT on the result record the router polls anyway —
+        # no new hop. collect() runs the trace.push chaos gate OUTSIDE
+        # self._lk; a faulted ship just means no "spans" key.
+        batch = self._tracebuf.collect(tid)
         with self._lk:
             # the (router, rid) key leaves the active set in the same
             # lock acquisition that publishes the result: a shed request
@@ -655,6 +689,8 @@ class ReplicaServer:
             self._active.discard((rtr, rid))
             rec = {"rid": rid, "trace_id": tid, "router": rtr,
                    "tokens": list(tokens), "reason": reason}
+            if batch is not None:
+                rec["spans"] = batch
             if kv is not None:
                 # a prefilled request's exported pages ride OUT on the
                 # result the router was polling for anyway — the transfer

@@ -58,7 +58,8 @@ from dataclasses import dataclass, field
 from ..distributed.fleet.elastic import FileRegistry
 from ..distributed.resilience import chaos
 from ..distributed.resilience.retry import classify
-from ..observability import metrics, recorder as _recorder, slo as _slo
+from ..observability import metrics, recorder as _recorder, \
+    reqtrace as _reqtrace, slo as _slo
 from ..observability.admin import job_token
 from .admission import AdmissionPolicy, AdmissionReject, reject as _reject, \
     retry_after_floor, slo_hists
@@ -119,6 +120,7 @@ class _Handle:
     queued_kv_pages: int = 0         # pages promised to queued transfers
     prefix_sharing: bool = False     # /kv_transfer probe worth a round trip
     evictable_pages: int = 0         # idle prefix-cache pages (reclaimable)
+    trace_cursor: int = 0            # /trace_pull read position (ISSUE 17)
     last_probe: float = field(default_factory=_slo.now)
 
     @property
@@ -183,6 +185,15 @@ class Router:
         # preempt at failover, retire exactly-once at the first result —
         # trace ids issued HERE flow to every replica attempt
         self.slo = _slo.RequestTracker(source="router")
+        # fleet-wide request tracing (ISSUE 17): the assembler is the
+        # tracker's trace_sink — every exactly-once retire folds the
+        # replica span batches (piggy-backed on /results) into ONE
+        # multi-process trace with critical-path attribution
+        self.trace = (_reqtrace.RouterTraceAssembler(self._rid_ns)
+                      if _reqtrace.enabled() else None)
+        if self.trace is not None:
+            self.slo.trace_sink = self.trace.on_router_retire
+        self._admin = None   # started on demand by start_admin()
         metrics.gauge("serve.fleet.replicas")
         # instance-scoped fleet counters (ISSUE 10 satellite, the PR-9
         # ROADMAP follow-up): summary() reads THESE, so two routers in
@@ -616,6 +627,10 @@ class Router:
         if doc is None:
             return None
         h.cursor = int(doc.get("cursor", h.cursor))
+        if self.trace is not None:
+            # BEFORE absorbing: a result record's piggy-backed span batch
+            # must be in the assembler when _absorb's retire assembles it
+            self.trace.ingest_results_doc(doc)
         for res in doc.get("results", []):
             # src: where this record physically came from — the disagg
             # frame fetch needs it even after the handle left the table
@@ -819,6 +834,67 @@ class Router:
             return True
         return False
 
+    def pull_traces(self) -> int:
+        """The ``/trace_pull`` fallback (ISSUE 17): drain every live
+        replica's cursor-addressed trace log. The piggy-back on /results
+        is the primary ship; this recovers batches whose piggy-back was
+        lost (a chaos-faulted ship, a result record evicted before the
+        poll) for postmortem reads. Returns the number of batches
+        ingested."""
+        if self.trace is None:
+            return 0
+        n = 0
+        for h in list(self._handles.values()):
+            doc = self._get(h.endpoint,
+                            f"/trace_pull?cursor={h.trace_cursor}")
+            if doc is None:
+                continue
+            n += len(doc.get("batches") or ())
+            self.trace.ingest_results_doc(doc,
+                                          source=doc.get("source") or h.id)
+            h.trace_cursor = max(int(doc.get("base", 0)),
+                                 int(doc.get("cursor", h.trace_cursor)))
+        return n
+
+    def _h_trace(self, query: dict):
+        """GET /trace?rid=<router rid>[&fmt=chrome] — the assembled
+        end-to-end trace of one retained request (tail-sampled: breaches
+        and the sliding slowest-p99). fmt=chrome returns the merged
+        chrome-trace document (one track per process, flow arrows)."""
+        raw = query.get("rid", [""])[0]
+        try:
+            rid = int(raw)
+        except (TypeError, ValueError):
+            return 400, {"ok": False,
+                         "reason": f"rid must be an integer, got {raw!r}"}
+        doc = None if self.trace is None else self.trace.get_trace(rid)
+        if doc is None:
+            return 404, {"ok": False, "rid": rid,
+                         "reason": ("tracing disabled (PADDLE_REQTRACE=0)"
+                                    if self.trace is None else
+                                    "no retained trace for this rid "
+                                    "(sampled out, evicted, or still "
+                                    "in flight)")}
+        if (query.get("fmt", [""])[0] or "").lower() == "chrome":
+            return 200, self.trace.chrome_trace(doc)
+        return 200, doc
+
+    def start_admin(self, port: int = 0, host: str = "127.0.0.1"):
+        """Opt-in admin endpoint for the ROUTER process — serves
+        ``GET /trace`` (plus the admin builtins) so operators read breach
+        postmortems over HTTP. Plain Routers embedded in a client process
+        never open a socket unless this is called. Idempotent; returns
+        the AdminServer (``.port`` carries the bound port)."""
+        if self._admin is None:
+            from ..observability.admin import AdminServer
+            self._admin = AdminServer(
+                port=port, host=host,
+                extra={"router": self.summary,
+                       **({"trace": self.trace.summary}
+                          if self.trace is not None else {})},
+                get_routes={"/trace": self._h_trace}).start()
+        return self._admin
+
     def replica_snapshots(self) -> dict:
         """{replica id: its admin /snapshot} over the current routing
         table — the PUBLIC read of per-replica telemetry (benches report
@@ -851,6 +927,9 @@ class Router:
         accumulate dead routers' gauges in every snapshot forever."""
         for c in self._fleet_counts:
             metrics.remove_gauge(f"serve.fleet.{c}.r_{self._rid_ns}")
+        if self._admin is not None:
+            self._admin.stop()
+            self._admin = None
 
 
 def _transient_send(e: Exception) -> bool:

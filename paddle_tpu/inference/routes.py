@@ -90,6 +90,11 @@ ROUTES = {
         "methods": ("POST",), "statuses": (200,),
         "doc": "begin the drain protocol (finish accepted, reject new, "
                "deregister, exit clean)"},
+    "/trace_pull": {
+        "methods": ("GET",), "statuses": (200, 400),
+        "doc": "?cursor=N cursor-addressed retired-request span batches — "
+               "the fallback ship when the /results piggy-back was lost "
+               "(400: non-integer cursor)"},
     "/warm_cache": {
         "methods": ("GET",), "statuses": (200, 400, 404),
         "doc": "?spec=<hash> jit executable-cache archive for warm start, "
@@ -100,6 +105,12 @@ ROUTES = {
         "doc": "?spec=<hash> packed model weights for warm start, raw "
                "octet-stream (400: spec param missing, 404: hash "
                "mismatch — fetcher falls back to seeded init)"},
+    # ---- router admin face (inference/router.py start_admin) ----
+    "/trace": {
+        "methods": ("GET",), "statuses": (200, 400, 404),
+        "doc": "?rid=N assembled end-to-end request trace, tail-sampled "
+               "(&fmt=chrome for the merged chrome-trace view; 400: bad "
+               "rid, 404: not retained / tracing off)"},
     # ---- autoscale controller face (inference/autoscale.py) ----
     "/autoscale": {
         "methods": ("GET",), "statuses": (200,),

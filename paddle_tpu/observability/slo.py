@@ -57,7 +57,8 @@ import time
 from . import metrics, recorder, spans
 
 __all__ = ["SloPolicy", "RequestTracker", "now", "bench_payload",
-           "HIST_TTFT", "HIST_TPOT", "HIST_QUEUE", "HIST_E2E", "STAGES"]
+           "HIST_TTFT", "HIST_TPOT", "HIST_QUEUE", "HIST_E2E", "STAGES",
+           "SPAN_TAXONOMY"]
 
 ENV_TTFT = "PADDLE_SLO_TTFT_S"
 ENV_TPOT = "PADDLE_SLO_TPOT_S"
@@ -71,12 +72,30 @@ HIST_E2E = "slo.e2e_s"
 
 COUNTER_BREACH = "slo.breach"
 
+# The per-request span taxonomy (ISSUE 17): THE single source of truth for
+# every ``req.*`` span name the fleet can emit. reqtrace (trace assembly),
+# the analyzer (O5 polices that no other module invents req.* spans; A3
+# sees these names through the retire-time emit below), and the README
+# "Distributed request tracing" section all consume this table, so a
+# renamed stage cannot silently desync the three.
+SPAN_TAXONOMY = {
+    "req": "whole request: enqueue -> retire (the e2e window)",
+    "req.queue": "pure queue wait: enqueue -> admission (per attempt)",
+    "req.prefill": "admission -> first token on the executing replica",
+    "req.decode": "first token -> last token on the executing replica",
+    "req.attempt": "a preempted attempt's admit -> preempt window",
+    "req.prefill_pool": "router: dispatch -> prefilled result (disagg)",
+    "req.transfer": "router: KV frame crossing the wire (disagg)",
+    "req.decode_pool": "router: decode dispatch -> terminal result (disagg)",
+}
+
 # disaggregated-serving stages (ISSUE 11): stage key -> (histogram, span
 # name). The DisaggRouter reports each lifecycle stage's duration through
 # RequestTracker.on_stage — durations fill the histogram immediately and
 # the span lands on the request's retire timeline next to req.queue /
 # req.prefill / req.decode, so a trace shows WHICH pool (or the wire) a
-# slow request spent its life in.
+# slow request spent its life in. Every span name here must exist in
+# SPAN_TAXONOMY above (pinned by tests/test_reqtrace.py).
 STAGES = {
     "prefill_pool": ("slo.prefill_pool_s", "req.prefill_pool"),
     "transfer": ("slo.transfer_s", "req.transfer"),
@@ -160,6 +179,43 @@ class _Rec:
         self.stages = []  # (span name, t0, t1) disagg lifecycle stages
 
 
+def _build_spans(rec: _Rec, rid: int, t_retire: float, n_tokens: int,
+                 reason: str, breaches: list) -> list[dict]:
+    """The request's retire-time span list as plain data
+    (``{name, t0, t1, args}``, SPAN_TAXONOMY names, perf-clock seconds):
+    one builder feeds BOTH the chrome span ring and the reqtrace sink so
+    the two views cannot drift apart."""
+    args = {"rid": rid, "trace": rec.trace_id, "tokens": n_tokens,
+            "preemptions": rec.preemptions, "reason": reason}
+    if breaches:
+        args["breach"] = "+".join(b["dim"] for b in breaches)
+    out = [{"name": "req", "t0": rec.t_enqueue, "t1": t_retire,
+            "args": args}]
+    admit = rec.t_admit if rec.t_admit is not None else t_retire
+    out.append({"name": "req.queue", "t0": rec.t_enqueue, "t1": admit,
+                "args": {"rid": rid, "trace": rec.trace_id}})
+    if rec.t_first is not None:
+        # prefill span only when the first token belongs to the CURRENT
+        # attempt (a preempted request's final admit can come after its
+        # first-attempt token — no backwards span)
+        if rec.t_admit is not None and rec.t_admit <= rec.t_first:
+            out.append({"name": "req.prefill", "t0": rec.t_admit,
+                        "t1": rec.t_first,
+                        "args": {"rid": rid, "trace": rec.trace_id}})
+        out.append({"name": "req.decode", "t0": rec.t_first,
+                    "t1": rec.t_last or t_retire,
+                    "args": {"rid": rid, "trace": rec.trace_id,
+                             "tokens": n_tokens}})
+    for name, t0, t1 in rec.spans:  # preempted attempts
+        out.append({"name": name, "t0": t0, "t1": t1,
+                    "args": {"rid": rid, "trace": rec.trace_id,
+                             "preempted": True}})
+    for name, t0, t1 in rec.stages:  # disagg lifecycle stages
+        out.append({"name": name, "t0": t0, "t1": t1,
+                    "args": {"rid": rid, "trace": rec.trace_id}})
+    return out
+
+
 class RequestTracker:
     """Per-engine lifecycle observer. Thread-safe (the admin endpoint may
     snapshot while the scheduler steps). Every hook is a few dict ops and
@@ -171,6 +227,12 @@ class RequestTracker:
         self._recs: dict[int, _Rec] = {}
         self._lk = threading.Lock()
         self.breached: int = 0
+        # reqtrace wiring (ISSUE 17): when set, every retire hands the
+        # request's full span payload to the sink (a ReplicaSpanBuffer on
+        # replicas, the RouterTraceAssembler on the router) — independent
+        # of whether chrome span tracing is on. Sink faults never reach
+        # the scheduler.
+        self.trace_sink = None
         # pre-register so scrapers/exporters see the latency series (and
         # the breach counter) before the first request ever lands
         for h in (HIST_TTFT, HIST_TPOT, HIST_QUEUE, HIST_E2E):
@@ -305,38 +367,34 @@ class RequestTracker:
                 tokens=n_tokens, reason=reason, breaches=breaches,
                 measured={k: round(v, 6) for k, v in measured.items()})
 
-        if spans.tracing_enabled():
+        built = None
+        if spans.tracing_enabled() or self.trace_sink is not None:
             try:
-                self._emit_spans(rec, rid, t, n_tokens, reason, breaches)
+                built = _build_spans(rec, rid, t, n_tokens, reason, breaches)
             except Exception:
-                pass  # tracing must never fail a retire
+                built = None  # tracing must never fail a retire
+        if built is not None and spans.tracing_enabled():
+            try:
+                self._emit_spans(built)
+            except Exception:
+                pass
+        if built is not None and self.trace_sink is not None:
+            try:
+                self.trace_sink({
+                    "rid": rid, "trace_id": rec.trace_id,
+                    "source": self.source, "reason": reason,
+                    "tokens": n_tokens, "preemptions": rec.preemptions,
+                    "t_enqueue": rec.t_enqueue, "t_retire": t,
+                    "measured": measured, "breaches": breaches,
+                    "spans": built})
+            except Exception:
+                pass
 
-    def _emit_spans(self, rec: _Rec, rid: int, t_retire: float,
-                    n_tokens: int, reason: str, breaches: list):
-        args = {"rid": rid, "trace": rec.trace_id, "tokens": n_tokens,
-                "preemptions": rec.preemptions, "reason": reason}
-        if breaches:
-            args["breach"] = "+".join(b["dim"] for b in breaches)
-        spans.add_span("req", "request", rec.t_enqueue, t_retire, **args)
-        admit = rec.t_admit if rec.t_admit is not None else t_retire
-        spans.add_span("req.queue", "request", rec.t_enqueue, admit,
-                       rid=rid, trace=rec.trace_id)
-        if rec.t_first is not None:
-            # prefill span only when the first token belongs to the
-            # CURRENT attempt (a preempted request's final admit can come
-            # after its first-attempt token — no backwards span)
-            if rec.t_admit is not None and rec.t_admit <= rec.t_first:
-                spans.add_span("req.prefill", "request", rec.t_admit,
-                               rec.t_first, rid=rid, trace=rec.trace_id)
-            spans.add_span("req.decode", "request", rec.t_first,
-                           rec.t_last or t_retire, rid=rid,
-                           trace=rec.trace_id, tokens=n_tokens)
-        for name, t0, t1 in rec.spans:  # preempted attempts
-            spans.add_span(name, "request", t0, t1, rid=rid,
-                           trace=rec.trace_id, preempted=True)
-        for name, t0, t1 in rec.stages:  # disagg lifecycle stages
-            spans.add_span(name, "request", t0, t1, rid=rid,
-                           trace=rec.trace_id)
+    @staticmethod
+    def _emit_spans(built: list):
+        for d in built:
+            spans.add_span(d["name"], "request", d["t0"], d["t1"],
+                           **d["args"])
 
     # ------------------------------------------------------------ summary
     def summary(self) -> dict:

@@ -239,6 +239,19 @@ declare("PADDLE_TRIGGER_MAX_CAPTURES", "3",
 declare("PADDLE_TRIGGER_XPLANE_STEPS", "4",
         "steps per trigger-armed XPlane window")
 
+# ----------------------------------------------------- distributed tracing
+
+declare("PADDLE_REQTRACE", "1",
+        "'0' disables fleet-wide per-request distributed tracing (span "
+        "batches, /results piggy-back, router trace assembly); tail "
+        "sampling bounds the always-on cost, tokens identical either way")
+declare("PADDLE_REQTRACE_KEEP", "256",
+        "bound on retained trace state per process: pending span batches "
+        "on a replica, assembled traces in the router's retained ring")
+declare("PADDLE_REQTRACE_WINDOW", "1024",
+        "sliding window of recent request e2e samples the tail sampler's "
+        "slowest-p99 threshold is computed over")
+
 # ------------------------------------------------------- quantized numerics
 
 declare("PADDLE_QUANT_ALLREDUCE", "0",
@@ -359,6 +372,12 @@ declare("PADDLE_AUTOSCALE_MIN", "1",
 declare("PADDLE_AUTOSCALE_MAX", "4",
         "per-pool ceiling: scale-out never spawns beyond this many "
         "replicas")
+declare("PADDLE_AUTOSCALE_SLO", "0",
+        "'1' adds the slo.* breach rate as a second scale-out trigger "
+        "beside queue pressure: a pool whose requests breach their SLO "
+        "targets inside a window counts a breach-window even when its "
+        "queue looks healthy; each ledger entry records which signal "
+        "fired ('pressure', 'slo', or 'pressure+slo')")
 declare("PADDLE_AUTOSCALE_DRAIN_TIMEOUT_S", "60",
         "deadline for a scale-in drain: past it the stall is flight-"
         "recorded and the drain retried — never force-killed (in-flight "

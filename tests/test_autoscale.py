@@ -222,6 +222,54 @@ class TestIndependentPools:
         assert c.decisions("scale_out") == []
 
 
+class TestSloBreachSignal:
+    """ISSUE 17 satellite: the slo.breach.* counter advance is a SECOND
+    scale-out trigger behind PADDLE_AUTOSCALE_SLO — a pool whose latency
+    is breaching scales even while queue pressure looks healthy, and the
+    ledger records WHICH signal fired."""
+
+    def test_breaches_scale_out_while_pressure_is_mid_band(self):
+        act = _StubActuator()
+        mid = _obs({"unified": [("r0", 2, 1, 3, True)]})  # pressure 0.67
+        c = _ctl(lambda: mid, act, breach_windows=2, slo_signal=True)
+        c.tick()
+        assert act.calls == []          # mid-band, no breach advance: calm
+        for _ in range(2):              # hysteresis applies to slo too
+            metrics.counter("slo.breach.ttft").inc()
+            c.tick()
+        assert len(act.of("scale_out")) == 1
+        d = c.decisions("scale_out")
+        assert d and d[-1]["signal"] == "slo"
+
+    def test_off_by_default_breaches_alone_never_scale(self):
+        act = _StubActuator()
+        mid = _obs({"unified": [("r0", 2, 1, 3, True)]})
+        c = _ctl(lambda: mid, act, breach_windows=1)
+        assert c.status()["slo_signal"] is False
+        for _ in range(3):
+            metrics.counter("slo.breach.e2e").inc()
+            c.tick()
+        assert act.calls == []
+
+    def test_pressure_plus_slo_records_both_signals(self):
+        act = _StubActuator()
+        hot = _obs({"unified": [("r0", 9, 3, 3, True)]})
+        c = _ctl(lambda: hot, act, breach_windows=2, slo_signal=True)
+        for _ in range(2):
+            metrics.counter("slo.breach.queue").inc()
+            c.tick()
+        d = c.decisions("scale_out")
+        assert d and d[-1]["signal"] == "pressure+slo"
+
+    def test_historical_breaches_before_construction_never_fire(self):
+        metrics.counter("slo.breach.tpot").inc()   # pre-existing counts
+        act = _StubActuator()
+        mid = _obs({"unified": [("r0", 2, 1, 3, True)]})
+        c = _ctl(lambda: mid, act, breach_windows=1, slo_signal=True)
+        c.tick()                        # baseline was taken at construction
+        assert act.calls == []
+
+
 class TestChaosNeverWedges:
     def test_decide_fault_is_a_recorded_noop_then_recovers(self):
         """chaos at autoscale.decide: no action, counters freeze, a

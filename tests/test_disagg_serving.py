@@ -926,6 +926,134 @@ class TestDisaggServingDrill:
             fleet.shutdown()
 
 
+# -------------------------------------------- distributed tracing drill
+
+class TestRequestTraceDrill:
+    """ISSUE 17 acceptance on the disagg fleet: one end-to-end trace per
+    request. A decode replica killed post-handoff forces a failover whose
+    ASSEMBLED trace shows both attempts (a second req.prefill_pool span)
+    under one trace id, spanning ≥3 processes, critical-path stages
+    summing to e2e within the measured clock tolerance, chrome export
+    with ≥3 tracks + flow arrows, served over real HTTP by GET /trace.
+    And the no-perturbation half: tracing on, tracing off
+    (PADDLE_REQTRACE=0), and chaos on trace.push all serve
+    token-identical output."""
+
+    def test_decode_kill_failover_assembles_one_trace(
+            self, small_model, tmp_path, monkeypatch):
+        import urllib.request
+        cfg, params = small_model
+        # a sub-ms e2e target every request breaches: the tail sampler
+        # must RETAIN the failover request's full trace
+        monkeypatch.setenv("PADDLE_SLO_E2E_S", "0.0001")
+        fleet = _DisaggReplicas(tmp_path, cfg, params,
+                                ["prefill", "decode", "decode"], ttl=1.0)
+        try:
+            router = DisaggRouter(fleet.registry)
+            assert router.trace is not None        # on by default
+            reqs = list(zip(_prompts(6, seed=17), (16, 20, 16, 18, 16, 20)))
+            rids = [router.submit(p, m) for p, m in reqs]
+            # tick until a request is DECODING, then kill THAT replica
+            deadline = time.time() + 60
+            victim = failover_rid = None
+            while time.time() < deadline:
+                router.tick()
+                stages = router.summary()["stages"]
+                decoding = [rid for rid, st in stages.items()
+                            if st == "decode"]
+                if decoding:
+                    failover_rid = decoding[0]
+                    victim = router._requests[failover_rid].replica
+                    break
+                time.sleep(0.01)
+            assert victim, "no request ever reached the decode pool"
+            next(r for r in fleet.reps if r.replica_id == victim).stop()
+            out = router.wait(rids, timeout=90)
+            for rid, (p, m) in zip(rids, reqs):
+                assert out[rid] == _reference(cfg, params, p, m)
+            assert router.summary()["failovers_decode"] >= 1
+
+            req = router._requests[failover_rid]
+            doc = router.trace.get_trace(failover_rid)
+            assert doc is not None, router.trace.summary()
+            # ONE trace id across every attempt and process
+            assert doc["trace_id"] == req.trace_id
+            assert doc["retained_for"] == "breach"
+            # ≥3 processes: router + prefill replica + surviving decode
+            assert len(doc["processes"]) >= 3, doc["processes"]
+            assert doc["processes"][0] == "router"
+            # BOTH attempts visible: the failover re-prefilled, so the
+            # router timeline carries a SECOND req.prefill_pool span
+            pool_spans = [s for s in doc["spans"]
+                          if s["name"] == "req.prefill_pool"]
+            assert len(pool_spans) >= 2, \
+                [s["name"] for s in doc["spans"]]
+            # critical path sums to e2e within the measured tolerance
+            assert set(doc["crit"]) == set(
+                ("router_queue", "prefill_queue", "prefill_compute",
+                 "transfer", "decode_queue", "decode", "spec_verify",
+                 "other"))
+            tol = doc["clock"]["tolerance_s"] + 1e-4   # + retained rounding
+            assert abs(sum(doc["crit"].values())
+                       - doc["measured"]["e2e"]) <= tol
+            # chrome export: one track per process, a flow chain across
+            ct = router.trace.chrome_trace(doc)
+            assert len({e["pid"] for e in ct["traceEvents"]}) >= 3
+            flow = [e for e in ct["traceEvents"]
+                    if e["ph"] in ("s", "t", "f")]
+            assert flow and flow[0]["ph"] == "s" and flow[-1]["ph"] == "f"
+
+            # the breach postmortem over REAL HTTP: GET /trace?rid=
+            admin = router.start_admin()
+            base = f"http://127.0.0.1:{admin.port}"
+            with urllib.request.urlopen(
+                    f"{base}/trace?rid={failover_rid}", timeout=10) as r:
+                wire = json.loads(r.read().decode())
+            assert wire["trace_id"] == doc["trace_id"]
+            assert wire["breaches"], wire
+            with urllib.request.urlopen(
+                    f"{base}/trace?rid={failover_rid}&fmt=chrome",
+                    timeout=10) as r:
+                wire_ct = json.loads(r.read().decode())
+            assert wire_ct["otherData"]["rid"] == failover_rid
+            router.close()
+        finally:
+            fleet.stop()
+
+    def _serve(self, tmp_path, cfg, params, sub, reqs, spec=None):
+        fleet = _DisaggReplicas(tmp_path / sub, cfg, params,
+                                ["prefill", "decode"])
+        try:
+            with chaos.inject(spec or ""):
+                router = DisaggRouter(fleet.registry)
+                rids = [router.submit(p, m) for p, m in reqs]
+                out = router.wait(rids, timeout=60)
+            trace_on = router.trace is not None
+            router.close()
+            return [out[r] for r in rids], trace_on
+        finally:
+            fleet.stop()
+
+    def test_tracing_on_off_and_chaos_token_identical(
+            self, small_model, tmp_path, monkeypatch):
+        cfg, params = small_model
+        reqs = list(zip(_prompts(3, seed=18), (6, 9, 5)))
+        ref = [_reference(cfg, params, p, m) for p, m in reqs]
+        # tracing ON (the default): token-identical
+        on, trace_on = self._serve(tmp_path, cfg, params, "on", reqs)
+        assert trace_on and on == ref
+        # chaos at trace.push on EVERY ship: batches drop, tokens don't
+        drops0 = metrics.counter("reqtrace.drops").value
+        ch, _ = self._serve(tmp_path, cfg, params, "ch", reqs,
+                            spec="trace.push:1+")
+        assert ch == ref
+        assert metrics.counter("reqtrace.drops").value > drops0
+        # tracing OFF: the layer vanishes, tokens identical
+        monkeypatch.setenv("PADDLE_REQTRACE", "0")
+        off, trace_off = self._serve(tmp_path, cfg, params, "off", reqs)
+        assert not trace_off and off == ref
+
+
 # ------------------------------------------------- bench disagg contract
 
 class TestDisaggBenchContract:
@@ -964,6 +1092,15 @@ class TestDisaggBenchContract:
             for stats in d["per_pool"][pool].values():
                 assert set(stats) == {"ttft_p50", "ttft_p95",
                                       "tpot_p50", "tpot_p95"}
+        # ISSUE 17: critical-path TTFT attribution rides the same line —
+        # per-stage p50/p95 SHARES of TTFT from the trace assembler
+        crit = d["crit"]
+        assert crit and crit["requests"] >= 1, crit
+        assert set(crit["stages"]) == {"router_queue", "prefill_queue",
+                                       "prefill_compute", "other"}
+        for stats in crit["stages"].values():
+            assert 0.0 <= stats["p50"] <= 1.0
+            assert 0.0 <= stats["p95"] <= 1.0
 
 
 # ------------------------------------------- sliced first hop (ISSUE 14)

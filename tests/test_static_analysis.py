@@ -5,7 +5,7 @@ The contracts under test:
     file shared by all rules, unified `# <layer>: ok (<why>)` markers
     (bare marker = finding M1), per-rule allowlists, SYNTAX findings,
     unknown-rule rejection.
-  * RULES — every rule (R1-R3, O1-O4, A1-A8, M1) has a triggering fixture
+  * RULES — every rule (R1-R3, O1-O5, A1-A8, M1) has a triggering fixture
     AND a near-miss that must stay clean. The ISSUE-15 passes: A6
     lock-order (cycle / self-reacquire vs consistent order), A7
     blocking-under-lock (sleep/urlopen/queue.get/one-hop socket send vs
@@ -237,6 +237,45 @@ class TestObservabilityRuleFixtures:
         findings = run(str(tmp_path), rule_ids=["O4"])
         assert [(f.path, f.rule) for f in findings] == \
             [("paddle_tpu/inference/bad.py", "O4")]
+
+    def test_o5_req_span_namespace_bad_and_near_misses(self, tmp_path):
+        """O5: a req.* add_span outside slo.py/reqtrace.py (literal OR
+        module-constant name) is a finding — the taxonomy is
+        single-sourced. Near misses stay clean: a non-req namespace, a
+        dynamic name the resolver can't prove, a marked line, and the
+        two sanctioned source files themselves."""
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/bad.py":
+                "from paddle_tpu.observability import spans\n"
+                "spans.add_span('req.sideband', 'request', 0.0, 1.0)\n",
+            "paddle_tpu/inference/bad_const.py":  # constant resolves too
+                "from paddle_tpu.observability import spans\n"
+                "NAME = 'req.detour'\n"
+                "spans.add_span(NAME, 'request', 0.0, 1.0)\n",
+            "paddle_tpu/inference/near_ns.py":  # not the req.* namespace
+                "from paddle_tpu.observability import spans\n"
+                "spans.add_span('request.foo', 'request', 0.0, 1.0)\n"
+                "spans.add_span('reqx', 'request', 0.0, 1.0)\n",
+            "paddle_tpu/inference/near_dyn.py":  # dynamic: unprovable
+                "from paddle_tpu.observability import spans\n"
+                "def f(name):\n"
+                "    spans.add_span(name, 'request', 0.0, 1.0)\n",
+            "paddle_tpu/inference/near_marked.py":
+                "from paddle_tpu.observability import spans\n"
+                "spans.add_span('req.audited', 'request', 0.0, 1.0)"
+                "  # observability: ok (audited one-off)\n",
+            "paddle_tpu/observability/slo.py":  # the sanctioned sources
+                "import spans\n"
+                "spans.add_span('req.queue', 'request', 0.0, 1.0)\n",
+            "paddle_tpu/observability/reqtrace.py":
+                "import spans\n"
+                "spans.add_span('req', 'request', 0.0, 1.0)\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["O5"])
+        assert sorted((f.path, f.rule) for f in findings) == \
+            [("paddle_tpu/inference/bad.py", "O5"),
+             ("paddle_tpu/inference/bad_const.py", "O5")]
+        assert all("single-sourced" in f.message for f in findings)
 
 
 # ---------------------------------------------------- fixtures: A1 spmd

@@ -223,6 +223,84 @@ class TestWarmStartWire:
             rep._admin.stop()
 
 
+class TestReqTraceWire:
+    """GET /trace_pull (replica face) and GET /trace (router admin face),
+    ISSUE 17 — the distributed-tracing wire contract over real HTTP."""
+
+    def test_trace_pull_route(self, tmp_path):
+        from paddle_tpu.inference.replica import ReplicaServer
+        rep = ReplicaServer(_StubBatcher(),
+                            FileRegistry(str(tmp_path), "wire"), "w2")
+        rep._admin.start()
+        try:
+            base = rep.endpoint
+            # seed one retired-request span batch through the sink surface
+            rep._tracebuf.publish({
+                "rid": 3, "trace_id": 99, "reason": "complete",
+                "tokens": 4, "preemptions": 0,
+                "measured": {"e2e": 0.01}, "breaches": [],
+                "spans": [{"name": "req", "t0": 0.0, "t1": 0.01,
+                           "args": {}}]})
+            st, body, _ = _req(base, "/trace_pull?cursor=0", token=False)
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["cursor"] == 1 and doc["base"] == 0
+            assert doc["batches"][0]["trace_id"] == 99
+            assert doc["source"] == rep.replica_id
+            # every response carries a fresh clock anchor (the router's
+            # NTP-style minimum filter feeds on these)
+            assert doc["trace_clock"]["anchor_wall"] > 0
+            assert "anchor_perf" in doc["trace_clock"]
+            st, body, _ = _req(base, "/trace_pull?cursor=1", token=False)
+            assert json.loads(body)["batches"] == []
+            # the declared 400: non-integer cursor
+            st, _, _ = _req(base, "/trace_pull?cursor=xyz", token=False)
+            assert st == 400
+        finally:
+            rep._admin.stop()
+
+
+class TestRouterTraceWire:
+    def test_trace_route_json_chrome_and_errors(self, tmp_path):
+        """GET /trace on the router's opt-in AdminServer: 200 JSON with
+        the crit decomposition, fmt=chrome loads as a chrome trace, and
+        the declared 400 (bad rid) / 404 (not retained) answers."""
+        from paddle_tpu.inference.router import Router
+        r = Router(FileRegistry(str(tmp_path), "wire"))
+        try:
+            assert r.trace is not None  # PADDLE_REQTRACE defaults on
+            admin = r.start_admin()
+            assert r.start_admin() is admin  # idempotent
+            base = f"http://127.0.0.1:{admin.port}"
+            r.trace.on_router_retire({
+                "rid": 7, "trace_id": 42, "source": "router",
+                "reason": "complete", "tokens": 4, "preemptions": 0,
+                "measured": {"e2e": 0.02, "ttft": 0.01, "queue": 0.004},
+                "breaches": [{"dim": "e2e", "value": 0.02,
+                              "target": 0.001}],
+                "spans": [{"name": "req", "t0": 0.0, "t1": 0.02,
+                           "args": {}}]})
+            st, body, _ = _req(base, "/trace?rid=7", token=False)
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["trace_id"] == 42
+            assert doc["retained_for"] == "breach"
+            assert abs(sum(doc["crit"].values())
+                       - doc["measured"]["e2e"]) < 1e-4
+            st, body, _ = _req(base, "/trace?rid=7&fmt=chrome",
+                               token=False)
+            assert st == 200
+            ch = json.loads(body)
+            assert any(e["ph"] == "M" for e in ch["traceEvents"])
+            assert ch["otherData"]["trace_id"] == 42
+            st, _, _ = _req(base, "/trace?rid=zzz", token=False)
+            assert st == 400
+            st, _, _ = _req(base, "/trace?rid=12345", token=False)
+            assert st == 404
+        finally:
+            r.close()
+
+
 class TestAutoscaleStatusWire:
     def test_autoscale_route_serves_status(self):
         """GET /autoscale on the controller's own AdminServer: the

@@ -208,6 +208,51 @@ class AdHocHttp(_ObservabilityRule):
                     "'# observability: ok (<why>)')")
 
 
+# the ONLY files that may emit req.* request spans: the per-process
+# retire emit (slo.py) and the fleet assembly layer (reqtrace.py). Every
+# other add_span in the req.* namespace would fork the per-request span
+# taxonomy (slo.SPAN_TAXONOMY) the router's trace assembler, rule A3's
+# collision checks, and the README section all consume.
+SPAN_SOURCES = ("paddle_tpu/observability/slo.py",
+                "paddle_tpu/observability/reqtrace.py")
+
+
+@register
+class RequestSpanNamespace(Rule):
+    id = "O5"
+    layer = LAYER
+    title = "request-span-namespace"
+    rationale = ("the req.* request-span namespace is single-sourced in "
+                 "slo.SPAN_TAXONOMY (emitted by slo.py, assembled by "
+                 "reqtrace.py) — a req.* add_span anywhere else desyncs "
+                 "the trace assembler and the taxonomy")
+
+    # deliberately NOT _ObservabilityRule: this rule polices
+    # observability/ itself (everything but the two sanctioned sources)
+    def scope(self, rel: str) -> bool:
+        return rel.startswith("paddle_tpu/") and rel not in SPAN_SOURCES
+
+    def check_file(self, ctx: FileCtx):
+        for node in ctx.nodes_of(ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name != "add_span" or not node.args:
+                continue
+            val = ctx.resolve_str_arg(node.args[0])
+            if val is None or not (val == "req" or val.startswith("req.")):
+                continue
+            if not ctx.marked(node.lineno, LAYER):
+                yield Finding(
+                    "O5", ctx.rel, node.lineno,
+                    f"req.* request span {val!r} emitted outside "
+                    "observability/slo.py + reqtrace.py: per-request "
+                    "spans are single-sourced there (slo.SPAN_TAXONOMY) "
+                    "so the fleet trace assembler sees every name — emit "
+                    "through RequestTracker, or mark "
+                    "'# observability: ok (<why>)'")
+
+
 @register
 class AdHocRequestTiming(_ObservabilityRule):
     id = "O4"
