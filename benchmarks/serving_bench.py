@@ -266,6 +266,111 @@ def _autoscale_drill() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _reliability_drill() -> dict:
+    """ISSUE 19: a 2-replica fleet with deadlines, cancels and hedged
+    re-dispatch in the request mix. Reports the reliability counters the
+    feature exists to bound: typed deadline shedding at the door,
+    exactly-once mid-flight cancels, and hedge volume under the global
+    retry budget. Every admitted request must account for exactly one
+    terminal reason — complete + cancelled sums to the admit count."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.inference.admission import AdmissionReject
+    from paddle_tpu.inference.router import ServingFleet
+
+    spec = {
+        "config": {"vocab_size": 256, "hidden_size": 64,
+                   "intermediate_size": 128, "num_hidden_layers": 2,
+                   "num_attention_heads": 4, "num_key_value_heads": 2,
+                   "max_position_embeddings": 128, "dtype": "float32"},
+        "seed": 3,
+        "batcher": {"max_batch": 3, "max_len": 96,
+                    "prompt_buckets": [8, 16, 32], "burst": 4,
+                    "page_size": 8},
+    }
+    n_req = int(os.environ.get("RELIABILITY_DRILL_REQUESTS", "10"))
+    rng = np.random.RandomState(19)
+    reqs = [(rng.randint(1, 256, int(n)).tolist(), int(m))
+            for n, m in zip(rng.randint(4, 16, n_req),
+                            rng.choice([4, 6, 10], n_req))]
+
+    root = tempfile.mkdtemp(prefix="reliability_bench_")
+    fleet = ServingFleet(
+        2, spec, root=root, ttl=1.2,
+        env={"JAX_PLATFORMS": "cpu", "PADDLE_CHAOS": "",
+             "PADDLE_SPEC_DECODE": "0"})
+    # hedging is ROUTER config (read at construction, in this process):
+    # a low floor makes ordinary CPU-fleet latency hedge-eligible, so the
+    # drill exercises the hedge path without needing a wedged replica —
+    # token parity makes the hedge invisible in the outputs either way
+    saved = {k: os.environ.get(k)
+             for k in ("PADDLE_HEDGE_DELAY_S", "PADDLE_RETRY_BUDGET_PCT")}
+    os.environ.setdefault("PADDLE_HEDGE_DELAY_S", "0.5")
+    os.environ.setdefault("PADDLE_RETRY_BUDGET_PCT", "50")
+    try:
+        fleet.start(timeout=180)
+        router = fleet.router()
+        shed = 0
+        try:
+            # an already-expired budget is shed typed AT THE DOOR —
+            # no replica ever sees it
+            router.submit(reqs[0][0], reqs[0][1], deadline_s=0.0)
+        except AdmissionReject as e:
+            if e.reason != "deadline_unmeetable":
+                raise RuntimeError(
+                    f"expected deadline_unmeetable, got {e.reason}")
+            shed += 1
+        rids = []
+        for p, m in reqs:
+            submit_deadline = _time.perf_counter() + 150.0
+            while True:
+                try:
+                    rids.append(router.submit(p, m, deadline_s=120.0))
+                    break
+                except AdmissionReject as e:
+                    if _time.perf_counter() > submit_deadline:
+                        raise TimeoutError(
+                            "reliability drill: submission still "
+                            f"rejected ({e.reason}) after 150s") from e
+                    _time.sleep(min(e.retry_after_s, 1.0))
+        # cooperative cancel on the freshest two — they may already have
+        # finished (cancel racing retire is a no-op by contract), so the
+        # terminal-reason tally below is what must balance, not these
+        cancel_states = [router.cancel(r) for r in rids[-2:]]
+        router.wait(rids, timeout=240)
+        s = router.summary()
+        reasons: dict = {}
+        for r in rids:
+            rec = router.result(r) or {}
+            k = rec.get("reason", "missing")
+            reasons[k] = reasons.get(k, 0) + 1
+        return {
+            "requests": n_req,
+            "shed": shed,
+            "completed": reasons.get("complete", 0),
+            "cancelled": s["cancelled"],
+            "deadline_exceeded": s["deadline_exceeded"],
+            "hedges": s["hedges"],
+            "hedge_wins": s["hedge_wins"],
+            "retry_budget_exhausted": s["retry_budget_exhausted"],
+            "dup_results": s["dup_results"],
+            "cancel_states": cancel_states,
+            "terminal_reasons": reasons,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        fleet.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _disagg_drill(n_prefill: int, n_decode: int) -> dict:
     """ISSUE 11: a MIXED fleet — prefill-pool + decode-pool subprocess
     replicas behind a DisaggRouter, quantized (int8) KV pages on the
@@ -732,6 +837,19 @@ def _main():
         except BaseException as e:
             autoscale_obj = {"error": f"{type(e).__name__}: {e}"}
 
+    # request-lifecycle reliability drill (ISSUE 19):
+    # PADDLE_SERVE_RELIABILITY=1 runs a deadline/cancel/hedge mix against
+    # a 2-replica fleet and the JSON line gains the `reliability`
+    # sub-object; the key is ABSENT (not null) when off. A drill failure
+    # lands as reliability.error — the JSON line survives.
+    reliability_obj = None
+    if (os.environ.get("PADDLE_SERVE_RELIABILITY", "")
+            or "0") not in ("", "0"):
+        try:
+            reliability_obj = _reliability_drill()
+        except BaseException as e:
+            reliability_obj = {"error": f"{type(e).__name__}: {e}"}
+
     payload = {
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": round(total_new / cont_s, 1),
@@ -760,6 +878,8 @@ def _main():
     }
     if autoscale_obj is not None:
         payload["autoscale"] = autoscale_obj
+    if reliability_obj is not None:
+        payload["reliability"] = reliability_obj
     print(json.dumps(payload))
 
     # hard parity gate AFTER the JSON line: the measured throughputs must

@@ -69,6 +69,13 @@ SITES: dict[str, str] = {
                        "wire (fault degrades that call to the full-precision "
                        "reducer — precision goes UP, numbers never wrong)",
     "rendezvous":      "before distributed rendezvous / parallel-env init",
+    "request.cancel":  "before a propagated cancel is applied to a live "
+                       "request (fault defers the cancel — the request "
+                       "runs on and retires normally; cancellation is "
+                       "best-effort, tokens never change)",
+    "router.hedge":    "before the router re-posts a stalled rid to its "
+                       "hedge candidate (fault skips the hedge this tick "
+                       "— the primary still completes, token-identical)",
     "rpc.rendezvous":  "one discovery poll of init_rpc's accumulating loop",
     "rpc.send":        "before any wire IO of an rpc call (retry-safe)",
     "serve.admit":     "before a serving request is admitted to a slot",

@@ -51,13 +51,15 @@ ENV_RETRY_AFTER = "PADDLE_ADMIT_RETRY_AFTER_S"
 
 _QUEUE_HIST = "slo.queue_wait_s"
 _E2E_HIST = "slo.e2e_s"
+_TTFT_HIST = "slo.ttft_s"
 
 
 class AdmissionReject(Exception):
     """Admission refused. ``retry_after_s`` is the computed backoff hint a
     well-behaved client honors before resubmitting; ``reason`` names the
     threshold that tripped (``queue_full`` / ``queue_p95`` / ``e2e_p95`` /
-    ``pool_pressure`` / ``draining`` / ``no_replicas``)."""
+    ``pool_pressure`` / ``deadline_unmeetable`` / ``draining`` /
+    ``no_replicas``)."""
 
     def __init__(self, retry_after_s: float, reason: str):
         self.retry_after_s = float(retry_after_s)
@@ -90,13 +92,14 @@ def slo_hists() -> dict:
     """The local process's SLO histogram stats, shaped for
     :meth:`AdmissionPolicy.decide` — {hist name: {p50, p95, count}}. The
     router builds the same shape from a replica's remote ``/snapshot``.
-    Reads ONLY the two consumed histograms — a full metrics.snapshot()
+    Reads ONLY the three consumed histograms — a full metrics.snapshot()
     would sort every registered histogram's reservoir under the registry
     locks each time. Enqueue boundaries pass the FUNCTION itself as
     ``hists=`` (decide/retry_after accept a callable and evaluate it at
     most once, only when actually consumed), so the common
     admit-with-default-policy path costs zero reservoir sorts."""
-    return {n: metrics.histogram(n).stats() for n in (_QUEUE_HIST, _E2E_HIST)}
+    return {n: metrics.histogram(n).stats()
+            for n in (_QUEUE_HIST, _E2E_HIST, _TTFT_HIST)}
 
 
 class AdmissionPolicy:
@@ -162,6 +165,36 @@ class AdmissionPolicy:
                 else retry_after_floor())
         return {"reason": "pool_pressure",
                 "retry_after_s": max(retry_after_floor(), hint)}
+
+    def decide_deadline(self, deadline_left_s: float | None,
+                        hists=None) -> dict | None:
+        """The THIRD admission dimension (ISSUE 19, request reliability):
+        a request whose remaining deadline budget is PROVABLY unmeetable —
+        below the pool's observed TTFT floor (the measured minimum of
+        ``slo.ttft_s``) — is shed at the door instead of burning prefill
+        FLOPs it can never turn into a timely first token. Conservative by
+        construction: only the floor rejects (never p50/p95, which an
+        unlucky window could inflate past an easily-meetable budget), and
+        an empty histogram (no floor observed yet) always admits.
+
+        None to admit, else ``{"reason": "deadline_unmeetable",
+        "retry_after_s"}``. The hint is the plain floor: retrying sooner
+        only helps if the client shows up with a fresher deadline, so
+        there is no capacity estimate to compute. An already-expired
+        budget (<= 0) rejects even without a measured floor."""
+        if deadline_left_s is None:
+            return None
+        left = float(deadline_left_s)
+        if left <= 0:
+            return {"reason": "deadline_unmeetable",
+                    "retry_after_s": retry_after_floor()}
+        if callable(hists):
+            hists = hists()
+        floor = ((hists or {}).get(_TTFT_HIST) or {}).get("min")
+        if floor and left < float(floor):
+            return {"reason": "deadline_unmeetable",
+                    "retry_after_s": retry_after_floor()}
+        return None
 
     def decide(self, queue_depth: int, max_batch: int,
                hists=None) -> dict | None:

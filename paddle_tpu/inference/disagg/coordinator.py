@@ -126,12 +126,32 @@ class DisaggRouter(Router):
         super()._mark_dead(h)
 
     # ------------------------------------------------------------ results
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
-        rid = super().submit(prompt_ids, max_new_tokens)
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               deadline_s: float | None = None) -> int:
+        rid = super().submit(prompt_ids, max_new_tokens, deadline_s)
         req = self._requests.get(rid)
         if req is not None and not req.t_stage:
             req.t_stage = _slo.now()   # the prefill_pool stage clock
         return rid
+
+    def _cancel_parked(self, req: RoutedRequest) -> bool:
+        """The transfer-parked lane is disagg-local custody: a cancelled
+        (or expired) request sitting between pools drops its held page
+        blob with it — the pages were freed on the prefill replica the
+        moment the blob was exported, so the drop IS the free."""
+        found = super()._cancel_parked(req)
+        if req.rid in self._xfer:
+            self._xfer.remove(req.rid)
+            found = True
+        return found
+
+    def _maybe_hedge(self) -> None:
+        # hedged re-dispatch is scoped to the single-stage fleet for now:
+        # a hedged prompt pass would strand its loser's exported page
+        # frame between pools, and the two-stage lifecycle already
+        # converges every stall/loss onto re-prefill + lease failover.
+        # Deadlines and cancellation DO cover every disagg hop.
+        return
 
     def _reprefill(self, req: RoutedRequest) -> None:
         """Send a request back to stage one: pages are reconstructible
@@ -275,6 +295,12 @@ class DisaggRouter(Router):
             if req is None or self._finished(rid) \
                     or req.stage != "transfer":
                 continue
+            if req.t_deadline is not None and now >= req.t_deadline:
+                # the budget ran out between pools: the blob drops with
+                # the typed retire — never ship pages a deadline-bound
+                # client can no longer use
+                self._retire_local(req, "deadline_exceeded")
+                continue
             if now < self._xfer_next_try and not req.last_faulted:
                 # declined last pass and no probe has refreshed the
                 # handles since: the answer cannot have changed — park
@@ -412,12 +438,16 @@ class DisaggRouter(Router):
             # binary hop (ISSUE 12): header JSON + raw payload in one
             # length-prefixed frame — the payload bytes ship verbatim
             # instead of paying the old base64-JSON 4/3× inflation
-            frame = pack_frame(
-                {"rid": req.rid, "prompt": req.prompt,
-                 "max_new_tokens": req.max_new_tokens,
-                 "trace_id": req.trace_id, "force": req.retried,
-                 "router": self._rid_ns, "kv": blob_meta(kv_send)},
-                bytes(kv_send["data"]))
+            header = {"rid": req.rid, "prompt": req.prompt,
+                      "max_new_tokens": req.max_new_tokens,
+                      "trace_id": req.trace_id, "force": req.retried,
+                      "router": self._rid_ns, "kv": blob_meta(kv_send)}
+            if req.t_deadline is not None:
+                # remaining budget re-derived at THIS hop's send time —
+                # the decode pool's admission and expiry see what is
+                # actually left, not what the client started with
+                header["deadline_left_s"] = req.t_deadline - _slo.now()
+            frame = pack_frame(header, bytes(kv_send["data"]))
             code, body = self._post_bytes(h.endpoint, "/kv_transfer",
                                           frame,
                                           timeout=self._xfer_timeout)

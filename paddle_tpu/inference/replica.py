@@ -7,11 +7,12 @@ A replica is three things bolted onto one batcher:
 
   * **an HTTP face** — the sanctioned AdminServer (lint O3) extended with
     POST ``/enqueue`` (body ``{rid, prompt, max_new_tokens, trace_id,
-    force}``; 200 admits, 429 carries the computed ``retry_after_s``),
-    GET ``/results?since=N`` (finished outputs after cursor N — the router
-    polls, nothing pushes), POST ``/drain``, and the readiness ``/health``
-    (ready / draining / queue depth / free pages — the one probe endpoint
-    a router or external LB needs);
+    force, deadline_left_s}``; 200 admits, 429 carries the computed
+    ``retry_after_s``), GET ``/results?since=N`` (finished outputs after
+    cursor N — the router polls, nothing pushes), POST ``/cancel``
+    (cooperative cancellation by rid, ISSUE 19), POST ``/drain``, and the
+    readiness ``/health`` (ready / draining / queue depth / free pages —
+    the one probe endpoint a router or external LB needs);
   * **a lease** — a heartbeat under ``serve.<id>`` into the SAME elastic
     registry (FileRegistry / KVServer) training uses for membership, TTL'd
     so a SIGKILL'd replica leaves the routing table within one TTL with no
@@ -45,6 +46,7 @@ import threading
 from collections import deque
 
 from ..distributed.fleet.elastic import FileRegistry
+from ..distributed.resilience import chaos
 from ..observability import metrics, recorder as _recorder, \
     reqtrace as _reqtrace, slo as _slo
 from ..observability.admin import AdminServer
@@ -120,8 +122,15 @@ class ReplicaServer:
         self._drain_grace = (drain_grace_s if drain_grace_s is not None
                              else env_flags.get_float(ENV_DRAIN_GRACE))
         self._lk = threading.Lock()
-        # (rid, prompt, mnt, trace_id, force, router-namespace)
+        # (rid, prompt, mnt, trace_id, force, router-namespace,
+        #  prefill_only, kv, deadline) — deadline is the ABSOLUTE local
+        # expiry on the slo.now() clock (None = none), fixed at the HTTP
+        # boundary so serve-loop lag never stretches the budget
         self._intake: deque = deque()
+        # cancels for rids already past intake ((router ns, rid)): the
+        # handler marks under _lk, the serve loop resolves the local rid
+        # and routes it through the batcher's lifecycle pass (ISSUE 19)
+        self._pending_cancels: list = []
         # finished results, cursor-addressed: the wire cursor for
         # _results[i] is _results_base + i. The prefix every poller has
         # had PADDLE_SERVE_RESULTS_KEEP results' worth of polls to collect
@@ -172,6 +181,7 @@ class ReplicaServer:
                         "/weights": self._h_weights},
             post_routes={"/enqueue": self._h_enqueue,
                          "/kv_transfer": self._h_kv_transfer,
+                         "/cancel": self._h_cancel,
                          "/drain": self._h_drain})
         self.port = self._admin.port
         self.endpoint = f"http://{host}:{self.port}"
@@ -251,6 +261,11 @@ class ReplicaServer:
         rtr = body.get("router")
         po = bool(body.get("prefill_only"))
         try:
+            dl = body.get("deadline_left_s")
+            dl = None if dl is None else float(dl)
+        except (TypeError, ValueError) as e:
+            return 400, {"ok": False, "reason": f"bad deadline: {e}"}
+        try:
             # never-admissible requests (over-budget, impossible page
             # demand) are refused HERE with a 400 — BEFORE any retryable
             # rejection (accepting one would turn the serve loop's
@@ -290,11 +305,18 @@ class ReplicaServer:
                 depth = len(self._intake) + self._b.health_summary()[
                     "queue_depth"]
                 d = pol.decide(depth, self._b.B, hists=hists)
+                if d is None:
+                    # deadline shedding (ISSUE 19): a remaining budget
+                    # provably unmeetable here — below this pool's
+                    # observed TTFT floor — is refused at the wire
+                    # instead of burning a prefill it can never deliver
+                    d = pol.decide_deadline(dl, hists=hists)
                 if d is not None:
                     return self._reject_429(d["reason"],
                                             d["retry_after_s"])
             self._intake.append((rid, prompt, mnt, tid, force, rtr, po,
-                                 None))
+                                 None,
+                                 None if dl is None else _slo.now() + dl))
             self._active.add((rtr, rid))
         return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
 
@@ -407,6 +429,11 @@ class ReplicaServer:
         tid = body.get("trace_id")
         force = bool(body.get("force"))
         rtr = body.get("router")
+        try:
+            dl = body.get("deadline_left_s")
+            dl = None if dl is None else float(dl)
+        except (TypeError, ValueError) as e:
+            return 400, {"ok": False, "reason": f"bad deadline: {e}"}
         if self.role == "prefill":
             # a misdirected transfer (stale role view, misconfigured
             # router) is refused AT the wire like every other
@@ -461,11 +488,14 @@ class ReplicaServer:
                             + health.get("evictable_pages", 0)
                             - health["queued_kv_pages"] - intake_kv)
                     d = pol.decide_pages(free, need, hists=hists)
+                if d is None:
+                    d = pol.decide_deadline(dl, hists=hists)
                 if d is not None:
                     return self._reject_429(d["reason"],
                                             d["retry_after_s"])
             self._intake.append((rid, prompt, mnt, tid, force, rtr, False,
-                                 kv))
+                                 kv,
+                                 None if dl is None else _slo.now() + dl))
             self._active.add((rtr, rid))
         return 200, {"ok": True, "rid": rid, "replica": self.replica_id}
 
@@ -529,6 +559,53 @@ class ReplicaServer:
         self.begin_drain()
         return 200, {"ok": True, "draining": True,
                      "pending": self._b.pending}
+
+    def _h_cancel(self, body: dict):
+        """POST /cancel — cooperative cancellation by rid (ISSUE 19).
+        Still in intake → dropped here (typed "cancelled" result, the
+        active-set entry released); already with the batcher → marked
+        for the serve loop, which resolves the local rid and routes it
+        through the engine's lifecycle pass (queued dropped, in-slot
+        retired with partial output and pages freed, parked pages
+        dropped). A rid this replica no longer holds is a NO-OP answer,
+        not an error: cancel racing retire loses cleanly, so fleet
+        accounting stays exactly-once."""
+        try:
+            rid = int(body["rid"])
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"ok": False, "reason": f"bad cancel: {e}"}
+        rtr = body.get("router")
+        dropped = None
+        with self._lk:
+            entry = next((e for e in self._intake
+                          if e[0] == rid and e[5] == rtr), None)
+            if entry is not None:
+                try:
+                    chaos.hit("request.cancel")
+                except chaos.ChaosError:
+                    # fault = this cancel is dropped; the request runs on
+                    # and retires normally (best-effort contract, same as
+                    # the engine-side gate — tokens never change)
+                    return 200, {"ok": True, "rid": rid,
+                                 "state": "deferred",
+                                 "replica": self.replica_id}
+                self._intake.remove(entry)
+                self._active.discard((rtr, rid))
+                dropped = entry
+                state = "intake"
+            elif (rtr, rid) in self._active:
+                self._pending_cancels.append((rtr, rid))
+                state = "marked"
+            else:
+                state = "unknown"
+        if dropped is not None:
+            # the typed result publishes OUTSIDE _lk (_push_result takes
+            # the lock itself); the request never reached the batcher, so
+            # this is its one retire record
+            metrics.counter("serve.cancelled").inc()
+            self._push_result(rid, dropped[3], rtr, [], "cancelled")
+        return 200, {"ok": True, "rid": rid, "state": state,
+                     "replica": self.replica_id}
 
     @property
     def drained(self) -> bool:
@@ -606,9 +683,11 @@ class ReplicaServer:
             with self._lk:
                 moved = list(self._intake)
                 self._intake.clear()
+                cancels = list(self._pending_cancels)
+                self._pending_cancels.clear()
                 draining = self._draining
                 drain_t0 = self._drain_t0
-            for rid, prompt, mnt, tid, force, rtr, po, kv in moved:
+            for rid, prompt, mnt, tid, force, rtr, po, kv, dl in moved:
                 try:
                     # admission already happened at the HTTP boundary —
                     # force=True here so the policy isn't double-applied.
@@ -618,12 +697,22 @@ class ReplicaServer:
                     local = self._b.add_request(
                         prompt, mnt, trace_id=tid, force=True,
                         prefill_only=po or self.role == "prefill",
-                        kv_import=kv)
+                        kv_import=kv,
+                        deadline_s=(None if dl is None
+                                    else dl - _slo.now()))
                 except Exception as e:
                     self._push_result(rid, tid, rtr, [],
                                       f"error: {type(e).__name__}: {e}")
                     continue
                 self._rid_map[local] = (rid, tid, rtr)
+            # cancels resolve AFTER the intake move: a rid marked while
+            # its tuple sat in `moved` has its local rid by now, so the
+            # mark lands in the engine's lifecycle pass this very step
+            for rtr_ns, rid in cancels:
+                local = next((l for l, v in self._rid_map.items()
+                              if v[0] == rid and v[2] == rtr_ns), None)
+                if local is not None:
+                    self._b.cancel(local)
             if draining and not deregistered:
                 # reject-new is already live (the handler checks); now
                 # leave the routing table so the router stops choosing us
@@ -688,7 +777,11 @@ class ReplicaServer:
             # re-routed back here must be accepted again, not deduped
             self._active.discard((rtr, rid))
             rec = {"rid": rid, "trace_id": tid, "router": rtr,
-                   "tokens": list(tokens), "reason": reason}
+                   "tokens": list(tokens), "reason": reason,
+                   # which replica produced it: a hedged pair's first
+                   # terminal result names the WINNER, so the router can
+                   # cancel the loser (ISSUE 19)
+                   "replica": self.replica_id}
             if batch is not None:
                 rec["spans"] = batch
             if kv is not None:

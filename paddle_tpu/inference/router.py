@@ -36,9 +36,38 @@ routes next tick), ``serve.replica_dead`` fails one failover re-enqueue
 rejection's computed retry-after to the floor (the rejection stands) —
 a chaos-on drill serves byte-identical tokens to a fault-free one.
 
+Request-lifecycle reliability (ISSUE 19) rides the same surface:
+
+  * **deadlines propagate as remaining budget** — ``submit(...,
+    deadline_s=)`` (default ``PADDLE_REQUEST_DEADLINE_S``; unset = no
+    deadline) stamps an absolute expiry on the router clock; every hop
+    re-derives ``deadline_left_s`` at send time so queueing anywhere
+    shrinks the budget. A provably-unmeetable budget (expired, or below
+    the observed TTFT floor) sheds typed ``deadline_unmeetable`` at
+    admission; an expired parked request retires typed
+    ``deadline_exceeded`` without ever (re)starting a prefill.
+  * **cancellation is cooperative and exactly-once** — ``cancel(rid)``
+    (router thread) or ``POST /cancel`` (admin thread: mark under a
+    dedicated lock, the next tick applies — decide-under-lock /
+    actuate-outside, the same split the autoscaler uses) drops parked
+    work locally and forwards in-flight work to the replica(s) holding
+    it; a cancel racing a retire is a no-op and the produced result
+    stands.
+  * **hedged re-dispatch is budgeted** — an in-flight request stalled
+    past the adaptive hedge delay (fleet e2e p95, floored at
+    ``PADDLE_HEDGE_DELAY_S``; 0 = off) is re-posted SAME rid to the next
+    candidate. The replica-side (router, rid) dedup and the first-result-
+    wins retire make the copy token-identical at temp=0; the loser is
+    cancelled on settle. The ``PADDLE_RETRY_BUDGET_PCT`` token bucket
+    (earn pct/100 per normal dispatch, spend 1 per hedge) caps total
+    hedge volume so a sick fleet degrades to shedding, never a retry
+    storm.
+
 Threading contract: the Router is SINGLE-THREADED by design — submit /
 tick / wait / drain are called from one client thread (the replicas are
-the concurrency). Metrics: ``serve.fleet.*`` counters/gauges; the
+the concurrency). The admin server's POST /cancel handler is the one
+cross-thread entry and touches ONLY the marks list under its own lock.
+Metrics: ``serve.fleet.*`` counters/gauges; the
 router's own RequestTracker (source="router") fills the slo.* histograms
 with FLEET-level queue/e2e measurements and keeps trace ids.
 """
@@ -48,6 +77,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -95,6 +125,15 @@ class RoutedRequest:
     # stage's start time for the per-stage slo histograms
     stage: str = "prefill"
     kv: dict | None = None
+    # request-lifecycle reliability (ISSUE 19): absolute deadline on the
+    # router clock (None = unbounded), the dispatch timestamp the hedge
+    # delay measures from, the replica running the hedge copy (None = not
+    # hedged), and a once-per-request latch so a blocked hedge counts
+    # retry_budget_exhausted once, not once per tick
+    t_deadline: float | None = None
+    t_dispatch: float = 0.0
+    hedge_replica: str | None = None
+    budget_blocked: bool = False
     # where the prefilled result physically came from (ISSUE 14
     # satellite): the /kv_blob fetch is DEFERRED until after the decode
     # pool's prefix probe, so the endpoint must outlive the handle (a
@@ -171,6 +210,24 @@ class Router:
         from ..utils import env_flags
         from .replica import ENV_RESULTS_KEEP  # ONE knob for both sides
         self._done_keep = int(env_flags.get_float(ENV_RESULTS_KEEP))
+        # hedged re-dispatch (ISSUE 19): floor/enable switch and the
+        # global retry budget as a token bucket — each NORMAL routed
+        # dispatch earns pct/100 tokens, each hedge spends one, so hedge
+        # volume is bounded at pct% of throughput no matter how sick the
+        # fleet looks. One token of initial credit lets the very first
+        # stall hedge before any history accrues; the cap bounds how big
+        # a burst an idle accumulation can fund.
+        self._hedge_floor = env_flags.get_float("PADDLE_HEDGE_DELAY_S")
+        pct = max(0.0, env_flags.get_float("PADDLE_RETRY_BUDGET_PCT"))
+        self._hedge_rate = pct / 100.0
+        self._retry_tokens = 1.0 if pct > 0 else 0.0  # pct=0: NO hedges
+        self._retry_tokens_cap = max(1.0, pct)
+        # cooperative cancellation (ISSUE 19): POST /cancel lands on the
+        # admin thread, which must never touch router state — it marks
+        # the rid HERE under a dedicated lock and the router thread's
+        # next tick applies it (decide-under-lock / actuate-outside)
+        self._cancel_lk = threading.Lock()
+        self._cancel_marks: list[int] = []
         self._requests: dict[int, RoutedRequest] = {}
         self._next_rid = 0
         # rid NAMESPACE: rids are router-local, but /results is one
@@ -205,7 +262,12 @@ class Router:
         # rides in the name (serve.fleet.<name>.r_<router_id>).
         self._fleet_counts = {c: 0 for c in (
             "routed", "rejected", "retried", "failovers", "route_faults",
-            "dup_results", "results_evicted")}
+            "dup_results", "results_evicted",
+            # lifecycle reliability (ISSUE 19) — "cancelled" and
+            # "deadline_exceeded" deliberately share their retire
+            # reason's spelling: _retire_local and _absorb count by it
+            "cancelled", "deadline_exceeded", "hedges", "hedge_wins",
+            "retry_budget_exhausted")}
         for c in self._fleet_counts:
             metrics.counter(f"serve.fleet.{c}")
 
@@ -219,7 +281,7 @@ class Router:
         """One fleet-counter event: instance tally (what summary()
         reports), process-global aggregate, and the router-id-labeled
         gauge export."""
-        self._fleet_counts[name] += 1
+        self._fleet_counts[name] += 1  # locks: ok (router thread only; _cancel_lk guards only _cancel_marks)
         metrics.counter(f"serve.fleet.{name}").inc()
         metrics.gauge(f"serve.fleet.{name}.r_{self._rid_ns}").set(
             self._fleet_counts[name])
@@ -396,8 +458,19 @@ class Router:
                 # would hold tick() in unthrottled /results polling for
                 # the whole saturation window
                 q.last_faulted = None
-        orphans = [rid for rid, q in self._inflight.items()
-                   if q.replica == h.id]
+        orphans = []
+        for rid, q in self._inflight.items():
+            if q.hedge_replica == h.id:
+                # the hedge copy died with the replica; the primary still
+                # runs — the pair just collapses back to one attempt
+                q.hedge_replica = None
+            if q.replica == h.id:
+                if q.hedge_replica is not None:
+                    # the PRIMARY died but its hedge survives: promote the
+                    # hedge instead of re-enqueueing a third attempt
+                    q.replica, q.hedge_replica = q.hedge_replica, None
+                else:
+                    orphans.append(rid)
         _recorder.record(
             "serve.replica_dead", echo=True,
             message=f"[serve] replica {h.id} lease expired and unreachable"
@@ -437,6 +510,185 @@ class Router:
         request — the DisaggRouter resets a decode-stage request to
         re-prefill here (its pages died with the replica's pool)."""
 
+    # -------------------------------------- request lifecycle (ISSUE 19)
+    def _retire_local(self, req: RoutedRequest, reason: str) -> None:
+        """Terminal local retire of a request not (or no longer) running
+        anywhere — typed result record, exactly-once SLO measure, fleet
+        counter (the counter name IS the retire reason: "cancelled" /
+        "deadline_exceeded"). Any held page blob drops with it."""
+        rid = req.rid
+        req.kv = None
+        self._inflight.pop(rid, None)
+        self._record_done(rid, {"rid": rid, "tokens": [], "reason": reason,
+                                "trace_id": req.trace_id,
+                                "router": self._rid_ns})
+        self.slo.on_retire(rid, n_tokens=0, reason=reason)
+        self._count(reason)
+
+    def _cancel_parked(self, req: RoutedRequest) -> bool:
+        """Remove ``req`` from the router's LOCAL custody (pending queue,
+        deferred-failover orphans). The DisaggRouter extends this to the
+        transfer-parked lane, dropping the held page blob. True when the
+        request was found somewhere local."""
+        found = False
+        try:
+            self._pending.remove(req)
+            found = True
+        except ValueError:
+            pass
+        try:
+            self._orphans.remove(req.rid)
+            found = True
+        except ValueError:
+            pass
+        return found
+
+    def cancel(self, rid: int) -> str:
+        """Cooperatively cancel one request NOW (router-thread entry —
+        the single-threaded twin of ``POST /cancel``). Returns the state
+        the rid was found in: "finished"/"unknown" are no-ops (a cancel
+        racing a retire LOSES — the tokens were produced and the result
+        stands), "deferred" means the request.cancel chaos site dropped
+        it (cancellation is best-effort by contract — the request runs on
+        and retires normally, token-identically), "cancelled" retired a
+        parked request locally, and "propagated" forwarded it to the
+        replica(s) holding it — their typed "cancelled" result retires it
+        exactly once through _absorb, pages freed on their side."""
+        if self._finished(rid):
+            return "finished"
+        req = self._requests.get(rid)
+        if req is None:
+            return "unknown"
+        try:
+            chaos.hit("request.cancel")
+        except chaos.ChaosError:
+            return "deferred"
+        if req.replica is None:
+            self._cancel_parked(req)
+            if req.last_faulted:
+                # the parked request's last send was AMBIGUOUS — it may be
+                # running over there. The local retire below wins the
+                # exactly-once race either way (a late result absorbs as a
+                # dup), but telling the replica stops the wasted decode.
+                lf = self._handles.get(req.last_faulted)
+                if lf is not None:
+                    self._post(lf.endpoint, "/cancel",
+                               {"rid": rid, "router": self._rid_ns})
+            self._retire_local(req, "cancelled")
+            return "cancelled"
+        for rep in {req.replica, req.hedge_replica} - {None}:
+            h = self._handles.get(rep)
+            if h is not None:
+                self._post(h.endpoint, "/cancel",
+                           {"rid": rid, "router": self._rid_ns})
+        return "propagated"
+
+    def _h_cancel(self, body: dict):
+        """POST /cancel — the admin-thread face of :meth:`cancel`. The
+        handler only MARKS the rid under the dedicated marks lock; the
+        router thread's next tick applies it (decide-under-lock /
+        actuate-outside: the admin thread must never walk router state or
+        block on replica HTTP while holding anything tick() needs)."""
+        try:
+            rid = int(body["rid"])
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"ok": False, "reason": f"bad cancel: {e}"}
+        with self._cancel_lk:
+            self._cancel_marks.append(rid)
+        return 200, {"ok": True, "rid": rid, "state": "marked",
+                     "router": self._rid_ns}
+
+    def _apply_cancels(self) -> None:
+        """Drain the admin-thread cancel marks and apply each on THIS
+        (the router) thread — the actuate half of the /cancel split."""
+        with self._cancel_lk:
+            if not self._cancel_marks:
+                return
+            marked, self._cancel_marks = self._cancel_marks, []
+        for rid in marked:
+            self.cancel(rid)
+
+    def _hedge_delay(self) -> float:
+        """The adaptive hedge trigger: p95 of the fleet-level e2e
+        histogram (the router's own tracker fills it), floored at
+        PADDLE_HEDGE_DELAY_S — an empty window hedges at the floor."""
+        st = metrics.histogram("slo.e2e_s").stats() or {}
+        return max(self._hedge_floor, float(st.get("p95") or 0.0))
+
+    def _maybe_hedge(self) -> None:
+        """Budgeted hedged re-dispatch: an in-flight request stalled past
+        :meth:`_hedge_delay` is re-posted — same rid, same namespace — to
+        the least-loaded OTHER candidate. The replica-side (router, rid)
+        dedup makes the copy idempotent per replica, the first terminal
+        result wins (_absorb's exactly-once retire), and the loser is
+        cancelled on settle — token-identical at temp=0 by the same
+        parity contract every failover rides. Gated three ways:
+        PADDLE_HEDGE_DELAY_S > 0 (off by default), the retry-budget
+        token bucket (exhausted → counted once per request, no hedge —
+        a sick fleet degrades to shedding, never a retry storm), and the
+        router.hedge chaos site (a fault skips this tick's hedge; the
+        primary still completes, token-identical). The hedge send is
+        NEVER forced: it is speculative work and takes admission's no
+        for an answer."""
+        if self._hedge_floor <= 0 or not self._inflight:
+            return
+        now = _slo.now()
+        delay = self._hedge_delay()
+        for rid, req in list(self._inflight.items()):
+            if req.hedge_replica is not None or req.last_faulted:
+                continue
+            if req.t_dispatch <= 0 or now - req.t_dispatch < delay:
+                continue
+            if req.t_deadline is not None and now >= req.t_deadline:
+                continue   # expired: the replica's own budget check
+                #            retires it typed — a hedge would be waste
+            if self._retry_tokens < 1.0:
+                if not req.budget_blocked:
+                    req.budget_blocked = True
+                    self._count("retry_budget_exhausted")
+                continue
+            cands = [h for h in
+                     self._candidates(role=self._route_role(req))
+                     if h.id != req.replica]
+            if not cands:
+                continue
+            try:
+                chaos.hit("router.hedge")
+            except chaos.ChaosError:
+                continue
+            h = cands[0]
+            code, body = self._post(h.endpoint, "/enqueue",
+                                    self._enqueue_body(req, False))
+            req.attempts += 1
+            if code == 200 and body.get("ok"):
+                self._retry_tokens -= 1.0  # locks: ok (router thread only; _cancel_lk guards only _cancel_marks)
+                req.hedge_replica = h.id
+                req.budget_blocked = False
+                h.queue_depth += 1   # optimistic; next probe corrects
+                self._count("hedges")
+                _recorder.record("serve.fleet.hedge", rid=rid,
+                                 primary=req.replica, hedge=h.id,
+                                 delay_s=round(delay, 4))
+            # any other answer (429, transport fault): a hedge is pure
+            # opportunism — no hedge this tick, the primary still owns
+            # the request and the budget was never spent
+
+    def _settle_hedge(self, req: RoutedRequest, res: dict) -> None:
+        """First terminal result of a hedged pair: count the winner,
+        cancel the loser. The loser's tokens are identical by the temp=0
+        parity contract — the cancel is pure waste reduction, and racing
+        its own retire is a no-op on the replica; its late duplicate
+        result absorbs as dup_results."""
+        winner = res.get("replica")
+        if winner == req.hedge_replica:
+            self._count("hedge_wins")
+        for loser in {req.replica, req.hedge_replica} - {None, winner}:
+            h = self._handles.get(loser)
+            if h is not None:
+                self._post(h.endpoint, "/cancel",
+                           {"rid": req.rid, "router": self._rid_ns})
+        req.hedge_replica = None
+
     # ------------------------------------------------------------- routing
     def _candidates(self, include_draining: bool = False,
                     role: str | None = None) -> list[_Handle]:
@@ -467,11 +719,17 @@ class Router:
 
     def _enqueue_body(self, req: RoutedRequest, force: bool) -> dict:
         """The /enqueue POST body — the DisaggRouter stamps prefill_only
-        on stage-1 sends."""
-        return {"rid": req.rid, "prompt": req.prompt,
+        on stage-1 sends. ``deadline_left_s`` is re-derived AT SEND TIME
+        (ISSUE 19): the budget a hop ships is what remains NOW, so time
+        parked in this router's queues shrinks it like time anywhere
+        else."""
+        body = {"rid": req.rid, "prompt": req.prompt,
                 "max_new_tokens": req.max_new_tokens,
                 "trace_id": req.trace_id, "force": force,
                 "router": self._rid_ns}
+        if req.t_deadline is not None:
+            body["deadline_left_s"] = req.t_deadline - _slo.now()
+        return body
 
     def _failover_site(self, req: RoutedRequest) -> str:
         """The chaos site guarding this request's failover re-enqueue —
@@ -521,6 +779,13 @@ class Router:
                 req.last_faulted = None
                 self._inflight[req.rid] = req
                 h.queue_depth += 1      # optimistic; next probe corrects
+                # the hedge clock starts at dispatch, and every NORMAL
+                # dispatch earns the retry budget its pct promises
+                req.t_dispatch = _slo.now()
+                req.hedge_replica = None
+                self._retry_tokens = min(self._retry_tokens_cap,
+                                         self._retry_tokens
+                                         + self._hedge_rate)
                 self.slo.on_admit(req.rid)
                 self._count("routed")
                 return "routed"
@@ -568,17 +833,39 @@ class Router:
                 f" — auth misconfig or handler bug, not capacity")
         return "fault" if faulted else "declined"
 
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               deadline_s: float | None = None) -> int:
         """Route one request or reject-with-retry-after. The ONLY entry
         that can refuse work: everything past here completes (failover,
         shed-retry and drain re-routing are internal, and a send
         interrupted by a fault stays pending — accepted work is never
-        converted into a rejection)."""
+        converted into a rejection).
+
+        ``deadline_s`` (ISSUE 19) is the request's total latency budget
+        in seconds (None falls back to ``PADDLE_REQUEST_DEADLINE_S``;
+        unset = no deadline). A budget provably unmeetable — already
+        expired, or below the fleet's observed TTFT floor — rejects
+        typed ``deadline_unmeetable`` here, before any replica burns
+        work on it; an admitted deadline then rides every hop as
+        remaining budget."""
         self.refresh()
         req = RoutedRequest(self._next_rid, [int(t) for t in prompt_ids],
                             int(max_new_tokens), trace_id=0)
-        self._next_rid += 1
+        self._next_rid += 1  # locks: ok (router thread only; _cancel_lk guards only _cancel_marks)
         req.trace_id = self.slo.on_enqueue(req.rid)
+        if deadline_s is None:
+            from ..utils import env_flags
+            dflt = env_flags.get("PADDLE_REQUEST_DEADLINE_S")
+            deadline_s = float(dflt) if dflt else None
+        if deadline_s is not None:
+            req.t_deadline = _slo.now() + float(deadline_s)
+            d = self._admission.decide_deadline(float(deadline_s),
+                                                hists=slo_hists)
+            if d is not None:
+                self.slo.on_reject(req.rid)
+                self._count("rejected")
+                self._retire_rid(req.rid, count=False)
+                _reject(d["reason"], d["retry_after_s"])
         cand = self._candidates(role=self._route_role(req))
         if not cand:
             self.slo.on_reject(req.rid)
@@ -658,10 +945,10 @@ class Router:
             return
         self._retired.add(rid)
         if count:
-            self._retired_count += 1
+            self._retired_count += 1  # locks: ok (router thread only; _cancel_lk guards only _cancel_marks)
         while self._retired_floor in self._retired:
             self._retired.discard(self._retired_floor)
-            self._retired_floor += 1
+            self._retired_floor += 1  # locks: ok (router thread only; _cancel_lk guards only _cancel_marks)
 
     def _record_done(self, rid: int, res: dict) -> None:
         """Publish a terminal result and enforce the retention bound:
@@ -708,6 +995,17 @@ class Router:
             # replica load-shed it: accepted work, so it re-routes under
             # the same trace id instead of surfacing a failure
             if self._inflight.pop(rid, None) is not None:
+                if req.hedge_replica is not None:
+                    # one copy of a hedged pair shed — the OTHER copy is
+                    # still running, so the pair collapses to it instead
+                    # of re-pending a third attempt
+                    survivor = (req.replica
+                                if res.get("replica") == req.hedge_replica
+                                else req.hedge_replica)
+                    req.replica = survivor
+                    req.hedge_replica = None
+                    self._inflight[rid] = req
+                    return
                 req.replica = None
                 req.retried = True
                 self.slo.on_preempt(rid)
@@ -715,12 +1013,20 @@ class Router:
                 self._count("retried")
             return
         self._inflight.pop(rid, None)
+        if req.hedge_replica is not None:
+            # first terminal result of a hedged pair wins; the loser is
+            # cancelled (its late duplicate absorbs as dup_results)
+            self._settle_hedge(req, res)
         self._record_done(rid, res)
         n = len(res.get("tokens") or [])
         if n:
             self.slo.on_first_token(rid)
             self.slo.on_tokens(rid, n)
         self.slo.on_retire(rid, n_tokens=n, reason=reason)
+        if reason in ("cancelled", "deadline_exceeded"):
+            # a replica-side cancel/expiry retires HERE exactly once —
+            # count it in the same fleet tally the local retires use
+            self._count(reason)
 
     # ---------------------------------------------------------------- tick
     def tick(self):
@@ -738,6 +1044,7 @@ class Router:
         hammer every replica with an HTTP poll per 4 ms pass."""
         self.refresh()
         self._failover()
+        self._apply_cancels()   # admin-thread /cancel marks, applied here
         now = _slo.now()
         if any(r.last_faulted for r in self._pending) \
                 or now - self._last_collect >= self._probe_s:
@@ -751,10 +1058,19 @@ class Router:
             self._last_collect = now
             for h in list(self._handles.values()):
                 self._collect_one(h)
+        self._maybe_hedge()   # after collection: a result that already
+        #                       arrived must not trigger a wasted hedge
         for _ in range(len(self._pending)):
             req = self._pending.popleft()
             if self._finished(req.rid):
                 continue  # fault-parked send actually landed; don't rerun
+            if req.t_deadline is not None \
+                    and _slo.now() >= req.t_deadline:
+                # the budget ran out while parked: retire typed, never
+                # dispatch — an expired request must not start (another)
+                # prefill past its expiry
+                self._retire_local(req, "deadline_exceeded")
+                continue
             try:
                 status = self._try_route(req, force=req.retried)
             except ValueError as e:
@@ -881,10 +1197,11 @@ class Router:
 
     def start_admin(self, port: int = 0, host: str = "127.0.0.1"):
         """Opt-in admin endpoint for the ROUTER process — serves
-        ``GET /trace`` (plus the admin builtins) so operators read breach
-        postmortems over HTTP. Plain Routers embedded in a client process
-        never open a socket unless this is called. Idempotent; returns
-        the AdminServer (``.port`` carries the bound port)."""
+        ``GET /trace`` and ``POST /cancel`` (plus the admin builtins) so
+        operators read breach postmortems and cancel runaway requests
+        over HTTP. Plain Routers embedded in a client process never open
+        a socket unless this is called. Idempotent; returns the
+        AdminServer (``.port`` carries the bound port)."""
         if self._admin is None:
             from ..observability.admin import AdminServer
             self._admin = AdminServer(
@@ -892,7 +1209,8 @@ class Router:
                 extra={"router": self.summary,
                        **({"trace": self.trace.summary}
                           if self.trace is not None else {})},
-                get_routes={"/trace": self._h_trace}).start()
+                get_routes={"/trace": self._h_trace},
+                post_routes={"/cancel": self._h_cancel}).start()
         return self._admin
 
     def replica_snapshots(self) -> dict:

@@ -72,8 +72,15 @@ ROUTES = {
     # ---- serving replica face (inference/replica.py) ----
     "/enqueue": {
         "methods": ("POST",), "statuses": (200, 400, 429),
-        "doc": "admission boundary (400: never-admissible, 429: "
-               "policy/draining rejection with retry_after_s)"},
+        "doc": "admission boundary; optional deadline_left_s field sheds "
+               "provably-unmeetable work (400: never-admissible, 429: "
+               "policy/draining/deadline rejection with retry_after_s)"},
+    "/cancel": {
+        "methods": ("POST",), "statuses": (200, 400),
+        "doc": "cooperative cancel by rid, served by router and replicas "
+               "(queued dropped, slots retired with pages freed, "
+               "transfers aborted; racing a retire is a no-op; 400: rid "
+               "missing)"},
     "/results": {
         "methods": ("GET",), "statuses": (200,),
         "doc": "?since=N cursor-addressed finished outputs; carries "

@@ -107,12 +107,18 @@ from .admission import AdmissionPolicy, reject as _admission_reject, \
     retry_after_floor, slo_hists
 from .paging import (PageAllocator, SCRATCH_PAGE, default_page_buckets,
                      pages_for)
+from ..utils import env_flags as _env_flags
 # import for its side effect: hands the HTTP wire-contract registry to
 # observability.admin, arming the admin.unregistered_route runtime mirror
 # in every process that serves (ISSUE 15, rule A8)
 from . import routes as _routes  # noqa: F401
 
 __all__ = ["ContinuousBatcher", "PredictorPool", "ServedRequest"]
+
+# the deadline gate used when no admission policy is installed — the
+# overload thresholds never fire through it (decide_deadline only reads
+# the TTFT histogram), so defaults are irrelevant beyond construction
+_DEADLINE_GATE = AdmissionPolicy()
 
 
 @dataclasses.dataclass
@@ -130,6 +136,10 @@ class ServedRequest:
     # its pages arrive as a transfer blob installed at admit time
     prefill_only: bool = False
     kv_import: dict | None = None
+    # request reliability (ISSUE 19): absolute expiry on the slo.now()
+    # clock (None = no deadline). Past it the request retires typed
+    # "deadline_exceeded" with whatever output it has, pages freed.
+    deadline: float | None = None
 
 
 class _PrefixGone(Exception):
@@ -374,6 +384,12 @@ class ContinuousBatcher:
         # it lock-free the way it reads queue length)
         self._parked: dict[int, dict] = {}
         self._queued_kv_pages = 0
+        # request reliability (ISSUE 19): rids with a cancel requested but
+        # not yet applied — cancel() marks (owner thread only, like every
+        # batcher entry point; the replica routes /cancel through its
+        # serve loop), the lifecycle pass at the top of step() applies
+        self._cancels: set[int] = set()
+        self._deadlines_seen = False   # any deadline'd request admitted?
         self._next_rid = 0
         self._admin = None  # live admin endpoint (start_admin)
         # SLO-aware admission (ISSUE 9): when a policy is installed,
@@ -409,7 +425,8 @@ class ContinuousBatcher:
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     trace_id: int | None = None, force: bool = False,
                     prefill_only: bool = False,
-                    kv_import: dict | None = None) -> int:
+                    kv_import: dict | None = None,
+                    deadline_s: float | None = None) -> int:
         """Enqueue one request. Budget violations are rejected HERE, at
         enqueue time — an over-budget request must never be admitted and
         then silently truncated (or, paged, wedge the queue forever waiting
@@ -418,6 +435,16 @@ class ContinuousBatcher:
         computed retry_after_s) unless ``force`` (router failover: already-
         accepted work must land somewhere). ``trace_id`` lets a router
         carry ONE trace id across replica retries.
+
+        Request reliability (ISSUE 19): ``deadline_s`` is the REMAINING
+        deadline budget in seconds at this hop (None falls back to
+        ``PADDLE_REQUEST_DEADLINE_S``; empty/unset = no deadline). A
+        budget provably unmeetable — already expired, or below the
+        pool's observed TTFT floor — rejects typed
+        ``deadline_unmeetable`` with retry-after; a ``force`` admit
+        (failover re-land) skips the gate like every other admission
+        dimension, and the lifecycle pass in :meth:`step` expires it
+        before any further work instead.
 
         Disaggregation (ISSUE 11): ``prefill_only`` runs the prompt pass
         and retires after the first token with the live pages parked for
@@ -445,6 +472,9 @@ class ContinuousBatcher:
             raise ValueError(
                 f"kv_import blob holds {kv_import.get('tlen')} prompt "
                 f"positions, request prompt has {len(prompt)}")
+        if deadline_s is None:
+            dflt = _env_flags.get("PADDLE_REQUEST_DEADLINE_S")
+            deadline_s = float(dflt) if dflt else None
         if self._draining and not force:
             # drain protocol: finish what was admitted, reject new admits
             _admission_reject("draining", retry_after_floor())
@@ -454,13 +484,25 @@ class ContinuousBatcher:
             # histogram reservoir sorts on this intake hot path
             self._admission.check(len(self._queue), self.B,
                                   hists=slo_hists)
+        if not force:
+            # deadline gate OUTSIDE the admission-policy guard: shedding
+            # a provably-unmeetable budget is a correctness rule, not
+            # load control — it holds even with no overload policy
+            d = (self._admission or _DEADLINE_GATE).decide_deadline(
+                deadline_s, hists=slo_hists)
+            if d is not None:
+                _admission_reject(d["reason"], d["retry_after_s"])
         rid = self._next_rid
         self._next_rid += 1
         req = ServedRequest(rid, prompt, max_new_tokens,
                             prefill_only=bool(prefill_only),
-                            kv_import=kv_import)
+                            kv_import=kv_import,
+                            deadline=(None if deadline_s is None
+                                      else _slo.now() + float(deadline_s)))
         self._queue.append(req)
         self._kv_acct(req, +1)
+        if req.deadline is not None:
+            self._deadlines_seen = True
         metrics.counter("serve.requests").inc()
         # trace id issued (or adopted from the router); queue-wait starts
         req.trace_id = self.slo.on_enqueue(rid, trace_id=trace_id)
@@ -1560,6 +1602,11 @@ class ContinuousBatcher:
         through draft-propose + one-launch verify instead of the scanned
         burst — same tokens, more of them per launch.
         """
+        if self._cancels or self._deadlines_seen:
+            # request reliability (ISSUE 19): apply cancels + expire
+            # deadlines before any scheduling — guarded so a fleet with
+            # neither feature in play pays two attribute reads
+            self._lifecycle_pass()
         if self._admission is not None:
             # graceful degradation under forced overload (router failover
             # can push past the cap): shed newest-queued first, never wedge
@@ -1650,6 +1697,90 @@ class ContinuousBatcher:
     @property
     def drained(self) -> bool:
         return self._draining and self.pending == 0
+
+    # ------------------------------- cancel + deadline expiry (ISSUE 19)
+    def cancel(self, rid: int) -> bool:
+        """Mark ``rid`` for cooperative cancellation; the lifecycle pass
+        at the top of the next :meth:`step` applies it (queued → dropped,
+        in-slot → retired with partial output and pages freed, parked →
+        pages dropped). Must run on the thread that owns the batcher —
+        the replica server routes /cancel through its serve loop. A rid
+        that already retired (or was never issued) is a NO-OP: cancel
+        racing retire loses cleanly, so accounting stays exactly-once.
+        Returns whether the rid was live (queued / in a slot / parked)."""
+        live = (rid in self._parked
+                or any(r.rid == rid for r in self._queue)
+                or any(r is not None and r.rid == rid
+                       for r in self._slot_req))
+        if live:
+            self._cancels.add(rid)
+        return live
+
+    def _expire(self, req: ServedRequest) -> None:
+        self.stats["deadline_exceeded"] = \
+            self.stats.get("deadline_exceeded", 0) + 1
+        metrics.counter("serve.deadline_exceeded").inc()
+        self._finish(req, reason="deadline_exceeded")
+
+    def _lifecycle_pass(self) -> None:
+        """Apply pending cancels and expire deadlines BEFORE this step's
+        scheduling: a cancelled/expired request must never start (or
+        continue) expensive work past the mark. Both exits retire through
+        :meth:`_finish` with a typed reason — measured exactly once by
+        the SLO tracker — and vacate through :meth:`_retire_slot`, the
+        one page-freeing path, so the pool gauge returns to baseline
+        within one step window."""
+        cancels, self._cancels = self._cancels, set()
+        for rid in sorted(cancels):
+            try:
+                chaos.hit("request.cancel")
+            except chaos.ChaosError:
+                # fault = this cancel is dropped: the request runs on and
+                # retires normally — cancellation is best-effort, tokens
+                # never change
+                continue
+            if rid in self._parked:
+                # parked pages belong to a request that already retired
+                # "prefilled" — free the pages, never re-measure it
+                self.drop_parked(rid)
+                self.stats["cancelled"] = self.stats.get("cancelled", 0) + 1
+                metrics.counter("serve.cancelled").inc()
+                continue
+            req = next((r for r in self._queue if r.rid == rid), None)
+            if req is not None:
+                self._queue.remove(req)
+                self._kv_acct(req, -1)
+            else:
+                slot = next((i for i, r in enumerate(self._slot_req)
+                             if r is not None and r.rid == rid), None)
+                if slot is None:
+                    continue          # retired already: cancel loses, no-op
+                req = self._slot_req[slot]
+                self._finish(req, reason="cancelled")
+                self._retire_slot(slot)
+                self.stats["cancelled"] = self.stats.get("cancelled", 0) + 1
+                metrics.counter("serve.cancelled").inc()
+                continue
+            self._finish(req, reason="cancelled")
+            self.stats["cancelled"] = self.stats.get("cancelled", 0) + 1
+            metrics.counter("serve.cancelled").inc()
+        # deadline expiry: queued first (an expired request must never
+        # start prefill past its expiry), then in-flight slots (retired
+        # with the partial output they have, pages freed)
+        now = None
+        for req in [r for r in self._queue if r.deadline is not None]:
+            now = _slo.now() if now is None else now
+            if req.deadline <= now:
+                self._queue.remove(req)
+                self._kv_acct(req, -1)
+                self._expire(req)
+        for slot, req in enumerate(self._slot_req):
+            if req is None or req.deadline is None:
+                continue
+            now = _slo.now() if now is None else now
+            if req.deadline <= now:
+                self._expire(req)
+                self._retire_slot(slot)
 
     def shed_newest(self, n: int = 1) -> list[ServedRequest]:
         """Load-shed up to `n` QUEUED requests, newest-queued first (the

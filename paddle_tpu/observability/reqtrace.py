@@ -379,6 +379,21 @@ class RouterTraceAssembler:
             if share is not None:
                 self._shares.append(share)
             thresh = _p99(self._e2e)
+        reason = payload.get("reason")
+        if reason in ("deadline_exceeded", "cancelled"):
+            # reliability retires (ISSUE 19) are force-retained: a
+            # deadline miss or cancel is exactly the trace an operator
+            # pulls to see WHERE the budget went (or where the cancel
+            # caught the request) — tail-sampling it out would hide every
+            # incident the feature exists to explain
+            doc = self._assemble(payload, batches, crit)
+            doc["retained_for"] = "reliability"
+            with self._lk:
+                self._retained[rid] = doc
+                while len(self._retained) > self._keep:
+                    self._retained.popitem(last=False)
+            metrics.counter(COUNTER_RETAINED).inc()
+            return
         if not payload.get("breaches") and e2e < thresh:
             metrics.counter(COUNTER_SAMPLED).inc()
             return

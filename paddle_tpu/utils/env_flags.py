@@ -398,6 +398,29 @@ declare("PADDLE_WARMSTART_TIMEOUT_S", "20",
         "HTTP timeout for one warm-start fetch (/warm_cache or /weights "
         "— archives ship megabytes where a health probe ships a doc)")
 
+# ------------------------------------------------------ request reliability
+
+declare("PADDLE_REQUEST_DEADLINE_S", "",
+        "default per-request deadline in seconds applied at submit when "
+        "the client supplies none (empty = no deadline); the remaining "
+        "budget rides every hop and an expired request retires typed "
+        "'deadline_exceeded' with its pages freed")
+declare("PADDLE_HEDGE_DELAY_S", "0",
+        "floor (and enable switch) for the router's hedged re-dispatch "
+        "delay in seconds: a dispatched stage stalled past "
+        "max(this, stage p95) is re-posted to the next candidate and the "
+        "loser cancelled on first completion (0 = hedging off)")
+declare("PADDLE_RETRY_BUDGET_PCT", "10",
+        "global hedge/retry budget as a percent of recent dispatches "
+        "(token bucket): each normal dispatch earns pct/100 tokens, each "
+        "hedge spends one — a sick fleet degrades to shedding, never a "
+        "retry storm")
+declare("PADDLE_SERVE_RELIABILITY", "0",
+        "serving_bench gate: 1 runs the request-lifecycle reliability "
+        "drill (deadline shed, mid-flight cancels, hedged re-dispatch "
+        "against a 2-replica fleet) and the JSON line gains the "
+        "'reliability' sub-object")
+
 # ------------------------------------------------------------------- misc
 
 declare("PADDLE_EXTENSION_DIR", "<tempdir>/paddle_tpu_extensions",

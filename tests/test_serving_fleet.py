@@ -1166,6 +1166,9 @@ class TestFleetBenchContract:
         # controller off — its presence half is pinned in
         # test_autoscale.py on its own bench run
         assert "autoscale" not in doc
+        # same contract for the reliability sub-object (ISSUE 19): its
+        # presence half is pinned in test_reliability.py
+        assert "reliability" not in doc
         # single-process absence (fleet_serve None) is asserted on the
         # already-paid-for bench run in test_ragged_attention.py
 

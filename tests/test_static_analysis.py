@@ -1155,6 +1155,113 @@ class TestAutoscaleSurfaceInScope:
         assert run(str(tmp_path), rule_ids=["A5", "A6", "A7"]) == []
 
 
+# --------------- fixtures: A5/A6/A7 on the request-lifecycle surface (19)
+
+class TestLifecycleSurfaceInScope:
+    """ISSUE 19: the cancel/hedge machinery makes the Router a
+    lock-using, HTTP-touching concurrent class — exactly the surface
+    A5/A6/A7 police. These fixtures plant each defect class at the
+    literal new code paths (hedge bookkeeping RMW, cancel-vs-retire
+    lock inversion, replica HTTP under the cancel-marks lock), plus
+    the shipped files staying clean and the new chaos sites being
+    registered AND test-named (rule A2)."""
+
+    def test_a5_unlocked_hedge_token_bookkeeping_trips(self, tmp_path):
+        # the one race budgeted hedging must not have: the token bucket
+        # read-modify-written outside the lock double-spends under a
+        # concurrent /cancel mark
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/router.py":
+                "import threading\n"
+                "class Router:\n"
+                "    def __init__(self):\n"
+                "        self._cancel_lk = threading.Lock()\n"
+                "        self._retry_tokens = 1.0\n"
+                "    def _maybe_hedge(self):\n"
+                "        with self._cancel_lk:\n"
+                "            pass\n"
+                "        self._retry_tokens -= 1.0\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A5"])
+        assert len(findings) == 1 and findings[0].line == 9
+        assert "read-modify-write" in findings[0].message
+
+    def test_a6_cancel_vs_retire_inversion_trips(self, tmp_path):
+        # router cancels INTO the replica while holding its cancel-marks
+        # lock; the replica's retire path locks itself then reads the
+        # router's marks — opposite orders across the two modules
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/router.py": """\
+                import threading
+                class Router:
+                    def __init__(self, rep):
+                        self._cancel_lk = threading.Lock()
+                        self._rep = rep
+                    def cancel(self, rid):
+                        with self._cancel_lk:
+                            self._rep.cancel_local(rid)
+                """,
+            "paddle_tpu/inference/replica.py": """\
+                import threading
+                class ReplicaServer:
+                    def __init__(self):
+                        self._lk = threading.Lock()
+                    def cancel_local(self, rid):
+                        with self._lk:
+                            pass
+                    def retire(self, router):
+                        with self._lk:
+                            with router._cancel_lk:
+                                pass
+                """,
+        })
+        findings = run(str(tmp_path), rule_ids=["A6"])
+        assert len(findings) == 1 and "cycle" in findings[0].message
+        assert "router.py:" in findings[0].message \
+            and "replica.py:" in findings[0].message
+
+    def test_a7_replica_http_under_cancel_lock_trips(self, tmp_path):
+        # the tempting bug the shipped _h_cancel/_apply_cancels split
+        # exists to prevent: POSTing /cancel to a replica while holding
+        # the marks lock — one blackholed replica wedges the admin
+        # thread AND every tick's drain
+        write_tree(tmp_path, {
+            "paddle_tpu/inference/router.py":
+                "import threading, urllib.request\n"
+                "class Router:\n"
+                "    def __init__(self):\n"
+                "        self._cancel_lk = threading.Lock()\n"
+                "    def _apply_cancels(self):\n"
+                "        with self._cancel_lk:\n"
+                "            urllib.request.urlopen('http://r0/cancel')\n",
+        })
+        findings = run(str(tmp_path), rule_ids=["A7"])
+        assert len(findings) == 1 and findings[0].line == 7
+        assert "urlopen" in findings[0].message
+
+    def test_shipped_lifecycle_surface_is_clean(self, tmp_path):
+        # the real modules, verbatim, under all three passes: the
+        # decide-under-lock (mark) / actuate-outside (apply on the
+        # router thread) split is load-bearing, not stylistic
+        for rel in ("paddle_tpu/inference/router.py",
+                    "paddle_tpu/inference/replica.py",
+                    "paddle_tpu/inference/serving.py"):
+            dst = tmp_path / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copyfile(os.path.join(REPO, rel), dst)
+        assert run(str(tmp_path), rule_ids=["A5", "A6", "A7"]) == []
+
+    def test_a2_new_sites_registered_and_test_named(self):
+        # request.cancel / router.hedge are registered with descriptions
+        # and named literally by tests (test_reliability.py drives both);
+        # an unregistered hit would be an A2 finding repo-wide
+        from paddle_tpu.distributed.resilience import chaos as _chaos
+        for site in ("request.cancel", "router.hedge"):
+            assert site in _chaos.SITES and _chaos.SITES[site]
+        src = open(os.path.join(HERE, "test_reliability.py")).read()
+        assert "request.cancel:1" in src and "router.hedge:1+" in src
+
+
 # --------------------------------------------- fixtures: A8 wire contract
 
 _ROUTES_REG = """\

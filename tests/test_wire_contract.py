@@ -223,6 +223,129 @@ class TestWarmStartWire:
             rep._admin.stop()
 
 
+class TestRequestLifecycleWire:
+    """POST /cancel (replica + router faces) and the /enqueue deadline
+    field, ISSUE 19 — the request-lifecycle wire contract over real
+    HTTP: every declared status (200/400/403 on /cancel, the 429
+    deadline_unmeetable shed and 400 malformed-deadline on /enqueue) is
+    driven, not just named."""
+
+    def _rep(self, tmp_path, batcher=None):
+        from paddle_tpu.inference.replica import ReplicaServer
+        b = batcher or _StubBatcher()
+        rep = ReplicaServer(b, FileRegistry(str(tmp_path), "wire"), "w2")
+        rep._admin.start()
+        return rep, b
+
+    def test_enqueue_deadline_shed_and_bad_deadline(self, tmp_path):
+        from paddle_tpu.inference.admission import AdmissionPolicy
+        b = _StubBatcher()
+        b.admission = AdmissionPolicy()
+        rep, _ = self._rep(tmp_path, b)
+        try:
+            base = rep.endpoint
+            tok = {"X-Paddle-Job-Token": _admin.job_token()}
+            # an expired remaining budget is shed AT THE WIRE: the
+            # declared 429 with the typed reason and a retry-after hint
+            st, body, _ = _req(
+                base, "/enqueue", "POST",
+                json.dumps({"rid": 1, "prompt": [1, 2],
+                            "max_new_tokens": 4,
+                            "deadline_left_s": -1.0}).encode(),
+                headers=tok)
+            assert st == 429
+            doc = json.loads(body)
+            assert doc["reason"] == "deadline_unmeetable"
+            assert doc["retry_after_s"] > 0
+            # a malformed deadline is the declared 400, not a crash
+            st, body, _ = _req(
+                base, "/enqueue", "POST",
+                json.dumps({"rid": 2, "prompt": [1, 2],
+                            "max_new_tokens": 4,
+                            "deadline_left_s": "soon"}).encode(),
+                headers=tok)
+            assert st == 400
+            assert "bad deadline" in json.loads(body)["reason"]
+            # a generous budget is admitted like any other request
+            st, body, _ = _req(
+                base, "/enqueue", "POST",
+                json.dumps({"rid": 3, "prompt": [1, 2],
+                            "max_new_tokens": 4,
+                            "deadline_left_s": 600.0}).encode(),
+                headers=tok)
+            assert st == 200 and json.loads(body)["ok"] is True
+        finally:
+            rep._admin.stop()
+
+    def test_replica_cancel_states_and_statuses(self, tmp_path):
+        rep, _ = self._rep(tmp_path)
+        try:
+            base = rep.endpoint
+            tok = {"X-Paddle-Job-Token": _admin.job_token()}
+            st, body, _ = _req(
+                base, "/enqueue", "POST",
+                json.dumps({"rid": 7, "prompt": [1, 2],
+                            "max_new_tokens": 4,
+                            "router": "nsA"}).encode(), headers=tok)
+            assert st == 200
+            # still in intake → dropped right here with a typed result
+            st, body, _ = _req(
+                base, "/cancel", "POST",
+                json.dumps({"rid": 7, "router": "nsA"}).encode(),
+                headers=tok)
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["ok"] is True and doc["state"] == "intake"
+            st, body, _ = _req(base, "/results?since=0", token=False)
+            recs = json.loads(body)["results"]
+            assert [r["reason"] for r in recs if r["rid"] == 7] \
+                == ["cancelled"]
+            # a rid this replica no longer holds: 200 no-op, NOT an error
+            # (cancel racing retire loses cleanly — exactly-once)
+            st, body, _ = _req(
+                base, "/cancel", "POST",
+                json.dumps({"rid": 7, "router": "nsA"}).encode(),
+                headers=tok)
+            assert st == 200 and json.loads(body)["state"] == "unknown"
+            # malformed rid → the declared 400
+            st, body, _ = _req(base, "/cancel", "POST",
+                               json.dumps({"rid": "x"}).encode(),
+                               headers=tok)
+            assert st == 400
+            assert "bad cancel" in json.loads(body)["reason"]
+            # mutating route: 403 without the job token
+            st, _, _ = _req(base, "/cancel", "POST", b'{"rid": 1}',
+                            token=False)
+            assert st == 403
+        finally:
+            rep._admin.stop()
+
+    def test_router_admin_cancel_marks_only(self, tmp_path):
+        """POST /cancel on the ROUTER admin face answers "marked" (the
+        admin thread never walks router state — the router thread's
+        next tick applies it) and 400 on a malformed rid."""
+        from paddle_tpu.inference.router import Router
+        router = Router(FileRegistry(str(tmp_path), "wire-rt", ttl=1.0))
+        admin = router.start_admin()
+        try:
+            base = f"http://127.0.0.1:{admin.port}"
+            tok = {"X-Paddle-Job-Token": _admin.job_token()}
+            st, body, _ = _req(base, "/cancel", "POST",
+                               json.dumps({"rid": 5}).encode(),
+                               headers=tok)
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["ok"] is True and doc["state"] == "marked"
+            assert doc["router"] == router.router_id
+            assert router._cancel_marks == [5]   # applied on next tick
+            st, _, _ = _req(base, "/cancel", "POST",
+                            json.dumps({"rid": None}).encode(),
+                            headers=tok)
+            assert st == 400
+        finally:
+            router.close()
+
+
 class TestReqTraceWire:
     """GET /trace_pull (replica face) and GET /trace (router admin face),
     ISSUE 17 — the distributed-tracing wire contract over real HTTP."""
